@@ -1,0 +1,329 @@
+"""The application-side context: alloc / free / put / get / copy.
+
+Analogue of libocm (/root/reference/src/lib.c + inc/oncillamem.h): the façade
+the app links against. ``ocm_init`` returns an :class:`Ocm`; handles are
+:class:`OcmAlloc`; ``ocm_copy`` composes the kind×kind matrix the reference
+implements as a 9-way switch (/root/reference/src/lib.c:502-665).
+
+Local arms (LOCAL_HOST, LOCAL_DEVICE) are served in-process from this host's
+arenas — the reference's single-node shortcut where ``alloc_find`` forces host
+memory when the cluster has one node (/root/reference/src/alloc.c:82-83).
+Remote arms require a control plane (a :class:`RemoteBackend`, wired in by
+:mod:`oncilla_tpu.runtime`); without one they raise ``OcmConnectError``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.errors import (
+    OcmConnectError,
+    OcmInvalidHandle,
+)
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.hbm import DeviceArena, from_bytes
+from oncilla_tpu.core.hostmem import HostArena
+from oncilla_tpu.core.kinds import Fabric, OcmKind
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
+
+
+class RemoteBackend(Protocol):
+    """What the runtime plugs in to serve remote arms. One-sided semantics:
+    after ``alloc`` returns, ``put``/``get`` involve no remote application
+    code (the reference's data plane bypasses the daemon per-transfer,
+    SURVEY.md §1 "two disjoint planes")."""
+
+    def alloc(self, nbytes: int, kind: OcmKind) -> OcmAlloc: ...
+    def free(self, handle: OcmAlloc) -> None: ...
+    def put(self, handle: OcmAlloc, data, offset: int) -> None: ...
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int): ...
+
+
+class Ocm:
+    """Per-process oncilla context (``ocm_init``/``ocm_tini`` pair,
+    /root/reference/src/lib.c:98,160)."""
+
+    def __init__(
+        self,
+        config: OcmConfig | None = None,
+        remote: RemoteBackend | None = None,
+        devices=None,
+    ):
+        self.config = config or OcmConfig()
+        self._remote = remote
+        self.host_arena = HostArena(
+            self.config.host_arena_bytes, self.config.alignment
+        )
+        if devices is None:
+            devices = jax.local_devices()[:1]
+        self.device_arenas = [
+            DeviceArena(self.config.device_arena_bytes, d, self.config.alignment)
+            for d in devices
+        ]
+        # Local alloc ids: odd counter so they never collide with the
+        # daemon's even pod-wide ids (rem_alloc_id analogue, mem.c:45).
+        self._next_id = itertools.count(1, 2)
+        self._allocs: dict[int, OcmAlloc] = {}  # the lib.c:84 allocs list
+        self._lock = threading.Lock()
+        self.tracer = GLOBAL_TRACER
+
+    # -- lifecycle -------------------------------------------------------
+
+    def tini(self) -> None:
+        """Free every live handle (``ocm_tini``; also covers the reference's
+        missing app-death reclamation, main.c:6-7)."""
+        with self._lock:
+            handles = list(self._allocs.values())
+        for h in handles:
+            try:
+                self.free(h)
+            except OcmInvalidHandle:
+                pass
+
+    # -- alloc / free ----------------------------------------------------
+
+    def _local_arena(self, kind: OcmKind, device_index: int):
+        if kind == OcmKind.LOCAL_HOST:
+            return self.host_arena
+        if not 0 <= device_index < len(self.device_arenas):
+            raise OcmInvalidHandle(
+                f"device_index {device_index} out of range "
+                f"(host has {len(self.device_arenas)} arena(s))"
+            )
+        return self.device_arenas[device_index]
+
+    def _remote_or_raise(self, kind) -> RemoteBackend:
+        if self._remote is None:
+            raise OcmConnectError(
+                f"kind {kind} needs a control plane; ocm_init was "
+                "called without one (single-node mode)"
+            )
+        return self._remote
+
+    def alloc(
+        self,
+        nbytes: int,
+        kind: OcmKind = OcmKind.LOCAL_HOST,
+        device_index: int = 0,
+    ) -> OcmAlloc:
+        """``ocm_alloc`` (/root/reference/src/lib.c:175)."""
+        with self.tracer.span("alloc"):
+            if kind in (OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE):
+                di = 0 if kind == OcmKind.LOCAL_HOST else device_index
+                ext = self._local_arena(kind, di).alloc(nbytes)
+                h = OcmAlloc(
+                    alloc_id=next(self._next_id),
+                    kind=kind,
+                    fabric=Fabric.LOCAL,
+                    nbytes=nbytes,
+                    rank=0,
+                    device_index=di,
+                    extent=ext,
+                    origin_rank=0,
+                )
+            else:
+                h = self._remote_or_raise(kind).alloc(nbytes, kind)
+            with self._lock:
+                self._allocs[h.alloc_id] = h
+            printd("alloc id=%d kind=%s nbytes=%d", h.alloc_id, kind, nbytes)
+            return h
+
+    def free(self, handle: OcmAlloc) -> None:
+        """``ocm_free`` (/root/reference/src/lib.c:347) — with the NULL-check
+        ordering bug (lib.c:357-359) not replicated."""
+        if handle is None:
+            raise OcmInvalidHandle("free(None)")
+        with self._lock:
+            if handle.freed or handle.alloc_id not in self._allocs:
+                raise OcmInvalidHandle(f"double free of alloc {handle.alloc_id}")
+            del self._allocs[handle.alloc_id]
+        if handle.kind == OcmKind.LOCAL_HOST:
+            self.host_arena.free(handle.extent)
+        elif handle.kind == OcmKind.LOCAL_DEVICE:
+            self.device_arenas[handle.device_index].free(handle.extent)
+        else:
+            self._remote_or_raise(handle.kind).free(handle)
+        handle.freed = True
+
+    # -- one-sided ops ---------------------------------------------------
+
+    def _check_live(self, handle: OcmAlloc) -> None:
+        if handle.freed:
+            raise OcmInvalidHandle(f"use of freed alloc {handle.alloc_id}")
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        """One-sided write (``ocm_copy_onesided`` op_flag=1,
+        /root/reference/src/lib.c:670)."""
+        self._check_live(handle)
+        raw_n = _nbytes_of(data)
+        with self.tracer.span("put", nbytes=raw_n):
+            if handle.kind == OcmKind.LOCAL_HOST:
+                self.host_arena.write(handle.extent, _to_numpy(data), offset)
+            elif handle.kind == OcmKind.LOCAL_DEVICE:
+                self.device_arenas[handle.device_index].write(
+                    handle.extent, data, offset
+                )
+            else:
+                self._remote_or_raise(handle.kind).put(handle, data, offset)
+
+    def get(self, handle: OcmAlloc, nbytes: int | None = None, offset: int = 0):
+        """One-sided read (``ocm_copy_onesided`` op_flag=0). Returns uint8
+        bytes: numpy for host arms, jax.Array for device arms."""
+        self._check_live(handle)
+        if nbytes is None:
+            nbytes = handle.nbytes - offset
+        with self.tracer.span("get", nbytes=nbytes):
+            if handle.kind == OcmKind.LOCAL_HOST:
+                return self.host_arena.read(handle.extent, nbytes, offset)
+            if handle.kind == OcmKind.LOCAL_DEVICE:
+                return self.device_arenas[handle.device_index].read(
+                    handle.extent, nbytes, offset
+                )
+            return self._remote_or_raise(handle.kind).get(handle, nbytes, offset)
+
+    def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0):
+        """Typed one-sided read."""
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        raw = self.get(handle, nbytes, offset)
+        if isinstance(raw, np.ndarray):
+            return raw.view(dtype).reshape(shape)
+        return from_bytes(raw, shape, dtype)
+
+    def localbuf(self, handle: OcmAlloc):
+        """``ocm_localbuf`` (/root/reference/src/lib.c:425): the app-side
+        window. Zero-copy numpy view for LOCAL_HOST; materialized jax.Array
+        for LOCAL_DEVICE; None for remote kinds (whose local staging is the
+        caller's own array)."""
+        self._check_live(handle)
+        if handle.kind == OcmKind.LOCAL_HOST:
+            return self.host_arena.view(handle.extent)
+        if handle.kind == OcmKind.LOCAL_DEVICE:
+            return self.device_arenas[handle.device_index].read(
+                handle.extent, handle.nbytes
+            )
+        return None
+
+    # -- two-sided copy matrix ------------------------------------------
+
+    def copy(
+        self,
+        dst: OcmAlloc,
+        src: OcmAlloc,
+        nbytes: int | None = None,
+        dst_offset: int = 0,
+        src_offset: int = 0,
+    ) -> None:
+        """``ocm_copy`` (/root/reference/src/lib.c:502-665): the kind×kind
+        matrix. The reference dispatches 9 cases by hand; here every pair
+        composes get→put, with same-arena fast paths."""
+        self._check_live(dst)
+        self._check_live(src)
+        if nbytes is None:
+            nbytes = min(src.nbytes - src_offset, dst.nbytes - dst_offset)
+        with self.tracer.span("copy", nbytes=nbytes):
+            if (
+                src.kind == OcmKind.LOCAL_DEVICE
+                and dst.kind == OcmKind.LOCAL_DEVICE
+                and src.device_index == dst.device_index
+            ):
+                # Fused on-chip move: one jitted slice+update, no host hop.
+                self.device_arenas[src.device_index].move(
+                    src.extent, dst.extent, nbytes, src_offset, dst_offset
+                )
+                return
+            data = self.get(src, nbytes, src_offset)
+            self.put(dst, data, dst_offset)
+
+    # -- introspection (oncillamem.h parity) ----------------------------
+
+    @staticmethod
+    def is_remote(handle: OcmAlloc) -> bool:
+        """``ocm_is_remote`` — correct version of lib.c:461 (see SURVEY.md
+        known-bugs list)."""
+        return handle.is_remote
+
+    @staticmethod
+    def alloc_kind(handle: OcmAlloc) -> OcmKind:
+        return handle.kind
+
+    @staticmethod
+    def remote_sz(handle: OcmAlloc) -> int:
+        return handle.remote_sz
+
+
+def _to_numpy(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        return data
+    return np.asarray(data)
+
+
+def _nbytes_of(data) -> int:
+    if isinstance(data, np.ndarray):
+        return data.nbytes
+    a = jnp.asarray(data)
+    return a.size * a.dtype.itemsize
+
+
+# ---------------------------------------------------------------------------
+# Module-level functional API, name-for-name with inc/oncillamem.h:69-89.
+# ---------------------------------------------------------------------------
+
+def ocm_init(
+    config: OcmConfig | None = None,
+    remote: RemoteBackend | None = None,
+    devices=None,
+) -> Ocm:
+    return Ocm(config=config, remote=remote, devices=devices)
+
+
+def ocm_tini(ctx: Ocm) -> None:
+    ctx.tini()
+
+
+def ocm_alloc(ctx: Ocm, nbytes: int, kind: OcmKind = OcmKind.LOCAL_HOST, **kw):
+    return ctx.alloc(nbytes, kind, **kw)
+
+
+def ocm_free(ctx: Ocm, handle: OcmAlloc) -> None:
+    ctx.free(handle)
+
+
+def ocm_localbuf(ctx: Ocm, handle: OcmAlloc):
+    return ctx.localbuf(handle)
+
+
+def ocm_is_remote(handle: OcmAlloc) -> bool:
+    return handle.is_remote
+
+
+def ocm_alloc_kind(handle: OcmAlloc) -> OcmKind:
+    return handle.kind
+
+
+def ocm_remote_sz(handle: OcmAlloc) -> int:
+    return handle.remote_sz
+
+
+def ocm_copy(ctx: Ocm, dst: OcmAlloc, src: OcmAlloc, **kw) -> None:
+    ctx.copy(dst, src, **kw)
+
+
+def ocm_copy_onesided(
+    ctx: Ocm, handle: OcmAlloc, local, op: str, offset: int = 0
+):
+    """``ocm_copy_onesided`` (/root/reference/src/lib.c:670): op is "write"
+    (push ``local`` into the allocation) or "read" (return bytes)."""
+    if op == "write":
+        ctx.put(handle, local, offset)
+        return None
+    if op == "read":
+        n = _nbytes_of(local) if local is not None else None
+        return ctx.get(handle, n, offset)
+    raise ValueError(f"op must be 'read' or 'write', got {op!r}")

@@ -1,0 +1,168 @@
+"""Device-HBM arena: a single pre-allocated ``jax.Array`` per chip.
+
+This is the TPU analogue of NIC memory registration: the reference pins one
+buffer per allocation with ``ibv_reg_mr`` (/root/reference/src/rdma_server.c:
+109-118) or ``rma2_register`` (/root/reference/src/extoll_server.c:83) so a
+peer can address it by (va, rkey) / (node, vpid, NLA). Here each chip owns one
+flat uint8 arena array; an allocation is an (offset, nbytes) extent inside it,
+addressable pod-wide as (rank, device, offset, nbytes).
+
+JAX is functional, so "one-sided write into the arena" is a jitted
+``dynamic_update_slice`` with the arena buffer **donated** — XLA reuses the
+same HBM pages, making the update in-place at the hardware level with no
+reallocation. Offsets are traced scalars, so one compiled executable serves
+every offset for a given transfer size.
+
+Concurrency: the buffer rebind after a donated update is a read-modify-write
+of ``self._buf``; a per-arena mutex serializes it (the reference's unlocked
+shared allocation lists are a documented bug — "TODO Lock this list",
+/root/reference/src/rdma.c:147-149 — not replicated here).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
+from oncilla_tpu.core.errors import OcmError
+
+# dynamic_slice offsets are traced scalars; int32 covers arenas < 2 GiB.
+# Larger arenas need int64 indices, which JAX only keeps with x64 enabled.
+_INT32_MAX = 2**31 - 1
+
+
+@partial(jax.jit, donate_argnums=0)
+def _arena_put(buf: jax.Array, data: jax.Array, offset) -> jax.Array:
+    """In-place (donated) byte write at a dynamic offset."""
+    return jax.lax.dynamic_update_slice(buf, data, (offset,))
+
+
+@partial(jax.jit, static_argnums=2)
+def _arena_get(buf: jax.Array, offset, nbytes: int) -> jax.Array:
+    return jax.lax.dynamic_slice(buf, (offset,), (nbytes,))
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=3)
+def _arena_move(buf: jax.Array, src_off, dst_off, nbytes: int) -> jax.Array:
+    chunk = jax.lax.dynamic_slice(buf, (src_off,), (nbytes,))
+    return jax.lax.dynamic_update_slice(buf, chunk, (dst_off,))
+
+
+def to_bytes(x) -> jax.Array:
+    """Flatten any array to a uint8 byte vector (device-side bitcast)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.uint8:
+        return x.reshape(-1)
+    return jax.lax.bitcast_convert_type(x.reshape(-1), jnp.uint8).reshape(-1)
+
+
+def from_bytes(raw: jax.Array, shape, dtype) -> jax.Array:
+    """Reinterpret a uint8 byte vector as (shape, dtype)."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.uint8:
+        return raw.reshape(shape)
+    n = int(np.prod(shape)) if shape else 1
+    grouped = raw.reshape(n, dtype.itemsize)
+    return jax.lax.bitcast_convert_type(grouped, dtype).reshape(shape)
+
+
+class DeviceArena:
+    """An HBM arena on one chip.
+
+    The arena holds the *current* buffer array and rebinds it after each
+    donated update; callers never hold the raw buffer, only extents.
+    """
+
+    def __init__(self, capacity: int, device=None, alignment: int = 512):
+        self.allocator = ArenaAllocator(capacity, alignment)
+        self.device = device if device is not None else jax.devices()[0]
+        if capacity > _INT32_MAX:
+            if not jax.config.jax_enable_x64:
+                raise OcmError(
+                    f"device arena of {capacity} B needs 64-bit offsets; "
+                    "set JAX_ENABLE_X64=1 (or use arenas < 2 GiB)"
+                )
+            self._idx_dtype = jnp.int64
+        else:
+            self._idx_dtype = jnp.int32
+        self._mu = threading.Lock()
+        self._buf = jax.device_put(
+            jnp.zeros(capacity, dtype=jnp.uint8), self.device
+        )
+
+    def _idx(self, off: int):
+        return jnp.asarray(off, dtype=self._idx_dtype)
+
+    @property
+    def capacity(self) -> int:
+        return self.allocator.capacity
+
+    def alloc(self, nbytes: int) -> Extent:
+        return self.allocator.alloc(nbytes)
+
+    def free(self, extent: Extent) -> None:
+        self.allocator.free(extent)
+
+    def write(self, extent: Extent, data, offset: int = 0) -> None:
+        """One-sided put of raw bytes (or any array, bitcast to bytes)."""
+        raw = to_bytes(jax.device_put(jnp.asarray(data), self.device))
+        check_bounds(extent, offset, int(raw.size))
+        with self._mu:
+            self._buf = _arena_put(self._buf, raw, self._idx(extent.offset + offset))
+
+    def read(self, extent: Extent, nbytes: int, offset: int = 0) -> jax.Array:
+        """One-sided get; returns a fresh uint8 jax.Array of ``nbytes``."""
+        check_bounds(extent, offset, nbytes)
+        with self._mu:
+            buf = self._buf
+        return _arena_get(buf, self._idx(extent.offset + offset), nbytes)
+
+    def read_as(self, extent: Extent, shape, dtype, offset: int = 0) -> jax.Array:
+        nbytes = int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+        return from_bytes(self.read(extent, nbytes, offset), shape, dtype)
+
+    def move(
+        self, src: Extent, dst: Extent, nbytes: int, src_offset: int = 0,
+        dst_offset: int = 0,
+    ) -> None:
+        """Fused on-chip extent-to-extent copy (no host hop)."""
+        check_bounds(src, src_offset, nbytes)
+        check_bounds(dst, dst_offset, nbytes)
+        with self._mu:
+            self._buf = _arena_move(
+                self._buf,
+                self._idx(src.offset + src_offset),
+                self._idx(dst.offset + dst_offset),
+                nbytes,
+            )
+
+    @property
+    def buffer(self) -> jax.Array:
+        """The live arena array (for data-plane kernels that operate on the
+        whole arena, e.g. ICI remote copies)."""
+        with self._mu:
+            return self._buf
+
+    def swap_buffer(self, new_buf: jax.Array) -> None:
+        """Rebind after an external donated update (ICI data plane).
+
+        Caller must hold no reference to the old buffer; for compound
+        read-modify-swap sequences use :meth:`update` instead.
+        """
+        assert new_buf.shape == (self.capacity,) and new_buf.dtype == jnp.uint8
+        with self._mu:
+            self._buf = new_buf
+
+    def update(self, fn) -> None:
+        """Atomically rebind ``self._buf = fn(self._buf)`` under the arena
+        lock — the safe primitive for external donated updates."""
+        with self._mu:
+            self._buf = fn(self._buf)
+
+    def block_until_ready(self) -> None:
+        self.buffer.block_until_ready()

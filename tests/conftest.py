@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective logic is
+validated on a virtual CPU mesh (the in-process fake-fabric capability the
+reference lacked — SURVEY.md §4 "gap to close"). Must run before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,77 @@
+"""Arena suballocator unit tests."""
+
+import pytest
+
+from oncilla_tpu import ArenaAllocator, OcmInvalidHandle, OcmOutOfMemory
+
+
+def test_alloc_free_roundtrip():
+    a = ArenaAllocator(1 << 20, alignment=512)
+    e = a.alloc(1000)
+    assert e.offset == 0
+    assert e.nbytes == 1000
+    assert a.num_live == 1
+    a.free(e)
+    assert a.num_live == 0
+    assert a.bytes_free == 1 << 20
+
+
+def test_alignment():
+    a = ArenaAllocator(1 << 20, alignment=512)
+    e1 = a.alloc(1)
+    e2 = a.alloc(1)
+    assert e2.offset == 512
+    assert e1.offset % 512 == 0
+
+
+def test_oom():
+    a = ArenaAllocator(4096)
+    a.alloc(4096)
+    with pytest.raises(OcmOutOfMemory):
+        a.alloc(1)
+
+
+def test_double_free_rejected():
+    a = ArenaAllocator(4096)
+    e = a.alloc(100)
+    a.free(e)
+    with pytest.raises(OcmInvalidHandle):
+        a.free(e)
+
+
+def test_coalescing_allows_full_realloc():
+    a = ArenaAllocator(4096, alignment=512)
+    es = [a.alloc(512) for _ in range(8)]
+    # Free in interleaved order to exercise both coalesce directions.
+    for i in [1, 3, 5, 7, 0, 2, 4, 6]:
+        a.free(es[i])
+    big = a.alloc(4096)
+    assert big.offset == 0
+
+
+def test_first_fit_reuses_hole():
+    a = ArenaAllocator(1 << 16, alignment=512)
+    e1 = a.alloc(512)
+    a.alloc(512)
+    a.free(e1)
+    e3 = a.alloc(512)
+    assert e3.offset == e1.offset
+
+
+def test_fragmentation_reported_in_error():
+    a = ArenaAllocator(2048, alignment=512)
+    keep = [a.alloc(512) for _ in range(4)]
+    a.free(keep[0])
+    a.free(keep[2])
+    with pytest.raises(OcmOutOfMemory):
+        a.alloc(1024)  # 1024 free but split into two 512 holes
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        ArenaAllocator(0)
+    with pytest.raises(ValueError):
+        ArenaAllocator(100, alignment=3)
+    a = ArenaAllocator(4096)
+    with pytest.raises(ValueError):
+        a.alloc(0)

@@ -1,0 +1,78 @@
+"""Regression tests for the review findings on the core layer: concurrent
+puts on one device arena (donated-buffer rebind race), >2 GiB arena offset
+width, and remote-handle ops without a control plane."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.arena import Extent
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.kinds import Fabric
+
+
+def test_concurrent_puts_same_device_arena():
+    ctx = ocm.ocm_init(ocm.OcmConfig(device_arena_bytes=4 << 20))
+    h1 = ctx.alloc(64 << 10, OcmKind.LOCAL_DEVICE)
+    h2 = ctx.alloc(64 << 10, OcmKind.LOCAL_DEVICE)
+    d1 = np.full(64 << 10, 0xAB, np.uint8)
+    d2 = np.full(64 << 10, 0xCD, np.uint8)
+    errs = []
+
+    def worker(h, d):
+        try:
+            for _ in range(200):
+                ctx.put(h, d)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [
+        threading.Thread(target=worker, args=(h1, d1)),
+        threading.Thread(target=worker, args=(h2, d2)),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    np.testing.assert_array_equal(np.asarray(ctx.get(h1)), d1)
+    np.testing.assert_array_equal(np.asarray(ctx.get(h2)), d2)
+    ctx.tini()
+
+
+def test_large_arena_requires_x64():
+    import jax
+
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled; large arenas are allowed")
+    with pytest.raises(ocm.OcmError, match="64-bit offsets"):
+        from oncilla_tpu.core.hbm import DeviceArena
+
+        DeviceArena(3 << 30)
+
+
+def test_remote_handle_ops_raise_connect_error():
+    ctx = ocm.ocm_init(ocm.OcmConfig())
+    fake = OcmAlloc(
+        alloc_id=2,
+        kind=OcmKind.REMOTE_DEVICE,
+        fabric=Fabric.ICI,
+        nbytes=1024,
+        rank=1,
+        device_index=0,
+        extent=Extent(0, 1024),
+        origin_rank=0,
+    )
+    with pytest.raises(ocm.OcmConnectError):
+        ctx.put(fake, np.zeros(16, np.uint8))
+    with pytest.raises(ocm.OcmConnectError):
+        ctx.get(fake, 16)
+
+
+def test_bad_device_index_typed_error():
+    ctx = ocm.ocm_init(ocm.OcmConfig())
+    with pytest.raises(ocm.OcmInvalidHandle, match="out of range"):
+        ctx.alloc(1024, OcmKind.LOCAL_DEVICE, device_index=7)

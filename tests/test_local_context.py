@@ -1,0 +1,132 @@
+"""Single-node context tests: the ocm_test.c test-1/test-3 analogues for the
+local arms (allocation lifecycle ×3 per kind, reference test/ocm_test.c:32-130;
+kind×kind copy matrix, ocm_test.c:208-321)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+
+
+@pytest.fixture
+def ctx():
+    cfg = ocm.OcmConfig(host_arena_bytes=8 << 20, device_arena_bytes=8 << 20)
+    c = ocm.ocm_init(cfg)
+    yield c
+    c.tini()
+
+
+LOCAL_KINDS = [OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE]
+
+
+@pytest.mark.parametrize("kind", LOCAL_KINDS)
+def test_lifecycle_three_iterations(ctx, kind):
+    # Mirrors ocm_test.c test 1: alloc → localbuf → introspect → free, ×3.
+    for _ in range(3):
+        h = ctx.alloc(4096, kind)
+        assert not h.freed
+        buf = ctx.localbuf(h)
+        assert buf is not None and len(buf) == 4096
+        assert ocm.ocm_is_remote(h) is False
+        assert ocm.ocm_alloc_kind(h) == kind
+        assert ocm.ocm_remote_sz(h) == 0
+        ctx.free(h)
+        assert h.freed
+
+
+@pytest.mark.parametrize("kind", LOCAL_KINDS)
+def test_put_get_pattern(ctx, rng, kind):
+    # Pattern-stamp + readback compare (idiom of ib_client.c:164-179).
+    h = ctx.alloc(8192, kind)
+    data = rng.integers(0, 256, size=8192, dtype=np.uint8)
+    ctx.put(h, data)
+    out = np.asarray(ctx.get(h, 8192))
+    np.testing.assert_array_equal(out, data)
+    ctx.free(h)
+
+
+@pytest.mark.parametrize("kind", LOCAL_KINDS)
+def test_put_get_with_offset(ctx, rng, kind):
+    h = ctx.alloc(4096, kind)
+    data = rng.integers(0, 256, size=1024, dtype=np.uint8)
+    ctx.put(h, data, offset=512)
+    out = np.asarray(ctx.get(h, 1024, offset=512))
+    np.testing.assert_array_equal(out, data)
+    ctx.free(h)
+
+
+@pytest.mark.parametrize("kind", LOCAL_KINDS)
+def test_bounds_checked(ctx, kind):
+    # post_send bounds-check analogue (rdma.c:55-59).
+    h = ctx.alloc(1024, kind)
+    with pytest.raises(ocm.OcmBoundsError):
+        ctx.put(h, np.zeros(2048, np.uint8))
+    with pytest.raises(ocm.OcmBoundsError):
+        ctx.get(h, 100, offset=1000)
+    ctx.free(h)
+
+
+def test_typed_roundtrip(ctx):
+    h = ctx.alloc(4 * 256, OcmKind.LOCAL_DEVICE)
+    x = jnp.arange(256, dtype=jnp.float32)
+    ctx.put(h, x)
+    y = ctx.get_as(h, (256,), jnp.float32)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    ctx.free(h)
+
+
+@pytest.mark.parametrize("src_kind", LOCAL_KINDS)
+@pytest.mark.parametrize("dst_kind", LOCAL_KINDS)
+def test_copy_matrix(ctx, rng, src_kind, dst_kind):
+    # ocm_copy across every local kind pair (ocm_test.c test 3).
+    src = ctx.alloc(2048, src_kind)
+    dst = ctx.alloc(2048, dst_kind)
+    data = rng.integers(0, 256, size=2048, dtype=np.uint8)
+    ctx.put(src, data)
+    ctx.copy(dst, src)
+    np.testing.assert_array_equal(np.asarray(ctx.get(dst)), data)
+    ctx.free(src)
+    ctx.free(dst)
+
+
+def test_copy_same_device_offsets(ctx, rng):
+    src = ctx.alloc(4096, OcmKind.LOCAL_DEVICE)
+    dst = ctx.alloc(4096, OcmKind.LOCAL_DEVICE)
+    data = rng.integers(0, 256, size=1024, dtype=np.uint8)
+    ctx.put(src, data, offset=256)
+    ctx.copy(dst, src, nbytes=1024, dst_offset=512, src_offset=256)
+    np.testing.assert_array_equal(np.asarray(ctx.get(dst, 1024, offset=512)), data)
+
+
+def test_use_after_free_rejected(ctx):
+    h = ctx.alloc(1024)
+    ctx.free(h)
+    with pytest.raises(ocm.OcmInvalidHandle):
+        ctx.put(h, np.zeros(16, np.uint8))
+    with pytest.raises(ocm.OcmInvalidHandle):
+        ctx.free(h)
+
+
+def test_remote_without_control_plane_rejected(ctx):
+    with pytest.raises(ocm.OcmConnectError):
+        ctx.alloc(1024, OcmKind.REMOTE_DEVICE)
+
+
+def test_copy_onesided_parity(ctx, rng):
+    h = ctx.alloc(1024, OcmKind.LOCAL_HOST)
+    data = rng.integers(0, 256, size=1024, dtype=np.uint8)
+    ocm.ocm_copy_onesided(ctx, h, data, "write")
+    out = ocm.ocm_copy_onesided(ctx, h, data, "read")
+    np.testing.assert_array_equal(out, data)
+
+
+def test_arena_reuse_many_allocs(ctx):
+    # Churn: allocate/free loops must not leak arena space.
+    for _ in range(50):
+        hs = [ctx.alloc(64 << 10, k) for k in LOCAL_KINDS for _ in range(4)]
+        for h in hs:
+            ctx.free(h)
+    assert ctx.host_arena.allocator.bytes_live == 0
+    assert ctx.device_arenas[0].allocator.bytes_live == 0
