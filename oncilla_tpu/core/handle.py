@@ -43,6 +43,9 @@ class OcmAlloc:
     extent: Extent
     origin_rank: int
     freed: bool = field(default=False, compare=False)
+    # (host, port) of the owner daemon, filled for DCN-reachable arms —
+    # the connectionless address the ALLOC_RESULT reply carries.
+    owner_addr: tuple[str, int] | None = field(default=None, compare=False)
 
     @property
     def is_remote(self) -> bool:

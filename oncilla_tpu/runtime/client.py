@@ -1,0 +1,276 @@
+"""App-side control-plane client: the RemoteBackend the Ocm context uses.
+
+Analogue of the app half of libocm (/root/reference/src/lib.c): registers
+with the local daemon (CONNECT handshake, lib.c:98-132), drives alloc/free
+through it, and talks **directly** to the owner daemon for REMOTE_HOST data
+(the reference's one-sided data plane bypasses the local daemon per transfer,
+SURVEY.md §1). REMOTE_DEVICE data rides the ICI plane supplied by the SPMD
+app (:mod:`oncilla_tpu.ops.ici`).
+
+Large host transfers are chunked and pipelined with a bounded in-flight
+window — the scheme of ``extoll_rma2_transfer`` (8 MB chunks, 2 overlapped
+ops, /root/reference/src/extoll.c:47-173).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+import numpy as np
+
+from oncilla_tpu.core.arena import Extent
+from oncilla_tpu.core.errors import (
+    OcmConnectError,
+    OcmInvalidHandle,
+    OcmProtocolError,
+)
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.kinds import Fabric, OcmKind
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.protocol import (
+    WIRE_KIND,
+    WIRE_KIND_INV,
+    Message,
+    MsgType,
+    recv_msg,
+    request,
+    send_msg,
+)
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
+
+
+class ControlPlaneClient:
+    """Connects an app process to its local daemon (and, for data, directly
+    to owner daemons). Implements the RemoteBackend protocol of
+    :class:`oncilla_tpu.core.context.Ocm`."""
+
+    def __init__(
+        self,
+        entries: list[NodeEntry],
+        rank: int,
+        config: OcmConfig | None = None,
+        ici_plane=None,
+        heartbeat: bool = True,
+    ):
+        self.entries = entries
+        self.rank = rank
+        self.config = config or OcmConfig()
+        self.pid = os.getpid()
+        self.ici_plane = ici_plane
+        self.tracer = GLOBAL_TRACER
+        self._lock = threading.Lock()
+        self._data_conns: dict[tuple[str, int], tuple[socket.socket, threading.Lock]] = {}
+        me = entries[rank]
+        try:
+            self._ctrl = socket.create_connection((me.host, me.port), timeout=30.0)
+        except OSError as e:
+            raise OcmConnectError(
+                f"local daemon unreachable at {me.host}:{me.port}: {e}"
+            ) from e
+        self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._ctrl_lock = threading.Lock()
+        # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132).
+        r = self._request(Message(MsgType.CONNECT, {"pid": self.pid, "rank": rank}))
+        if r.type != MsgType.CONNECT_CONFIRM:
+            raise OcmConnectError(f"bad handshake reply {r.type.name}")
+        self.nnodes = r.fields["nnodes"]
+        self._hb_stop = threading.Event()
+        if heartbeat:
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                 name=f"ocm-hb-{rank}")
+            t.start()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _request(self, msg: Message) -> Message:
+        with self._ctrl_lock:
+            return request(self._ctrl, msg)
+
+    def _data_conn(self, host: str, port: int):
+        key = (host, port)
+        with self._lock:
+            entry = self._data_conns.get(key)
+            if entry is None:
+                s = socket.create_connection(key, timeout=30.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                entry = (s, threading.Lock())
+                self._data_conns[key] = entry
+        return entry
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.config.heartbeat_s):
+            try:
+                self._request(
+                    Message(MsgType.HEARTBEAT, {"rank": self.rank, "pid": self.pid})
+                )
+            except (OSError, OcmProtocolError):
+                printd("client rank %d: heartbeat failed", self.rank)
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        try:
+            send_msg(self._ctrl, Message(MsgType.DISCONNECT, {"pid": self.pid}))
+        except OSError:
+            pass
+        for s, _ in list(self._data_conns.values()):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._data_conns.clear()
+        try:
+            self._ctrl.close()
+        except OSError:
+            pass
+
+    # -- RemoteBackend: alloc / free ------------------------------------
+
+    def alloc(self, nbytes: int, kind: OcmKind) -> OcmAlloc:
+        r = self._request(
+            Message(
+                MsgType.REQ_ALLOC,
+                {
+                    "orig_rank": self.rank,
+                    "pid": self.pid,
+                    "kind": WIRE_KIND[kind.value],
+                    "nbytes": nbytes,
+                },
+            )
+        )
+        f = r.fields
+        placed_kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        fabric = (
+            Fabric.LOCAL
+            if not placed_kind.is_remote
+            else (Fabric.ICI if placed_kind == OcmKind.REMOTE_DEVICE else Fabric.DCN)
+        )
+        h = OcmAlloc(
+            alloc_id=f["alloc_id"],
+            kind=placed_kind,
+            fabric=fabric,
+            nbytes=nbytes,
+            rank=f["rank"],
+            device_index=f["device_index"],
+            extent=Extent(offset=f["offset"], nbytes=nbytes),
+            origin_rank=self.rank,
+        )
+        h.owner_addr = (f["owner_host"], f["owner_port"])  # for the DCN path
+        return h
+
+    def free(self, handle: OcmAlloc) -> None:
+        self._request(
+            Message(
+                MsgType.REQ_FREE,
+                {"alloc_id": handle.alloc_id, "rank": handle.rank},
+            )
+        )
+
+    # -- RemoteBackend: one-sided data ----------------------------------
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        if handle.kind == OcmKind.REMOTE_DEVICE:
+            self._ici(handle).put(handle, data, offset)
+            return
+        raw = np.ascontiguousarray(np.asarray(data)).view(np.uint8).reshape(-1)
+        self._dcn_put(handle, raw, offset)
+
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0):
+        if handle.kind == OcmKind.REMOTE_DEVICE:
+            return self._ici(handle).get(handle, nbytes, offset)
+        return self._dcn_get(handle, nbytes, offset)
+
+    def _ici(self, handle: OcmAlloc):
+        if self.ici_plane is None:
+            raise OcmInvalidHandle(
+                "REMOTE_DEVICE data needs an ICI plane; pass ici_plane= to "
+                "ControlPlaneClient (see oncilla_tpu.ops.ici)"
+            )
+        return self.ici_plane
+
+    # DCN path: chunked, pipelined DATA_PUT/GET straight to the owner
+    # daemon (extoll.c:47-173 scheme over TCP).
+    def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
+        host, port = self._owner_addr(handle)
+        s, lk = self._data_conn(host, port)
+        chunk = self.config.chunk_bytes
+        window = max(1, self.config.inflight_ops)
+        with self.tracer.span("dcn_put", nbytes=raw.nbytes), lk:
+            sent = []  # in-flight chunk sizes awaiting replies
+            pos = 0
+            while pos < raw.nbytes or sent:
+                while pos < raw.nbytes and len(sent) < window:
+                    n = min(chunk, raw.nbytes - pos)
+                    send_msg(
+                        s,
+                        Message(
+                            MsgType.DATA_PUT,
+                            {
+                                "alloc_id": handle.alloc_id,
+                                "offset": offset + pos,
+                                "nbytes": n,
+                            },
+                            raw[pos : pos + n].tobytes(),
+                        ),
+                    )
+                    sent.append(n)
+                    pos += n
+                r = recv_msg(s)
+                if r.type == MsgType.ERROR:
+                    raise OcmProtocolError(r.fields["detail"])
+                sent.pop(0)
+
+    def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int) -> np.ndarray:
+        host, port = self._owner_addr(handle)
+        s, lk = self._data_conn(host, port)
+        chunk = self.config.chunk_bytes
+        window = max(1, self.config.inflight_ops)
+        out = np.empty(nbytes, dtype=np.uint8)
+        with self.tracer.span("dcn_get", nbytes=nbytes), lk:
+            req_pos = 0
+            got_pos = 0
+            inflight = []
+            while got_pos < nbytes or inflight:
+                while req_pos < nbytes and len(inflight) < window:
+                    n = min(chunk, nbytes - req_pos)
+                    send_msg(
+                        s,
+                        Message(
+                            MsgType.DATA_GET,
+                            {
+                                "alloc_id": handle.alloc_id,
+                                "offset": offset + req_pos,
+                                "nbytes": n,
+                            },
+                        ),
+                    )
+                    inflight.append((req_pos, n))
+                    req_pos += n
+                r = recv_msg(s)
+                if r.type == MsgType.ERROR:
+                    raise OcmProtocolError(r.fields["detail"])
+                start, n = inflight.pop(0)
+                out[start : start + n] = np.frombuffer(r.data, dtype=np.uint8)
+                got_pos += n
+        return out
+
+    def _owner_addr(self, handle: OcmAlloc) -> tuple[str, int]:
+        addr = getattr(handle, "owner_addr", None)
+        if addr is not None:
+            return addr
+        e = self.entries[handle.rank]
+        return (e.host, e.port)
+
+    # -- introspection ---------------------------------------------------
+
+    def status(self, rank: int | None = None) -> dict:
+        if rank is None or rank == self.rank:
+            return self._request(Message(MsgType.STATUS, {})).fields
+        e = self.entries[rank]
+        s = socket.create_connection((e.host, e.port), timeout=30.0)
+        try:
+            return request(s, Message(MsgType.STATUS, {})).fields
+        finally:
+            s.close()

@@ -1,0 +1,67 @@
+"""Cluster membership.
+
+The reference's membership is a positional text nodefile
+``#rank hostname ethernet_ip ocm_port rdmacm_port`` parsed into a global
+table, with self-rank found by matching gethostname()
+(/root/reference/src/nodefile.c:30-37,92-103). Here the same file format is
+supported (minus the per-fabric port column — the data plane is
+connectionless), and on a real TPU pod membership can instead come from the
+JAX runtime (``jax.process_index``/``process_count``).
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+
+from oncilla_tpu.core.errors import OcmError
+
+
+@dataclass(frozen=True)
+class NodeEntry:
+    """One row of the cluster table (``struct node_entry`` analogue,
+    /root/reference/inc/nodefile.h:19-27)."""
+
+    rank: int
+    host: str
+    port: int
+
+
+def parse_nodefile(path: str) -> list[NodeEntry]:
+    """Parse ``rank host port`` lines; '#' starts a comment."""
+    entries: list[NodeEntry] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) < 3:
+                raise OcmError(f"{path}:{lineno}: expected 'rank host port'")
+            entries.append(
+                NodeEntry(rank=int(parts[0]), host=parts[1], port=int(parts[2]))
+            )
+    entries.sort(key=lambda e: e.rank)
+    if [e.rank for e in entries] != list(range(len(entries))):
+        raise OcmError(f"{path}: ranks must be contiguous from 0")
+    return entries
+
+
+def detect_rank(entries: list[NodeEntry]) -> int:
+    """Self-rank by hostname match (nodefile.c:92-103 behavior)."""
+    hostname = socket.gethostname()
+    for e in entries:
+        if e.host in (hostname, hostname.split(".")[0], "localhost", "127.0.0.1"):
+            return e.rank
+    raise OcmError(f"hostname {hostname!r} not present in nodefile")
+
+
+def jax_membership(base_port: int) -> tuple[list[NodeEntry], int]:
+    """Membership from the JAX distributed runtime: one daemon per host,
+    rank = jax.process_index(). Used on real pods where the nodefile would
+    duplicate what the runtime already knows (SURVEY.md §7 mapping table)."""
+    import jax
+
+    n = jax.process_count()
+    entries = [NodeEntry(rank=i, host="localhost", port=base_port + i) for i in range(n)]
+    return entries, jax.process_index()

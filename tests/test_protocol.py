@@ -1,0 +1,83 @@
+"""Wire-protocol unit tests: round-trips, framing, malformed input."""
+
+import struct
+
+import pytest
+
+from oncilla_tpu import OcmProtocolError
+from oncilla_tpu.runtime import protocol as P
+
+
+def roundtrip(msg: P.Message) -> P.Message:
+    b = P.pack(msg)
+    return P.unpack(b[: P.HEADER.size], b[P.HEADER.size :])
+
+
+def test_roundtrip_all_schemas():
+    samples = {
+        "pid": 1234, "rank": 3, "nnodes": 4, "host": "node-7.pod", "port": 17980,
+        "ndevices": 4, "device_arena_bytes": 1 << 30, "host_arena_bytes": 2 << 30,
+        "orig_rank": 2, "kind": 2, "nbytes": 123456789, "device_index": 3,
+        "alloc_id": (5 << 32) | 42, "offset": 98765, "code": 1,
+        "detail": "boom", "lease_s": 30.0, "live_allocs": 7,
+        "host_bytes_live": 11, "device_bytes_live": 22,
+        "owner_host": "10.0.0.1", "owner_port": 18000,
+    }
+    for mtype, schema in P._SCHEMAS.items():
+        msg = P.Message(mtype, {k: samples[k] for k, _ in schema})
+        out = roundtrip(msg)
+        assert out.type == mtype
+        assert out.fields == msg.fields, mtype
+
+
+def test_data_payload_roundtrip():
+    blob = bytes(range(256)) * 100
+    msg = P.Message(
+        P.MsgType.DATA_PUT,
+        {"alloc_id": 7, "offset": 0, "nbytes": len(blob)},
+        blob,
+    )
+    out = roundtrip(msg)
+    assert out.data == blob
+
+
+def test_bad_magic_rejected():
+    b = P.pack(P.Message(P.MsgType.STATUS, {}))
+    bad = b"XXXX" + b[4:]
+    with pytest.raises(OcmProtocolError, match="magic"):
+        P.unpack(bad[: P.HEADER.size], bad[P.HEADER.size :])
+
+
+def test_bad_version_rejected():
+    b = bytearray(P.pack(P.Message(P.MsgType.STATUS, {})))
+    b[4] = 99
+    with pytest.raises(OcmProtocolError, match="version"):
+        P.unpack(bytes(b[: P.HEADER.size]), bytes(b[P.HEADER.size :]))
+
+
+def test_unknown_type_rejected():
+    hdr = P.HEADER.pack(P.MAGIC, P.VERSION, 200, 0, 0)
+    with pytest.raises(OcmProtocolError, match="unknown message type"):
+        P.unpack(hdr, b"")
+
+
+def test_length_mismatch_rejected():
+    b = P.pack(P.Message(P.MsgType.STATUS, {}))
+    with pytest.raises(OcmProtocolError, match="length"):
+        P.unpack(b[: P.HEADER.size], b"extra")
+
+
+def test_unicode_strings():
+    msg = P.Message(
+        P.MsgType.ERROR, {"code": 0, "detail": "нода недоступна 🔥"}
+    )
+    assert roundtrip(msg).fields["detail"] == "нода недоступна 🔥"
+
+
+def test_header_layout_stable():
+    # The C++ daemon hard-codes this layout; lock it down.
+    assert P.HEADER.size == 12
+    b = P.pack(P.Message(P.MsgType.CONNECT, {"pid": 1, "rank": 0}))
+    magic, ver, typ, flags, plen = P.HEADER.unpack(b[:12])
+    assert (magic, ver, typ, flags, plen) == (b"OCM1", 1, 1, 0, 16)
+    assert struct.unpack("<qq", b[12:28]) == (1, 0)
