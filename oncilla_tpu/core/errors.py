@@ -25,7 +25,18 @@ class OcmInvalidHandle(OcmError):
 
 
 class OcmProtocolError(OcmError):
-    """Malformed or unexpected control-plane message."""
+    """Malformed or unexpected control-plane message (transport-level: the
+    connection can no longer be trusted)."""
+
+
+class OcmRemoteError(OcmProtocolError):
+    """A peer replied with a well-formed ERROR message. The connection
+    remains in sync and reusable; ``code`` is the wire ErrCode value."""
+
+    def __init__(self, code: int, detail: str):
+        super().__init__(detail)
+        self.code = code
+        self.detail = detail
 
 
 class OcmConnectError(OcmError):

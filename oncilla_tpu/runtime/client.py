@@ -25,10 +25,12 @@ from oncilla_tpu.core.errors import (
     OcmConnectError,
     OcmInvalidHandle,
     OcmProtocolError,
+    OcmRemoteError,
 )
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import Fabric, OcmKind
 from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime.protocol import (
     WIRE_KIND,
     WIRE_KIND_INV,
@@ -61,8 +63,7 @@ class ControlPlaneClient:
         self.pid = os.getpid()
         self.ici_plane = ici_plane
         self.tracer = GLOBAL_TRACER
-        self._lock = threading.Lock()
-        self._data_conns: dict[tuple[str, int], tuple[socket.socket, threading.Lock]] = {}
+        self._pool = PeerPool()
         me = entries[rank]
         try:
             self._ctrl = socket.create_connection((me.host, me.port), timeout=30.0)
@@ -89,17 +90,6 @@ class ControlPlaneClient:
         with self._ctrl_lock:
             return request(self._ctrl, msg)
 
-    def _data_conn(self, host: str, port: int):
-        key = (host, port)
-        with self._lock:
-            entry = self._data_conns.get(key)
-            if entry is None:
-                s = socket.create_connection(key, timeout=30.0)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                entry = (s, threading.Lock())
-                self._data_conns[key] = entry
-        return entry
-
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.config.heartbeat_s):
             try:
@@ -115,12 +105,7 @@ class ControlPlaneClient:
             send_msg(self._ctrl, Message(MsgType.DISCONNECT, {"pid": self.pid}))
         except OSError:
             pass
-        for s, _ in list(self._data_conns.values()):
-            try:
-                s.close()
-            except OSError:
-                pass
-        self._data_conns.clear()
+        self._pool.close()
         try:
             self._ctrl.close()
         except OSError:
@@ -191,69 +176,78 @@ class ControlPlaneClient:
         return self.ici_plane
 
     # DCN path: chunked, pipelined DATA_PUT/GET straight to the owner
-    # daemon (extoll.c:47-173 scheme over TCP).
-    def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
+    # daemon (extoll.c:47-173 scheme over TCP). On a peer ERROR reply the
+    # remaining in-flight replies are drained before raising, keeping the
+    # pooled connection in sync; transport errors evict it.
+    def _pipelined(self, handle: OcmAlloc, total: int, make_req, on_reply) -> None:
         host, port = self._owner_addr(handle)
-        s, lk = self._data_conn(host, port)
+        s, lk = self._pool.connection(host, port)
         chunk = self.config.chunk_bytes
         window = max(1, self.config.inflight_ops)
-        with self.tracer.span("dcn_put", nbytes=raw.nbytes), lk:
-            sent = []  # in-flight chunk sizes awaiting replies
+        with lk:
+            inflight: list[tuple[int, int]] = []  # (chunk_offset, nbytes)
             pos = 0
-            while pos < raw.nbytes or sent:
-                while pos < raw.nbytes and len(sent) < window:
-                    n = min(chunk, raw.nbytes - pos)
-                    send_msg(
-                        s,
-                        Message(
-                            MsgType.DATA_PUT,
-                            {
-                                "alloc_id": handle.alloc_id,
-                                "offset": offset + pos,
-                                "nbytes": n,
-                            },
-                            raw[pos : pos + n].tobytes(),
-                        ),
-                    )
-                    sent.append(n)
-                    pos += n
-                r = recv_msg(s)
-                if r.type == MsgType.ERROR:
-                    raise OcmProtocolError(r.fields["detail"])
-                sent.pop(0)
+            failure: OcmRemoteError | None = None
+            try:
+                while pos < total or inflight:
+                    while pos < total and len(inflight) < window and failure is None:
+                        n = min(chunk, total - pos)
+                        send_msg(s, make_req(pos, n))
+                        inflight.append((pos, n))
+                        pos += n
+                    if not inflight:
+                        break
+                    r = recv_msg(s)
+                    start, n = inflight.pop(0)
+                    if r.type == MsgType.ERROR:
+                        # Remember the first failure; keep draining replies
+                        # for chunks already on the wire.
+                        if failure is None:
+                            failure = OcmRemoteError(
+                                r.fields["code"], r.fields["detail"]
+                            )
+                    elif failure is None:
+                        on_reply(r, start, n)
+            except (OSError, OcmProtocolError) as e:
+                if not isinstance(e, OcmRemoteError):
+                    self._pool.evict(host, port)
+                raise
+            if failure is not None:
+                raise failure
+
+    def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
+        def make_req(pos: int, n: int) -> Message:
+            return Message(
+                MsgType.DATA_PUT,
+                {
+                    "alloc_id": handle.alloc_id,
+                    "offset": offset + pos,
+                    "nbytes": n,
+                },
+                raw[pos : pos + n].tobytes(),
+            )
+
+        with self.tracer.span("dcn_put", nbytes=raw.nbytes):
+            self._pipelined(handle, raw.nbytes, make_req, lambda r, s0, n: None)
 
     def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int) -> np.ndarray:
-        host, port = self._owner_addr(handle)
-        s, lk = self._data_conn(host, port)
-        chunk = self.config.chunk_bytes
-        window = max(1, self.config.inflight_ops)
         out = np.empty(nbytes, dtype=np.uint8)
-        with self.tracer.span("dcn_get", nbytes=nbytes), lk:
-            req_pos = 0
-            got_pos = 0
-            inflight = []
-            while got_pos < nbytes or inflight:
-                while req_pos < nbytes and len(inflight) < window:
-                    n = min(chunk, nbytes - req_pos)
-                    send_msg(
-                        s,
-                        Message(
-                            MsgType.DATA_GET,
-                            {
-                                "alloc_id": handle.alloc_id,
-                                "offset": offset + req_pos,
-                                "nbytes": n,
-                            },
-                        ),
-                    )
-                    inflight.append((req_pos, n))
-                    req_pos += n
-                r = recv_msg(s)
-                if r.type == MsgType.ERROR:
-                    raise OcmProtocolError(r.fields["detail"])
-                start, n = inflight.pop(0)
-                out[start : start + n] = np.frombuffer(r.data, dtype=np.uint8)
-                got_pos += n
+
+        def make_req(pos: int, n: int) -> Message:
+            return Message(
+                MsgType.DATA_GET,
+                {
+                    "alloc_id": handle.alloc_id,
+                    "offset": offset + pos,
+                    "nbytes": n,
+                },
+            )
+
+        def on_reply(r: Message, start: int, n: int) -> None:
+            out[start : start + n] = np.frombuffer(r.data, dtype=np.uint8)
+
+        with self.tracer.span("dcn_get", nbytes=nbytes):
+            self._pipelined(handle, nbytes, make_req, on_reply)
         return out
 
     def _owner_addr(self, handle: OcmAlloc) -> tuple[str, int]:

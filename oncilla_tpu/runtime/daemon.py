@@ -26,6 +26,8 @@ import time
 
 from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 from oncilla_tpu.core.errors import (
+    OcmBoundsError,
+    OcmConnectError,
     OcmError,
     OcmInvalidHandle,
     OcmOutOfMemory,
@@ -35,6 +37,7 @@ from oncilla_tpu.core.errors import (
 from oncilla_tpu.core.hostmem import HostArena
 from oncilla_tpu.core.kinds import OcmKind
 from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime.placement import (
     POLICIES,
     NodeResources,
@@ -53,49 +56,6 @@ from oncilla_tpu.runtime.protocol import (
 from oncilla_tpu.runtime.registry import AllocRegistry, RegEntry
 from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import printd
-
-
-class PeerPool:
-    """Cached daemon->daemon connections (the reference reconnects per
-    message, mem.c:92-111; a pool keeps alloc p50 down)."""
-
-    def __init__(self):
-        self._conns: dict[tuple[str, int], tuple[socket.socket, threading.Lock]] = {}
-        self._lock = threading.Lock()
-
-    def request(self, host: str, port: int, msg: Message) -> Message:
-        key = (host, port)
-        with self._lock:
-            entry = self._conns.get(key)
-            if entry is None:
-                s = socket.create_connection(key, timeout=30.0)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                entry = (s, threading.Lock())
-                self._conns[key] = entry
-        s, lk = entry
-        try:
-            with lk:
-                return request(s, msg)
-        except (OSError, OcmProtocolError):
-            # Reconnect once (peer restarted or idle connection dropped).
-            with self._lock:
-                self._conns.pop(key, None)
-            s2 = socket.create_connection(key, timeout=30.0)
-            s2.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            lk2 = threading.Lock()
-            with self._lock:
-                self._conns[key] = (s2, lk2)
-            with lk2:
-                return request(s2, msg)
-
-    def close(self):
-        with self._lock:
-            for s, _ in self._conns.values():
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._conns.clear()
 
 
 class Daemon:
@@ -194,7 +154,7 @@ class Daemon:
             try:
                 self.peers.request(r0.host, r0.port, msg)
                 return
-            except OSError:
+            except (OSError, OcmConnectError):
                 time.sleep(min(0.05 * 2**i, 2.0))
         raise OcmError(f"rank 0 daemon unreachable at {r0.host}:{r0.port}")
 
@@ -224,12 +184,17 @@ class Daemon:
                     reply = self._dispatch(msg)
                 except OcmOutOfMemory as e:
                     reply = _err(ErrCode.OOM, str(e))
+                except OcmBoundsError as e:
+                    reply = _err(ErrCode.BOUNDS, str(e))
                 except OcmInvalidHandle as e:
                     reply = _err(ErrCode.BAD_ALLOC_ID, str(e))
                 except OcmPlacementError as e:
                     reply = _err(ErrCode.PLACEMENT, str(e))
                 except OcmError as e:
                     reply = _err(ErrCode.UNKNOWN, str(e))
+                except Exception as e:  # noqa: BLE001 — always answer with a
+                    # typed ERROR frame rather than killing the connection.
+                    reply = _err(ErrCode.UNKNOWN, f"{type(e).__name__}: {e}")
                 send_msg(conn, reply)
         except OSError:
             pass
@@ -267,7 +232,11 @@ class Daemon:
         printd("daemon %d: app pid %d connected", self.rank, msg.fields["pid"])
         return Message(
             MsgType.CONNECT_CONFIRM,
-            {"rank": self.rank, "nnodes": max(1, self.policy.nnodes)},
+            {
+                "rank": self.rank,
+                "nnodes": self.policy.nnodes if self.rank == 0
+                else len(self.entries),
+            },
         )
 
     def _on_disconnect(self, msg: Message) -> Message:
@@ -385,6 +354,8 @@ class Daemon:
     def _on_req_free(self, msg: Message) -> Message:
         f = msg.fields
         owner_rank = f["rank"]
+        if not 0 <= owner_rank < len(self.entries):
+            raise OcmInvalidHandle(f"bad owner rank {owner_rank}")
         if owner_rank == self.rank:
             self._do_free_local(f["alloc_id"])
         else:
@@ -424,7 +395,7 @@ class Daemon:
             r0 = self.entries[0]
             try:
                 self.peers.request(r0.host, r0.port, note)
-            except OSError:
+            except (OSError, OcmConnectError):
                 printd("daemon %d: NOTE_FREE to rank0 failed", self.rank)
 
     def _on_note_free(self, msg: Message) -> Message:
@@ -483,7 +454,7 @@ class Daemon:
                     continue
                 try:
                     self.peers.request(e.host, e.port, msg)
-                except OSError:
+                except (OSError, OcmConnectError):
                     printd("daemon %d: heartbeat relay to %d failed",
                            self.rank, e.rank)
         return Message(MsgType.HEARTBEAT_OK, {"lease_s": self.registry.lease_s})

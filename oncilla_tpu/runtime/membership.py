@@ -56,12 +56,32 @@ def detect_rank(entries: list[NodeEntry]) -> int:
     raise OcmError(f"hostname {hostname!r} not present in nodefile")
 
 
-def jax_membership(base_port: int) -> tuple[list[NodeEntry], int]:
+def jax_membership(
+    base_port: int, hosts: list[str] | None = None
+) -> tuple[list[NodeEntry], int]:
     """Membership from the JAX distributed runtime: one daemon per host,
-    rank = jax.process_index(). Used on real pods where the nodefile would
-    duplicate what the runtime already knows (SURVEY.md §7 mapping table)."""
+    rank = jax.process_index(). JAX does not expose peer hostnames, so on a
+    real multi-host pod pass ``hosts`` explicitly or set ``OCM_HOSTS`` to a
+    comma-separated list ordered by process index (the nodefile equivalent).
+    Single-process falls back to localhost."""
+    import os
+
     import jax
 
     n = jax.process_count()
-    entries = [NodeEntry(rank=i, host="localhost", port=base_port + i) for i in range(n)]
+    if hosts is None:
+        env = os.environ.get("OCM_HOSTS")
+        hosts = [h.strip() for h in env.split(",")] if env else None
+    if hosts is None:
+        if n > 1:
+            raise OcmError(
+                "multi-host membership needs hostnames: pass hosts= or set "
+                "OCM_HOSTS=host0,host1,... ordered by jax.process_index"
+            )
+        hosts = ["localhost"]
+    if len(hosts) != n:
+        raise OcmError(f"got {len(hosts)} hosts for {n} JAX processes")
+    entries = [
+        NodeEntry(rank=i, host=hosts[i], port=base_port + i) for i in range(n)
+    ]
     return entries, jax.process_index()

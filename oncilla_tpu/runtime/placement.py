@@ -66,7 +66,7 @@ class PlacementPolicy:
     def note_alloc(self, p: Placement, nbytes: int) -> None:
         with self._lock:
             node = self._nodes[p.rank]
-            if p.kind == OcmKind.REMOTE_HOST:
+            if p.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
                 node.host_used += nbytes
             else:
                 node.device_used[p.device_index] += nbytes
@@ -74,7 +74,7 @@ class PlacementPolicy:
     def note_free(self, p: Placement, nbytes: int) -> None:
         with self._lock:
             node = self._nodes[p.rank]
-            if p.kind == OcmKind.REMOTE_HOST:
+            if p.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
                 node.host_used = max(0, node.host_used - nbytes)
             else:
                 node.device_used[p.device_index] = max(

@@ -25,7 +25,7 @@ import socket
 import struct
 from dataclasses import dataclass, field
 
-from oncilla_tpu.core.errors import OcmProtocolError
+from oncilla_tpu.core.errors import OcmProtocolError, OcmRemoteError
 
 MAGIC = b"OCM1"
 VERSION = 1
@@ -250,11 +250,13 @@ def recv_msg(sock: socket.socket) -> Message:
 
 def request(sock: socket.socket, msg: Message) -> Message:
     """Send and await the reply (``send_recv_msg`` analogue, mem.c:63-88).
-    Raises on an ERROR reply."""
+    An ERROR reply raises :class:`OcmRemoteError` — the connection stays in
+    sync and reusable, unlike transport-level OcmProtocolError."""
     send_msg(sock, msg)
     reply = recv_msg(sock)
     if reply.type == MsgType.ERROR:
-        raise OcmProtocolError(
-            f"{ErrCode(reply.fields['code']).name}: {reply.fields['detail']}"
+        raise OcmRemoteError(
+            reply.fields["code"],
+            f"{ErrCode(reply.fields['code']).name}: {reply.fields['detail']}",
         )
     return reply

@@ -201,3 +201,48 @@ def test_many_concurrent_allocs():
         assert len({h.alloc_id for h in handles}) == 40  # ids unique
         for h in handles:
             ctx.free(h)
+
+
+def test_pipelined_error_does_not_desync_connection():
+    # A multi-chunk put that fails must drain in-flight replies so the
+    # pooled data connection stays usable (review finding regression).
+    with local_cluster(2, config=small_cfg(chunk_bytes=1024)) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(16 << 10, OcmKind.REMOTE_HOST)
+        bad = np.zeros(8 << 10, np.uint8)
+        with pytest.raises(ocm.OcmError):
+            ctx.put(h, bad, offset=12 << 10)  # runs past the extent
+        # Same connection must still carry a clean multi-chunk roundtrip.
+        data = np.random.default_rng(3).integers(0, 256, 8 << 10, dtype=np.uint8)
+        ctx.put(h, data)
+        np.testing.assert_array_equal(ctx.get(h, 8 << 10), data)
+        ctx.free(h)
+
+
+def test_bounds_error_code_on_wire():
+    from oncilla_tpu.runtime.protocol import ErrCode
+
+    with local_cluster(2, config=small_cfg(chunk_bytes=1 << 20)) as c:
+        client = c.client(0)
+        h = client.alloc(4096, OcmKind.REMOTE_HOST)
+        try:
+            client.put(h, np.zeros(8192, np.uint8), 0)
+            raise AssertionError("expected bounds error")
+        except ocm.OcmError as e:
+            assert getattr(e, "code", None) == int(ErrCode.BOUNDS)
+        client.free(h)
+
+
+def test_malformed_request_gets_typed_error_not_dead_thread():
+    # A handler-level crash (bad rank) must produce an ERROR frame, not a
+    # dead connection (review finding regression).
+    with local_cluster(2, config=small_cfg()) as c:
+        client = c.client(0)
+        from oncilla_tpu.runtime.protocol import Message, MsgType
+
+        with pytest.raises(ocm.OcmProtocolError, match="bad owner rank"):
+            client._request(
+                Message(MsgType.REQ_FREE, {"alloc_id": 1, "rank": 99})
+            )
+        # Control connection still alive:
+        assert client.status()["rank"] == 0
