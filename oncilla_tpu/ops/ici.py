@@ -1,0 +1,128 @@
+"""ICI data plane, app side: REMOTE_DEVICE put/get/copy over chip interconnect.
+
+The reference's device data plane is one-sided RDMA into a remote daemon's
+registered buffer (/root/reference/src/rdma.c:241-263). On TPU the analogue
+splits in two:
+
+- **This module** — the single-controller orchestration path: the app holds
+  one :class:`DeviceArena` per chip (the "registered" HBM regions) and moves
+  bytes with ``jax.device_put``, which XLA routes over ICI for chip-to-chip
+  transfers. It implements the data half of the client's RemoteBackend for
+  ``REMOTE_DEVICE`` handles.
+- :mod:`oncilla_tpu.parallel.spmd_arena` — the in-mesh SPMD fabric used
+  *inside* jitted training steps (shard_map + ppermute / Pallas remote DMA),
+  where collectives are compiler-scheduled.
+
+Addressing is connectionless, EXTOLL-style (node, vpid, NLA ≙ rank,
+device_index, offset — SURVEY.md §7 mapping table).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.errors import OcmInvalidHandle
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.hbm import DeviceArena
+from oncilla_tpu.parallel.mesh import global_index
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER
+
+
+class IciDataPlane:
+    """Per-chip HBM arenas addressable pod-wide by (rank, device_index).
+
+    ``devices_per_rank`` maps a handle's (rank, device_index) to a global
+    device: ``global = rank * devices_per_rank + device_index``. The arena
+    capacities must match what the daemons' bookkeeping allocators assume
+    (``OcmConfig.device_arena_bytes``), since daemons hand out offsets into
+    these arenas without touching the bytes.
+    """
+
+    def __init__(
+        self,
+        config: OcmConfig | None = None,
+        devices=None,
+        devices_per_rank: int | None = None,
+    ):
+        self.config = config or OcmConfig()
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.devices_per_rank = devices_per_rank or len(self.devices)
+        self.arenas = [
+            DeviceArena(self.config.device_arena_bytes, d, self.config.alignment)
+            for d in self.devices
+        ]
+        self.tracer = GLOBAL_TRACER
+
+    def _arena(self, handle: OcmAlloc) -> DeviceArena:
+        if not 0 <= handle.device_index < self.devices_per_rank:
+            raise OcmInvalidHandle(
+                f"device_index {handle.device_index} out of range for "
+                f"{self.devices_per_rank} devices per rank"
+            )
+        g = global_index(handle.rank, handle.device_index, self.devices_per_rank)
+        if not 0 <= g < len(self.arenas):
+            raise OcmInvalidHandle(
+                f"handle addresses device {g} but only "
+                f"{len(self.arenas)} devices are attached"
+            )
+        return self.arenas[g]
+
+    # -- RemoteBackend data interface ------------------------------------
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        """One-sided write: host (or any device) -> owning chip's arena."""
+        arena = self._arena(handle)
+        with self.tracer.span("ici_put", nbytes=_nbytes(data)):
+            arena.write(handle.extent, data, offset)
+
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0) -> jax.Array:
+        """One-sided read from the owning chip's arena."""
+        arena = self._arena(handle)
+        with self.tracer.span("ici_get", nbytes=nbytes):
+            return arena.read(handle.extent, nbytes, offset)
+
+    def copy(
+        self,
+        dst: OcmAlloc,
+        src: OcmAlloc,
+        nbytes: int,
+        dst_offset: int = 0,
+        src_offset: int = 0,
+    ) -> None:
+        """Chip-to-chip extent copy. Same chip fuses on-device; different
+        chips ride ICI via device-to-device transfer, chunked with the
+        reference's pipeline scheme (8 MB x 2 in flight, extoll.c:47-51)."""
+        a_src, a_dst = self._arena(src), self._arena(dst)
+        with self.tracer.span("ici_copy", nbytes=nbytes):
+            if a_src is a_dst:
+                a_src.move(src.extent, dst.extent, nbytes, src_offset, dst_offset)
+                return
+            chunk = self.config.chunk_bytes
+            inflight: list[tuple[jax.Array, int]] = []
+            pos = 0
+            while pos < nbytes or inflight:
+                while pos < nbytes and len(inflight) < max(1, self.config.inflight_ops):
+                    n = min(chunk, nbytes - pos)
+                    piece = a_src.read(src.extent, n, src_offset + pos)
+                    # Async D2D transfer (ICI on TPU pods).
+                    moved = jax.device_put(piece, a_dst.device)
+                    inflight.append((moved, pos))
+                    pos += n
+                moved, at = inflight.pop(0)
+                a_dst.write(dst.extent, moved, dst_offset + at)
+
+    # -- typed helpers ----------------------------------------------------
+
+    def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0) -> jax.Array:
+        arena = self._arena(handle)
+        return arena.read_as(handle.extent, shape, dtype, offset)
+
+
+def _nbytes(data) -> int:
+    if isinstance(data, np.ndarray):
+        return data.nbytes
+    a = jnp.asarray(data)
+    return a.size * a.dtype.itemsize

@@ -1,0 +1,246 @@
+"""Pallas TPU kernels for the ICI data plane.
+
+True one-sided remote DMA between chips' HBM arenas — the TPU analogue of
+``ib_write``/``ib_read`` posting RDMA work requests to the NIC
+(/root/reference/src/rdma.c:47-85,241-263): the origin chip's DMA engine
+writes directly into the target chip's arena over ICI, tracked by send/recv
+semaphores (the completion-queue analogue of ``ib_poll``, rdma.c:267-302).
+
+Addressing granularity: the arena is viewed as ``(nblocks, 32, 128)`` uint8 —
+4096-byte blocks, each exactly one TPU int8 tile — because Mosaic requires
+dynamic HBM slice offsets to be provably tile-aligned; the leading block
+dimension is untiled, so dynamic block indices are free. ``OcmConfig.
+alignment = 4096`` guarantees every extent is whole blocks (the analogue of
+page-granular NIC registration, extoll_server.c:62 posix_memalign(4096)).
+
+These kernels require real TPU hardware; the portable CollectivePermute path
+lives in :mod:`oncilla_tpu.parallel.spmd_arena`.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import jax.experimental.pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from oncilla_tpu.parallel.mesh import NODE_AXIS
+
+BLOCK = 4096  # bytes per DMA-addressable block = one (32, 128) uint8 tile
+
+
+def _as_blocks(arena_row: jax.Array) -> jax.Array:
+    """(row_bytes,) uint8 -> (nblocks, 32, 128) block view."""
+    assert arena_row.shape[-1] % BLOCK == 0, "arena must be BLOCK-aligned"
+    return arena_row.reshape(-1, 32, 128)
+
+
+def _make_copy_kernel(nblocks: int):
+    """One-sided arena->arena copy of ``nblocks`` blocks.
+
+    meta = [me, src_dev, dst_dev, src_blk, dst_blk]; the output arena ref
+    aliases the input (in-place HBM update). Only the src and dst devices
+    act; every other device falls straight through.
+    """
+
+    def kernel(meta_ref, arena_in, arena_out, send_sem, recv_sem, local_sem):
+        del arena_in  # aliased with arena_out
+        me = meta_ref[0]
+        src_dev = meta_ref[1]
+        dst_dev = meta_ref[2]
+        src_blk = meta_ref[3]
+        dst_blk = meta_ref[4]
+
+        # Same-device fast path: local DMA, no ICI.
+        @pl.when(jnp.logical_and(me == src_dev, src_dev == dst_dev))
+        def _():
+            dma = pltpu.make_async_copy(
+                arena_out.at[pl.ds(src_blk, nblocks)],
+                arena_out.at[pl.ds(dst_blk, nblocks)],
+                local_sem,
+            )
+            dma.start()
+            dma.wait()
+
+        def rdma():
+            return pltpu.make_async_remote_copy(
+                src_ref=arena_out.at[pl.ds(src_blk, nblocks)],
+                dst_ref=arena_out.at[pl.ds(dst_blk, nblocks)],
+                send_sem=send_sem,
+                recv_sem=recv_sem,
+                device_id=dst_dev,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        # Origin: post the remote DMA (ib_write analogue), await local send
+        # completion (tx half of ib_poll).
+        @pl.when(jnp.logical_and(me == src_dev, src_dev != dst_dev))
+        def _():
+            d = rdma()
+            d.start()
+            d.wait_send()
+
+        # Target: block until the bytes landed (rx half of ib_poll).
+        @pl.when(jnp.logical_and(me == dst_dev, src_dev != dst_dev))
+        def _():
+            rdma().wait_recv()
+
+    return kernel
+
+
+def _make_copy_call(nblocks: int, row_blocks: int):
+    return pl.pallas_call(
+        _make_copy_kernel(nblocks),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA(()),   # send
+                pltpu.SemaphoreType.DMA(()),   # recv
+                pltpu.SemaphoreType.DMA(()),   # same-device local DMA
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((row_blocks, 32, 128), jnp.uint8),
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )
+
+
+def pallas_supported(offset_a: int, offset_b: int, nbytes: int) -> bool:
+    return (
+        offset_a % BLOCK == 0 and offset_b % BLOCK == 0 and
+        nbytes % BLOCK == 0 and nbytes > 0
+    )
+
+
+def pallas_ici_copy(
+    arena: jax.Array,
+    src_dev,
+    dst_dev,
+    src_off,
+    dst_off,
+    nbytes: int,
+    *,
+    mesh,
+) -> jax.Array:
+    """Copy ``nbytes`` (BLOCK-aligned, as are the offsets) from device
+    src_dev's arena row to dst_dev's over ICI. Device ids and offsets are
+    dynamic scalars — one compiled executable serves every route, unlike
+    the ppermute path's static routes (EXTOLL-style connectionless
+    addressing, SURVEY.md §7)."""
+    row_bytes = arena.shape[-1]
+    assert pallas_supported(int(src_off), int(dst_off), nbytes), (
+        "pallas path needs BLOCK-aligned offsets/size; use spmd_arena."
+        "ici_copy which falls back to the ppermute path"
+    )
+    fn = _cached_ici_copy(nbytes // BLOCK, row_bytes, mesh)
+    return fn(
+        arena,
+        jnp.int32(src_dev),
+        jnp.int32(dst_dev),
+        jnp.int32(src_off // BLOCK),
+        jnp.int32(dst_off // BLOCK),
+    )
+
+
+@lru_cache(maxsize=256)
+def _cached_ici_copy(nblocks: int, row_bytes: int, mesh):
+    """One compiled executable per (transfer size, arena size, mesh); device
+    ids and offsets stay dynamic, so every route shares it."""
+    row_blocks = row_bytes // BLOCK
+
+    def shard_fn(arena_shard, s_dev, d_dev, s_blk, d_blk):
+        me = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32)
+        meta = jnp.stack([me, s_dev, d_dev, s_blk, d_blk])
+        blocks = _as_blocks(arena_shard[0])
+        out = _make_copy_call(nblocks, row_blocks)(meta, blocks)
+        return out.reshape(1, row_bytes)
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(NODE_AXIS, None), P(), P(), P(), P()),
+            out_specs=P(NODE_AXIS, None),
+            check_vma=False,
+        ),
+        donate_argnums=0,
+    )
+
+
+# -- single-chip HBM->HBM copy kernel (bench + local fast path) -----------
+
+
+def _make_local_copy_kernel(nblocks: int):
+    def kernel(meta_ref, buf_in, buf_out, sems):
+        """The DMA engine copies HBM->HBM directly; two overlapped
+        descriptors pipeline the transfer (the extoll.c:44-51 two-in-flight
+        scheme on-chip)."""
+        del buf_in
+        src_blk = meta_ref[0]
+        dst_blk = meta_ref[1]
+        half = max(nblocks // 2, 1)
+        rest = nblocks - half
+
+        dma0 = pltpu.make_async_copy(
+            buf_out.at[pl.ds(src_blk, half)],
+            buf_out.at[pl.ds(dst_blk, half)],
+            sems.at[0],
+        )
+        dma0.start()
+        if rest:
+            dma1 = pltpu.make_async_copy(
+                buf_out.at[pl.ds(src_blk + half, rest)],
+                buf_out.at[pl.ds(dst_blk + half, rest)],
+                sems.at[1],
+            )
+            dma1.start()
+            dma0.wait()
+            dma1.wait()
+        else:
+            dma0.wait()
+
+    return kernel
+
+
+def pallas_local_copy(buf: jax.Array, src_off, dst_off, nbytes: int) -> jax.Array:
+    """In-place HBM extent copy on one chip via overlapped DMA descriptors.
+    Offsets and size must be BLOCK-aligned and the ranges must not overlap
+    (a raw DMA over overlapping ranges reads undefined bytes)."""
+    assert pallas_supported(int(src_off), int(dst_off), nbytes)
+    assert (
+        int(src_off) + nbytes <= int(dst_off)
+        or int(dst_off) + nbytes <= int(src_off)
+    ), "overlapping ranges are unsafe for raw DMA; use DeviceArena.move"
+    total = buf.shape[-1]
+    meta = jnp.stack([jnp.int32(src_off // BLOCK), jnp.int32(dst_off // BLOCK)])
+    return _cached_local_copy(nbytes // BLOCK, total)(meta, buf)
+
+
+@lru_cache(maxsize=256)
+def _cached_local_copy(nblocks: int, total: int):
+    call = pl.pallas_call(
+        _make_local_copy_kernel(nblocks),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        ),
+        out_shape=jax.ShapeDtypeStruct((total // BLOCK, 32, 128), jnp.uint8),
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )
+
+    def run(meta, b):
+        out = call(meta, b.reshape(-1, 32, 128))
+        return out.reshape(total)
+
+    return jax.jit(run, donate_argnums=1)

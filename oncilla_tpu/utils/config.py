@@ -30,7 +30,10 @@ class OcmConfig:
     device_arena_bytes: int = field(
         default_factory=lambda: _env_int("OCM_DEVICE_ARENA_BYTES", 128 << 20)
     )
-    alignment: int = 512
+    # 4096 = the Pallas data-plane block (one (32,128) uint8 tile): extents
+    # aligned to it let the remote-DMA kernels address HBM by whole blocks
+    # (Mosaic cannot prove arbitrary dynamic byte offsets tile-aligned).
+    alignment: int = 4096
 
     # Control plane. The reference's daemon listens on the nodefile's
     # ocm_port; per-allocation IB ports came from a counter at 67980

@@ -1,0 +1,147 @@
+"""ICI data-plane tests on the 8-device virtual mesh: REMOTE_DEVICE
+allocations through the control plane with data riding the device fabric —
+the end-to-end slice of SURVEY.md §7 step 3 (ocm_test tests 1-3 for the
+device arm), plus the SpmdArena in-mesh fabric."""
+
+import jax
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.ops.ici import IciDataPlane
+from oncilla_tpu.parallel import spmd_arena as sa
+from oncilla_tpu.parallel.mesh import node_mesh
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def cfg(**kw):
+    d = dict(
+        host_arena_bytes=4 << 20,
+        device_arena_bytes=2 << 20,
+        heartbeat_s=0.5,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+@pytest.fixture
+def cluster2x4():
+    # 2 "hosts" x 4 chips over the 8 virtual devices.
+    c = OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=2 << 20)
+    with local_cluster(2, config=c, ndevices=4) as cl:
+        plane = IciDataPlane(config=c, devices=jax.devices(), devices_per_rank=4)
+        yield cl, plane
+
+
+def test_remote_device_put_get_roundtrip(cluster2x4, rng):
+    cl, plane = cluster2x4
+    ctx = cl.context(0, ici_plane=plane)
+    h = ctx.alloc(256 << 10, OcmKind.REMOTE_DEVICE)
+    assert h.rank == 1  # placed off-origin
+    data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+    ctx.put(h, data)
+    out = np.asarray(ctx.get(h))
+    np.testing.assert_array_equal(out, data)
+    # Bytes physically live in the owner chip's arena at the handle's extent.
+    from oncilla_tpu.parallel.mesh import global_index
+
+    g = global_index(h.rank, h.device_index, 4)
+    row = np.asarray(plane.arenas[g].read(h.extent, 256 << 10))
+    np.testing.assert_array_equal(row, data)
+    ctx.free(h)
+
+
+def test_remote_device_typed(cluster2x4):
+    import jax.numpy as jnp
+
+    cl, plane = cluster2x4
+    client = cl.client(0, ici_plane=plane)
+    h = client.alloc(4 * 1024, OcmKind.REMOTE_DEVICE)
+    x = jnp.arange(1024, dtype=jnp.float32)
+    client.put(h, x, 0)
+    y = plane.get_as(h, (1024,), jnp.float32)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    client.free(h)
+
+
+def test_ici_copy_chip_to_chip(cluster2x4, rng):
+    cl, plane = cluster2x4
+    ctx = cl.context(0, ici_plane=plane)
+    ctx1 = cl.context(1, ici_plane=plane)
+    h0 = ctx1.alloc(128 << 10, OcmKind.REMOTE_DEVICE)  # on rank 0 devices
+    h1 = ctx.alloc(128 << 10, OcmKind.REMOTE_DEVICE)   # on rank 1 devices
+    assert (h0.rank, h1.rank) == (0, 1)
+    data = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+    plane.put(h0, data)
+    plane.copy(h1, h0, 128 << 10)
+    np.testing.assert_array_equal(np.asarray(plane.get(h1, 128 << 10)), data)
+    ctx.free(h1)
+    ctx1.free(h0)
+
+
+def test_device_arm_needs_ici_plane(cluster2x4):
+    cl, _ = cluster2x4
+    client = cl.client(0)  # no plane
+    h = client.alloc(4096, OcmKind.REMOTE_DEVICE)
+    with pytest.raises(ocm.OcmInvalidHandle, match="ICI plane"):
+        client.put(h, np.zeros(16, np.uint8), 0)
+    client.free(h)
+
+
+# -- SpmdArena: the in-mesh fabric ---------------------------------------
+
+
+def test_spmd_arena_host_put_get(rng):
+    mesh = node_mesh()
+    arena = sa.make_arena(mesh, 64 << 10)
+    data = rng.integers(0, 256, 4096, dtype=np.uint8)
+    arena = sa.host_put(arena, 3, data, 8192, mesh=mesh)
+    got = np.asarray(sa.host_get(arena, 3, 4096, 8192, mesh=mesh))
+    np.testing.assert_array_equal(got, data)
+    # Other rows untouched.
+    assert not np.any(np.asarray(sa.host_get(arena, 2, 4096, 8192, mesh=mesh)))
+
+
+def test_spmd_arena_ici_copy(rng):
+    mesh = node_mesh()
+    arena = sa.make_arena(mesh, 64 << 10)
+    data = rng.integers(0, 256, 4096, dtype=np.uint8)
+    arena = sa.host_put(arena, 1, data, 0, mesh=mesh)
+    arena = sa.ici_copy(arena, 1, 6, 0, 4096, 4096, mesh=mesh, use_pallas=False)
+    got = np.asarray(sa.host_get(arena, 6, 4096, 4096, mesh=mesh))
+    np.testing.assert_array_equal(got, data)
+    # Source intact, sharding preserved.
+    np.testing.assert_array_equal(
+        np.asarray(sa.host_get(arena, 1, 4096, 0, mesh=mesh)), data
+    )
+    assert "node" in str(arena.sharding.spec)
+
+
+def test_spmd_arena_ring_shift():
+    mesh = node_mesh()
+    d = mesh.devices.size
+    arena = sa.make_arena(mesh, 8 << 10)
+    for i in range(d):
+        arena = sa.host_put(arena, i, np.full(512, i, np.uint8), 0, mesh=mesh)
+    arena = sa.ring_shift(arena, 0, 512, mesh=mesh)
+    for i in range(d):
+        got = np.asarray(sa.host_get(arena, (i + 1) % d, 512, 0, mesh=mesh))
+        assert np.all(got == i)
+    # Reverse shift restores the original layout.
+    arena = sa.ring_shift(arena, 0, 512, mesh=mesh, reverse=True)
+    for i in range(d):
+        got = np.asarray(sa.host_get(arena, i, 512, 0, mesh=mesh))
+        assert np.all(got == i)
+
+
+def test_spmd_arena_read_typed(rng):
+    import jax.numpy as jnp
+
+    mesh = node_mesh()
+    arena = sa.make_arena(mesh, 64 << 10)
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    arena = sa.host_put(arena, 4, x, 4096, mesh=mesh)
+    y = sa.read_typed(arena, 4, (32, 16), jnp.float32, 4096, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(y), x)
