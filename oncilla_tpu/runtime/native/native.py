@@ -1,0 +1,72 @@
+"""Build/launch helpers for the native daemon (oncillamemd).
+
+The Python daemon (runtime/daemon.py) is the executable spec; oncillamemd is
+the production twin. Both speak the identical wire protocol, so
+ControlPlaneClient works unchanged against either.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).resolve().parent
+BUILD_DIR = NATIVE_DIR / "build"
+BINARY = BUILD_DIR / "oncillamemd"
+
+
+def build(force: bool = False, tsan: bool = False) -> Path:
+    """Build oncillamemd with CMake (+ Ninja when available); cached."""
+    target = BUILD_DIR / ("oncillamemd_tsan" if tsan else "oncillamemd")
+    if target.exists() and not force:
+        return target
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
+    if tsan:
+        cfg.append("-DOCM_TSAN=ON")
+    subprocess.run(cfg, check=True, capture_output=True)
+    subprocess.run(
+        ["cmake", "--build", str(BUILD_DIR)], check=True, capture_output=True
+    )
+    return target
+
+
+def spawn(
+    nodefile: str,
+    rank: int,
+    *,
+    policy: str = "capacity",
+    ndevices: int = 1,
+    host_arena_bytes: int | None = None,
+    device_arena_bytes: int | None = None,
+    lease_s: float | None = None,
+    heartbeat_s: float | None = None,
+    tsan: bool = False,
+    env: dict | None = None,
+) -> subprocess.Popen:
+    """Launch one native daemon process (``bin/oncillamem nodefile``
+    analogue)."""
+    binary = build(tsan=tsan)
+    cmd = [
+        str(binary),
+        "--nodefile", nodefile,
+        "--rank", str(rank),
+        "--policy", policy,
+        "--ndevices", str(ndevices),
+    ]
+    if host_arena_bytes is not None:
+        cmd += ["--host-arena-bytes", str(host_arena_bytes)]
+    if device_arena_bytes is not None:
+        cmd += ["--device-arena-bytes", str(device_arena_bytes)]
+    if lease_s is not None:
+        cmd += ["--lease-s", str(lease_s)]
+    if heartbeat_s is not None:
+        cmd += ["--heartbeat-s", str(heartbeat_s)]
+    return subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env={**os.environ, **(env or {})},
+    )

@@ -1,0 +1,201 @@
+"""Integration tests for the C++ daemon (oncillamemd): the identical client
+flows that run against the Python daemon, now against native processes —
+proving the wire protocol is one protocol, not two dialects."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.context import Ocm
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.native import native
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def binary():
+    try:
+        return native.build()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+
+
+@pytest.fixture
+def native_cluster(binary, tmp_path):
+    ports = _free_ports(2)
+    nodefile = tmp_path / "nodefile"
+    nodefile.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    kw = dict(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=8 << 20,
+        lease_s=30.0,
+        heartbeat_s=0.5,
+    )
+    procs = [native.spawn(str(nodefile), r, ndevices=2, **kw) for r in range(2)]
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    # Wait for both daemons to accept.
+    deadline = time.time() + 10
+    for e in entries:
+        while time.time() < deadline:
+            try:
+                socket.create_connection((e.host, e.port), timeout=0.5).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            for p in procs:
+                p.kill()
+            pytest.fail("native daemon did not come up")
+    cfg = OcmConfig(chunk_bytes=256 << 10, heartbeat_s=0.2, **{
+        k: v for k, v in kw.items() if k in ("host_arena_bytes", "device_arena_bytes")
+    })
+    yield entries, cfg
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except Exception:  # noqa: BLE001
+            p.kill()
+
+
+def test_native_connect_and_status(native_cluster):
+    entries, cfg = native_cluster
+    client = ControlPlaneClient(entries, 0, config=cfg)
+    assert client.nnodes == 2
+    st = client.status()
+    assert st["rank"] == 0 and st["nnodes"] == 2 and st["live_allocs"] == 0
+    client.close()
+
+
+def test_native_remote_host_roundtrip(native_cluster, rng):
+    entries, cfg = native_cluster
+    client = ControlPlaneClient(entries, 0, config=cfg)
+    ctx = Ocm(config=cfg, remote=client)
+    h = ctx.alloc(2 << 20, OcmKind.REMOTE_HOST)
+    assert h.is_remote and h.rank == 1
+    data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+    ctx.put(h, data)  # multi-chunk pipelined path
+    np.testing.assert_array_equal(ctx.get(h), data)
+    # offsets
+    ctx.put(h, data[:4096], offset=8192)
+    np.testing.assert_array_equal(ctx.get(h, 4096, offset=8192), data[:4096])
+    st = client.status(rank=1)
+    assert st["live_allocs"] == 1 and st["host_bytes_live"] >= 2 << 20
+    ctx.free(h)
+    assert client.status(rank=1)["live_allocs"] == 0
+    client.close()
+
+
+def test_native_device_bookkeeping_and_demotion(native_cluster):
+    entries, cfg = native_cluster
+    client = ControlPlaneClient(entries, 0, config=cfg)
+    h = client.alloc(1 << 20, OcmKind.REMOTE_DEVICE)
+    assert h.kind == OcmKind.REMOTE_DEVICE and h.rank == 1
+    st = client.status(rank=1)
+    assert st["device_bytes_live"] >= 1 << 20
+    client.free(h)
+    assert client.status(rank=1)["device_bytes_live"] == 0
+    client.close()
+
+
+def test_native_errors_typed(native_cluster):
+    from oncilla_tpu.runtime.protocol import ErrCode
+
+    entries, cfg = native_cluster
+    client = ControlPlaneClient(entries, 0, config=cfg)
+    h = client.alloc(4096, OcmKind.REMOTE_HOST)
+    # bounds
+    try:
+        client.put(h, np.zeros(8192, np.uint8), 0)
+        raise AssertionError("expected bounds error")
+    except ocm.OcmError as e:
+        assert getattr(e, "code", None) == int(ErrCode.BOUNDS)
+    # oom
+    with pytest.raises(ocm.OcmError, match="fit|OOM"):
+        client.alloc(64 << 20, OcmKind.REMOTE_HOST)
+    # double free -> BAD_ALLOC_ID
+    client.free(h)
+    with pytest.raises(ocm.OcmProtocolError, match="unknown alloc_id"):
+        client.free(h)
+    # garbage frame does not kill the daemon
+    s = socket.create_connection((entries[0].host, entries[0].port))
+    s.sendall(b"NOT A VALID FRAME AT ALL")
+    s.close()
+    assert client.status()["rank"] == 0
+    client.close()
+
+
+def test_native_pipelined_error_does_not_desync(native_cluster, rng):
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=1024,
+    )
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    h = client.alloc(16 << 10, OcmKind.REMOTE_HOST)
+    with pytest.raises(ocm.OcmError):
+        client.put(h, np.zeros(8 << 10, np.uint8), 12 << 10)
+    data = rng.integers(0, 256, 8 << 10, dtype=np.uint8)
+    client.put(h, data, 0)
+    np.testing.assert_array_equal(client.get(h, 8 << 10, 0), data)
+    client.free(h)
+    client.close()
+
+
+def test_native_lease_reaping(binary, tmp_path):
+    ports = _free_ports(2)
+    nodefile = tmp_path / "nf"
+    nodefile.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    procs = [
+        native.spawn(
+            str(nodefile), r,
+            host_arena_bytes=8 << 20, device_arena_bytes=8 << 20,
+            lease_s=0.5, heartbeat_s=0.1,
+        )
+        for r in range(2)
+    ]
+    try:
+        entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+        deadline = time.time() + 10
+        for e in entries:
+            while time.time() < deadline:
+                try:
+                    socket.create_connection((e.host, e.port), timeout=0.5).close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+        client = ControlPlaneClient(entries, 0, heartbeat=False)
+        client.alloc(4096, OcmKind.REMOTE_HOST)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if client.status(rank=1)["live_allocs"] == 0:
+                break
+            time.sleep(0.1)
+        assert client.status(rank=1)["live_allocs"] == 0
+        client.close()
+    finally:
+        for p in procs:
+            p.kill()
