@@ -1,0 +1,195 @@
+"""oncilla-tpu benchmark: the alloc + one-sided put/get loop on real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+What runs (adapted to the hardware available — a single chip; BASELINE.md's
+north star is the same loop across a v5p-16 over ICI, which needs multi-chip
+hardware this environment does not expose):
+
+1. p50 ``ocm_alloc`` latency (the control-path metric in BASELINE.json).
+2. HBM arena copy bandwidth: extent-to-extent one-sided copies inside the
+   chip's arena, measured two ways — the XLA path (donated
+   dynamic-slice/update) and the Pallas DMA-engine kernel
+   (oncilla_tpu/ops/pallas_ici.py) — iterated inside one compiled program
+   so the (tunneled) dispatch latency is amortized out. The better of the
+   two is reported.
+
+``vs_baseline`` = value / (0.80 * 819 GB/s): the reference publishes no
+numbers (BASELINE.md), so the target transplanted from the north star
+("≥80 % of line rate") is 80 % of the v5e chip's 819 GB/s HBM bandwidth —
+a copy touches each byte twice (read + write), so we credit 2·nbytes of
+HBM traffic per copy.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+
+V5E_HBM_GBPS = 819.0
+TARGET = 0.80 * V5E_HBM_GBPS
+
+ARENA = 256 << 20
+NBYTES = 64 << 20   # per copy
+ITERS = 2000        # copies per timed program (amortizes the
+                    # remote-dispatch latency of the dev tunnel)
+BLOCK = 4096
+
+
+def bench_alloc_p50(ctx, n=2000) -> float:
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        h = ctx.alloc(1 << 20, OcmKind.LOCAL_DEVICE)
+        ts.append(time.perf_counter() - t0)
+        ctx.free(h)
+    return sorted(ts)[n // 2] * 1e6
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2))
+def _xla_copy_loop(buf, nbytes, iters):
+    # Alternate directions so no iteration is redundant.
+    def body(i, b):
+        src = jnp.where(i % 2 == 0, 0, nbytes)
+        dst = jnp.where(i % 2 == 0, nbytes, 0)
+        chunk = jax.lax.dynamic_slice(b, (src,), (nbytes,))
+        return jax.lax.dynamic_update_slice(b, chunk, (dst,))
+
+    return jax.lax.fori_loop(0, iters, body, buf)
+
+
+def _sync(b) -> None:
+    """Force completion. block_until_ready alone does not reliably block on
+    the tunneled dev platform; a readback of the producing op does."""
+    np.asarray(jax.device_get(b.reshape(-1)[:8]))
+
+
+def bench_xla_copy(buf) -> tuple[float, jax.Array]:
+    xla_iters = ITERS // 4  # the XLA path is slower; keep wall time bounded
+    buf = _xla_copy_loop(buf, NBYTES, 2)  # warm up / compile
+    _sync(buf)
+    buf = _xla_copy_loop(buf, NBYTES, xla_iters)
+    _sync(buf)
+    t0 = time.perf_counter()
+    buf = _xla_copy_loop(buf, NBYTES, xla_iters)
+    _sync(buf)
+    dt = time.perf_counter() - t0
+    return 2.0 * NBYTES * xla_iters / dt / 1e9, buf
+
+
+def _pallas_copy_loop(total_bytes, nbytes, iters):
+    """A ping-pong extent copy iterated inside one kernel: two overlapped
+    DMA descriptors per copy (the extoll.c:44-51 scheme on the on-chip DMA
+    engine)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nblocks = nbytes // BLOCK
+
+    def kernel(buf_in, buf_out, sems):
+        del buf_in
+
+        def body(i, _):
+            fwd = i % 2 == 0
+            src = jnp.where(fwd, 0, nblocks)
+            dst = jnp.where(fwd, nblocks, 0)
+            half = nblocks // 2
+            d0 = pltpu.make_async_copy(
+                buf_out.at[pl.ds(src, half)],
+                buf_out.at[pl.ds(dst, half)],
+                sems.at[0],
+            )
+            d1 = pltpu.make_async_copy(
+                buf_out.at[pl.ds(src + half, nblocks - half)],
+                buf_out.at[pl.ds(dst + half, nblocks - half)],
+                sems.at[1],
+            )
+            d0.start()
+            d1.start()
+            d0.wait()
+            d1.wait()
+            return 0
+
+        jax.lax.fori_loop(0, iters, body, 0)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        out_shape=jax.ShapeDtypeStruct((total_bytes // BLOCK, 32, 128), jnp.uint8),
+        input_output_aliases={0: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )
+
+    def run(b):
+        out = call(b.reshape(-1, 32, 128))
+        return out.reshape(total_bytes)
+
+    return jax.jit(run, donate_argnums=0)
+
+
+def bench_pallas_copy(buf) -> tuple[float, jax.Array]:
+    run_warm = _pallas_copy_loop(buf.shape[0], NBYTES, 2)
+    run = _pallas_copy_loop(buf.shape[0], NBYTES, ITERS)
+    buf = run_warm(buf)
+    _sync(buf)
+    buf = run(buf)
+    _sync(buf)
+    t0 = time.perf_counter()
+    buf = run(buf)
+    _sync(buf)
+    dt = time.perf_counter() - t0
+    return 2.0 * NBYTES * ITERS / dt / 1e9, buf
+
+
+def main() -> None:
+    cfg = ocm.OcmConfig(
+        host_arena_bytes=1 << 20, device_arena_bytes=ARENA
+    )
+    ctx = ocm.ocm_init(cfg)
+    p50_us = bench_alloc_p50(ctx)
+
+    # Stamp a pattern so copies move real data.
+    h = ctx.alloc(2 * NBYTES, OcmKind.LOCAL_DEVICE)
+    ctx.put(h, np.arange(NBYTES, dtype=np.uint8), 0)
+    buf = ctx.device_arenas[0].buffer
+
+    xla_gbps, buf = bench_xla_copy(buf)
+    try:
+        pallas_gbps, buf = bench_pallas_copy(buf)
+    except Exception:  # noqa: BLE001 — pallas path needs real TPU
+        pallas_gbps = 0.0
+
+    gbps = max(xla_gbps, pallas_gbps)
+    print(
+        json.dumps(
+            {
+                "metric": "ocm alloc+copy loop: single-chip HBM arena copy "
+                "bandwidth (2x bytes, read+write)",
+                "value": round(gbps, 2),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / TARGET, 4),
+                "detail": {
+                    "xla_gbps": round(xla_gbps, 2),
+                    "pallas_gbps": round(pallas_gbps, 2),
+                    "alloc_p50_us": round(p50_us, 2),
+                    "copy_nbytes": NBYTES,
+                    "target_gbps": TARGET,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""KV-cache paging through OCM handles: long-context decode whose KV pages
+live anywhere in the pod — local HBM, a *remote* chip's HBM (ICI fabric), or
+remote host DRAM (DCN fabric) — BASELINE.md config 5.
+
+The decode working set stays small: a local tail window of the KV cache plus
+a list of opaque OCM handles for completed pages. Attention over the full
+context fetches pages back through the data plane. This is exactly the
+reference's usage pattern (allocate remote, fill with ocm put, read back
+with ocm get — test/ocm_test.c test 2) with a transformer as the
+application.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.hbm import from_bytes, to_bytes
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.models.llama import LlamaConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER
+
+
+@dataclass
+class PagedKVCache:
+    """KV pages for one decode session.
+
+    ``backend`` is anything with alloc/free/put/get — an :class:`Ocm`
+    context (local arms) or a :class:`ControlPlaneClient` (remote arms).
+    Page layout: both K and V of one page are packed into a single
+    allocation: (2, L, B, KV, page_tokens, Hd) bitcast to bytes.
+    """
+
+    backend: object
+    cfg: LlamaConfig
+    batch: int
+    page_tokens: int = 128
+    kind: OcmKind = OcmKind.REMOTE_DEVICE
+    dtype: str = "float32"
+    pages: list[OcmAlloc] = field(default_factory=list)
+
+    @property
+    def page_shape(self) -> tuple:
+        c = self.cfg
+        return (2, c.n_layers, self.batch, c.n_kv_heads, self.page_tokens,
+                c.head_dim)
+
+    @property
+    def page_bytes(self) -> int:
+        return int(np.prod(self.page_shape)) * jnp.dtype(self.dtype).itemsize
+
+    @property
+    def tokens_paged(self) -> int:
+        return len(self.pages) * self.page_tokens
+
+    def store_page(self, k_page: jax.Array, v_page: jax.Array) -> OcmAlloc:
+        """Ship one completed page into the pod (one-sided put). k/v:
+        (L, B, KV, page_tokens, Hd)."""
+        packed = jnp.stack([k_page, v_page]).astype(jnp.dtype(self.dtype))
+        assert packed.shape == self.page_shape, (packed.shape, self.page_shape)
+        with GLOBAL_TRACER.span("kv_store_page", nbytes=self.page_bytes):
+            h = self.backend.alloc(self.page_bytes, self.kind)
+            self.backend.put(h, to_bytes(packed), 0)
+        self.pages.append(h)
+        return h
+
+    def fetch_pages(self) -> tuple[jax.Array, jax.Array] | None:
+        """Gather every page back (one-sided gets) and concatenate along the
+        token axis: (L, B, KV, tokens_paged, Hd) x2."""
+        if not self.pages:
+            return None
+        ks, vs = [], []
+        with GLOBAL_TRACER.span(
+            "kv_fetch_pages", nbytes=self.page_bytes * len(self.pages)
+        ):
+            for h in self.pages:
+                raw = self.backend.get(h, self.page_bytes, 0)
+                packed = from_bytes(
+                    jnp.asarray(np.asarray(raw)), self.page_shape, self.dtype
+                )
+                ks.append(packed[0])
+                vs.append(packed[1])
+        return jnp.concatenate(ks, axis=3), jnp.concatenate(vs, axis=3)
+
+    def free(self) -> None:
+        for h in self.pages:
+            self.backend.free(h)
+        self.pages.clear()
+
+
+def paged_decode_step(
+    params: dict,
+    token: jax.Array,
+    pos: int,
+    k_ctx: jax.Array | None,
+    v_ctx: jax.Array | None,
+    cfg: LlamaConfig,
+):
+    """Decode one token attending over the full valid context.
+
+    k_ctx/v_ctx: (L, B, KV, T, Hd) — paged pages + local tail concatenated,
+    containing exactly the T = ``pos`` valid entries (no masking needed);
+    None when pos == 0. Returns (logits, (new_k, new_v)) where new_k/new_v
+    are this token's (L, B, KV, 1, Hd) cache entries.
+    """
+    from oncilla_tpu.models import llama
+
+    B = token.shape[0]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
+    positions = jnp.asarray([pos])
+    new_k, new_v = [], []
+
+    for i in range(cfg.n_layers):
+        lp = {
+            key: params[key][i]
+            for key in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                        "ln_attn", "ln_mlp")
+        }
+        h = llama.rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, 1, H, Hd)
+        kn = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, 1, KV, Hd)
+        vn = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, 1, KV, Hd)
+        q = llama.rope(q.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+        kn = llama.rope(kn.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+        vn = vn.transpose(0, 2, 1, 3)
+        new_k.append(kn)
+        new_v.append(vn)
+
+        if k_ctx is not None:
+            k_all = jnp.concatenate(
+                [k_ctx[i].astype(x.dtype), kn.astype(x.dtype)], axis=2
+            )
+            v_all = jnp.concatenate(
+                [v_ctx[i].astype(x.dtype), vn.astype(x.dtype)], axis=2
+            )
+        else:
+            k_all, v_all = kn.astype(x.dtype), vn.astype(x.dtype)
+        k_rep = llama._repeat_kv(k_all, H // KV)
+        v_rep = llama._repeat_kv(v_all, H // KV)
+        scale = 1.0 / np.sqrt(Hd)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_rep).astype(jnp.float32) * scale
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", p, v_rep)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, H * Hd)
+        x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
+
+        h = llama.rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+
+    x = llama.rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0], (jnp.stack(new_k), jnp.stack(new_v))
+
+
+class PagedDecoder:
+    """A decode session whose KV history pages out through OCM.
+
+    The local working set is one page of tail KV; every ``page_tokens``
+    steps the tail ships into the pod (remote chip HBM / remote host DRAM
+    per ``kind``) and decode continues against fetched pages + fresh tail —
+    the Llama-KV-cache-in-remote-pod-HBM loop of BASELINE.md config 5.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: LlamaConfig,
+        backend,
+        batch: int = 1,
+        page_tokens: int = 16,
+        kind: OcmKind = OcmKind.REMOTE_DEVICE,
+        dtype: str = "float32",
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.cache = PagedKVCache(
+            backend, cfg, batch, page_tokens, kind, dtype
+        )
+        self.page_tokens = page_tokens
+        self.pos = 0
+        self._tail_k: list = []  # per-step (L, B, KV, 1, Hd)
+        self._tail_v: list = []
+        self._fetched = None  # cached fetch of paged context
+
+    def _context(self):
+        parts_k, parts_v = [], []
+        if self.cache.pages:
+            if self._fetched is None:
+                self._fetched = self.cache.fetch_pages()
+            parts_k.append(self._fetched[0])
+            parts_v.append(self._fetched[1])
+        if self._tail_k:
+            parts_k.append(jnp.concatenate(self._tail_k, axis=3))
+            parts_v.append(jnp.concatenate(self._tail_v, axis=3))
+        if not parts_k:
+            return None, None
+        return (
+            jnp.concatenate(parts_k, axis=3),
+            jnp.concatenate(parts_v, axis=3),
+        )
+
+    def step(self, token: jax.Array) -> jax.Array:
+        k_ctx, v_ctx = self._context()
+        logits, (nk, nv) = paged_decode_step(
+            self.params, token, self.pos, k_ctx, v_ctx, self.cfg
+        )
+        self._tail_k.append(nk)
+        self._tail_v.append(nv)
+        self.pos += 1
+        if len(self._tail_k) == self.page_tokens:
+            # Ship the full tail into the pod; invalidate the fetch cache.
+            k_page = jnp.concatenate(self._tail_k, axis=3)
+            v_page = jnp.concatenate(self._tail_v, axis=3)
+            self.cache.store_page(k_page, v_page)
+            self._tail_k, self._tail_v = [], []
+            self._fetched = None
+        return logits
+
+    def close(self) -> None:
+        self.cache.free()

@@ -1,0 +1,269 @@
+"""Flagship model: a Llama-style decoder-only transformer, pure JAX.
+
+TPU-first design notes:
+- All matmuls are einsums over (dim, heads*head_dim)-shaped weights so GSPMD
+  can shard heads/ffn over the ``tp`` mesh axis and batch over ``dp``.
+- Attention optionally runs as ring attention over a ``sp`` sequence axis
+  (:mod:`oncilla_tpu.parallel.ring_attention`) for long-context training.
+- bfloat16 activations by default (MXU-native), fp32 RMSNorm accumulation.
+- Decode uses a KV cache that can be paged into OCM arenas — local or
+  *remote* chips' HBM — via :mod:`oncilla_tpu.models.kv_paging`
+  (BASELINE.md config 5).
+
+This is demo/benchmark cargo for the disaggregated-memory runtime (the
+reference is not an ML framework — SURVEY.md §0); it exists to exercise the
+OCM data planes with a real workload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    ffn_hidden: int = 1408
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        """CI-size config for the virtual CPU mesh."""
+        return LlamaConfig(
+            vocab=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_hidden=128, max_seq=128, dtype="float32",
+        )
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        """Llama-3-8B geometry (BASELINE.md config 5)."""
+        return LlamaConfig(
+            vocab=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_hidden=14336, max_seq=8192, rope_theta=500000.0,
+        )
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Scaled-normal init; layers stacked along a leading axis so the whole
+    model is a handful of leaves (scan-friendly, sharding-friendly)."""
+    k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
+    dt = jnp.dtype(cfg.dtype)
+    L, D, H, KV, Hd, F = (
+        cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.ffn_hidden,
+    )
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dt)
+
+    ks = jax.random.split(k_attn, 4)
+    km = jax.random.split(k_mlp, 3)
+    s_in = 1.0 / np.sqrt(D)
+    s_out = 1.0 / np.sqrt(2 * L * D)
+    return {
+        "embed": norm(k_emb, (cfg.vocab, D), 1.0),
+        "wq": norm(ks[0], (L, D, H * Hd), s_in),
+        "wk": norm(ks[1], (L, D, KV * Hd), s_in),
+        "wv": norm(ks[2], (L, D, KV * Hd), s_in),
+        "wo": norm(ks[3], (L, H * Hd, D), s_out),
+        "w_gate": norm(km[0], (L, D, F), s_in),
+        "w_up": norm(km[1], (L, D, F), s_in),
+        "w_down": norm(km[2], (L, F, D), s_out),
+        "ln_attn": jnp.ones((L, D), dtype=jnp.float32),
+        "ln_mlp": jnp.ones((L, D), dtype=jnp.float32),
+        "ln_out": jnp.ones((D,), dtype=jnp.float32),
+        "lm_head": norm(k_out, (D, cfg.vocab), s_in),
+    }
+
+
+def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * w).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: (B, H, S, Hd); positions: (S,) or (B, S)."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    if positions.ndim == 1:
+        ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (S, hd/2)
+        ang = ang[None, None]
+    else:
+        ang = positions[:, None, :, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _dense_causal_attention(q, k, v):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    S, T = q.shape[2], k.shape[2]
+    # Causal for the self-attention case; for decode (S=1, T=cache) the
+    # caller masks by valid length instead.
+    mask = jnp.tril(jnp.ones((S, T), dtype=bool), k=T - S)
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=1)
+
+
+def _layer(cfg: LlamaConfig, x, lp, positions, attn_fn):
+    """One transformer block. x: (B, S, D); lp: this layer's param slice."""
+    B, S, D = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, H, Hd)
+    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, KV, Hd)
+    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, KV, Hd)
+    q = rope(q.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+    k = rope(k.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+    v = v.transpose(0, 2, 1, 3)
+    k = _repeat_kv(k, H // KV)
+    v = _repeat_kv(v, H // KV)
+    attn = attn_fn(q, k, v)  # (B, H, S, Hd)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
+    x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
+
+    h = rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+    x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    return x
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    mesh=None,
+    seq_axis: str | None = None,
+) -> jax.Array:
+    """Logits for a token batch (B, S). With ``mesh`` + ``seq_axis``,
+    attention runs as ring attention over the sequence-sharded axis."""
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    positions = jnp.arange(S)
+
+    if seq_axis is not None:
+        from oncilla_tpu.parallel.ring_attention import ring_attention
+
+        def attn_fn(q, k, v):
+            return ring_attention(q, k, v, mesh, axis_name=seq_axis, causal=True)
+    else:
+        attn_fn = _dense_causal_attention
+
+    lparams = {k: params[k] for k in (
+        "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln_attn", "ln_mlp"
+    )}
+    # Python loop over layers (L is small; keeps per-layer sharding simple
+    # and lets ring attention's shard_map nest cleanly).
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], lparams)
+        x = _layer(cfg, x, lp, positions, attn_fn)
+
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig, **kw) -> jax.Array:
+    """Next-token cross entropy."""
+    logits = forward(params, tokens, cfg, **kw)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# -- decode-time attention over a KV cache --------------------------------
+
+
+def decode_step(
+    params: dict,
+    token: jax.Array,         # (B,) current token ids
+    pos: jax.Array,           # scalar current position
+    kv_cache: tuple,          # (k, v) each (L, B, KV, max_seq, Hd)
+    cfg: LlamaConfig,
+):
+    """Single-token decode: returns (logits, new_kv_cache). The cache layout
+    is the one :mod:`oncilla_tpu.models.kv_paging` pages through OCM."""
+    B = token.shape[0]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))  # (B,1,D)
+    k_cache, v_cache = kv_cache
+    positions = pos[None] if pos.ndim == 0 else pos
+
+    for i in range(cfg.n_layers):
+        lp = {
+            key: params[key][i]
+            for key in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                        "ln_attn", "ln_mlp")
+        }
+        h = rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, 1, H, Hd)
+        kn = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, 1, KV, Hd)
+        vn = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, 1, KV, Hd)
+        q = rope(q.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+        kn = rope(kn.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+        vn = vn.transpose(0, 2, 1, 3)
+
+        # Append to the cache at `pos`.
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, kn[None].astype(k_cache.dtype), (i, 0, 0, pos, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, vn[None].astype(v_cache.dtype), (i, 0, 0, pos, 0)
+        )
+        k_all = _repeat_kv(k_cache[i].astype(x.dtype), H // KV)  # (B,H,T,Hd)
+        v_all = _repeat_kv(v_cache[i].astype(x.dtype), H // KV)
+
+        scale = 1.0 / np.sqrt(Hd)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all).astype(jnp.float32) * scale
+        valid = jnp.arange(k_all.shape[2])[None, None, None, :] <= pos
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", p, v_all)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, H * Hd)
+        x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
+
+        h = rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0], (k_cache, v_cache)
+
+
+def make_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
+    dt = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, cfg.max_seq, cfg.head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)

@@ -1,0 +1,103 @@
+"""Sharded training step for the flagship model.
+
+Mesh axes: ``dp`` (batch data parallel), ``tp`` (tensor parallel over
+heads/ffn), ``sp`` (sequence parallel — ring attention). Parameters are
+sharded with NamedSharding and GSPMD inserts the collectives over ICI
+(all-reduce for dp grads, all-gather/reduce-scatter for tp) — the
+"pick a mesh, annotate shardings, let XLA insert collectives" recipe.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oncilla_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn
+
+DP, TP, SP = "dp", "tp", "sp"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Factor the devices into a (dp, tp, sp) mesh: sp gets the largest
+    power-of-two factor ≤ 2, tp next, rest dp — small meshes stay usable."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    sp = 2 if n % 2 == 0 and n >= 4 else 1
+    tp = 2 if (n // sp) % 2 == 0 and (n // sp) >= 2 else 1
+    dp = n // (sp * tp)
+    arr = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(arr, (DP, TP, SP))
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs: heads/ffn over tp, vocab over tp for the big tables."""
+    return {
+        "embed": P(TP, None),
+        "wq": P(None, None, TP),
+        "wk": P(None, None, TP),
+        "wv": P(None, None, TP),
+        "wo": P(None, TP, None),
+        "w_gate": P(None, None, TP),
+        "w_up": P(None, None, TP),
+        "w_down": P(None, TP, None),
+        "ln_attn": P(None, None),
+        "ln_mlp": P(None, None),
+        "ln_out": P(None),
+        "lm_head": P(None, TP),
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: LlamaConfig) -> dict:
+    specs = param_specs(cfg)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def data_spec() -> P:
+    # Batch over dp; sequence over sp (ring attention consumes it).
+    return P(DP, SP)
+
+
+def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
+    params = shard_params(init_params(key, cfg), mesh, cfg)
+    tx = optax.adamw(lr, weight_decay=0.01)
+    opt_state = tx.init(params)
+    return params, opt_state, tx
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True):
+    """The jitted full training step (forward + backward + adamw update),
+    sharded over the (dp, tp, sp) mesh."""
+    seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg, mesh=mesh, seq_axis=seq_axis)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    specs = param_specs(cfg)
+    pshard = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    dshard = NamedSharding(mesh, data_spec())
+    return jax.jit(
+        step,
+        in_shardings=(pshard, None, dshard),
+        donate_argnums=(0, 1),
+    )
+
+
+def sample_batch(rng: np.random.Generator, cfg: LlamaConfig, batch: int, seq: int):
+    return jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(batch, seq), dtype=np.int32)
+    )

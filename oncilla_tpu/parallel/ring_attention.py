@@ -1,0 +1,119 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context sequence parallelism for the flagship model: each device holds a
+sequence chunk of Q/K/V; K/V chunks rotate around the mesh ring
+(CollectivePermute over ICI) while a flash-style online softmax accumulates
+the exact result — sequence length scales with the number of devices, and
+the K/V traffic rides the same ICI fabric as the OCM arenas.
+
+The reference has no ML parallelism (SURVEY.md §2 checklist); this module is
+part of the TPU framework's first-class long-context support, built on the
+same ring pattern as :func:`oncilla_tpu.parallel.spmd_arena.ring_shift`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One (Q-chunk x K-chunk) block: scores, masked, unnormalized.
+
+    q: (B, H, Sq, D), k/v: (B, H, Sk, D), mask: (Sq, Sk) bool or None.
+    Returns (p @ v, row_max, row_sum_exp) for online-softmax merging.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG)
+    m = jnp.max(s, axis=-1)                      # (B, H, Sq)
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # A fully-masked row has m == _NEG and p == 1 everywhere; zero it.
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                      # (B, H, Sq)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = True):
+    """Per-shard ring attention body (call inside shard_map over
+    ``axis_name``). q/k/v: (B, H, S_local, D); returns (B, H, S_local, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s_local = q.shape[2]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        # Which global chunk do we currently hold? Chunks rotate forward, so
+        # after i steps device `me` holds chunk (me - i) mod n.
+        j = (me - i) % n
+
+        if causal:
+            # Block-level causality: chunk j attends only if j <= me; the
+            # diagonal block needs the triangular mask.
+            qpos = jnp.arange(s_local)[:, None]
+            kpos = jnp.arange(s_local)[None, :]
+            diag_mask = qpos >= kpos
+            full = jnp.ones((s_local, s_local), dtype=bool)
+            none = jnp.zeros((s_local, s_local), dtype=bool)
+            mask = jnp.where(
+                j == me, diag_mask, jnp.where(j < me, full, none)
+            )
+        else:
+            mask = None
+
+        o_blk, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, mask)
+
+        # Online-softmax merge (flash-attention accumulation).
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l * alpha + l_blk * beta
+        o_new = o * alpha[..., None] + o_blk * beta[..., None]
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_nxt, v_nxt
+
+    o0 = jnp.zeros_like(q)
+    # Derive from q so the carry inherits q's varying manual axis (shard_map
+    # rejects unvarying-in / varying-out loop carries).
+    m0 = jnp.full_like(q[..., 0], _NEG)
+    l0 = jnp.zeros_like(q[..., 0])
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention with Q/K/V sequence-sharded over ``axis_name``.
+
+    q/k/v: (B, H, S, D) with S sharded over the mesh axis. Usable standalone
+    or inside a larger jitted step (shard_map composes with jit)."""
+    fn = jax.shard_map(
+        partial(ring_attention_shard, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, axis_name, None),
+            P(None, None, axis_name, None),
+            P(None, None, axis_name, None),
+        ),
+        out_specs=P(None, None, axis_name, None),
+    )
+    return fn(q, k, v)

@@ -1,0 +1,75 @@
+"""Flagship model tests: forward correctness, ring-vs-dense equivalence,
+sharded train step on the (dp, tp, sp) mesh, KV-cache decode consistency."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.models import llama, train
+
+
+CFG = llama.LlamaConfig.tiny()
+
+
+def test_forward_shapes(rng):
+    params = llama.init_params(jax.random.key(0), CFG)
+    tokens = train.sample_batch(rng, CFG, 2, 32)
+    logits = llama.forward(params, tokens, CFG)
+    assert logits.shape == (2, 32, CFG.vocab)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_ring_forward_matches_dense(rng):
+    mesh = train.make_mesh()  # 2x2x2 over the 8 virtual devices
+    assert dict(mesh.shape) == {"dp": 2, "tp": 2, "sp": 2}
+    params = llama.init_params(jax.random.key(0), CFG)
+    tokens = train.sample_batch(rng, CFG, 2, 64)
+    dense = llama.forward(params, tokens, CFG)
+    sparams = train.shard_params(params, mesh, CFG)
+    ring = llama.forward(sparams, tokens, CFG, mesh=mesh, seq_axis=train.SP)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_sharded_train_step_loss_decreases(rng):
+    mesh = train.make_mesh()
+    params, opt_state, tx = train.make_train_state(jax.random.key(1), CFG, mesh)
+    step = train.make_train_step(CFG, mesh, tx)
+    tokens = train.sample_batch(rng, CFG, 4, 64)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    # Overfitting one batch must reduce loss materially.
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_decode_matches_forward(rng):
+    """Greedy decode with a KV cache reproduces teacher-forced logits."""
+    params = llama.init_params(jax.random.key(2), CFG)
+    tokens = train.sample_batch(rng, CFG, 1, 16)
+    full = llama.forward(params, tokens, CFG)  # (1, 16, V)
+
+    cfg = CFG
+    kv = llama.make_kv_cache(cfg, 1, dtype="float32")
+    step = jax.jit(
+        lambda p, t, pos, kv: llama.decode_step(p, t, pos, kv, cfg)
+    )
+    for i in range(16):
+        logits, kv = step(params, tokens[:, i], jnp.int32(i), kv)
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(full[0, i]), atol=2e-3, rtol=2e-3
+        )
+
+
+def test_mesh_factoring():
+    m = train.make_mesh(8)
+    assert m.devices.size == 8
+    m4 = train.make_mesh(4)
+    assert m4.devices.size == 4 and dict(m4.shape)["sp"] == 2
+    m2 = train.make_mesh(2)
+    assert dict(m2.shape) == {"dp": 1, "tp": 2, "sp": 1}
+    m1 = train.make_mesh(1)
+    assert m1.devices.size == 1
