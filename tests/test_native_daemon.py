@@ -69,21 +69,33 @@ def native_cluster(binary, tmp_path):
         k: v for k, v in kw.items() if k in ("host_arena_bytes", "device_arena_bytes")
     })
     # Wait until rank 1's ADD_NODE has reached the master (its notify loop
-    # retries with backoff, so port-accepting does not imply joined).
+    # retries with backoff, so port-accepting does not imply joined). Any
+    # setup failure must kill the spawned daemons (no post-yield teardown
+    # runs when setup fails).
     from oncilla_tpu.runtime.protocol import Message, MsgType, request as preq
 
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        s = socket.create_connection((entries[0].host, entries[0].port))
-        try:
-            st = preq(s, Message(MsgType.STATUS, {}))
-        finally:
-            s.close()
-        if st.fields["nnodes"] >= 2:
-            break
-        time.sleep(0.05)
-    else:
-        pytest.fail("rank 1 never joined the master")
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(
+                    (entries[0].host, entries[0].port), timeout=2.0
+                )
+                try:
+                    st = preq(s, Message(MsgType.STATUS, {}))
+                finally:
+                    s.close()
+                if st.fields["nnodes"] >= 2:
+                    break
+            except (OSError, ocm.OcmProtocolError):
+                pass
+            time.sleep(0.05)
+        else:
+            pytest.fail("rank 1 never joined the master")
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
     yield entries, cfg
     for p in procs:
         p.terminate()
