@@ -159,16 +159,34 @@ def main() -> None:
     ctx = ocm.ocm_init(cfg)
     p50_us = bench_alloc_p50(ctx)
 
-    # Stamp a pattern so copies move real data.
+    # Stamp a pattern so copies move real data. The copy loops donate the
+    # buffer, so they run through arena.update(), which atomically rebinds
+    # the arena to the loop's output (holding the raw buffer across a
+    # donation would leave the arena pointing at a deleted array).
+    arena = ctx.device_arenas[0]
     h = ctx.alloc(2 * NBYTES, OcmKind.LOCAL_DEVICE)
     ctx.put(h, np.arange(NBYTES, dtype=np.uint8), 0)
-    buf = ctx.device_arenas[0].buffer
 
-    xla_gbps, buf = bench_xla_copy(buf)
+    results = {}
+
+    def run_xla(buf):
+        gbps, buf = bench_xla_copy(buf)
+        results["xla"] = gbps
+        return buf
+
+    def run_pallas(buf):
+        gbps, buf = bench_pallas_copy(buf)
+        results["pallas"] = gbps
+        return buf
+
+    arena.update(run_xla)
     try:
-        pallas_gbps, buf = bench_pallas_copy(buf)
+        arena.update(run_pallas)
     except Exception:  # noqa: BLE001 — pallas path needs real TPU
-        pallas_gbps = 0.0
+        results["pallas"] = 0.0
+    xla_gbps, pallas_gbps = results["xla"], results["pallas"]
+    # The arena is still fully usable after benchmarking:
+    ctx.free(h)
 
     gbps = max(xla_gbps, pallas_gbps)
     print(

@@ -106,56 +106,34 @@ def paged_decode_step(
     containing exactly the T = ``pos`` valid entries (no masking needed);
     None when pos == 0. Returns (logits, (new_k, new_v)) where new_k/new_v
     are this token's (L, B, KV, 1, Hd) cache entries.
+
+    Reuses :func:`llama.block` — one transformer-block implementation for
+    training, cached decode, and paged decode.
     """
     from oncilla_tpu.models import llama
 
-    B = token.shape[0]
-    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
     positions = jnp.asarray([pos])
     new_k, new_v = [], []
 
     for i in range(cfg.n_layers):
-        lp = {
-            key: params[key][i]
-            for key in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                        "ln_attn", "ln_mlp")
-        }
-        h = llama.rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, 1, H, Hd)
-        kn = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, 1, KV, Hd)
-        vn = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, 1, KV, Hd)
-        q = llama.rope(q.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
-        kn = llama.rope(kn.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
-        vn = vn.transpose(0, 2, 1, 3)
-        new_k.append(kn)
-        new_v.append(vn)
+        def attend(q, kn, vn, i=i):
+            new_k.append(kn)
+            new_v.append(vn)
+            if k_ctx is not None:
+                k_all = jnp.concatenate(
+                    [k_ctx[i].astype(q.dtype), kn.astype(q.dtype)], axis=2
+                )
+                v_all = jnp.concatenate(
+                    [v_ctx[i].astype(q.dtype), vn.astype(q.dtype)], axis=2
+                )
+            else:
+                k_all, v_all = kn.astype(q.dtype), vn.astype(q.dtype)
+            return llama.grouped_attention(q, k_all, v_all)
 
-        if k_ctx is not None:
-            k_all = jnp.concatenate(
-                [k_ctx[i].astype(x.dtype), kn.astype(x.dtype)], axis=2
-            )
-            v_all = jnp.concatenate(
-                [v_ctx[i].astype(x.dtype), vn.astype(x.dtype)], axis=2
-            )
-        else:
-            k_all, v_all = kn.astype(x.dtype), vn.astype(x.dtype)
-        k_rep = llama._repeat_kv(k_all, H // KV)
-        v_rep = llama._repeat_kv(v_all, H // KV)
-        scale = 1.0 / np.sqrt(Hd)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_rep).astype(jnp.float32) * scale
-        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", p, v_rep)
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, H * Hd)
-        x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
+        x = llama.block(cfg, x, llama.layer_params(params, i), positions, attend)
 
-        h = llama.rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
-        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
-
-    x = llama.rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    logits = llama.final_logits(params, x, cfg)
     return logits[:, 0], (jnp.stack(new_k), jnp.stack(new_v))
 
 
@@ -187,12 +165,13 @@ class PagedDecoder:
         self.pos = 0
         self._tail_k: list = []  # per-step (L, B, KV, 1, Hd)
         self._tail_v: list = []
-        self._fetched = None  # cached fetch of paged context
+        self._fetched = None  # concatenated paged context (k, v)
 
     def _context(self):
         parts_k, parts_v = [], []
         if self.cache.pages:
             if self._fetched is None:
+                # Cold start (e.g. resuming a session): one bulk fetch.
                 self._fetched = self.cache.fetch_pages()
             parts_k.append(self._fetched[0])
             parts_v.append(self._fetched[1])
@@ -215,12 +194,26 @@ class PagedDecoder:
         self._tail_v.append(nv)
         self.pos += 1
         if len(self._tail_k) == self.page_tokens:
-            # Ship the full tail into the pod; invalidate the fetch cache.
-            k_page = jnp.concatenate(self._tail_k, axis=3)
-            v_page = jnp.concatenate(self._tail_v, axis=3)
+            # Ship the full tail into the pod; extend the local fetched
+            # concat with the page we already hold instead of refetching
+            # every page (keeps remote traffic O(pages), not O(pages^2)).
+            k_page = jnp.concatenate(self._tail_k, axis=3).astype(
+                jnp.dtype(self.cache.dtype)
+            )
+            v_page = jnp.concatenate(self._tail_v, axis=3).astype(
+                jnp.dtype(self.cache.dtype)
+            )
             self.cache.store_page(k_page, v_page)
+            if self._fetched is None and len(self.cache.pages) > 1:
+                self._fetched = self.cache.fetch_pages()
+            elif self._fetched is None:
+                self._fetched = (k_page, v_page)
+            else:
+                self._fetched = (
+                    jnp.concatenate([self._fetched[0], k_page], axis=3),
+                    jnp.concatenate([self._fetched[1], v_page], axis=3),
+                )
             self._tail_k, self._tail_v = [], []
-            self._fetched = None
         return logits
 
     def close(self) -> None:

@@ -5,7 +5,10 @@ TPU-first design notes:
   can shard heads/ffn over the ``tp`` mesh axis and batch over ``dp``.
 - Attention optionally runs as ring attention over a ``sp`` sequence axis
   (:mod:`oncilla_tpu.parallel.ring_attention`) for long-context training.
-- bfloat16 activations by default (MXU-native), fp32 RMSNorm accumulation.
+  K/V stay unexpanded (GQA) all the way into the attention kernels, so the
+  ring rotates group-size-times fewer bytes over ICI.
+- bfloat16 activations by default (MXU-native); scores/softmax accumulate
+  in fp32 on every path.
 - Decode uses a KV cache that can be paged into OCM arenas — local or
   *remote* chips' HBM — via :mod:`oncilla_tpu.models.kv_paging`
   (BASELINE.md config 5).
@@ -18,7 +21,6 @@ OCM data planes with a real workload.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -59,9 +61,14 @@ class LlamaConfig:
         )
 
 
+LAYER_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln_attn", "ln_mlp"
+)
+
+
 def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     """Scaled-normal init; layers stacked along a leading axis so the whole
-    model is a handful of leaves (scan-friendly, sharding-friendly)."""
+    model is a handful of leaves (sharding-friendly)."""
     k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
     dt = jnp.dtype(cfg.dtype)
     L, D, H, KV, Hd, F = (
@@ -92,6 +99,10 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     }
 
 
+def layer_params(params: dict, i: int) -> dict:
+    return {k: params[k][i] for k in LAYER_KEYS}
+
+
 def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -114,47 +125,65 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
-def _dense_causal_attention(q, k, v):
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    S, T = q.shape[2], k.shape[2]
-    # Causal for the self-attention case; for decode (S=1, T=cache) the
-    # caller masks by valid length instead.
-    mask = jnp.tril(jnp.ones((S, T), dtype=bool), k=T - S)
-    s = jnp.where(mask[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+def grouped_attention(q, k, v, mask=None):
+    """Dense attention with unexpanded GQA K/V, fp32 softmax.
+
+    q: (B, H, Sq, D); k/v: (B, KV, Sk, D) with KV dividing H;
+    mask: (Sq, Sk) bool or None. Returns (B, H, Sq, D) in q's dtype."""
+    B, H, Sq, D = q.shape
+    KV = k.shape[1]
+    q5 = q.reshape(B, KV, H // KV, Sq, D)
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum(
+        "bkgqd,bksd->bkgqs", q5, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum(
+        "bkgqs,bksd->bkgqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return o.reshape(B, H, Sq, D).astype(q.dtype)
 
 
-def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
-    if n_rep == 1:
-        return x
-    return jnp.repeat(x, n_rep, axis=1)
+def causal_mask(sq: int, sk: int) -> jax.Array:
+    """Lower-triangular mask aligned to the *end* of the key axis (the self-
+    attention case where the last sq keys are the queries' own positions)."""
+    return jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
 
 
-def _layer(cfg: LlamaConfig, x, lp, positions, attn_fn):
-    """One transformer block. x: (B, S, D); lp: this layer's param slice."""
+def block(cfg: LlamaConfig, x, lp, positions, attend):
+    """One transformer block — the single implementation every path uses.
+
+    x: (B, S, D); lp: this layer's params; ``attend(q, kn, vn)`` receives
+    this block's fresh rotary-embedded q (B, H, S, Hd) and *unexpanded* KV
+    (B, KV, S, Hd) and returns the attention output (B, H, S, Hd) — the
+    callback decides dense/ring/cached attention.
+    """
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
     q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, S, H, Hd)
-    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, KV, Hd)
-    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, KV, Hd)
+    kn = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, S, KV, Hd)
+    vn = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, S, KV, Hd)
     q = rope(q.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
-    k = rope(k.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
-    v = v.transpose(0, 2, 1, 3)
-    k = _repeat_kv(k, H // KV)
-    v = _repeat_kv(v, H // KV)
-    attn = attn_fn(q, k, v)  # (B, H, S, Hd)
+    kn = rope(kn.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
+    vn = vn.transpose(0, 2, 1, 3)
+    attn = attend(q, kn, vn)  # (B, H, S, Hd)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
     x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
 
     h = rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-    x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
-    return x
+    return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+
+
+def final_logits(params, x, cfg: LlamaConfig) -> jax.Array:
+    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward(
@@ -174,22 +203,15 @@ def forward(
     if seq_axis is not None:
         from oncilla_tpu.parallel.ring_attention import ring_attention
 
-        def attn_fn(q, k, v):
-            return ring_attention(q, k, v, mesh, axis_name=seq_axis, causal=True)
+        def attend(q, kn, vn):
+            return ring_attention(q, kn, vn, mesh, axis_name=seq_axis, causal=True)
     else:
-        attn_fn = _dense_causal_attention
+        def attend(q, kn, vn):
+            return grouped_attention(q, kn, vn, causal_mask(S, S))
 
-    lparams = {k: params[k] for k in (
-        "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln_attn", "ln_mlp"
-    )}
-    # Python loop over layers (L is small; keeps per-layer sharding simple
-    # and lets ring attention's shard_map nest cleanly).
     for i in range(cfg.n_layers):
-        lp = jax.tree.map(lambda a: a[i], lparams)
-        x = _layer(cfg, x, lp, positions, attn_fn)
-
-    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+        x = block(cfg, x, layer_params(params, i), positions, attend)
+    return final_logits(params, x, cfg)
 
 
 def loss_fn(params, tokens, cfg: LlamaConfig, **kw) -> jax.Array:
@@ -214,52 +236,33 @@ def decode_step(
 ):
     """Single-token decode: returns (logits, new_kv_cache). The cache layout
     is the one :mod:`oncilla_tpu.models.kv_paging` pages through OCM."""
-    B = token.shape[0]
-    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))  # (B,1,D)
     k_cache, v_cache = kv_cache
     positions = pos[None] if pos.ndim == 0 else pos
+    T = k_cache.shape[3]
+    valid = (jnp.arange(T)[None, :] <= pos)  # (1, T)
 
     for i in range(cfg.n_layers):
-        lp = {
-            key: params[key][i]
-            for key in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                        "ln_attn", "ln_mlp")
-        }
-        h = rmsnorm(x, lp["ln_attn"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(B, 1, H, Hd)
-        kn = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(B, 1, KV, Hd)
-        vn = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(B, 1, KV, Hd)
-        q = rope(q.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
-        kn = rope(kn.transpose(0, 2, 1, 3), positions, cfg.rope_theta)
-        vn = vn.transpose(0, 2, 1, 3)
+        lp = layer_params(params, i)
+        state = {}
 
-        # Append to the cache at `pos`.
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, kn[None].astype(k_cache.dtype), (i, 0, 0, pos, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, vn[None].astype(v_cache.dtype), (i, 0, 0, pos, 0)
-        )
-        k_all = _repeat_kv(k_cache[i].astype(x.dtype), H // KV)  # (B,H,T,Hd)
-        v_all = _repeat_kv(v_cache[i].astype(x.dtype), H // KV)
+        def attend(q, kn, vn, i=i, state=state):
+            kc = jax.lax.dynamic_update_slice(
+                k_cache[i], kn.astype(k_cache.dtype), (0, 0, pos, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                v_cache[i], vn.astype(v_cache.dtype), (0, 0, pos, 0)
+            )
+            state["kc"], state["vc"] = kc, vc
+            return grouped_attention(
+                q, kc.astype(q.dtype), vc.astype(q.dtype), valid
+            )
 
-        scale = 1.0 / np.sqrt(Hd)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all).astype(jnp.float32) * scale
-        valid = jnp.arange(k_all.shape[2])[None, None, None, :] <= pos
-        s = jnp.where(valid, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", p, v_all)
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, H * Hd)
-        x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
+        x = block(cfg, x, lp, positions, attend)
+        k_cache = k_cache.at[i].set(state["kc"])
+        v_cache = v_cache.at[i].set(state["vc"])
 
-        h = rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
-        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
-
-    x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    logits = final_logits(params, x, cfg)
     return logits[:, 0], (k_cache, v_cache)
 
 

@@ -6,6 +6,11 @@ sequence chunk of Q/K/V; K/V chunks rotate around the mesh ring
 the exact result — sequence length scales with the number of devices, and
 the K/V traffic rides the same ICI fabric as the OCM arenas.
 
+GQA-aware: K/V may carry fewer heads than Q (``n_kv_heads``); the ring
+rotates the *unexpanded* KV tensors (group-size-times less ICI traffic) and
+the per-block einsum works on grouped heads. Scores and accumulators are
+fp32 regardless of the activation dtype, matching the dense path.
+
 The reference has no ML parallelism (SURVEY.md §2 checklist); this module is
 part of the TPU framework's first-class long-context support, built on the
 same ring pattern as :func:`oncilla_tpu.parallel.spmd_arena.ring_shift`.
@@ -22,33 +27,42 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG = -1e30
 
 
-def _block_attend(q, k, v, scale, mask):
-    """One (Q-chunk x K-chunk) block: scores, masked, unnormalized.
+def _block_attend(q5, k, v, scale, mask):
+    """One (Q-chunk x K-chunk) block with grouped KV heads, fp32 math.
 
-    q: (B, H, Sq, D), k/v: (B, H, Sk, D), mask: (Sq, Sk) bool or None.
-    Returns (p @ v, row_max, row_sum_exp) for online-softmax merging.
+    q5: (B, KV, G, Sq, D) — query heads grouped by KV head.
+    k/v: (B, KV, Sk, D), mask: (Sq, Sk) bool or None.
+    Returns (o, row_max, row_sum) for online-softmax merging, all fp32.
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum(
+        "bkgqd,bksd->bkgqs", q5, k, preferred_element_type=jnp.float32
+    ) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, _NEG)
-    m = jnp.max(s, axis=-1)                      # (B, H, Sq)
+        s = jnp.where(mask[None, None, None], s, _NEG)
+    m = jnp.max(s, axis=-1)                      # (B, KV, G, Sq)
     p = jnp.exp(s - m[..., None])
     if mask is not None:
         # A fully-masked row has m == _NEG and p == 1 everywhere; zero it.
-        p = jnp.where(mask[None, None], p, 0.0)
-    l = jnp.sum(p, axis=-1)                      # (B, H, Sq)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        p = jnp.where(mask[None, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(
+        "bkgqs,bksd->bkgqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
     return o, m, l
 
 
 def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = True):
     """Per-shard ring attention body (call inside shard_map over
-    ``axis_name``). q/k/v: (B, H, S_local, D); returns (B, H, S_local, D).
-    """
+    ``axis_name``). q: (B, H, S_local, D); k/v: (B, KV, S_local, D) with
+    KV dividing H. Returns (B, H, S_local, D) in q's dtype."""
     n = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    s_local = q.shape[2]
+    B, H, s_local, D = q.shape
+    KV = k.shape[1]
+    G = H // KV
+    q5 = q.reshape(B, KV, G, s_local, D)
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(D))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -72,9 +86,9 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = True):
         else:
             mask = None
 
-        o_blk, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, mask)
+        o_blk, m_blk, l_blk = _block_attend(q5, k_cur, v_cur, scale, mask)
 
-        # Online-softmax merge (flash-attention accumulation).
+        # Online-softmax merge (flash-attention accumulation), fp32.
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(m_blk - m_new)
@@ -85,13 +99,14 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = True):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return o_new, m_new, l_new, k_nxt, v_nxt
 
-    o0 = jnp.zeros_like(q)
-    # Derive from q so the carry inherits q's varying manual axis (shard_map
-    # rejects unvarying-in / varying-out loop carries).
-    m0 = jnp.full_like(q[..., 0], _NEG)
-    l0 = jnp.zeros_like(q[..., 0])
+    # Derive carries from q5 so they inherit the varying manual axis
+    # (shard_map rejects unvarying-in / varying-out loop carries).
+    o0 = jnp.zeros_like(q5, dtype=jnp.float32)
+    m0 = jnp.full_like(q5[..., 0], _NEG, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q5[..., 0], dtype=jnp.float32)
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, s_local, D).astype(q.dtype)
 
 
 def ring_attention(
@@ -104,8 +119,9 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention with Q/K/V sequence-sharded over ``axis_name``.
 
-    q/k/v: (B, H, S, D) with S sharded over the mesh axis. Usable standalone
-    or inside a larger jitted step (shard_map composes with jit)."""
+    q: (B, H, S, D); k/v: (B, KV, S, D), KV dividing H (GQA); S sharded over
+    the mesh axis. Usable standalone or inside a larger jitted step
+    (shard_map composes with jit)."""
     fn = jax.shard_map(
         partial(ring_attention_shard, axis_name=axis_name, causal=causal),
         mesh=mesh,

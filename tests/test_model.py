@@ -73,3 +73,23 @@ def test_mesh_factoring():
     assert dict(m2.shape) == {"dp": 1, "tp": 2, "sp": 1}
     m1 = train.make_mesh(1)
     assert m1.devices.size == 1
+
+
+def test_ring_matches_dense_bf16(rng):
+    # Regression: ring attention must accumulate in fp32 so bf16 models get
+    # the same logits from the ring and dense paths.
+    import jax.numpy as jnp
+    from dataclasses import replace
+
+    cfg = replace(CFG, dtype="bfloat16")
+    mesh = train.make_mesh()
+    params = llama.init_params(jax.random.key(5), cfg)
+    tokens = train.sample_batch(rng, cfg, 2, 64)
+    dense = llama.forward(params, tokens, cfg)
+    ring = llama.forward(
+        train.shard_params(params, mesh, cfg), tokens, cfg,
+        mesh=mesh, seq_axis=train.SP,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), atol=5e-2, rtol=5e-2
+    )
