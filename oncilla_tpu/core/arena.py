@@ -101,6 +101,30 @@ class ArenaAllocator:
             f"({self.bytes_free} B free, fragmented into {len(self._free)} spans)"
         )
 
+    def reserve(self, offset: int, nbytes: int) -> Extent:
+        """Claim a specific extent (snapshot restore): carve
+        [offset, offset+aligned) out of the free list."""
+        if nbytes <= 0:
+            raise ValueError("nbytes must be positive")
+        if offset % self.alignment:
+            raise OcmInvalidHandle(f"offset {offset} not aligned")
+        need = _align_up(nbytes, self.alignment)
+        with self._lock:
+            for i, (off, span) in enumerate(self._free):
+                if off <= offset and offset + need <= off + span:
+                    self._free.pop(i)
+                    if off < offset:
+                        self._free.insert(i, (off, offset - off))
+                        i += 1
+                    tail = (off + span) - (offset + need)
+                    if tail:
+                        self._free.insert(i, (offset + need, tail))
+                    self._live[offset] = need
+                    return Extent(offset=offset, nbytes=nbytes)
+        raise OcmInvalidHandle(
+            f"cannot reserve [{offset}, {offset + need}): overlaps live extent"
+        )
+
     def free(self, extent: Extent) -> None:
         with self._lock:
             need = self._live.pop(extent.offset, None)

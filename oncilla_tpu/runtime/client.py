@@ -180,7 +180,29 @@ class ControlPlaneClient:
     # remaining in-flight replies are drained before raising, keeping the
     # pooled connection in sync; transport errors evict it.
     def _pipelined(self, handle: OcmAlloc, total: int, make_req, on_reply) -> None:
-        host, port = self._owner_addr(handle)
+        """DATA_PUT/DATA_GET are idempotent (same bytes, same offsets), so a
+        transport failure mid-transfer gets one full retry — through the
+        membership table's address for the owner rank, covering daemons that
+        restarted (snapshot restore) on a new port with a stale cached
+        owner_addr or a dead pooled connection."""
+        try:
+            self._pipelined_once(handle, total, make_req, on_reply,
+                                 self._owner_addr(handle))
+            return
+        except (OSError, OcmConnectError, OcmProtocolError) as err:
+            if isinstance(err, OcmRemoteError):
+                raise  # application error: the transfer itself was rejected
+            e = self.entries[handle.rank]
+            handle.owner_addr = (e.host, e.port)
+            printd("retrying transfer via membership address %s:%d", e.host,
+                   e.port)
+            self._pipelined_once(handle, total, make_req, on_reply,
+                                 (e.host, e.port))
+
+    def _pipelined_once(
+        self, handle: OcmAlloc, total: int, make_req, on_reply, addr
+    ) -> None:
+        host, port = addr
         s, lk = self._pool.connection(host, port)
         chunk = self.config.chunk_bytes
         window = max(1, self.config.inflight_ops)

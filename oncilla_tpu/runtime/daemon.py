@@ -69,7 +69,9 @@ class Daemon:
         policy: str = "capacity",
         ndevices: int = 1,
         host: str = "127.0.0.1",
+        snapshot_path: str | None = None,
     ):
+        self.snapshot_path = snapshot_path
         self.rank = rank
         self.entries = entries
         self.config = config or OcmConfig()
@@ -93,6 +95,9 @@ class Daemon:
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._running = threading.Event()
+        self._started_ok = False
+        self._conns: set[socket.socket] = set()
+        self._conns_mu = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -115,16 +120,151 @@ class Daemon:
             self.policy.add_node(self._own_resources())
         else:
             self._notify_rank0()
+        self._maybe_restore()
+        self._started_ok = True
         printd("daemon rank=%d listening on %s:%d", self.rank, self.host, self.port)
 
     def stop(self) -> None:
+        # Quiesce first: stop accepting, kick every serve thread off its
+        # socket, and only then snapshot — otherwise in-flight requests can
+        # tear the snapshot (half-written puts, allocations granted after
+        # the registry walk).
         self._running.clear()
         if self._listener is not None:
+            # shutdown() wakes the thread blocked in accept(); a bare close()
+            # leaves the kernel file description (and the LISTEN socket)
+            # alive until that accept returns, blocking port rebinds.
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
                 pass
+        with self._conns_mu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._conns_mu:
+                if not self._conns:
+                    break
+            time.sleep(0.01)
+        # Snapshot only if this daemon actually served (a failed start must
+        # not clobber a good on-disk snapshot with an empty registry).
+        if self.snapshot_path and self._started_ok:
+            try:
+                self.save_snapshot()
+            except OSError:
+                printd("daemon %d: snapshot write failed", self.rank)
         self.peers.close()
+
+    # -- checkpoint / resume (SURVEY.md §5.4 upgrade) --------------------
+
+    def save_snapshot(self, path: str | None = None) -> None:
+        """Persist the registry and the REMOTE_HOST arm's live bytes."""
+        from oncilla_tpu.runtime import snapshot as snap
+
+        entries = []
+        for e in self.registry.snapshot():
+            data = b""
+            if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                data = self.host_arena.read(e.extent, e.nbytes, 0).tobytes()
+            entries.append(
+                snap.SnapEntry(
+                    alloc_id=e.alloc_id,
+                    kind=WIRE_KIND[e.kind.value],
+                    device_index=e.device_index,
+                    offset=e.extent.offset,
+                    nbytes=e.nbytes,
+                    origin_rank=e.origin_rank,
+                    origin_pid=e.origin_pid,
+                    data=data,
+                )
+            )
+        snap.write_file(
+            path or self.snapshot_path,
+            snap.Snapshot(self.rank, self.registry.counter, entries),
+        )
+
+    def _maybe_restore(self) -> None:
+        import os
+
+        from oncilla_tpu.runtime import snapshot as snap
+
+        if not self.snapshot_path or not os.path.exists(self.snapshot_path):
+            return
+        sp = snap.read_file(self.snapshot_path)
+        if sp.rank != self.rank:
+            raise OcmError(
+                f"snapshot is for rank {sp.rank}, daemon is rank {self.rank}"
+            )
+        self.registry.restore_counter(sp.id_counter)
+        import numpy as np
+
+        for e in sp.entries:
+            kind = OcmKind(WIRE_KIND_INV[e.kind])
+            if kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                ext = self.host_arena.allocator.reserve(e.offset, e.nbytes)
+                if e.data:
+                    self.host_arena.write(
+                        ext, np.frombuffer(e.data, dtype=np.uint8), 0
+                    )
+            else:
+                self.device_books[e.device_index].reserve(e.offset, e.nbytes)
+            self.registry.insert(
+                RegEntry(
+                    alloc_id=e.alloc_id,
+                    kind=kind,
+                    rank=self.rank,
+                    device_index=e.device_index,
+                    extent=Extent(e.offset, e.nbytes),
+                    nbytes=e.nbytes,
+                    origin_rank=e.origin_rank,
+                    origin_pid=e.origin_pid,
+                    lease_expiry=self.registry.new_lease_deadline(),
+                )
+            )
+            # Resync the master's placement accounting.
+            note = Message(
+                MsgType.NOTE_ALLOC,
+                {
+                    "kind": e.kind,
+                    "rank": self.rank,
+                    "device_index": e.device_index,
+                    "nbytes": e.nbytes,
+                },
+            )
+            if self.rank == 0:
+                self._on_note_alloc(note)
+            else:
+                try:
+                    r0 = self.entries[0]
+                    self.peers.request(r0.host, r0.port, note)
+                except (OSError, OcmConnectError):
+                    printd("daemon %d: NOTE_ALLOC to rank0 failed", self.rank)
+        printd(
+            "daemon %d restored %d allocations from snapshot",
+            self.rank, len(sp.entries),
+        )
+
+    def _on_note_alloc(self, msg: Message) -> Message:
+        if self.rank == 0:
+            f = msg.fields
+            self.policy.note_alloc(
+                Placement(
+                    rank=f["rank"],
+                    device_index=f["device_index"],
+                    kind=OcmKind(WIRE_KIND_INV[f["kind"]]),
+                ),
+                f["nbytes"],
+            )
+        return Message(MsgType.FREE_OK, {"alloc_id": 0})
 
     def _own_resources(self) -> NodeResources:
         return NodeResources(
@@ -167,6 +307,8 @@ class Daemon:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_mu:
+                self._conns.add(conn)
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
@@ -199,6 +341,8 @@ class Daemon:
         except OSError:
             pass
         finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -493,12 +637,14 @@ def main(argv=None) -> int:
     ap.add_argument("--rank", type=int, default=None)
     ap.add_argument("--policy", default="capacity", choices=sorted(POLICIES))
     ap.add_argument("--ndevices", type=int, default=1)
+    ap.add_argument("--snapshot", default=None,
+                    help="snapshot file: restored on start, written on stop")
     args = ap.parse_args(argv)
 
     entries = parse_nodefile(args.nodefile)
     rank = args.rank if args.rank is not None else detect_rank(entries)
     d = Daemon(rank, entries, policy=args.policy, ndevices=args.ndevices,
-               host=entries[rank].host)
+               host=entries[rank].host, snapshot_path=args.snapshot)
     d.start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -519,6 +665,7 @@ _HANDLERS = {
     MsgType.REQ_FREE: Daemon._on_req_free,
     MsgType.DO_FREE: Daemon._on_do_free,
     MsgType.NOTE_FREE: Daemon._on_note_free,
+    MsgType.NOTE_ALLOC: Daemon._on_note_alloc,
     MsgType.DATA_PUT: Daemon._on_data_put,
     MsgType.DATA_GET: Daemon._on_data_get,
     MsgType.HEARTBEAT: Daemon._on_heartbeat,

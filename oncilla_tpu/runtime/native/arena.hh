@@ -50,6 +50,26 @@ class ArenaAllocator {
     throw OomError("arena cannot fit " + std::to_string(nbytes) + " B");
   }
 
+  // Claim a specific extent (snapshot restore).
+  Extent reserve(uint64_t offset, uint64_t nbytes) {
+    if (nbytes == 0) throw BadHandleError("nbytes must be positive");
+    if (offset % alignment_) throw BadHandleError("offset not aligned");
+    uint64_t need = (nbytes + alignment_ - 1) / alignment_ * alignment_;
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto it = free_.begin(); it != free_.end(); ++it) {
+      uint64_t off = it->first, span = it->second;
+      if (off <= offset && offset + need <= off + span) {
+        free_.erase(it);
+        if (off < offset) free_[off] = offset - off;
+        uint64_t tail = (off + span) - (offset + need);
+        if (tail) free_[offset + need] = tail;
+        live_[offset] = need;
+        return Extent{offset, nbytes};
+      }
+    }
+    throw BadHandleError("cannot reserve extent: overlaps live allocation");
+  }
+
   void release(uint64_t offset) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = live_.find(offset);

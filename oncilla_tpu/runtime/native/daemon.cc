@@ -18,18 +18,21 @@
 #include <netinet/tcp.h>
 #include <signal.h>
 #include <sys/socket.h>
+#include <fcntl.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cerrno>
 #include <cstdio>
 #include <cstring>
 #include <fstream>
 #include <iostream>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <sstream>
 #include <thread>
 #include <vector>
@@ -258,6 +261,23 @@ class Registry {
     return entries_.size();
   }
 
+  uint64_t counter() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return counter_;
+  }
+
+  void restore_counter(uint64_t v) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (v > counter_) counter_ = v;
+  }
+
+  std::vector<RegEntry> all() const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<RegEntry> out;
+    for (auto& kv : entries_) out.push_back(kv.second);
+    return out;
+  }
+
  private:
   int64_t rank_;
   double lease_s_;
@@ -384,6 +404,7 @@ class Placement {
 
 struct Config {
   std::string nodefile;
+  std::string snapshot_path;
   int64_t rank = -1;
   bool capacity_policy = true;
   uint32_t ndevices = 1;
@@ -430,6 +451,8 @@ class Daemon {
     } else {
       notify_rank0();
     }
+    maybe_restore();
+    started_ok_ = true;
     std::printf("oncillamemd rank=%lld listening on %s:%d\n",
                 (long long)cfg_.rank, entries_[cfg_.rank].host.c_str(),
                 entries_[cfg_.rank].port);
@@ -439,13 +462,44 @@ class Daemon {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) break;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        conns_.insert(fd);
+      }
       std::thread([this, fd] { serve(fd); }).detach();
     }
+    stop();  // signal handler only requested; do the real teardown here
+  }
+
+  // Async-signal-safe: called from the SIGINT/SIGTERM handler. Only an
+  // atomic store + shutdown(2); the real teardown (mutexes, file I/O)
+  // happens on the main thread once accept() returns.
+  void request_stop() {
+    running_.store(false);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
   }
 
   void stop() {
     running_ = false;
-    if (listen_fd_ >= 0) ::close(listen_fd_);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    // Quiesce serve threads before snapshotting (they check running_ before
+    // each request; kick them off their blocking recvs).
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (int i = 0; i < 200; ++i) {
+      {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        if (conns_.empty()) break;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    if (started_ok_) save_snapshot();
     peers_.close_all();
   }
 
@@ -491,7 +545,7 @@ class Daemon {
 
   void serve(int fd) {
     // inbound_thread analogue (mem.c:319-393): loop until peer closes.
-    for (;;) {
+    while (running_) {
       Message msg;
       try {
         msg = recv_msg(fd);
@@ -517,6 +571,10 @@ class Daemon {
       } catch (const ProtocolError&) {
         break;
       }
+    }
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      conns_.erase(fd);
     }
     ::close(fd);
   }
@@ -545,6 +603,7 @@ class Daemon {
         do_free_local(m.u("alloc_id"));
         return {MsgType::FREE_OK, {{"alloc_id", Value::U(m.u("alloc_id"))}}, {}};
       case MsgType::NOTE_FREE: return on_note_free(m);
+      case MsgType::NOTE_ALLOC: return on_note_alloc(m);
       case MsgType::DATA_PUT: return on_data_put(m);
       case MsgType::DATA_GET: return on_data_get(m);
       case MsgType::HEARTBEAT: return on_heartbeat(m);
@@ -690,6 +749,144 @@ class Daemon {
     return {MsgType::FREE_OK, {{"alloc_id", Value::U(0)}}, {}};
   }
 
+  Message on_note_alloc(const Message& m) {
+    if (cfg_.rank == 0)
+      placement_.note(Kind(uint8_t(m.u("kind"))), m.i("rank"),
+                      uint32_t(m.u("device_index")), m.u("nbytes"),
+                      /*alloc=*/true);
+    return {MsgType::FREE_OK, {{"alloc_id", Value::U(0)}}, {}};
+  }
+
+  // -- checkpoint / resume (snapshot.py's binary format, interchangeable
+  // with the Python daemon's snapshots) ----------------------------------
+
+  void save_snapshot() {
+    if (cfg_.snapshot_path.empty()) return;
+    std::vector<uint8_t> out;
+    auto put_le = [&](uint64_t v, int n) {
+      for (int i = 0; i < n; ++i) out.push_back((v >> (8 * i)) & 0xff);
+    };
+    out.insert(out.end(), {'O', 'C', 'M', 'S'});
+    out.push_back(1);  // snapshot version
+    put_le(uint64_t(cfg_.rank), 8);
+    put_le(registry_.counter(), 8);
+    auto entries = registry_.all();
+    put_le(entries.size(), 4);
+    for (const RegEntry& e : entries) {
+      put_le(e.alloc_id, 8);
+      out.push_back(uint8_t(e.kind));
+      put_le(e.device_index, 4);
+      put_le(e.extent.offset, 8);
+      put_le(e.nbytes, 8);
+      put_le(uint64_t(e.origin_rank), 8);
+      put_le(uint64_t(e.origin_pid), 8);
+      if (kind_is_host(e.kind)) {
+        put_le(e.nbytes, 8);
+        out.insert(out.end(), host_store_.begin() + e.extent.offset,
+                   host_store_.begin() + e.extent.offset + e.nbytes);
+      } else {
+        put_le(0, 8);
+      }
+    }
+    std::string tmp = cfg_.snapshot_path + ".tmp";
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) {
+      std::fprintf(stderr, "oncillamemd: snapshot open failed: %s\n",
+                   std::strerror(errno));
+      return;
+    }
+    size_t done = 0;
+    while (done < out.size()) {
+      ssize_t w = ::write(fd, out.data() + done, out.size() - done);
+      if (w <= 0) {
+        std::fprintf(stderr, "oncillamemd: snapshot write failed: %s\n",
+                     std::strerror(errno));
+        ::close(fd);
+        ::unlink(tmp.c_str());  // never rename a bad snapshot into place
+        return;
+      }
+      done += size_t(w);
+    }
+    if (::fsync(fd) != 0 || ::close(fd) != 0 ||
+        ::rename(tmp.c_str(), cfg_.snapshot_path.c_str()) != 0) {
+      std::fprintf(stderr, "oncillamemd: snapshot finalize failed: %s\n",
+                   std::strerror(errno));
+      ::unlink(tmp.c_str());
+    }
+  }
+
+  void maybe_restore() {
+    if (cfg_.snapshot_path.empty()) return;
+    std::ifstream f(cfg_.snapshot_path, std::ios::binary);
+    if (!f) return;
+    std::vector<uint8_t> raw((std::istreambuf_iterator<char>(f)),
+                             std::istreambuf_iterator<char>());
+    size_t off = 0;
+    auto get_le = [&](int n) -> uint64_t {
+      if (off + n > raw.size()) throw ProtocolError("truncated snapshot");
+      uint64_t v = 0;
+      for (int i = 0; i < n; ++i) v |= uint64_t(raw[off + i]) << (8 * i);
+      off += n;
+      return v;
+    };
+    if (raw.size() < 5 || std::memcmp(raw.data(), "OCMS", 4) != 0)
+      throw ProtocolError("bad snapshot magic");
+    off = 4;
+    if (get_le(1) != 1) throw ProtocolError("unsupported snapshot version");
+    int64_t srank = int64_t(get_le(8));
+    if (srank != cfg_.rank)
+      throw std::runtime_error("snapshot rank mismatch");
+    registry_.restore_counter(get_le(8));
+    uint64_t n = get_le(4);
+    for (uint64_t i = 0; i < n; ++i) {
+      RegEntry e;
+      e.alloc_id = get_le(8);
+      e.kind = Kind(uint8_t(get_le(1)));
+      e.device_index = uint32_t(get_le(4));
+      uint64_t offset = get_le(8);
+      e.nbytes = get_le(8);
+      e.origin_rank = int64_t(get_le(8));
+      e.origin_pid = int64_t(get_le(8));
+      uint64_t dlen = get_le(8);
+      if (kind_is_host(e.kind)) {
+        e.extent = host_arena_.reserve(offset, e.nbytes);
+        if (dlen) {
+          if (off + dlen > raw.size())
+            throw ProtocolError("truncated snapshot data");
+          if (dlen > e.nbytes || offset + dlen > host_store_.size())
+            throw ProtocolError("snapshot data exceeds its extent");
+          std::memcpy(host_store_.data() + offset, raw.data() + off, dlen);
+        }
+      } else {
+        if (e.device_index >= device_books_.size())
+          throw ProtocolError("snapshot device_index out of range for this "
+                              "daemon's --ndevices");
+        e.extent = device_books_[e.device_index]->reserve(offset, e.nbytes);
+      }
+      off += dlen;
+      e.lease_expiry = registry_.new_deadline();
+      registry_.insert(e);
+      // Resync the master's accounting.
+      Message note{MsgType::NOTE_ALLOC,
+                   {{"kind", Value::U(uint64_t(e.kind))},
+                    {"rank", Value::I(cfg_.rank)},
+                    {"device_index", Value::U(e.device_index)},
+                    {"nbytes", Value::U(e.nbytes)}},
+                   {}};
+      if (cfg_.rank == 0) {
+        on_note_alloc(note);
+      } else {
+        try {
+          NodeEntry r0 = entry(0);
+          peers_.request(r0.host, r0.port, note);
+        } catch (const ProtocolError&) {
+        }
+      }
+    }
+    std::printf("oncillamemd rank=%lld restored %llu allocations\n",
+                (long long)cfg_.rank, (unsigned long long)n);
+  }
+
   // DCN data plane: one-sided put/get into the daemon-owned host arena (the
   // registered-buffer analogue, alloc.c:171-176).
   Message on_data_put(const Message& m) {
@@ -768,13 +965,16 @@ class Daemon {
   Placement placement_;
   PeerPool peers_;
   std::atomic<bool> running_{false};
+  bool started_ok_ = false;
+  std::mutex conns_mu_;
+  std::set<int> conns_;
   int listen_fd_ = -1;
 };
 
 Daemon* g_daemon = nullptr;
 
 void on_signal(int) {
-  if (g_daemon) g_daemon->stop();
+  if (g_daemon) g_daemon->request_stop();
 }
 
 }  // namespace
@@ -797,6 +997,7 @@ int main(int argc, char** argv) {
     else if (a == "--alignment") cfg.alignment = std::stoull(next());
     else if (a == "--lease-s") cfg.lease_s = std::stod(next());
     else if (a == "--heartbeat-s") cfg.heartbeat_s = std::stod(next());
+    else if (a == "--snapshot") cfg.snapshot_path = next();
     else {
       std::fprintf(stderr, "unknown flag %s\n", a.c_str());
       return 2;

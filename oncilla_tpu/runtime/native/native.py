@@ -44,6 +44,7 @@ def spawn(
     lease_s: float | None = None,
     heartbeat_s: float | None = None,
     tsan: bool = False,
+    snapshot: str | None = None,
     env: dict | None = None,
 ) -> subprocess.Popen:
     """Launch one native daemon process (``bin/oncillamem nodefile``
@@ -64,6 +65,8 @@ def spawn(
         cmd += ["--lease-s", str(lease_s)]
     if heartbeat_s is not None:
         cmd += ["--heartbeat-s", str(heartbeat_s)]
+    if snapshot is not None:
+        cmd += ["--snapshot", snapshot]
     return subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,

@@ -41,6 +41,8 @@ const std::map<MsgType, std::vector<Field>>& schemas() {
         {"owner_port", 'I'}}},
       {MsgType::NOTE_FREE,
        {{"kind", 'B'}, {"rank", 'q'}, {"device_index", 'I'}, {"nbytes", 'Q'}}},
+      {MsgType::NOTE_ALLOC,
+       {{"kind", 'B'}, {"rank", 'q'}, {"device_index", 'I'}, {"nbytes", 'Q'}}},
       {MsgType::DO_FREE, {{"alloc_id", 'Q'}}},
       {MsgType::FREE_OK, {{"alloc_id", 'Q'}}},
       {MsgType::DATA_PUT, {{"alloc_id", 'Q'}, {"offset", 'Q'}, {"nbytes", 'Q'}}},

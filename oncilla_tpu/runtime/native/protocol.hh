@@ -37,6 +37,7 @@ enum class MsgType : uint8_t {
   FREE_OK = 18,
   ALLOC_RESULT = 19,
   NOTE_FREE = 20,
+  NOTE_ALLOC = 21,
   DATA_PUT = 30,
   DATA_PUT_OK = 31,
   DATA_GET = 32,

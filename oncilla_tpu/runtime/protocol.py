@@ -50,6 +50,7 @@ class MsgType(enum.IntEnum):
     FREE_OK = 18
     ALLOC_RESULT = 19       # local daemon -> app: the complete handle
     NOTE_FREE = 20          # owner -> rank 0: update placement accounting
+    NOTE_ALLOC = 21         # restored owner -> rank 0: resync accounting
     # DCN data plane (reference: the per-fabric one-sided put/get)
     DATA_PUT = 30
     DATA_PUT_OK = 31
@@ -143,6 +144,12 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
         ("owner_port", "I"),
     ],
     MsgType.NOTE_FREE: [
+        ("kind", "B"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("nbytes", "Q"),
+    ],
+    MsgType.NOTE_ALLOC: [
         ("kind", "B"),
         ("rank", "q"),
         ("device_index", "I"),

@@ -49,6 +49,15 @@ class AllocRegistry:
             self._counter += 1
             return (self._rank << 32) | (self._counter << 1)
 
+    @property
+    def counter(self) -> int:
+        with self._lock:
+            return self._counter
+
+    def restore_counter(self, value: int) -> None:
+        with self._lock:
+            self._counter = max(self._counter, value)
+
     def insert(self, entry: RegEntry) -> None:
         with self._lock:
             self._entries[entry.alloc_id] = entry

@@ -1,0 +1,216 @@
+"""Checkpoint/resume: allocations (ids, extents, REMOTE_HOST bytes) survive
+a daemon restart — the capability the reference entirely lacks
+(SURVEY.md §5.4: killing bin/oncillamem loses every allocation)."""
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.runtime import snapshot as snap
+from oncilla_tpu.runtime.cluster import LocalCluster
+from oncilla_tpu.runtime.daemon import Daemon
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def test_snapshot_roundtrip_format():
+    s = snap.Snapshot(
+        rank=2,
+        id_counter=41,
+        entries=[
+            snap.SnapEntry(100, 3, 0, 4096, 1000, 1, 777, b"\x01" * 1000),
+            snap.SnapEntry(102, 2, 3, 8192, 64, 0, 778, b""),
+        ],
+    )
+    out = snap.load(snap.dump(s))
+    assert out.rank == 2 and out.id_counter == 41
+    assert out.entries == s.entries
+
+
+def test_daemon_restart_restores_allocations(tmp_path, rng):
+    cfg = OcmConfig(host_arena_bytes=8 << 20, device_arena_bytes=8 << 20)
+    cl = LocalCluster(2, config=cfg)
+    snap_path = str(tmp_path / "d1.ocms")
+    try:
+        # Replace daemon 1 with a snapshotting one.
+        cl.daemons[1].stop()
+        d1 = Daemon(1, cl.entries, config=cfg, snapshot_path=snap_path)
+        cl.entries[1] = cl.entries[1].__class__(1, "127.0.0.1", 0)
+        d1.port = 0
+        d1.start()
+        cl.daemons[1] = d1
+
+        client = cl.client(0)
+        h_host = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        h_dev = client.alloc(256 << 10, OcmKind.REMOTE_DEVICE)
+        assert h_host.rank == 1 and h_dev.rank == 1
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h_host, data, 0)
+
+        # Daemon dies (snapshot written on stop) and a fresh one restores.
+        # Close the client first: its established data connections pin the
+        # port and would block the rebind.
+        client.close()
+        cl.clients.remove(client)
+        # Daemon 0's peer pool also holds connections into d1's port (from
+        # the DO_ALLOC/heartbeat legs); drop them so the port frees up.
+        cl.daemons[0].peers.close()
+        d1.stop()
+        import time as _t
+        _t.sleep(0.3)  # let d1's serve threads notice the closed peers
+        d2 = Daemon(
+            1, cl.entries, config=cfg, snapshot_path=snap_path
+        )
+        d2.port = d1.port  # rebind same port; entries already updated
+        d2.start()
+        cl.daemons[1] = d2
+
+        assert d2.registry.live_count() == 2
+        # Data survived and is readable through a fresh client.
+        client2 = cl.client(0)
+        got = client2.get(h_host, 1 << 20, 0)
+        np.testing.assert_array_equal(got, data)
+        # The restored extents are really reserved: new allocations don't
+        # collide, and frees work with the old ids.
+        h_new = client2.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        if h_new.rank == 1:
+            assert h_new.extent.offset != h_host.extent.offset
+        client2.free(h_host)
+        client2.free(h_dev)
+        client2.free(h_new)
+        assert d2.registry.live_count() == 0
+        # Id monotonicity across restart: new ids never reuse old ones.
+        h2 = client2.alloc(4096, OcmKind.REMOTE_HOST)
+        assert h2.alloc_id not in (h_host.alloc_id, h_dev.alloc_id)
+    finally:
+        cl.stop()
+
+
+def test_restore_wrong_rank_rejected(tmp_path):
+    cfg = OcmConfig(host_arena_bytes=1 << 20, device_arena_bytes=1 << 20)
+    path = str(tmp_path / "wrong.ocms")
+    snap.write_file(path, snap.Snapshot(rank=5, id_counter=0, entries=[]))
+    from oncilla_tpu.runtime.membership import NodeEntry
+
+    d = Daemon(0, [NodeEntry(0, "127.0.0.1", 0)], config=cfg,
+               snapshot_path=path)
+    with pytest.raises(ocm.OcmError, match="rank 5"):
+        d.start()
+    # stop() after a failed start must NOT clobber the on-disk snapshot
+    # with an empty registry.
+    before = open(path, "rb").read()
+    d.stop()
+    assert open(path, "rb").read() == before
+
+
+def _wait_port(host, port, timeout=10):
+    import socket as sk
+    import time as t
+
+    deadline = t.time() + timeout
+    while t.time() < deadline:
+        try:
+            sk.create_connection((host, port), timeout=0.5).close()
+            return
+        except OSError:
+            t.sleep(0.05)
+    raise TimeoutError(f"{host}:{port} never came up")
+
+
+def test_native_daemon_snapshot_restart(tmp_path, rng):
+    """The C++ daemon snapshots on SIGTERM and restores on start."""
+    import socket as sk
+
+    from oncilla_tpu.runtime.client import ControlPlaneClient
+    from oncilla_tpu.runtime.membership import NodeEntry
+    from oncilla_tpu.runtime.native import native
+
+    try:
+        native.build()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+
+    s = sk.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    nodefile = tmp_path / "nf"
+    nodefile.write_text(f"0 127.0.0.1 {port}\n")
+    snap_file = str(tmp_path / "d0.ocms")
+    kw = dict(host_arena_bytes=8 << 20, device_arena_bytes=8 << 20)
+
+    p = native.spawn(str(nodefile), 0, snapshot=snap_file, **kw)
+    try:
+        _wait_port("127.0.0.1", port)
+        entries = [NodeEntry(0, "127.0.0.1", port)]
+        client = ControlPlaneClient(entries, 0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)  # demotes to LOCAL_HOST
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h, data, 0)
+        client.close()
+        p.terminate()
+        assert p.wait(timeout=5) is not None
+        assert (tmp_path / "d0.ocms").exists()
+
+        p2 = native.spawn(str(nodefile), 0, snapshot=snap_file, **kw)
+        try:
+            _wait_port("127.0.0.1", port)
+            client2 = ControlPlaneClient(entries, 0, heartbeat=False)
+            assert client2.status()["live_allocs"] == 1
+            got = client2.get(h, 1 << 20, 0)
+            np.testing.assert_array_equal(got, data)
+            client2.free(h)
+            client2.close()
+        finally:
+            p2.kill()
+    finally:
+        p.kill()
+
+
+def test_python_snapshot_restored_by_native_daemon(tmp_path, rng):
+    """Snapshots are interchangeable across implementations: a Python-daemon
+    snapshot restores into the C++ daemon."""
+    import socket as sk
+
+    from oncilla_tpu.runtime.client import ControlPlaneClient
+    from oncilla_tpu.runtime.membership import NodeEntry
+    from oncilla_tpu.runtime.native import native
+
+    try:
+        native.build()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+
+    cfg = OcmConfig(host_arena_bytes=8 << 20, device_arena_bytes=8 << 20)
+    snap_file = str(tmp_path / "cross.ocms")
+
+    # Python daemon, one allocation with data, snapshot on stop.
+    from oncilla_tpu.runtime.membership import NodeEntry as NE
+
+    pyd = Daemon(0, [NE(0, "127.0.0.1", 0)], config=cfg,
+                 snapshot_path=snap_file)
+    pyd.start()
+    entries = [NE(0, "127.0.0.1", pyd.port)]
+    client = ControlPlaneClient(entries, 0, heartbeat=False)
+    h = client.alloc(512 << 10, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 512 << 10, dtype=np.uint8)
+    client.put(h, data, 0)
+    client.close()
+    pyd.stop()
+
+    # Native daemon restores it.
+    s = sk.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    nodefile = tmp_path / "nf2"
+    nodefile.write_text(f"0 127.0.0.1 {port}\n")
+    p = native.spawn(str(nodefile), 0, snapshot=snap_file,
+                     host_arena_bytes=8 << 20, device_arena_bytes=8 << 20)
+    try:
+        _wait_port("127.0.0.1", port)
+        client2 = ControlPlaneClient(
+            [NodeEntry(0, "127.0.0.1", port)], 0, heartbeat=False
+        )
+        assert client2.status()["live_allocs"] == 1
+        got = client2.get(h, 512 << 10, 0)
+        np.testing.assert_array_equal(got, data)
+        client2.close()
+    finally:
+        p.kill()
