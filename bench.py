@@ -189,6 +189,15 @@ def main() -> None:
     ctx.free(h)
 
     gbps = max(xla_gbps, pallas_gbps)
+
+    # GUPS random-access over the chip's HBM (BASELINE.md config 4).
+    try:
+        from oncilla_tpu.benchmarks.gups import gups_single
+
+        gups = gups_single(words=1 << 22, batch=1 << 20, steps=32)["gups"]
+    except Exception:  # noqa: BLE001 — never fail the headline metric
+        gups = 0.0
+
     print(
         json.dumps(
             {
@@ -201,6 +210,7 @@ def main() -> None:
                     "xla_gbps": round(xla_gbps, 2),
                     "pallas_gbps": round(pallas_gbps, 2),
                     "alloc_p50_us": round(p50_us, 2),
+                    "gups": round(gups, 4),
                     "copy_nbytes": NBYTES,
                     "target_gbps": TARGET,
                 },

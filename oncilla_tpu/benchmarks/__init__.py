@@ -1,0 +1,19 @@
+"""Benchmark harnesses (the analogue of the reference's test/ bandwidth
+programs, /root/reference/test/ocm_test.c:323-425 and ib_client.c:78-141):
+
+- :mod:`oncilla_tpu.benchmarks.sweep` — size-doubling one-sided read/write
+  bandwidth sweep over any handle kind, plus the all-links SPMD ring sweep.
+- :mod:`oncilla_tpu.benchmarks.gups` — GUPS random-access benchmark over the
+  arena fabric (BASELINE.md config 4; no reference analogue).
+"""
+
+from oncilla_tpu.benchmarks.sweep import SweepPoint, size_sweep, spmd_ring_sweep
+from oncilla_tpu.benchmarks.gups import gups_single, gups_mesh
+
+__all__ = [
+    "SweepPoint",
+    "size_sweep",
+    "spmd_ring_sweep",
+    "gups_single",
+    "gups_mesh",
+]

@@ -1,0 +1,153 @@
+"""GUPS — giga-updates-per-second random access over the arena fabric.
+
+BASELINE.md config 4 (no reference analogue): measure how fast randomly
+addressed words can be updated, (a) within one chip's HBM arena and (b)
+across the mesh, where every update targets a random word on a random chip
+and rides ICI. TPU-idiomatic formulation: updates are batched scatter-adds
+inside one jitted ``fori_loop`` (no per-update dispatch), and the cross-chip
+flavor routes each batch with ``lax.all_to_all`` under ``shard_map`` — each
+source device draws ``batch // D`` random target words *per destination
+device*, so destinations are uniform and shapes stay static.
+
+Updates are ``+1`` on a uint32 table, so correctness is checkable:
+``table.sum() == total_updates`` (duplicate indices accumulate).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from oncilla_tpu.benchmarks._util import fence as _fence
+from oncilla_tpu.parallel.mesh import NODE_AXIS, arena_sharding, node_mesh
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4))
+def _gups_single_run(table, steps: int, batch: int, words: int, seed: int):
+    def body(i, t):
+        key = jax.random.fold_in(jax.random.key(seed), i)
+        idx = jax.random.randint(key, (batch,), 0, words, dtype=jnp.int32)
+        return t.at[idx].add(jnp.uint32(1))
+
+    return jax.lax.fori_loop(0, steps, body, table)
+
+
+def gups_single(
+    words: int = 1 << 20,
+    batch: int = 1 << 14,
+    steps: int = 64,
+    seed: int = 0,
+    device=None,
+) -> dict:
+    """Single-chip GUPS on a ``words``-word uint32 HBM table."""
+    def fresh():
+        t = jnp.zeros((words,), dtype=jnp.uint32)
+        return jax.device_put(t, device) if device is not None else t
+
+    # Warm up with the SAME static args (steps is a static argnum — a
+    # different value would recompile inside the timed region).
+    _fence(_gups_single_run(fresh(), steps, batch, words, seed))
+    table = fresh()
+    _fence(table)
+    t0 = time.perf_counter()
+    table = _gups_single_run(table, steps, batch, words, seed)
+    _fence(table)
+    dt = time.perf_counter() - t0
+    updates = steps * batch
+    total = int(np.asarray(table).astype(np.uint64).sum())
+    return {
+        "mode": "single",
+        "gups": updates / dt / 1e9,
+        "updates": updates,
+        "seconds": dt,
+        "table_sum": total,  # == updates (duplicates accumulate)
+    }
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4, 5))
+def _gups_mesh_run(table, steps: int, per_dest: int, words: int, seed: int, mesh):
+    def shard_fn(shard):  # shard: (1, words) — this device's table row
+        me = jax.lax.axis_index(NODE_AXIS)
+        d = jax.lax.axis_size(NODE_AXIS)
+
+        def body(i, row):
+            key = jax.random.fold_in(jax.random.key(seed), me * 1_000_003 + i)
+            # Row j of idx targets device j; all_to_all delivers to it.
+            idx = jax.random.randint(
+                key, (d, per_dest), 0, words, dtype=jnp.int32
+            )
+            recv = jax.lax.all_to_all(idx, NODE_AXIS, 0, 0)
+            return row.at[recv.reshape(-1)].add(jnp.uint32(1))
+
+        return jax.lax.fori_loop(0, steps, body, shard[0])[None]
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=P(NODE_AXIS, None),
+        out_specs=P(NODE_AXIS, None),
+    )(table)
+
+
+def gups_mesh(
+    mesh=None,
+    words_per_dev: int = 1 << 18,
+    batch: int = 1 << 12,
+    steps: int = 32,
+    seed: int = 0,
+) -> dict:
+    """Cross-chip GUPS: each device issues ``batch`` random updates per step,
+    each targeting a uniformly random word on a uniformly random device; the
+    index batches ride ICI via all_to_all. The table is laid out exactly like
+    the SPMD arena (one row per chip's HBM, ``arena_sharding``)."""
+    mesh = mesh if mesh is not None else node_mesh()
+    d = mesh.devices.size
+    per_dest = max(1, batch // d)
+    def fresh():
+        return jax.device_put(
+            jnp.zeros((d, words_per_dev), dtype=jnp.uint32), arena_sharding(mesh)
+        )
+
+    _fence(_gups_mesh_run(fresh(), steps, per_dest, words_per_dev, seed, mesh))
+    table = fresh()
+    _fence(table)
+    t0 = time.perf_counter()
+    table = _gups_mesh_run(table, steps, per_dest, words_per_dev, seed, mesh)
+    _fence(table)
+    dt = time.perf_counter() - t0
+    updates = steps * d * d * per_dest  # per step: d sources x d dests x per_dest
+    total = int(np.asarray(table).astype(np.uint64).sum())
+    return {
+        "mode": f"mesh:{d}dev",
+        "gups": updates / dt / 1e9,
+        "updates": updates,
+        "seconds": dt,
+        "table_sum": total,  # == updates (duplicates accumulate)
+    }
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=["single", "mesh"], default="single")
+    ap.add_argument("--words", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=1 << 14)
+    ap.add_argument("--steps", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.mode == "mesh":
+        out = gups_mesh(
+            words_per_dev=args.words, batch=args.batch, steps=args.steps
+        )
+    else:
+        out = gups_single(words=args.words, batch=args.batch, steps=args.steps)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

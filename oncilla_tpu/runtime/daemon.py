@@ -110,17 +110,22 @@ class Daemon:
             self.entries[self.rank] = NodeEntry(self.rank, self.host, self.port)
         self._listener.listen(64)
         self._running.set()
+        # Join the cluster (ADD_NODE resets rank-0 accounting for this node)
+        # and restore the snapshot (NOTE_ALLOC resyncs it) BEFORE serving:
+        # the listen backlog queues early connections, so no request can
+        # claim an extent the snapshot needs (the C++ daemon orders the same
+        # way, native/daemon.cc restore-before-accept).
+        if self.rank == 0:
+            self.policy.add_node(self._own_resources())
+        else:
+            self._notify_rank0()
+        self._maybe_restore()
         t = threading.Thread(target=self._accept_loop, daemon=True, name=f"d{self.rank}-accept")
         t.start()
         self._threads.append(t)
         r = threading.Thread(target=self._reaper_loop, daemon=True, name=f"d{self.rank}-reaper")
         r.start()
         self._threads.append(r)
-        if self.rank == 0:
-            self.policy.add_node(self._own_resources())
-        else:
-            self._notify_rank0()
-        self._maybe_restore()
         self._started_ok = True
         printd("daemon rank=%d listening on %s:%d", self.rank, self.host, self.port)
 
@@ -170,13 +175,16 @@ class Daemon:
         """Persist the registry and the REMOTE_HOST arm's live bytes."""
         from oncilla_tpu.runtime import snapshot as snap
 
-        entries = []
-        for e in self.registry.snapshot():
-            data = b""
-            if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
-                data = self.host_arena.read(e.extent, e.nbytes, 0).tobytes()
-            entries.append(
-                snap.SnapEntry(
+        reg_entries = self.registry.snapshot()
+
+        def lazy_entries():
+            # Arena bytes are read per entry inside the write loop, so peak
+            # memory overhead is one entry, not the whole live arena.
+            for e in reg_entries:
+                data = b""
+                if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                    data = self.host_arena.read(e.extent, e.nbytes, 0).tobytes()
+                yield snap.SnapEntry(
                     alloc_id=e.alloc_id,
                     kind=WIRE_KIND[e.kind.value],
                     device_index=e.device_index,
@@ -186,10 +194,10 @@ class Daemon:
                     origin_pid=e.origin_pid,
                     data=data,
                 )
-            )
-        snap.write_file(
+
+        snap.write_file_iter(
             path or self.snapshot_path,
-            snap.Snapshot(self.rank, self.registry.counter, entries),
+            self.rank, self.registry.counter, len(reg_entries), lazy_entries(),
         )
 
     def _maybe_restore(self) -> None:
@@ -216,6 +224,12 @@ class Daemon:
                         ext, np.frombuffer(e.data, dtype=np.uint8), 0
                     )
             else:
+                if not 0 <= e.device_index < len(self.device_books):
+                    raise OcmProtocolError(
+                        "snapshot device_index out of range for this "
+                        f"daemon's ndevices ({e.device_index} >= "
+                        f"{len(self.device_books)})"
+                    )
                 self.device_books[e.device_index].reserve(e.offset, e.nbytes)
             self.registry.insert(
                 RegEntry(

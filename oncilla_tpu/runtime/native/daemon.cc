@@ -762,32 +762,6 @@ class Daemon {
 
   void save_snapshot() {
     if (cfg_.snapshot_path.empty()) return;
-    std::vector<uint8_t> out;
-    auto put_le = [&](uint64_t v, int n) {
-      for (int i = 0; i < n; ++i) out.push_back((v >> (8 * i)) & 0xff);
-    };
-    out.insert(out.end(), {'O', 'C', 'M', 'S'});
-    out.push_back(1);  // snapshot version
-    put_le(uint64_t(cfg_.rank), 8);
-    put_le(registry_.counter(), 8);
-    auto entries = registry_.all();
-    put_le(entries.size(), 4);
-    for (const RegEntry& e : entries) {
-      put_le(e.alloc_id, 8);
-      out.push_back(uint8_t(e.kind));
-      put_le(e.device_index, 4);
-      put_le(e.extent.offset, 8);
-      put_le(e.nbytes, 8);
-      put_le(uint64_t(e.origin_rank), 8);
-      put_le(uint64_t(e.origin_pid), 8);
-      if (kind_is_host(e.kind)) {
-        put_le(e.nbytes, 8);
-        out.insert(out.end(), host_store_.begin() + e.extent.offset,
-                   host_store_.begin() + e.extent.offset + e.nbytes);
-      } else {
-        put_le(0, 8);
-      }
-    }
     std::string tmp = cfg_.snapshot_path + ".tmp";
     int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
     if (fd < 0) {
@@ -795,17 +769,52 @@ class Daemon {
                    std::strerror(errno));
       return;
     }
-    size_t done = 0;
-    while (done < out.size()) {
-      ssize_t w = ::write(fd, out.data() + done, out.size() - done);
-      if (w <= 0) {
-        std::fprintf(stderr, "oncillamemd: snapshot write failed: %s\n",
-                     std::strerror(errno));
-        ::close(fd);
-        ::unlink(tmp.c_str());  // never rename a bad snapshot into place
-        return;
+    auto write_all = [&](const uint8_t* p, size_t n) {
+      size_t done = 0;
+      while (done < n) {
+        ssize_t w = ::write(fd, p + done, n - done);
+        if (w <= 0) return false;
+        done += size_t(w);
       }
-      done += size_t(w);
+      return true;
+    };
+    // Live arena bytes are written straight from host_store_, entry by
+    // entry, so peak memory overhead is one metadata record — not a full
+    // copy of every live byte (which could double resident memory on a
+    // mostly-full arena at shutdown).
+    std::vector<uint8_t> rec;
+    auto put_le = [&](uint64_t v, int n) {
+      for (int i = 0; i < n; ++i) rec.push_back((v >> (8 * i)) & 0xff);
+    };
+    bool ok = true;
+    rec.insert(rec.end(), {'O', 'C', 'M', 'S'});
+    rec.push_back(1);  // snapshot version
+    put_le(uint64_t(cfg_.rank), 8);
+    put_le(registry_.counter(), 8);
+    auto entries = registry_.all();
+    put_le(entries.size(), 4);
+    ok = write_all(rec.data(), rec.size());
+    for (const RegEntry& e : entries) {
+      if (!ok) break;
+      rec.clear();
+      put_le(e.alloc_id, 8);
+      rec.push_back(uint8_t(e.kind));
+      put_le(e.device_index, 4);
+      put_le(e.extent.offset, 8);
+      put_le(e.nbytes, 8);
+      put_le(uint64_t(e.origin_rank), 8);
+      put_le(uint64_t(e.origin_pid), 8);
+      put_le(kind_is_host(e.kind) ? e.nbytes : 0, 8);
+      ok = write_all(rec.data(), rec.size());
+      if (ok && kind_is_host(e.kind))
+        ok = write_all(host_store_.data() + e.extent.offset, e.nbytes);
+    }
+    if (!ok) {
+      std::fprintf(stderr, "oncillamemd: snapshot write failed: %s\n",
+                   std::strerror(errno));
+      ::close(fd);
+      ::unlink(tmp.c_str());  // never rename a bad snapshot into place
+      return;
     }
     if (::fsync(fd) != 0 || ::close(fd) != 0 ||
         ::rename(tmp.c_str(), cfg_.snapshot_path.c_str()) != 0) {

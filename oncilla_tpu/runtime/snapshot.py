@@ -64,6 +64,8 @@ def dump(snap: Snapshot) -> bytes:
 
 
 def load(raw: bytes) -> Snapshot:
+    if len(raw) < _HDR.size:
+        raise OcmProtocolError("truncated snapshot")
     magic, version, rank, counter, n = _HDR.unpack_from(raw, 0)
     if magic != MAGIC:
         raise OcmProtocolError("bad snapshot magic")
@@ -72,6 +74,8 @@ def load(raw: bytes) -> Snapshot:
     off = _HDR.size
     entries = []
     for _ in range(n):
+        if len(raw) - off < _ENTRY.size:
+            raise OcmProtocolError("truncated snapshot")
         (alloc_id, kind, dev, offset, nbytes, orank, opid, dlen) = (
             _ENTRY.unpack_from(raw, off)
         )
@@ -87,11 +91,33 @@ def load(raw: bytes) -> Snapshot:
 
 
 def write_file(path: str, snap: Snapshot) -> None:
+    write_file_iter(path, snap.rank, snap.id_counter,
+                    len(snap.entries), iter(snap.entries))
+
+
+def write_file_iter(path, rank: int, id_counter: int, nentries: int, entries):
+    """Stream entries to disk one at a time, so peak memory overhead is one
+    entry's bytes rather than the whole live arena (entries may be a lazy
+    generator that reads arena bytes on demand)."""
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(dump(snap))
-        f.flush()
-        os.fsync(f.fileno())
+    try:
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(MAGIC, VERSION, rank, id_counter, nentries))
+            for e in entries:
+                f.write(_ENTRY.pack(
+                    e.alloc_id, e.kind, e.device_index, e.offset, e.nbytes,
+                    e.origin_rank, e.origin_pid, len(e.data),
+                ))
+                f.write(e.data)
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        # Never leave a half-written .tmp behind (and never rename it in).
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     os.replace(tmp, path)  # atomic
 
 

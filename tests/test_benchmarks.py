@@ -1,0 +1,61 @@
+"""Benchmark-harness tests on the 8-device virtual mesh: the sweep keeps the
+reference's measurement shape (/root/reference/test/ocm_test.c:323-402) and
+GUPS updates are conserved (table sum == updates issued)."""
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.benchmarks import gups_mesh, gups_single, size_sweep, spmd_ring_sweep
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def _check_points(res, min_bytes, max_bytes):
+    sizes = [p.nbytes for p in res.points]
+    assert sizes[0] == min_bytes and sizes[-1] == max_bytes
+    assert sizes == [min_bytes * 2**i for i in range(len(sizes))]
+    for p in res.points:
+        assert p.write_gbps > 0 and p.read_gbps > 0
+
+
+@pytest.mark.parametrize("kind", [OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE])
+def test_size_sweep_local(kind):
+    cfg = OcmConfig(host_arena_bytes=1 << 20, device_arena_bytes=1 << 20)
+    ctx = ocm.ocm_init(cfg)
+    res = size_sweep(ctx, kind, min_bytes=64, max_bytes=64 << 10, iters=2)
+    _check_points(res, 64, 64 << 10)
+    assert res.as_dict()["points"][0]["nbytes"] == 64
+    ocm.ocm_tini(ctx)
+
+
+def test_size_sweep_remote_host():
+    cfg = OcmConfig(host_arena_bytes=2 << 20, device_arena_bytes=1 << 20)
+    with local_cluster(2, config=cfg) as c:
+        ctx = c.context(0)
+        res = size_sweep(
+            ctx, OcmKind.REMOTE_HOST, min_bytes=64, max_bytes=64 << 10, iters=2
+        )
+        _check_points(res, 64, 64 << 10)
+
+
+def test_spmd_ring_sweep():
+    res = spmd_ring_sweep(min_bytes=1 << 10, max_bytes=16 << 10, iters=2)
+    _check_points(res, 1 << 10, 16 << 10)
+    assert res.label.endswith("8dev")
+
+
+def test_gups_single_conserves_updates():
+    out = gups_single(words=1 << 12, batch=256, steps=8, seed=3)
+    assert out["table_sum"] == out["updates"] == 8 * 256
+    assert out["gups"] > 0
+
+
+def test_gups_mesh_conserves_updates():
+    out = gups_mesh(words_per_dev=1 << 10, batch=64, steps=4, seed=3)
+    d = 8
+    per_dest = 64 // d
+    assert out["updates"] == 4 * d * d * per_dest
+    assert out["table_sum"] == out["updates"]
+    assert out["gups"] > 0

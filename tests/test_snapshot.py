@@ -214,3 +214,40 @@ def test_python_snapshot_restored_by_native_daemon(tmp_path, rng):
         client2.close()
     finally:
         p.kill()
+
+
+def test_truncated_snapshot_raises_protocol_error(tmp_path):
+    # struct-level truncation (mid-header, mid-entry) must surface as
+    # OcmProtocolError, not a raw struct.error.
+    good = snap.dump(
+        snap.Snapshot(
+            rank=0, id_counter=2,
+            entries=[snap.SnapEntry(2, 0, 0, 0, 4, 0, 0, b"abcd")],
+        )
+    )
+    for cut in (3, snap._HDR.size + 5, len(good) - 2):
+        with pytest.raises(ocm.OcmProtocolError, match="truncated"):
+            snap.load(good[:cut])
+
+
+def test_restore_device_index_out_of_range(tmp_path):
+    from oncilla_tpu.runtime.membership import NodeEntry
+    from oncilla_tpu.runtime.protocol import WIRE_KIND
+
+    cfg = OcmConfig(host_arena_bytes=1 << 20, device_arena_bytes=1 << 20)
+    path = str(tmp_path / "dev.ocms")
+    # A device-kind entry on device 3, restored by a 1-device daemon.
+    snap.write_file(
+        path,
+        snap.Snapshot(
+            rank=0, id_counter=4,
+            entries=[snap.SnapEntry(
+                2, WIRE_KIND[OcmKind.REMOTE_DEVICE.value], 3, 0, 512, 0, 0
+            )],
+        ),
+    )
+    d = Daemon(0, [NodeEntry(0, "127.0.0.1", 0)], config=cfg,
+               snapshot_path=path, ndevices=1)
+    with pytest.raises(ocm.OcmProtocolError, match="device_index"):
+        d.start()
+    d.stop()
