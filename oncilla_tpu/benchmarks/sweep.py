@@ -115,6 +115,11 @@ def spmd_ring_sweep(
     mesh = mesh if mesh is not None else node_mesh()
     if arena_bytes is None:
         arena_bytes = max_bytes
+    if arena_bytes < max_bytes:
+        raise ValueError(
+            f"arena_bytes ({arena_bytes}) must hold the largest chunk "
+            f"(max_bytes={max_bytes})"
+        )
     arena = sa.make_arena(mesh, arena_bytes)
     res = SweepResult(label=f"spmd_ring_sweep:{mesh.devices.size}dev")
     for nbytes in _doubling_sizes(min_bytes, max_bytes):

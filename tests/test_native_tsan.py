@@ -1,0 +1,151 @@
+"""ThreadSanitizer run of the native daemon (SURVEY.md §5.2: the reference
+shipped known races — reply-before-listen mem.c:350-354, unlocked shared
+lists rdma.c:147-149 — and no sanitizer coverage; here the C++ daemon is
+exercised under a concurrent client workload with TSan live, and any data
+race report fails the test)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.context import Ocm
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.native import native
+from oncilla_tpu.utils.config import OcmConfig
+
+TSAN_EXIT = 66
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def tsan_binary():
+    try:
+        return native.build(tsan=True)
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"TSan build unavailable: {e}")
+
+
+def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
+    ports = _free_ports(2)
+    nodefile = tmp_path / "nodefile"
+    nodefile.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    snap_path = str(tmp_path / "r1.ocms")
+    env = {"TSAN_OPTIONS": f"halt_on_error=0 exitcode={TSAN_EXIT}"}
+    procs = [
+        native.spawn(
+            str(nodefile), r, ndevices=2, tsan=True,
+            host_arena_bytes=16 << 20, device_arena_bytes=8 << 20,
+            heartbeat_s=0.2, lease_s=30.0, env=env,
+            snapshot=snap_path if r == 1 else None,
+        )
+        for r in range(2)
+    ]
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    cfg = OcmConfig(
+        host_arena_bytes=16 << 20, device_arena_bytes=8 << 20,
+        chunk_bytes=64 << 10, heartbeat_s=0.2,
+    )
+    try:
+        # TSan slows startup ~10x; wait generously for both accept loops
+        # and for rank 1 to join the master.
+        deadline = time.time() + 60
+        for e in entries:
+            while time.time() < deadline:
+                try:
+                    socket.create_connection((e.host, e.port), timeout=0.5).close()
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                pytest.fail("TSan daemon did not come up")
+        from oncilla_tpu.runtime.protocol import Message, MsgType, request
+
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection((entries[0].host, entries[0].port), 2.0)
+                try:
+                    if request(s, Message(MsgType.STATUS, {})).fields["nnodes"] >= 2:
+                        break
+                finally:
+                    s.close()
+            except (OSError, ocm.OcmProtocolError):
+                pass
+            time.sleep(0.1)
+        else:
+            pytest.fail("rank 1 never joined under TSan")
+
+        # Concurrent workload: parallel clients hammering alloc/put/get/free
+        # (the paths where the daemon spawns a serve thread per connection),
+        # with status polls interleaved from another thread.
+        errors = []
+
+        def worker(seed):
+            try:
+                client = ControlPlaneClient(entries, 0, config=cfg)
+                ctx = Ocm(config=cfg, remote=client)
+                r = np.random.default_rng(seed)
+                for i in range(8):
+                    h = ctx.alloc(256 << 10, OcmKind.REMOTE_HOST)
+                    data = r.integers(0, 256, 64 << 10, dtype=np.uint8)
+                    ctx.put(h, data, offset=(i % 4) * (64 << 10))
+                    out = ctx.get(h, 64 << 10, offset=(i % 4) * (64 << 10))
+                    np.testing.assert_array_equal(out, data)
+                    ctx.free(h)
+                client.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def poller():
+            try:
+                client = ControlPlaneClient(entries, 0, config=cfg)
+                for _ in range(20):
+                    client.status()
+                    client.status(rank=1)
+                    time.sleep(0.02)
+                client.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+        threads.append(threading.Thread(target=poller))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, f"workers hung (daemon deadlock?): {hung}"
+        assert not errors, errors
+    finally:
+        for p in procs:
+            p.terminate()
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=30)
+        except Exception:  # noqa: BLE001
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+    report = "\n".join(outs)
+    assert "WARNING: ThreadSanitizer" not in report, report
+    for p in procs:
+        assert p.returncode != TSAN_EXIT, report
