@@ -108,11 +108,14 @@ int dial(const std::string& host, int port) {
 }
 
 // Cached peer connections, no re-send on failure (pool.py semantics: control
-// messages are not idempotent).
+// messages are not idempotent). Conns are shared_ptr-held: eviction/shutdown
+// only ::shutdown()s the fd (waking any blocked recv) and drops the map
+// reference; the fd is ::close()d by ~Conn when the last in-flight request
+// lets go — so no thread ever uses a closed-and-reused fd number.
 class PeerPool {
  public:
   Message request(const std::string& host, int port, const Message& m) {
-    Conn* c = get(host, port);
+    std::shared_ptr<Conn> c = get(host, port);
     try {
       std::lock_guard<std::mutex> g(c->mu);
       send_msg(c->fd, m);
@@ -123,28 +126,34 @@ class PeerPool {
     }
   }
 
+  // Terminal: refuses new dials afterwards, so a worker racing shutdown
+  // cannot re-dial a hung peer and block stop()'s join forever.
   void close_all() {
     std::lock_guard<std::mutex> g(mu_);
-    for (auto& kv : conns_) ::close(kv.second->fd);
+    closed_ = true;
+    for (auto& kv : conns_) ::shutdown(kv.second->fd, SHUT_RDWR);
     conns_.clear();
   }
 
  private:
   struct Conn {
-    int fd;
+    int fd = -1;  // -1 until dial succeeds: ~Conn must never close(0)
     std::mutex mu;
+    ~Conn() {
+      if (fd >= 0) ::close(fd);
+    }
   };
 
-  Conn* get(const std::string& host, int port) {
+  std::shared_ptr<Conn> get(const std::string& host, int port) {
     auto key = host + ":" + std::to_string(port);
     std::lock_guard<std::mutex> g(mu_);
+    if (closed_) throw ProtocolError("peer pool is shut down");
     auto it = conns_.find(key);
-    if (it != conns_.end()) return it->second.get();
-    auto c = std::make_unique<Conn>();
+    if (it != conns_.end()) return it->second;
+    auto c = std::make_shared<Conn>();
     c->fd = dial(host, port);
-    Conn* raw = c.get();
-    conns_[key] = std::move(c);
-    return raw;
+    conns_[key] = c;
+    return c;
   }
 
   void evict(const std::string& host, int port) {
@@ -152,13 +161,14 @@ class PeerPool {
     std::lock_guard<std::mutex> g(mu_);
     auto it = conns_.find(key);
     if (it != conns_.end()) {
-      ::close(it->second->fd);
+      ::shutdown(it->second->fd, SHUT_RDWR);
       conns_.erase(it);
     }
   }
 
   std::mutex mu_;
-  std::map<std::string, std::unique_ptr<Conn>> conns_;
+  bool closed_ = false;
+  std::map<std::string, std::shared_ptr<Conn>> conns_;
 };
 
 // ---------------------------------------------------------------------------
@@ -443,15 +453,17 @@ class Daemon {
     ::listen(listen_fd_, 64);
     running_ = true;
 
-    std::thread reaper([this] { reaper_loop(); });
-    reaper.detach();
-
     if (cfg_.rank == 0) {
       placement_.add_node(own_resources());
     } else {
       notify_rank0();
     }
     maybe_restore();
+    // Joined in stop(), never detached: a detached worker can wake after
+    // run() returns and the Daemon is destroyed (use-after-free caught by
+    // the TSan test). Started only after the fallible setup above — a throw
+    // while a joinable thread is live would hit std::terminate in ~thread.
+    reaper_thread_ = std::thread([this] { reaper_loop(); });
     started_ok_ = true;
     std::printf("oncillamemd rank=%lld listening on %s:%d\n",
                 (long long)cfg_.rank, entries_[cfg_.rank].host.c_str(),
@@ -466,7 +478,8 @@ class Daemon {
         std::lock_guard<std::mutex> g(conns_mu_);
         conns_.insert(fd);
       }
-      std::thread([this, fd] { serve(fd); }).detach();
+      std::lock_guard<std::mutex> g(reap_mu_);
+      serve_threads_.emplace_back([this, fd] { serve(fd); });
     }
     stop();  // signal handler only requested; do the real teardown here
   }
@@ -492,15 +505,25 @@ class Daemon {
       std::lock_guard<std::mutex> g(conns_mu_);
       for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
     }
-    for (int i = 0; i < 200; ++i) {
-      {
-        std::lock_guard<std::mutex> g(conns_mu_);
-        if (conns_.empty()) break;
-      }
-      std::this_thread::sleep_for(std::chrono::milliseconds(10));
-    }
-    if (started_ok_) save_snapshot();
+    // Unblock any worker waiting on a peer reply BEFORE joining — a hung
+    // peer must not turn SIGTERM into an infinite hang (close_all also
+    // refuses new dials from here on).
     peers_.close_all();
+    // Serve threads exit promptly once their sockets are shut down; join
+    // them (and the reaper) so no worker can touch a destroyed Daemon.
+    // Only the accept loop spawns serve threads and it has exited by now.
+    // Joins run outside reap_mu_: an exiting serve thread takes that lock
+    // for its final finished_ push.
+    std::vector<std::thread> leftover;
+    {
+      std::lock_guard<std::mutex> g(reap_mu_);
+      leftover.swap(serve_threads_);
+      finished_.clear();
+    }
+    for (std::thread& t : leftover)
+      if (t.joinable()) t.join();
+    if (reaper_thread_.joinable()) reaper_thread_.join();
+    if (started_ok_) save_snapshot();
   }
 
  private:
@@ -531,9 +554,14 @@ class Daemon {
 
   void reaper_loop() {
     // Lease reclamation (the reference's unresolved TODO, main.c:6-7).
+    // Sleep in short slices so stop()'s join returns promptly.
+    double slept = 0.0;
     while (running_) {
-      std::this_thread::sleep_for(
-          std::chrono::milliseconds(int64_t(cfg_.heartbeat_s * 1000)));
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      reap_finished();
+      slept += 0.05;
+      if (slept < cfg_.heartbeat_s) continue;
+      slept = 0.0;
       for (uint64_t id : registry_.expired()) {
         try {
           do_free_local(id);
@@ -577,6 +605,31 @@ class Daemon {
       conns_.erase(fd);
     }
     ::close(fd);
+    // Last member access: report this thread as joinable-now so the accept
+    // loop can reclaim it (a joinable pthread's stack is not freed until
+    // joined; detaching instead would re-open the shutdown use-after-free).
+    std::lock_guard<std::mutex> g(reap_mu_);
+    finished_.push_back(std::this_thread::get_id());
+  }
+
+  // Join serve threads that have finished (their stacks are not reclaimed
+  // until joined). Runs from the reaper loop so idle daemons reclaim too,
+  // not just ones with a steady stream of new connections. Joins happen
+  // outside reap_mu_ — the exiting thread's own final push needs that lock.
+  void reap_finished() {
+    std::vector<std::thread> done;
+    {
+      std::lock_guard<std::mutex> g(reap_mu_);
+      for (std::thread::id id : finished_)
+        for (auto it = serve_threads_.begin(); it != serve_threads_.end(); ++it)
+          if (it->get_id() == id) {
+            done.push_back(std::move(*it));
+            serve_threads_.erase(it);
+            break;
+          }
+      finished_.clear();
+    }
+    for (std::thread& t : done) t.join();
   }
 
   static Message err(ErrCode c, const std::string& detail) {
@@ -974,6 +1027,10 @@ class Daemon {
   Placement placement_;
   PeerPool peers_;
   std::atomic<bool> running_{false};
+  std::thread reaper_thread_;
+  std::vector<std::thread> serve_threads_;
+  std::mutex reap_mu_;
+  std::vector<std::thread::id> finished_;
   bool started_ok_ = false;
   std::mutex conns_mu_;
   std::set<int> conns_;

@@ -46,6 +46,7 @@ def spawn(
     tsan: bool = False,
     snapshot: str | None = None,
     env: dict | None = None,
+    log_path: str | None = None,
 ) -> subprocess.Popen:
     """Launch one native daemon process (``bin/oncillamem nodefile``
     analogue)."""
@@ -67,9 +68,16 @@ def spawn(
         cmd += ["--heartbeat-s", str(heartbeat_s)]
     if snapshot is not None:
         cmd += ["--snapshot", snapshot]
-    return subprocess.Popen(
-        cmd,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        env={**os.environ, **(env or {})},
-    )
+    # Spool output to a file when asked: an undrained PIPE caps at ~64KB and
+    # a chatty child (e.g. TSan reports) would block writing to it.
+    out = open(log_path, "wb") if log_path is not None else subprocess.PIPE
+    try:
+        return subprocess.Popen(
+            cmd,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, **(env or {})},
+        )
+    finally:
+        if log_path is not None:
+            out.close()  # child keeps its own descriptor

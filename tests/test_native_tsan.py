@@ -50,12 +50,14 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
     )
     snap_path = str(tmp_path / "r1.ocms")
     env = {"TSAN_OPTIONS": f"halt_on_error=0 exitcode={TSAN_EXIT}"}
+    logs = [str(tmp_path / f"daemon{r}.log") for r in range(2)]
     procs = [
         native.spawn(
             str(nodefile), r, ndevices=2, tsan=True,
             host_arena_bytes=16 << 20, device_arena_bytes=8 << 20,
             heartbeat_s=0.2, lease_s=30.0, env=env,
             snapshot=snap_path if r == 1 else None,
+            log_path=logs[r],
         )
         for r in range(2)
     ]
@@ -137,15 +139,15 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
     finally:
         for p in procs:
             p.terminate()
-    outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=30)
+            p.wait(timeout=30)
         except Exception:  # noqa: BLE001
             p.kill()
-            out, _ = p.communicate()
-        outs.append(out.decode(errors="replace"))
-    report = "\n".join(outs)
+            p.wait()
+    report = "\n".join(
+        open(lp, "rb").read().decode(errors="replace") for lp in logs
+    )
     assert "WARNING: ThreadSanitizer" not in report, report
     for p in procs:
         assert p.returncode != TSAN_EXIT, report
