@@ -66,10 +66,12 @@ class ControlPlaneClient:
         self._pool = PeerPool()
         me = entries[rank]
         try:
-            self._ctrl = socket.create_connection((me.host, me.port), timeout=30.0)
+            self._ctrl = socket.create_connection(
+                (me.connect_host, me.port), timeout=30.0
+            )
         except OSError as e:
             raise OcmConnectError(
-                f"local daemon unreachable at {me.host}:{me.port}: {e}"
+                f"local daemon unreachable at {me.connect_host}:{me.port}: {e}"
             ) from e
         self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._ctrl_lock = threading.Lock()
@@ -193,11 +195,11 @@ class ControlPlaneClient:
             if isinstance(err, OcmRemoteError):
                 raise  # application error: the transfer itself was rejected
             e = self.entries[handle.rank]
-            handle.owner_addr = (e.host, e.port)
-            printd("retrying transfer via membership address %s:%d", e.host,
-                   e.port)
+            handle.owner_addr = (e.connect_host, e.port)
+            printd("retrying transfer via membership address %s:%d",
+                   e.connect_host, e.port)
             self._pipelined_once(handle, total, make_req, on_reply,
-                                 (e.host, e.port))
+                                 (e.connect_host, e.port))
 
     def _pipelined_once(
         self, handle: OcmAlloc, total: int, make_req, on_reply, addr
@@ -277,7 +279,7 @@ class ControlPlaneClient:
         if addr is not None:
             return addr
         e = self.entries[handle.rank]
-        return (e.host, e.port)
+        return (e.connect_host, e.port)
 
     # -- introspection ---------------------------------------------------
 
@@ -285,7 +287,7 @@ class ControlPlaneClient:
         if rank is None or rank == self.rank:
             return self._request(Message(MsgType.STATUS, {})).fields
         e = self.entries[rank]
-        s = socket.create_connection((e.host, e.port), timeout=30.0)
+        s = socket.create_connection((e.connect_host, e.port), timeout=30.0)
         try:
             return request(s, Message(MsgType.STATUS, {})).fields
         finally:

@@ -68,7 +68,7 @@ class Daemon:
         config: OcmConfig | None = None,
         policy: str = "capacity",
         ndevices: int = 1,
-        host: str = "127.0.0.1",
+        host: str = "0.0.0.0",
         snapshot_path: str | None = None,
     ):
         self.snapshot_path = snapshot_path
@@ -104,10 +104,15 @@ class Daemon:
     def start(self) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Bind the wildcard by default (the C++ daemon binds INADDR_ANY):
+        # peers dial the nodefile's addr column, which need not match what
+        # the local resolver maps our own hostname to.
         self._listener.bind((self.host, self.port))
         if self.port == 0:  # ephemeral port (tests)
             self.port = self._listener.getsockname()[1]
-            self.entries[self.rank] = NodeEntry(self.rank, self.host, self.port)
+            self.entries[self.rank] = NodeEntry(
+                self.rank, self.host, self.port, self.entries[self.rank].addr
+            )
         self._listener.listen(64)
         self._running.set()
         # Join the cluster (ADD_NODE resets rank-0 accounting for this node)
@@ -259,7 +264,7 @@ class Daemon:
             else:
                 try:
                     r0 = self.entries[0]
-                    self.peers.request(r0.host, r0.port, note)
+                    self.peers.request(r0.connect_host, r0.port, note)
                 except (OSError, OcmConnectError):
                     printd("daemon %d: NOTE_ALLOC to rank0 failed", self.rank)
         printd(
@@ -296,7 +301,9 @@ class Daemon:
             MsgType.ADD_NODE,
             {
                 "rank": self.rank,
-                "host": self.host,
+                # Announce a peer-reachable address: the bind host may be the
+                # wildcard. Short-form entries fall back to the host column.
+                "host": self.entries[self.rank].connect_host,
                 "port": self.port,
                 "ndevices": self.ndevices,
                 "device_arena_bytes": self.config.device_arena_bytes,
@@ -306,11 +313,11 @@ class Daemon:
         r0 = self.entries[0]
         for i in range(retries):
             try:
-                self.peers.request(r0.host, r0.port, msg)
+                self.peers.request(r0.connect_host, r0.port, msg)
                 return
             except (OSError, OcmConnectError):
                 time.sleep(min(0.05 * 2**i, 2.0))
-        raise OcmError(f"rank 0 daemon unreachable at {r0.host}:{r0.port}")
+        raise OcmError(f"rank 0 daemon unreachable at {r0.connect_host}:{r0.port}")
 
     # -- server loops ----------------------------------------------------
 
@@ -414,9 +421,14 @@ class Daemon:
                 host_arena_bytes=f["host_arena_bytes"],
             )
         )
-        # Record the peer's address for forwarding.
-        if f["rank"] < len(self.entries):
-            self.entries[f["rank"]] = NodeEntry(f["rank"], f["host"], f["port"])
+        # Record the peer's address for forwarding. A nodefile-provided
+        # connect address wins over the announced hostname (the announcement
+        # carries the daemon's bind host, which may not be routable).
+        if 0 <= f["rank"] < len(self.entries):
+            prev = self.entries[f["rank"]]
+            self.entries[f["rank"]] = NodeEntry(
+                f["rank"], f["host"], f["port"], prev.addr
+            )
         return Message(MsgType.ADD_NODE_OK, {"nnodes": self.policy.nnodes})
 
     # REQ_ALLOC: non-masters proxy the request to rank 0 (the placement leg,
@@ -427,7 +439,7 @@ class Daemon:
         f = msg.fields
         if self.rank != 0:
             r0 = self.entries[0]
-            return self.peers.request(r0.host, r0.port, msg)
+            return self.peers.request(r0.connect_host, r0.port, msg)
         kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         nbytes = f["nbytes"]
         placed = self.policy.place(f["orig_rank"], kind, nbytes)
@@ -439,7 +451,7 @@ class Daemon:
             )
         else:
             r = self.peers.request(
-                owner.host,
+                owner.connect_host,
                 owner.port,
                 Message(
                     MsgType.DO_ALLOC,
@@ -463,7 +475,7 @@ class Daemon:
                 "kind": WIRE_KIND[placed.kind.value],
                 "offset": offset,
                 "nbytes": nbytes,
-                "owner_host": owner.host,
+                "owner_host": owner.connect_host,
                 "owner_port": owner.port,
             },
         )
@@ -519,7 +531,7 @@ class Daemon:
         else:
             owner = self.entries[owner_rank]
             self.peers.request(
-                owner.host, owner.port,
+                owner.connect_host, owner.port,
                 Message(MsgType.DO_FREE, {"alloc_id": f["alloc_id"]}),
             )
         return Message(MsgType.FREE_OK, {"alloc_id": f["alloc_id"]})
@@ -552,7 +564,7 @@ class Daemon:
         else:
             r0 = self.entries[0]
             try:
-                self.peers.request(r0.host, r0.port, note)
+                self.peers.request(r0.connect_host, r0.port, note)
             except (OSError, OcmConnectError):
                 printd("daemon %d: NOTE_FREE to rank0 failed", self.rank)
 
@@ -611,7 +623,7 @@ class Daemon:
                 if e.rank == self.rank:
                     continue
                 try:
-                    self.peers.request(e.host, e.port, msg)
+                    self.peers.request(e.connect_host, e.port, msg)
                 except (OSError, OcmConnectError):
                     printd("daemon %d: heartbeat relay to %d failed",
                            self.rank, e.rank)

@@ -20,15 +20,32 @@ from oncilla_tpu.core.errors import OcmError
 @dataclass(frozen=True)
 class NodeEntry:
     """One row of the cluster table (``struct node_entry`` analogue,
-    /root/reference/inc/nodefile.h:19-27)."""
+    /root/reference/inc/nodefile.h:19-27).
+
+    ``host`` is the DNS name used for self-rank detection; ``addr`` (the
+    reference's ethernet_ip column) is the address peers connect to, and
+    defaults to ``host`` for short-form nodefiles.
+    """
 
     rank: int
     host: str
     port: int
+    addr: str | None = None
+
+    @property
+    def connect_host(self) -> str:
+        return self.addr or self.host
 
 
 def parse_nodefile(path: str) -> list[NodeEntry]:
-    """Parse ``rank host port`` lines; '#' starts a comment."""
+    """Parse nodefile lines; '#' starts a comment. Three layouts:
+
+    - ``rank host port`` (short form)
+    - ``rank host ip port``
+    - ``rank host ip ocm_port rdmacm_port`` — the reference's format
+      (/root/reference/src/nodefile.c:30-37); the trailing per-fabric port is
+      ignored because the TPU data plane is connectionless.
+    """
     entries: list[NodeEntry] = []
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
@@ -36,11 +53,27 @@ def parse_nodefile(path: str) -> list[NodeEntry]:
             if not line:
                 continue
             parts = line.split()
-            if len(parts) < 3:
-                raise OcmError(f"{path}:{lineno}: expected 'rank host port'")
-            entries.append(
-                NodeEntry(rank=int(parts[0]), host=parts[1], port=int(parts[2]))
-            )
+            try:
+                if len(parts) == 3:
+                    entry = NodeEntry(
+                        rank=int(parts[0]), host=parts[1], port=int(parts[2])
+                    )
+                elif len(parts) in (4, 5):
+                    entry = NodeEntry(
+                        rank=int(parts[0]),
+                        host=parts[1],
+                        port=int(parts[3]),
+                        addr=parts[2],
+                    )
+                else:
+                    raise ValueError("wrong field count")
+            except ValueError:
+                raise OcmError(
+                    f"{path}:{lineno}: expected 'rank host port', "
+                    "'rank host ip port' or "
+                    "'rank host ip ocm_port rdmacm_port'"
+                ) from None
+            entries.append(entry)
     entries.sort(key=lambda e: e.rank)
     if [e.rank for e in entries] != list(range(len(entries))):
         raise OcmError(f"{path}: ranks must be contiguous from 0")

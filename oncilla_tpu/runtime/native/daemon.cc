@@ -177,10 +177,19 @@ class PeerPool {
 
 struct NodeEntry {
   int64_t rank;
-  std::string host;
+  std::string host;  // DNS name (self-rank detection / logs)
   int port;
+  std::string addr;  // connect address column; empty for short-form lines
+  // Address peers connect to: the nodefile's addr column when present,
+  // else the (possibly ADD_NODE-updated) host. Matches the Python
+  // NodeEntry.connect_host contract so mixed Python/C++ clusters route
+  // peers identically.
+  const std::string& caddr() const { return addr.empty() ? host : addr; }
 };
 
+// Accepts "rank host port", "rank host ip port", and the reference's
+// "rank host ip ocm_port rdmacm_port" (src/nodefile.c:30-37); the trailing
+// per-fabric port is ignored (the TPU data plane is connectionless).
 std::vector<NodeEntry> parse_nodefile(const std::string& path) {
   std::ifstream f(path);
   if (!f) throw std::runtime_error("cannot open nodefile " + path);
@@ -190,8 +199,24 @@ std::vector<NodeEntry> parse_nodefile(const std::string& path) {
     auto hash = line.find('#');
     if (hash != std::string::npos) line = line.substr(0, hash);
     std::istringstream ss(line);
+    std::vector<std::string> tok;
+    std::string t;
+    while (ss >> t) tok.push_back(t);
+    if (tok.empty()) continue;
     NodeEntry e;
-    if (ss >> e.rank >> e.host >> e.port) entries.push_back(e);
+    try {
+      if (tok.size() == 3) {
+        e = {std::stoll(tok[0]), tok[1], std::stoi(tok[2]), ""};
+      } else if (tok.size() == 4 || tok.size() == 5) {
+        e = {std::stoll(tok[0]), tok[1], std::stoi(tok[3]), tok[2]};
+      } else {
+        throw std::runtime_error("nodefile line has " +
+                                 std::to_string(tok.size()) + " fields");
+      }
+    } catch (const std::logic_error&) {  // stoi/stoll invalid or overflow
+      throw std::runtime_error("bad nodefile line: " + line);
+    }
+    entries.push_back(e);
   }
   std::sort(entries.begin(), entries.end(),
             [](auto& a, auto& b) { return a.rank < b.rank; });
@@ -543,7 +568,7 @@ class Daemon {
               {}};
     for (int attempt = 0; attempt < 40; ++attempt) {
       try {
-        peers_.request(entries_[0].host, entries_[0].port, m);
+        peers_.request(entries_[0].caddr(), entries_[0].port, m);
         return;
       } catch (const ProtocolError&) {
         std::this_thread::sleep_for(std::chrono::milliseconds(250));
@@ -674,7 +699,8 @@ class Daemon {
     int64_t rank = m.i("rank");
     if (rank >= 0 && size_t(rank) < entries_.size()) {
       std::lock_guard<std::mutex> g(entries_mu_);
-      entries_[rank] = {rank, m.s("host"), int(m.u("port"))};
+      entries_[rank] = {rank, m.s("host"), int(m.u("port")),
+                        entries_[rank].addr};
     }
     return {MsgType::ADD_NODE_OK, {{"nnodes", Value::I(placement_.nnodes())}}, {}};
   }
@@ -684,7 +710,7 @@ class Daemon {
       // Proxy the whole request to the master (the placement leg,
       // mem.c:128).
       NodeEntry r0 = entry(0);
-      return peers_.request(r0.host, r0.port, m);
+      return peers_.request(r0.caddr(), r0.port, m);
     }
     Kind kind = Kind(uint8_t(m.u("kind")));
     uint64_t nbytes = m.u("nbytes");
@@ -696,7 +722,7 @@ class Daemon {
                      m.i("orig_rank"), m.i("pid"), &alloc_id, &offset);
     } else {
       Message r = peers_.request(
-          owner.host, owner.port,
+          owner.caddr(), owner.port,
           {MsgType::DO_ALLOC,
            {{"orig_rank", Value::I(m.i("orig_rank"))},
             {"pid", Value::I(m.i("pid"))},
@@ -717,7 +743,7 @@ class Daemon {
              {"kind", Value::U(uint64_t(placed.kind))},
              {"offset", Value::U(offset)},
              {"nbytes", Value::U(nbytes)},
-             {"owner_host", Value::S(owner.host)},
+             {"owner_host", Value::S(owner.caddr())},
              {"owner_port", Value::U(uint64_t(owner.port))}},
             {}};
   }
@@ -761,7 +787,7 @@ class Daemon {
     } else {
       NodeEntry owner = entry(owner_rank);
       Message r = peers_.request(
-          owner.host, owner.port,
+          owner.caddr(), owner.port,
           {MsgType::DO_FREE, {{"alloc_id", Value::U(m.u("alloc_id"))}}, {}});
       if (r.type == MsgType::ERR) return r;
     }
@@ -788,7 +814,7 @@ class Daemon {
     } else {
       try {
         NodeEntry r0 = entry(0);
-        peers_.request(r0.host, r0.port, note);
+        peers_.request(r0.caddr(), r0.port, note);
       } catch (const ProtocolError&) {
       }
     }
@@ -940,7 +966,7 @@ class Daemon {
       } else {
         try {
           NodeEntry r0 = entry(0);
-          peers_.request(r0.host, r0.port, note);
+          peers_.request(r0.caddr(), r0.port, note);
         } catch (const ProtocolError&) {
         }
       }
@@ -989,7 +1015,7 @@ class Daemon {
         if (int64_t(r) == cfg_.rank) continue;
         try {
           NodeEntry e = entry(int64_t(r));
-          peers_.request(e.host, e.port, m);
+          peers_.request(e.caddr(), e.port, m);
         } catch (const ProtocolError&) {
         }
       }

@@ -18,10 +18,18 @@ BINARY = BUILD_DIR / "oncillamemd"
 
 
 def build(force: bool = False, tsan: bool = False) -> Path:
-    """Build oncillamemd with CMake (+ Ninja when available); cached."""
+    """Build oncillamemd with CMake (+ Ninja when available); cached, but
+    rebuilt whenever any native source is newer than the binary (a stale
+    cached binary would silently test old daemon code)."""
     target = BUILD_DIR / ("oncillamemd_tsan" if tsan else "oncillamemd")
     if target.exists() and not force:
-        return target
+        srcs = [
+            *NATIVE_DIR.glob("*.cc"),
+            *NATIVE_DIR.glob("*.hh"),
+            NATIVE_DIR / "CMakeLists.txt",
+        ]
+        if target.stat().st_mtime >= max(p.stat().st_mtime for p in srcs):
+            return target
     gen = ["-G", "Ninja"] if shutil.which("ninja") else []
     cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
     if tsan:

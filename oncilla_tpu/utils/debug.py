@@ -61,9 +61,15 @@ class Tracer:
 
     @contextmanager
     def span(self, op: str, nbytes: int = 0):
+        cls = _annotation_cls()
+        annotation = cls(f"ocm:{op}") if cls is not None else None
         t0 = time.perf_counter()
         try:
-            yield
+            if annotation is None:
+                yield
+            else:
+                with annotation:
+                    yield
         finally:
             dt = time.perf_counter() - t0
             with self._lock:
@@ -90,6 +96,45 @@ class Tracer:
                 }
                 for k, v in self._stats.items()
             }
+
+
+_ANNOTATION_CLS: object = False  # False = unresolved, None = unavailable
+
+
+def _annotation_cls():
+    """``jax.profiler.TraceAnnotation`` resolved once, so ocm op spans show
+    up on the TensorBoard trace timeline; None when the profiler is
+    unavailable (e.g. stripped minimal builds). Resolving per-span would put
+    an import lookup inside every timed hot-path op."""
+    global _ANNOTATION_CLS
+    if _ANNOTATION_CLS is False:
+        try:
+            import jax.profiler
+
+            _ANNOTATION_CLS = jax.profiler.TraceAnnotation
+        except Exception:  # noqa: BLE001
+            _ANNOTATION_CLS = None
+    return _ANNOTATION_CLS
+
+
+@contextmanager
+def capture_trace(log_dir: str):
+    """Capture a ``jax.profiler`` program trace around a block of ocm work::
+
+        with capture_trace("/tmp/ocm-trace"):
+            ctx.put(h, data)
+            ctx.get(h)
+
+    View with TensorBoard's profile plugin. Op spans recorded through
+    ``Tracer.span`` appear as ``ocm:<op>`` annotations on the timeline.
+    """
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
 
 
 GLOBAL_TRACER = Tracer()

@@ -1,0 +1,116 @@
+"""Nodefile parsing — all three accepted layouts (the 5-field one is the
+reference's format, /root/reference/src/nodefile.c:30-37) — and the tracer's
+profiler integration."""
+
+import pytest
+
+from oncilla_tpu.core.errors import OcmError
+from oncilla_tpu.runtime.membership import NodeEntry, parse_nodefile
+from oncilla_tpu.utils.debug import Tracer, capture_trace
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "nodefile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_short_form(tmp_path):
+    entries = parse_nodefile(_write(tmp_path, "0 hostA 17980\n1 hostB 17981\n"))
+    assert entries == [
+        NodeEntry(0, "hostA", 17980),
+        NodeEntry(1, "hostB", 17981),
+    ]
+    assert entries[0].connect_host == "hostA"
+
+
+def test_four_field_form(tmp_path):
+    entries = parse_nodefile(
+        _write(tmp_path, "0 hostA 10.0.0.1 17980\n1 hostB 10.0.0.2 17980\n")
+    )
+    assert entries[0].host == "hostA"
+    assert entries[0].connect_host == "10.0.0.1"
+    assert entries[1].port == 17980
+
+
+def test_reference_five_field_form(tmp_path):
+    # "#rank hostname ethernet_ip ocm_port rdmacm_port"; the per-fabric
+    # port column is parsed but ignored (connectionless data plane).
+    entries = parse_nodefile(
+        _write(
+            tmp_path,
+            "# rank host ip ocm rdmacm\n"
+            "0 shiva 10.0.0.1 17980 67980\n"
+            "1 ifrit 10.0.0.2 17980 67981\n",
+        )
+    )
+    assert [e.rank for e in entries] == [0, 1]
+    assert entries[1].connect_host == "10.0.0.2"
+    assert entries[1].port == 17980
+
+
+def test_bad_field_count(tmp_path):
+    with pytest.raises(OcmError, match="expected"):
+        parse_nodefile(_write(tmp_path, "0 hostA\n"))
+
+
+def test_non_numeric_port(tmp_path):
+    with pytest.raises(OcmError, match="expected"):
+        parse_nodefile(_write(tmp_path, "0 hostA 10.0.0.1\n"))
+
+
+def test_noncontiguous_ranks(tmp_path):
+    with pytest.raises(OcmError, match="contiguous"):
+        parse_nodefile(_write(tmp_path, "0 a 1\n2 b 2\n"))
+
+
+def test_host_addr_split_cluster():
+    # Entries whose DNS-name column is unroutable but whose addr column is
+    # loopback: every control/data-plane connection must use the addr
+    # (regression: ADD_NODE used to clobber the nodefile addr with the
+    # announced bind host).
+    import numpy as np
+
+    from oncilla_tpu.core.context import Ocm
+    from oncilla_tpu.runtime.client import ControlPlaneClient
+    from oncilla_tpu.runtime.daemon import Daemon
+    from oncilla_tpu.utils.config import OcmConfig
+    from oncilla_tpu import OcmKind
+
+    cfg = OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=4 << 20)
+    entries = [
+        NodeEntry(r, f"nosuchhost{r}", 0, addr="127.0.0.1") for r in range(2)
+    ]
+    daemons = [Daemon(r, entries, config=cfg) for r in range(2)]
+    for d in daemons:
+        d.start()
+    try:
+        client = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+        ctx = Ocm(config=cfg, remote=client)
+        h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = np.random.default_rng(3).integers(0, 256, 1 << 20, dtype=np.uint8)
+        ctx.put(h, data)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+        ctx.free(h)
+        ctx.tini()
+    finally:
+        for d in daemons:
+            d.stop()
+
+
+def test_tracer_span_with_profiler_annotation():
+    tr = Tracer()
+    with tr.span("put", nbytes=128):
+        pass
+    st = tr.stats("put")
+    assert st.count == 1 and st.total_bytes == 128
+
+
+def test_capture_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with capture_trace(str(tmp_path / "trace")):
+        jnp.ones(8).sum().block_until_ready()
+    assert any((tmp_path / "trace").rglob("*")), "no trace output written"
+    del jax
