@@ -14,7 +14,9 @@ from oncilla_tpu.core.context import (
     ocm_alloc,
     ocm_alloc_kind,
     ocm_copy,
+    ocm_copy_in,
     ocm_copy_onesided,
+    ocm_copy_out,
     ocm_free,
     ocm_init,
     ocm_is_remote,
@@ -55,7 +57,9 @@ __all__ = [
     "ocm_alloc",
     "ocm_alloc_kind",
     "ocm_copy",
+    "ocm_copy_in",
     "ocm_copy_onesided",
+    "ocm_copy_out",
     "ocm_free",
     "ocm_init",
     "ocm_is_remote",

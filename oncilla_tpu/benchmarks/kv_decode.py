@@ -1,0 +1,155 @@
+"""Decode throughput with OCM-paged KV cache — BASELINE.md config 5.
+
+Measures single-chip tokens/s for a Llama-style decoder in three modes:
+
+- ``plain``: classic in-HBM KV cache (``llama.decode_step``), the ceiling.
+- ``device``: KV history paged through OCM into the chip's HBM *arena*
+  (``OcmKind.LOCAL_DEVICE``) via :class:`BucketedPagedDecoder` — on a pod
+  the same loop lands pages in a *remote* chip's arena over ICI.
+- ``host``: pages ride to host DRAM (``OcmKind.LOCAL_HOST``) — the
+  device->host->device round trip is the single-chip analogue of the DCN
+  arm.
+
+The bucketed decoder keeps shapes static per page (O(tokens/page)
+compilations), which is what makes this measurable on real hardware: the
+unjitted reference path recompiles every token.
+
+The paged arms run the decoder with ``refetch=True``: every completed page
+is shipped out with a one-sided put AND the whole paged context is read
+back through one-sided gets at each page boundary, so both directions of
+the data plane are on the measured path (the usage pattern of
+/root/reference/test/ocm_test.c test 2, with a transformer as the
+application; the reference has no ML analogue).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.benchmarks._util import fence as _sync
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.models import llama
+from oncilla_tpu.models.kv_paging import BucketedPagedDecoder
+
+
+_decode_step = partial(jax.jit, static_argnames=("cfg",))(llama.decode_step)
+
+
+def bench_plain(params, cfg, tokens) -> float:
+    """Tokens/s for cached in-HBM decode (the ceiling). The cache is sized
+    to the decoded length, not cfg.max_seq, so per-step attention work
+    matches the paged arms (a 2048-slot cache for a 384-token run would
+    understate — even negate — the reported paging overhead)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, max_seq=tokens.shape[1])
+
+    def run():
+        kv = llama.make_kv_cache(cfg, 1, dtype=cfg.dtype)
+        logits = None
+        for i in range(tokens.shape[1]):
+            logits, kv = _decode_step(
+                params, tokens[:, i], jnp.int32(i), kv, cfg
+            )
+        _sync(logits)
+
+    run()  # compile
+    t0 = time.perf_counter()
+    run()
+    return tokens.shape[1] / (time.perf_counter() - t0)
+
+
+def bench_paged(params, cfg, tokens, ctx, kind, page_tokens) -> float:
+    """Tokens/s with KV history paged through OCM handles."""
+
+    def run():
+        dec = BucketedPagedDecoder(
+            params, cfg, ctx, batch=1, page_tokens=page_tokens, kind=kind,
+            dtype=cfg.dtype, refetch=True,
+        )
+        logits = None
+        for i in range(tokens.shape[1]):
+            logits = dec.step(tokens[:, i])
+        _sync(logits)
+        dec.close()
+
+    run()  # compile all page buckets
+    t0 = time.perf_counter()
+    run()
+    return tokens.shape[1] / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    import oncilla_tpu as ocm
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tokens", type=int, default=384)
+    ap.add_argument("--page-tokens", type=int, default=128)
+    ap.add_argument(
+        "--modes", default="plain,device,host",
+        help="comma list of plain|device|host",
+    )
+    ap.add_argument("--config", choices=["small", "tiny"], default="small")
+    args = ap.parse_args()
+
+    cfg = (
+        llama.LlamaConfig() if args.config == "small"
+        else llama.LlamaConfig.tiny()
+    )
+    params = llama.init_params_host(0, cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(1, args.tokens), dtype=np.int32)
+    )
+
+    # Arena sized for all pages of the run (both timed + warmup sessions
+    # free their pages on close).
+    page_bytes = (
+        2 * cfg.n_layers * cfg.n_kv_heads * args.page_tokens * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    npages = args.tokens // args.page_tokens
+    arena = max(64 << 20, 2 * npages * page_bytes)
+    ctx = ocm.ocm_init(
+        ocm.OcmConfig(host_arena_bytes=arena, device_arena_bytes=arena)
+    )
+
+    out = {"config": args.config, "tokens": args.tokens,
+           "page_tokens": args.page_tokens, "tok_s": {}}
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    for mode in modes:
+        if mode == "plain":
+            tps = bench_plain(params, cfg, tokens)
+        elif mode == "device":
+            tps = bench_paged(
+                params, cfg, tokens, ctx, OcmKind.LOCAL_DEVICE,
+                args.page_tokens,
+            )
+        elif mode == "host":
+            tps = bench_paged(
+                params, cfg, tokens, ctx, OcmKind.LOCAL_HOST,
+                args.page_tokens,
+            )
+        else:
+            raise SystemExit(f"unknown mode {mode!r}")
+        out["tok_s"][mode] = round(tps, 2)
+
+    if "plain" in out["tok_s"]:
+        base = out["tok_s"]["plain"]
+        out["paging_overhead"] = {
+            m: round(base / v - 1.0, 4)
+            for m, v in out["tok_s"].items() if m != "plain" and v
+        }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

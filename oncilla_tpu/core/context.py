@@ -327,3 +327,18 @@ def ocm_copy_onesided(
         n = _nbytes_of(local) if local is not None else None
         return ctx.get(handle, n, offset)
     raise ValueError(f"op must be 'read' or 'write', got {op!r}")
+
+
+def ocm_copy_out(ctx: Ocm, src: OcmAlloc, nbytes: int | None = None,
+                 offset: int = 0):
+    """``ocm_copy_out`` (/root/reference/inc/oncillamem.h:84): drain an
+    allocation into a fresh local buffer. The reference left this as a −1
+    stub (lib.c:491-494); here it is a working one-sided read."""
+    return ctx.get(src, nbytes, offset)
+
+
+def ocm_copy_in(ctx: Ocm, dst: OcmAlloc, src, offset: int = 0) -> None:
+    """``ocm_copy_in`` (/root/reference/inc/oncillamem.h:85): fill an
+    allocation from a local buffer. The reference left this as a −1 stub
+    (lib.c:496-499); here it is a working one-sided write."""
+    ctx.put(dst, src, offset)

@@ -13,6 +13,7 @@ application.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -135,6 +136,145 @@ def paged_decode_step(
 
     logits = llama.final_logits(params, x, cfg)
     return logits[:, 0], (jnp.stack(new_k), jnp.stack(new_v))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def paged_decode_step_jit(
+    params: dict,
+    token: jax.Array,      # (B,) current token ids
+    pos: jax.Array,        # scalar current absolute position
+    k_ctx: jax.Array,      # (L, B, KV, C, Hd) paged context; C may be 0
+    v_ctx: jax.Array,
+    tail_k: jax.Array,     # (L, B, KV, P, Hd) local tail buffer
+    tail_v: jax.Array,
+    tail_len: jax.Array,   # scalar: valid tail entries before this step
+    cfg: LlamaConfig,
+):
+    """Shape-bucketed jitted paged decode.
+
+    Unlike :func:`paged_decode_step` (whose context length grows by one
+    every token, forcing an XLA recompile per step), the tail lives in a
+    fixed (L, B, KV, P, Hd) buffer masked by ``tail_len``, so the traced
+    shapes change only when the paged context ``C`` grows by a page:
+    O(tokens / page_tokens) compilations instead of O(tokens). This is the
+    static-shape formulation TPU/XLA wants and what makes paged decode
+    usable as a real-chip benchmark (BASELINE.md config 5).
+
+    Returns (logits, new_tail_k, new_tail_v); the caller owns tail_len
+    bookkeeping and page shipping.
+    """
+    from oncilla_tpu.models import llama
+
+    x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
+    positions = pos[None] if pos.ndim == 0 else pos
+    P = tail_k.shape[3]
+    C = k_ctx.shape[3]
+    # Keys = [paged context (all valid) | tail slots (valid through this
+    # step's insertion at index tail_len)].
+    valid = jnp.concatenate(
+        [jnp.ones((C,), bool), jnp.arange(P) <= tail_len]
+    )[None, :]
+
+    for i in range(cfg.n_layers):
+        state = {}
+
+        def attend(q, kn, vn, i=i, state=state):
+            tk = jax.lax.dynamic_update_slice(
+                tail_k[i], kn.astype(tail_k.dtype), (0, 0, tail_len, 0)
+            )
+            tv = jax.lax.dynamic_update_slice(
+                tail_v[i], vn.astype(tail_v.dtype), (0, 0, tail_len, 0)
+            )
+            state["tk"], state["tv"] = tk, tv
+            k_all = jnp.concatenate(
+                [k_ctx[i].astype(q.dtype), tk.astype(q.dtype)], axis=2
+            )
+            v_all = jnp.concatenate(
+                [v_ctx[i].astype(q.dtype), tv.astype(q.dtype)], axis=2
+            )
+            return llama.grouped_attention(q, k_all, v_all, valid)
+
+        x = llama.block(cfg, x, llama.layer_params(params, i), positions, attend)
+        tail_k = tail_k.at[i].set(state["tk"])
+        tail_v = tail_v.at[i].set(state["tv"])
+
+    logits = llama.final_logits(params, x, cfg)
+    return logits[:, 0], tail_k, tail_v
+
+
+class BucketedPagedDecoder:
+    """Jitted decode session with OCM-paged KV history.
+
+    Same contract as :class:`PagedDecoder`, but decode steps run through
+    :func:`paged_decode_step_jit` with a fixed-size masked tail, so a long
+    decode compiles once per *page* rather than once per *token*.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: LlamaConfig,
+        backend,
+        batch: int = 1,
+        page_tokens: int = 16,
+        kind: OcmKind = OcmKind.REMOTE_DEVICE,
+        dtype: str = "float32",
+        refetch: bool = False,
+    ):
+        """``refetch=True`` re-reads the *whole* paged context through the
+        OCM data plane (one-sided gets) at every page boundary instead of
+        extending a locally retained copy — O(pages^2) read traffic, the
+        mode that actually exercises the get path (and what a resumed
+        session with no local copy would do every page)."""
+        self.params = params
+        self.cfg = cfg
+        self.cache = PagedKVCache(backend, cfg, batch, page_tokens, kind, dtype)
+        self.page_tokens = page_tokens
+        self.refetch = refetch
+        self.pos = 0
+        shape = (cfg.n_layers, batch, cfg.n_kv_heads, page_tokens, cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        self._tail_k = jnp.zeros(shape, dt)
+        self._tail_v = jnp.zeros(shape, dt)
+        self._tail_len = 0
+        # Paged context starts empty (C = 0); grows a page at a time.
+        empty = shape[:3] + (0,) + shape[4:]
+        self._fetched = (jnp.zeros(empty, dt), jnp.zeros(empty, dt))
+
+    def step(self, token: jax.Array) -> jax.Array:
+        logits, self._tail_k, self._tail_v = paged_decode_step_jit(
+            self.params, token, jnp.int32(self.pos),
+            self._fetched[0], self._fetched[1],
+            self._tail_k, self._tail_v, jnp.int32(self._tail_len), self.cfg,
+        )
+        self.pos += 1
+        self._tail_len += 1
+        if self._tail_len == self.page_tokens:
+            # Ship the full tail into the pod and extend the local concat
+            # (same O(pages) traffic policy as PagedDecoder.step).
+            k_page = self._tail_k.astype(jnp.dtype(self.cache.dtype))
+            v_page = self._tail_v.astype(jnp.dtype(self.cache.dtype))
+            self.cache.store_page(k_page, v_page)
+            dt = jnp.dtype(self.cfg.dtype)
+            if self.refetch:
+                fk, fv = self.cache.fetch_pages()
+                self._fetched = (fk.astype(dt), fv.astype(dt))
+            else:
+                self._fetched = (
+                    jnp.concatenate(
+                        [self._fetched[0], k_page.astype(dt)], axis=3
+                    ),
+                    jnp.concatenate(
+                        [self._fetched[1], v_page.astype(dt)], axis=3
+                    ),
+                )
+            # Stale tail contents are masked out by tail_len; no need to
+            # zero the buffers.
+            self._tail_len = 0
+        return logits
+
+    def close(self) -> None:
+        self.cache.free()
 
 
 class PagedDecoder:

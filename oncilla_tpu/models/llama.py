@@ -66,37 +66,64 @@ LAYER_KEYS = (
 )
 
 
-def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
-    """Scaled-normal init; layers stacked along a leading axis so the whole
-    model is a handful of leaves (sharding-friendly)."""
-    k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
-    dt = jnp.dtype(cfg.dtype)
+def param_spec(cfg: LlamaConfig) -> dict:
+    """{name: (shape, init_scale | None)} for every weight leaf; None means
+    a ones-initialized norm gain. The single source of truth both
+    initializers consume, so they cannot drift structurally."""
     L, D, H, KV, Hd, F = (
         cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
         cfg.ffn_hidden,
     )
-
-    def norm(key, shape, scale):
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dt)
-
-    ks = jax.random.split(k_attn, 4)
-    km = jax.random.split(k_mlp, 3)
     s_in = 1.0 / np.sqrt(D)
     s_out = 1.0 / np.sqrt(2 * L * D)
     return {
-        "embed": norm(k_emb, (cfg.vocab, D), 1.0),
-        "wq": norm(ks[0], (L, D, H * Hd), s_in),
-        "wk": norm(ks[1], (L, D, KV * Hd), s_in),
-        "wv": norm(ks[2], (L, D, KV * Hd), s_in),
-        "wo": norm(ks[3], (L, H * Hd, D), s_out),
-        "w_gate": norm(km[0], (L, D, F), s_in),
-        "w_up": norm(km[1], (L, D, F), s_in),
-        "w_down": norm(km[2], (L, F, D), s_out),
-        "ln_attn": jnp.ones((L, D), dtype=jnp.float32),
-        "ln_mlp": jnp.ones((L, D), dtype=jnp.float32),
-        "ln_out": jnp.ones((D,), dtype=jnp.float32),
-        "lm_head": norm(k_out, (D, cfg.vocab), s_in),
+        "embed": ((cfg.vocab, D), 1.0),
+        "wq": ((L, D, H * Hd), s_in),
+        "wk": ((L, D, KV * Hd), s_in),
+        "wv": ((L, D, KV * Hd), s_in),
+        "wo": ((L, H * Hd, D), s_out),
+        "w_gate": ((L, D, F), s_in),
+        "w_up": ((L, D, F), s_in),
+        "w_down": ((L, F, D), s_out),
+        "ln_attn": ((L, D), None),
+        "ln_mlp": ((L, D), None),
+        "ln_out": ((D,), None),
+        "lm_head": ((D, cfg.vocab), s_in),
     }
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Scaled-normal init; layers stacked along a leading axis so the whole
+    model is a handful of leaves (sharding-friendly)."""
+    spec = param_spec(cfg)
+    dt = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(key, len(spec))
+    out = {}
+    for k, (name, (shape, scale)) in zip(keys, spec.items()):
+        if scale is None:
+            out[name] = jnp.ones(shape, dtype=jnp.float32)
+        else:
+            out[name] = (
+                jax.random.normal(k, shape, dtype=jnp.float32) * scale
+            ).astype(dt)
+    return out
+
+
+def init_params_host(seed: int, cfg: LlamaConfig) -> dict:
+    """Same pytree as :func:`init_params` (not bit-identical), built with
+    numpy on the host and transferred. On a tunneled dev chip the jax.random
+    path compiles one kernel per weight shape (minutes of first-run wall
+    time); benchmarks that do not care about the exact init use this."""
+    rng = np.random.default_rng(seed)
+    dt = jnp.dtype(cfg.dtype)
+    out = {}
+    for name, (shape, scale) in param_spec(cfg).items():
+        if scale is None:
+            out[name] = jax.device_put(np.ones(shape, dtype=np.float32))
+        else:
+            x = rng.standard_normal(shape, dtype=np.float32) * scale
+            out[name] = jax.device_put(x.astype(dt))
+    return out
 
 
 def layer_params(params: dict, i: int) -> dict:

@@ -68,3 +68,52 @@ def test_paged_decoder_frees_pages(rng):
         assert cl.daemons[1].registry.live_count() == len(dec.cache.pages) > 0
         dec.close()
         assert cl.daemons[1].registry.live_count() == 0
+
+
+def test_bucketed_paged_decode_matches_reference(rng):
+    # The jitted shape-bucketed path must be numerically identical to plain
+    # cached decode (and hence to the unjitted PagedDecoder).
+    cfg_rt = OcmConfig(host_arena_bytes=32 << 20, device_arena_bytes=32 << 20)
+    params = llama.init_params(jax.random.key(5), CFG)
+    tokens = jnp.asarray(
+        rng.integers(0, CFG.vocab, size=(1, 21), dtype=np.int32)
+    )
+    want = reference_decode(params, tokens)
+
+    with local_cluster(2, config=cfg_rt) as cl:
+        client = cl.client(0)
+        dec = kv_paging.BucketedPagedDecoder(
+            params, CFG, client, batch=1, page_tokens=8,
+            kind=OcmKind.REMOTE_HOST,
+        )
+        got = []
+        for i in range(21):  # 21 tokens / page 8 -> 2 pages + partial tail
+            got.append(np.asarray(dec.step(tokens[:, i])))
+        assert len(dec.cache.pages) == 2
+        for h in dec.cache.pages:
+            assert h.is_remote
+        dec.close()
+
+    np.testing.assert_allclose(np.stack(got), want, atol=2e-3, rtol=2e-3)
+
+
+def test_bucketed_refetch_matches_reference(rng):
+    # refetch=True replaces the locally retained context with bytes read
+    # back through the data plane — results must be identical.
+    cfg_rt = OcmConfig(host_arena_bytes=32 << 20, device_arena_bytes=32 << 20)
+    params = llama.init_params(jax.random.key(6), CFG)
+    tokens = jnp.asarray(
+        rng.integers(0, CFG.vocab, size=(1, 20), dtype=np.int32)
+    )
+    want = reference_decode(params, tokens)
+
+    with local_cluster(2, config=cfg_rt) as cl:
+        client = cl.client(0)
+        dec = kv_paging.BucketedPagedDecoder(
+            params, CFG, client, batch=1, page_tokens=8,
+            kind=OcmKind.REMOTE_HOST, refetch=True,
+        )
+        got = [np.asarray(dec.step(tokens[:, i])) for i in range(20)]
+        dec.close()
+
+    np.testing.assert_allclose(np.stack(got), want, atol=2e-3, rtol=2e-3)

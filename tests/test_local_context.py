@@ -130,3 +130,36 @@ def test_arena_reuse_many_allocs(ctx):
             ctx.free(h)
     assert ctx.host_arena.allocator.bytes_live == 0
     assert ctx.device_arenas[0].allocator.bytes_live == 0
+
+
+def test_ocm_copy_out_in_named_api():
+    # The reference declares ocm_copy_out/ocm_copy_in but ships -1 stubs
+    # (/root/reference/src/lib.c:491-499); here they are working one-sided
+    # read/write wrappers.
+    import numpy as np
+
+    import oncilla_tpu as ocm
+    from oncilla_tpu import OcmKind
+
+    ctx = ocm.ocm_init(
+        ocm.OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=4 << 20)
+    )
+    try:
+        data = np.random.default_rng(0).integers(
+            0, 256, 1 << 16, dtype=np.uint8
+        )
+        for kind in (OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE):
+            h = ctx.alloc(1 << 16, kind)
+            ocm.ocm_copy_in(ctx, h, data)
+            np.testing.assert_array_equal(
+                np.asarray(ocm.ocm_copy_out(ctx, h)), data
+            )
+            # offset round trip
+            ocm.ocm_copy_in(ctx, h, data[:1024], offset=2048)
+            np.testing.assert_array_equal(
+                np.asarray(ocm.ocm_copy_out(ctx, h, nbytes=1024, offset=2048)),
+                data[:1024],
+            )
+            ctx.free(h)
+    finally:
+        ctx.tini()

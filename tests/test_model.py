@@ -93,3 +93,17 @@ def test_ring_matches_dense_bf16(rng):
     np.testing.assert_allclose(
         np.asarray(ring), np.asarray(dense), atol=5e-2, rtol=5e-2
     )
+
+
+def test_init_params_host_matches_pytree():
+    # init_params_host must stay structurally identical to init_params
+    # (same leaves, shapes, dtypes) — it exists to skip on-device random
+    # kernel compiles, not to define a different model.
+    import jax
+
+    a = llama.init_params(jax.random.key(0), CFG)
+    b = llama.init_params_host(0, CFG)
+    assert jax.tree.structure(a) == jax.tree.structure(b)
+    sa = jax.tree.map(lambda x: (x.shape, str(x.dtype)), a)
+    sb = jax.tree.map(lambda x: (x.shape, str(x.dtype)), b)
+    assert sa == sb
