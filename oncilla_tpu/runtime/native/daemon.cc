@@ -38,6 +38,8 @@
 #include <vector>
 
 #include "arena.hh"
+#include "membership.hh"
+#include "net.hh"
 #include "protocol.hh"
 
 namespace ocm {
@@ -47,64 +49,6 @@ double now_s() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
-}
-
-// ---------------------------------------------------------------------------
-// Socket plumbing (conn_put/conn_get analogue, /root/reference/src/sock.c).
-// ---------------------------------------------------------------------------
-
-void send_all(int fd, const uint8_t* p, size_t n) {
-  while (n) {
-    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (w <= 0) throw ProtocolError("send failed");
-    p += w;
-    n -= size_t(w);
-  }
-}
-
-void recv_all(int fd, uint8_t* p, size_t n) {
-  while (n) {
-    ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) throw ProtocolError("peer closed");
-    p += r;
-    n -= size_t(r);
-  }
-}
-
-void send_msg(int fd, const Message& m) {
-  auto buf = pack(m);
-  send_all(fd, buf.data(), buf.size());
-}
-
-Message recv_msg(int fd) {
-  uint8_t header[kHeaderSize];
-  recv_all(fd, header, kHeaderSize);
-  uint64_t plen = 0;
-  for (int i = 0; i < 4; ++i) plen |= uint64_t(header[8 + i]) << (8 * i);
-  if (plen > kMaxPayload) throw ProtocolError("advertised payload too large");
-  std::vector<uint8_t> payload(plen);
-  if (plen) recv_all(fd, payload.data(), plen);
-  return unpack(header, payload.data(), plen);
-}
-
-int dial(const std::string& host, int port) {
-  struct addrinfo hints = {};
-  hints.ai_family = AF_INET;
-  hints.ai_socktype = SOCK_STREAM;
-  struct addrinfo* res = nullptr;
-  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res))
-    throw ProtocolError("resolve failed for " + host);
-  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
-    freeaddrinfo(res);
-    if (fd >= 0) ::close(fd);
-    throw ProtocolError("connect failed to " + host + ":" +
-                        std::to_string(port));
-  }
-  freeaddrinfo(res);
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  return fd;
 }
 
 // Cached peer connections, no re-send on failure (pool.py semantics: control
@@ -174,57 +118,6 @@ class PeerPool {
 // ---------------------------------------------------------------------------
 // Membership, registry, placement.
 // ---------------------------------------------------------------------------
-
-struct NodeEntry {
-  int64_t rank;
-  std::string host;  // DNS name (self-rank detection / logs)
-  int port;
-  std::string addr;  // connect address column; empty for short-form lines
-  // Address peers connect to: the nodefile's addr column when present,
-  // else the (possibly ADD_NODE-updated) host. Matches the Python
-  // NodeEntry.connect_host contract so mixed Python/C++ clusters route
-  // peers identically.
-  const std::string& caddr() const { return addr.empty() ? host : addr; }
-};
-
-// Accepts "rank host port", "rank host ip port", and the reference's
-// "rank host ip ocm_port rdmacm_port" (src/nodefile.c:30-37); the trailing
-// per-fabric port is ignored (the TPU data plane is connectionless).
-std::vector<NodeEntry> parse_nodefile(const std::string& path) {
-  std::ifstream f(path);
-  if (!f) throw std::runtime_error("cannot open nodefile " + path);
-  std::vector<NodeEntry> entries;
-  std::string line;
-  while (std::getline(f, line)) {
-    auto hash = line.find('#');
-    if (hash != std::string::npos) line = line.substr(0, hash);
-    std::istringstream ss(line);
-    std::vector<std::string> tok;
-    std::string t;
-    while (ss >> t) tok.push_back(t);
-    if (tok.empty()) continue;
-    NodeEntry e;
-    try {
-      if (tok.size() == 3) {
-        e = {std::stoll(tok[0]), tok[1], std::stoi(tok[2]), ""};
-      } else if (tok.size() == 4 || tok.size() == 5) {
-        e = {std::stoll(tok[0]), tok[1], std::stoi(tok[3]), tok[2]};
-      } else {
-        throw std::runtime_error("nodefile line has " +
-                                 std::to_string(tok.size()) + " fields");
-      }
-    } catch (const std::logic_error&) {  // stoi/stoll invalid or overflow
-      throw std::runtime_error("bad nodefile line: " + line);
-    }
-    entries.push_back(e);
-  }
-  std::sort(entries.begin(), entries.end(),
-            [](auto& a, auto& b) { return a.rank < b.rank; });
-  for (size_t i = 0; i < entries.size(); ++i)
-    if (entries[i].rank != int64_t(i))
-      throw std::runtime_error("nodefile ranks must be contiguous from 0");
-  return entries;
-}
 
 struct RegEntry {
   uint64_t alloc_id;

@@ -1,0 +1,362 @@
+// libocm_tpu — C-linkable client library for the oncilla-tpu control plane.
+//
+// The app half of the reference's libocm (/root/reference/src/lib.c) rebuilt
+// on this framework's versioned wire protocol: CONNECT handshake with the
+// local daemon (lib.c:98-132), REQ_ALLOC/REQ_FREE through it, and chunked,
+// pipelined DATA_PUT/DATA_GET straight to the owner daemon (the one-sided
+// data plane that bypasses the local daemon per transfer, SURVEY.md §1;
+// window scheme of extoll_rma2_transfer, extoll.c:47-173). Mirrors
+// oncilla_tpu/runtime/client.py (the executable spec).
+//
+// Built as a shared library so C/C++/Fortran applications can drive the
+// same daemons as the Python binding.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "membership.hh"
+#include "net.hh"
+#include "ocm_client.h"
+#include "protocol.hh"
+
+namespace {
+
+using namespace ocm;
+
+std::mutex g_init_err_mu;
+std::string g_init_err;  // ocmc_last_error(NULL)
+
+struct DataConn {
+  int fd = -1;
+  std::mutex mu;
+  ~DataConn() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+struct ocmc_ctx {
+  std::vector<NodeEntry> entries;
+  int64_t rank = 0;
+  int64_t pid = 0;
+  int64_t nnodes = 0;
+  uint64_t chunk_bytes = 8u << 20;  // extoll.c:49-51
+  int inflight = 2;                 // extoll.c:44-47
+  int ctrl_fd = -1;
+  std::mutex ctrl_mu;
+  std::map<std::string, std::shared_ptr<DataConn>> data_conns;
+  std::mutex data_mu;
+  std::string last_error;
+  std::mutex err_mu;
+  std::thread hb_thread;
+  std::atomic<bool> hb_stop{false};
+  std::condition_variable hb_cv;
+  std::mutex hb_mu;
+
+  ~ocmc_ctx() {
+    hb_stop = true;
+    hb_cv.notify_all();
+    if (hb_thread.joinable()) hb_thread.join();
+    if (ctrl_fd >= 0) {
+      try {
+        Message m{MsgType::DISCONNECT, {{"pid", Value::I(pid)}}, {}};
+        send_msg(ctrl_fd, m);
+      } catch (...) {
+      }
+      ::close(ctrl_fd);
+    }
+  }
+
+  void set_error(const std::string& e) {
+    std::lock_guard<std::mutex> g(err_mu);
+    last_error = e;
+  }
+
+  Message ctrl_request(const Message& m) {
+    std::lock_guard<std::mutex> g(ctrl_mu);
+    send_msg(ctrl_fd, m);
+    Message r = recv_msg(ctrl_fd);
+    if (r.type == MsgType::ERR)
+      throw ProtocolError("daemon error " + std::to_string(r.u("code")) +
+                          ": " + r.s("detail"));
+    return r;
+  }
+
+  std::shared_ptr<DataConn> data_conn(const std::string& host, int port) {
+    auto key = host + ":" + std::to_string(port);
+    std::lock_guard<std::mutex> g(data_mu);
+    auto it = data_conns.find(key);
+    if (it != data_conns.end()) return it->second;
+    auto c = std::make_shared<DataConn>();
+    c->fd = dial(host, port);
+    data_conns[key] = c;
+    return c;
+  }
+
+  void evict_data_conn(const std::string& host, int port) {
+    auto key = host + ":" + std::to_string(port);
+    std::lock_guard<std::mutex> g(data_mu);
+    data_conns.erase(key);  // ~DataConn closes when last user drops it
+  }
+
+  // Chunked, windowed transfer to the owner daemon (client.py
+  // _pipelined_once): keep `inflight` requests on the wire; on a daemon
+  // ERR reply drain the remaining in-flight replies before failing so the
+  // cached connection stays in sync; transport errors evict it. One full
+  // retry through the membership address (DATA_PUT/GET are idempotent).
+  void transfer(const ocmc_handle* h, uint64_t total,
+                const std::function<Message(uint64_t, uint64_t)>& make_req,
+                const std::function<void(const Message&, uint64_t, uint64_t)>&
+                    on_reply) {
+    try {
+      transfer_once(h->owner_host, int(h->owner_port), total, make_req,
+                    on_reply);
+      return;
+    } catch (const ProtocolError& e) {
+      if (std::string(e.what()).rfind("daemon error", 0) == 0) throw;
+      const NodeEntry& e2 = entries.at(size_t(h->rank));
+      transfer_once(e2.caddr(), e2.port, total, make_req, on_reply);
+    }
+  }
+
+  void transfer_once(
+      const std::string& host, int port, uint64_t total,
+      const std::function<Message(uint64_t, uint64_t)>& make_req,
+      const std::function<void(const Message&, uint64_t, uint64_t)>&
+          on_reply) {
+    auto c = data_conn(host, port);
+    std::lock_guard<std::mutex> g(c->mu);
+    std::deque<std::pair<uint64_t, uint64_t>> window;  // (chunk_off, nbytes)
+    uint64_t pos = 0;
+    std::string failure;
+    try {
+      while (pos < total || !window.empty()) {
+        while (pos < total && window.size() < size_t(inflight) &&
+               failure.empty()) {
+          uint64_t n = std::min(chunk_bytes, total - pos);
+          send_msg(c->fd, make_req(pos, n));
+          window.emplace_back(pos, n);
+          pos += n;
+        }
+        if (window.empty()) break;
+        Message r = recv_msg(c->fd);
+        auto [start, n] = window.front();
+        window.pop_front();
+        if (r.type == MsgType::ERR) {
+          if (failure.empty())
+            failure = "daemon error " + std::to_string(r.u("code")) + ": " +
+                      r.s("detail");
+        } else if (failure.empty()) {
+          on_reply(r, start, n);
+        }
+      }
+    } catch (const ProtocolError&) {
+      evict_data_conn(host, port);
+      throw;
+    }
+    if (!failure.empty()) throw ProtocolError(failure);
+  }
+};
+
+namespace {
+
+void heartbeat_loop(ocmc_ctx* ctx, double period_s) {
+  std::unique_lock<std::mutex> lk(ctx->hb_mu);
+  while (!ctx->hb_stop) {
+    ctx->hb_cv.wait_for(
+        lk, std::chrono::duration<double>(period_s),
+        [&] { return ctx->hb_stop.load(); });
+    if (ctx->hb_stop) return;
+    try {
+      ctx->ctrl_request(Message{MsgType::HEARTBEAT,
+                                {{"rank", Value::I(ctx->rank)},
+                                 {"pid", Value::I(ctx->pid)}},
+                                {}});
+    } catch (...) {  // transient: next beat retries
+    }
+  }
+}
+
+bool kind_is_device(uint8_t k) {
+  return k == OCMC_KIND_LOCAL_DEVICE || k == OCMC_KIND_REMOTE_DEVICE;
+}
+
+}  // namespace
+
+extern "C" {
+
+ocmc_ctx* ocmc_init(const char* nodefile, int64_t rank, double heartbeat_s) {
+  auto fail = [&](const std::string& e) -> ocmc_ctx* {
+    std::lock_guard<std::mutex> g(g_init_err_mu);
+    g_init_err = e;
+    return nullptr;
+  };
+  try {
+    auto ctx = std::make_unique<ocmc_ctx>();
+    ctx->entries = parse_nodefile(nodefile ? nodefile : "");
+    if (rank < 0 || size_t(rank) >= ctx->entries.size())
+      return fail("rank out of range for nodefile");
+    ctx->rank = rank;
+    ctx->pid = int64_t(::getpid());
+    const NodeEntry& me = ctx->entries[size_t(rank)];
+    ctx->ctrl_fd = dial(me.caddr(), me.port);
+    Message r = ctx->ctrl_request(Message{
+        MsgType::CONNECT,
+        {{"pid", Value::I(ctx->pid)}, {"rank", Value::I(rank)}},
+        {}});
+    if (r.type != MsgType::CONNECT_CONFIRM)
+      return fail("bad handshake reply");
+    ctx->nnodes = r.i("nnodes");
+    if (heartbeat_s > 0) {
+      ocmc_ctx* raw = ctx.get();
+      ctx->hb_thread =
+          std::thread([raw, heartbeat_s] { heartbeat_loop(raw, heartbeat_s); });
+    }
+    return ctx.release();
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  }
+}
+
+void ocmc_tini(ocmc_ctx* ctx) { delete ctx; }
+
+int ocmc_alloc(ocmc_ctx* ctx, uint64_t nbytes, uint8_t kind,
+               ocmc_handle* out) {
+  if (!ctx || !out) return -1;
+  try {
+    Message r = ctx->ctrl_request(Message{MsgType::REQ_ALLOC,
+                                          {{"orig_rank", Value::I(ctx->rank)},
+                                           {"pid", Value::I(ctx->pid)},
+                                           {"kind", Value::U(kind)},
+                                           {"nbytes", Value::U(nbytes)}},
+                                          {}});
+    std::memset(out, 0, sizeof(*out));
+    out->alloc_id = r.u("alloc_id");
+    out->rank = r.i("rank");
+    out->device_index = uint32_t(r.u("device_index"));
+    out->kind = uint8_t(r.u("kind"));
+    out->nbytes = nbytes;
+    out->offset = r.u("offset");
+    std::snprintf(out->owner_host, sizeof(out->owner_host), "%s",
+                  r.s("owner_host").c_str());
+    out->owner_port = uint32_t(r.u("owner_port"));
+    return 0;
+  } catch (const std::exception& e) {
+    ctx->set_error(e.what());
+    return -1;
+  }
+}
+
+int ocmc_free(ocmc_ctx* ctx, const ocmc_handle* h) {
+  if (!ctx || !h) return -1;
+  try {
+    ctx->ctrl_request(Message{MsgType::REQ_FREE,
+                              {{"alloc_id", Value::U(h->alloc_id)},
+                               {"rank", Value::I(h->rank)}},
+                              {}});
+    return 0;
+  } catch (const std::exception& e) {
+    ctx->set_error(e.what());
+    return -1;
+  }
+}
+
+int ocmc_put(ocmc_ctx* ctx, const ocmc_handle* h, const void* buf,
+             uint64_t nbytes, uint64_t offset) {
+  if (!ctx || !h || (!buf && nbytes)) return -1;
+  if (kind_is_device(h->kind)) {
+    ctx->set_error(
+        "device-kind data moves through the JAX/SPMD binding, not libocm");
+    return -1;
+  }
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  try {
+    ctx->transfer(
+        h, nbytes,
+        [&](uint64_t pos, uint64_t n) {
+          Message m{MsgType::DATA_PUT,
+                    {{"alloc_id", Value::U(h->alloc_id)},
+                     {"offset", Value::U(offset + pos)},
+                     {"nbytes", Value::U(n)}},
+                    {}};
+          m.data.assign(p + pos, p + pos + n);
+          return m;
+        },
+        [](const Message&, uint64_t, uint64_t) {});
+    return 0;
+  } catch (const std::exception& e) {
+    ctx->set_error(e.what());
+    return -1;
+  }
+}
+
+int ocmc_get(ocmc_ctx* ctx, const ocmc_handle* h, void* buf, uint64_t nbytes,
+             uint64_t offset) {
+  if (!ctx || !h || (!buf && nbytes)) return -1;
+  if (kind_is_device(h->kind)) {
+    ctx->set_error(
+        "device-kind data moves through the JAX/SPMD binding, not libocm");
+    return -1;
+  }
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  try {
+    ctx->transfer(
+        h, nbytes,
+        [&](uint64_t pos, uint64_t n) {
+          return Message{MsgType::DATA_GET,
+                         {{"alloc_id", Value::U(h->alloc_id)},
+                          {"offset", Value::U(offset + pos)},
+                          {"nbytes", Value::U(n)}},
+                         {}};
+        },
+        [&](const Message& r, uint64_t start, uint64_t n) {
+          if (r.data.size() != n)
+            throw ProtocolError("short DATA_GET reply");
+          std::memcpy(p + start, r.data.data(), n);
+        });
+    return 0;
+  } catch (const std::exception& e) {
+    ctx->set_error(e.what());
+    return -1;
+  }
+}
+
+int ocmc_is_remote(const ocmc_handle* h) {
+  if (!h) return 0;
+  return (h->kind == OCMC_KIND_REMOTE_HOST ||
+          h->kind == OCMC_KIND_REMOTE_DEVICE)
+             ? 1
+             : 0;
+}
+
+uint64_t ocmc_remote_sz(const ocmc_handle* h) {
+  return (h && ocmc_is_remote(h)) ? h->nbytes : 0;
+}
+
+int64_t ocmc_nnodes(const ocmc_ctx* ctx) { return ctx ? ctx->nnodes : 0; }
+
+const char* ocmc_last_error(const ocmc_ctx* ctx) {
+  if (!ctx) {
+    std::lock_guard<std::mutex> g(g_init_err_mu);
+    // Leaked copy is fine: init failures are rare and the caller needs a
+    // stable pointer with no context to own it.
+    return strdup(g_init_err.c_str());
+  }
+  return ctx->last_error.c_str();
+}
+
+}  // extern "C"

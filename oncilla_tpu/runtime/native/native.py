@@ -17,19 +17,23 @@ BUILD_DIR = NATIVE_DIR / "build"
 BINARY = BUILD_DIR / "oncillamemd"
 
 
+def _stale(target: Path) -> bool:
+    srcs = [
+        *NATIVE_DIR.glob("*.cc"),
+        *NATIVE_DIR.glob("*.hh"),
+        *NATIVE_DIR.glob("*.h"),
+        NATIVE_DIR / "CMakeLists.txt",
+    ]
+    return target.stat().st_mtime < max(p.stat().st_mtime for p in srcs)
+
+
 def build(force: bool = False, tsan: bool = False) -> Path:
     """Build oncillamemd with CMake (+ Ninja when available); cached, but
     rebuilt whenever any native source is newer than the binary (a stale
     cached binary would silently test old daemon code)."""
     target = BUILD_DIR / ("oncillamemd_tsan" if tsan else "oncillamemd")
-    if target.exists() and not force:
-        srcs = [
-            *NATIVE_DIR.glob("*.cc"),
-            *NATIVE_DIR.glob("*.hh"),
-            NATIVE_DIR / "CMakeLists.txt",
-        ]
-        if target.stat().st_mtime >= max(p.stat().st_mtime for p in srcs):
-            return target
+    if target.exists() and not force and not _stale(target):
+        return target
     gen = ["-G", "Ninja"] if shutil.which("ninja") else []
     cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
     if tsan:
@@ -89,3 +93,21 @@ def spawn(
     finally:
         if log_path is not None:
             out.close()  # child keeps its own descriptor
+
+
+def build_lib(force: bool = False) -> Path:
+    """Build and return libocm_tpu.so — the C-linkable client library
+    (the app-linked libocm.so analogue, /root/reference/SConstruct:176)."""
+    target = BUILD_DIR / "libocm_tpu.so"
+    if target.exists() and not force and not _stale(target):
+        return target
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", str(BUILD_DIR), "--target", "ocm_tpu", "ocm_c_demo"],
+        check=True, capture_output=True,
+    )
+    return target

@@ -1,0 +1,243 @@
+"""libocm_tpu.so — the C-linkable client library — driven via ctypes against
+both the C++ and the Python daemons (the app-linked libocm.so capability of
+the reference, /root/reference/SConstruct:176 + inc/oncillamem.h)."""
+
+import ctypes
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from oncilla_tpu.runtime.membership import NodeEntry
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class OcmcHandle(ctypes.Structure):
+    _fields_ = [
+        ("alloc_id", ctypes.c_uint64),
+        ("rank", ctypes.c_int64),
+        ("device_index", ctypes.c_uint32),
+        ("kind", ctypes.c_uint8),
+        ("nbytes", ctypes.c_uint64),
+        ("offset", ctypes.c_uint64),
+        ("owner_host", ctypes.c_char * 256),
+        ("owner_port", ctypes.c_uint32),
+    ]
+
+
+@pytest.fixture(scope="module")
+def lib():
+    from oncilla_tpu.runtime.native import native
+
+    try:
+        path = native.build_lib()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+    L = ctypes.CDLL(str(path))
+    L.ocmc_init.restype = ctypes.c_void_p
+    L.ocmc_init.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_double]
+    L.ocmc_tini.argtypes = [ctypes.c_void_p]
+    L.ocmc_alloc.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8,
+        ctypes.POINTER(OcmcHandle),
+    ]
+    L.ocmc_free.argtypes = [ctypes.c_void_p, ctypes.POINTER(OcmcHandle)]
+    L.ocmc_put.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(OcmcHandle), ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    L.ocmc_get.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(OcmcHandle), ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    L.ocmc_is_remote.argtypes = [ctypes.POINTER(OcmcHandle)]
+    L.ocmc_remote_sz.restype = ctypes.c_uint64
+    L.ocmc_remote_sz.argtypes = [ctypes.POINTER(OcmcHandle)]
+    L.ocmc_nnodes.restype = ctypes.c_int64
+    L.ocmc_nnodes.argtypes = [ctypes.c_void_p]
+    L.ocmc_last_error.restype = ctypes.c_char_p
+    L.ocmc_last_error.argtypes = [ctypes.c_void_p]
+    return L
+
+
+def _wait_cluster(ports, n=2, deadline_s=15.0):
+    from oncilla_tpu.runtime.protocol import Message, MsgType, request
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", ports[0]), timeout=1.0)
+            try:
+                st = request(s, Message(MsgType.STATUS, {}))
+            finally:
+                s.close()
+            if st.fields["nnodes"] >= n:
+                return
+        except OSError:
+            pass
+        time.sleep(0.05)
+    pytest.fail("daemons did not form a cluster")
+
+
+@pytest.fixture(params=["native", "python"])
+def cluster(request, tmp_path):
+    """Two daemons (C++ or Python) + the nodefile path."""
+    ports = _free_ports(2)
+    nodefile = tmp_path / "nodefile"
+    nodefile.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    if request.param == "native":
+        from oncilla_tpu.runtime.native import native
+
+        try:
+            native.build()
+        except Exception as e:  # noqa: BLE001
+            pytest.skip(f"native build unavailable: {e}")
+        procs = [
+            native.spawn(str(nodefile), r, host_arena_bytes=8 << 20)
+            for r in range(2)
+        ]
+        try:
+            _wait_cluster(ports)
+            yield str(nodefile)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.wait(timeout=5)
+    else:
+        from oncilla_tpu.runtime.daemon import Daemon
+        from oncilla_tpu.utils.config import OcmConfig
+
+        entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+        cfg = OcmConfig(host_arena_bytes=8 << 20)
+        daemons = [Daemon(r, entries, config=cfg) for r in range(2)]
+        for d in daemons:
+            d.start()
+        try:
+            _wait_cluster(ports)
+            yield str(nodefile)
+        finally:
+            for d in daemons:
+                d.stop()
+
+
+def test_c_client_roundtrip(lib, cluster):
+    ctx = lib.ocmc_init(cluster.encode(), 0, 0.0)
+    assert ctx, lib.ocmc_last_error(None)
+    try:
+        assert lib.ocmc_nnodes(ctx) == 2
+        h = OcmcHandle()
+        assert lib.ocmc_alloc(ctx, 1 << 20, 3, ctypes.byref(h)) == 0  # REMOTE_HOST
+        assert h.rank == 1 and lib.ocmc_is_remote(ctypes.byref(h)) == 1
+        assert lib.ocmc_remote_sz(ctypes.byref(h)) == 1 << 20
+
+        data = np.random.default_rng(0).integers(
+            0, 256, 1 << 20, dtype=np.uint8
+        )
+        assert lib.ocmc_put(
+            ctx, ctypes.byref(h),
+            data.ctypes.data_as(ctypes.c_void_p), data.nbytes, 0,
+        ) == 0
+        out = np.zeros_like(data)
+        assert lib.ocmc_get(
+            ctx, ctypes.byref(h),
+            out.ctypes.data_as(ctypes.c_void_p), out.nbytes, 0,
+        ) == 0
+        np.testing.assert_array_equal(out, data)
+
+        # offset round trip
+        assert lib.ocmc_put(
+            ctx, ctypes.byref(h),
+            data.ctypes.data_as(ctypes.c_void_p), 1024, 4096,
+        ) == 0
+        out2 = np.zeros(1024, dtype=np.uint8)
+        assert lib.ocmc_get(
+            ctx, ctypes.byref(h),
+            out2.ctypes.data_as(ctypes.c_void_p), 1024, 4096,
+        ) == 0
+        np.testing.assert_array_equal(out2, data[:1024])
+
+        assert lib.ocmc_free(ctx, ctypes.byref(h)) == 0
+    finally:
+        lib.ocmc_tini(ctx)
+
+
+def test_c_client_errors(lib, cluster):
+    ctx = lib.ocmc_init(cluster.encode(), 0, 0.0)
+    assert ctx, lib.ocmc_last_error(None)
+    try:
+        h = OcmcHandle()
+        assert lib.ocmc_alloc(ctx, 4096, 3, ctypes.byref(h)) == 0
+
+        # out-of-bounds put -> daemon ERR -> -1 with a message
+        buf = np.zeros(8192, dtype=np.uint8)
+        rc = lib.ocmc_put(
+            ctx, ctypes.byref(h),
+            buf.ctypes.data_as(ctypes.c_void_p), 8192, 0,
+        )
+        assert rc == -1
+        assert b"daemon error" in lib.ocmc_last_error(ctx)
+
+        # the connection survives the error: a valid op still works
+        assert lib.ocmc_put(
+            ctx, ctypes.byref(h),
+            buf.ctypes.data_as(ctypes.c_void_p), 4096, 0,
+        ) == 0
+        assert lib.ocmc_free(ctx, ctypes.byref(h)) == 0
+        # double free fails cleanly
+        assert lib.ocmc_free(ctx, ctypes.byref(h)) == -1
+
+        # device-kind data is rejected at the client
+        hd = OcmcHandle()
+        assert lib.ocmc_alloc(ctx, 4096, 2, ctypes.byref(hd)) == 0  # REMOTE_DEVICE
+        rc = lib.ocmc_put(
+            ctx, ctypes.byref(hd),
+            buf.ctypes.data_as(ctypes.c_void_p), 4096, 0,
+        )
+        assert rc == -1 and b"JAX" in lib.ocmc_last_error(ctx)
+        assert lib.ocmc_free(ctx, ctypes.byref(hd)) == 0
+    finally:
+        lib.ocmc_tini(ctx)
+
+
+def test_c_client_init_failure(lib, tmp_path):
+    bad = tmp_path / "nf"
+    bad.write_text("0 127.0.0.1 1\n")  # port 1: nothing listening
+    ctx = lib.ocmc_init(str(bad).encode(), 0, 0.0)
+    assert not ctx
+    assert b"connect failed" in lib.ocmc_last_error(None)
+
+
+def test_c_demo_program(cluster):
+    # The pure-C demo app (ocm_test.c test-2 shape) against live daemons.
+    import subprocess
+
+    from oncilla_tpu.runtime.native import native
+
+    try:
+        native.build_lib()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+    demo = native.BUILD_DIR / "ocm_c_demo"
+    if not demo.exists():
+        pytest.skip("ocm_c_demo not built")
+    r = subprocess.run(
+        [str(demo), cluster, "0", str(1 << 20)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pass:" in r.stdout
