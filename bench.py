@@ -147,6 +147,141 @@ def _pallas_copy_loop(total_bytes, nbytes, iters):
     return jax.jit(run, donate_argnums=0)
 
 
+def _pallas_remote_loop(total_bytes, nbytes, iters):
+    """The one-sided ICI fabric measured on one chip: the same two-stream
+    ping-pong schedule as ``_pallas_copy_loop``, but every transfer is a
+    loopback ``make_async_remote_copy`` — the full remote-DMA descriptor +
+    send/recv semaphore machinery of oncilla_tpu/ops/pallas_ici.py (the
+    ib_write/ib_poll analogue, /root/reference/src/rdma.c:241-302), with the
+    chip addressing itself. Run under shard_map over a 1-device mesh so
+    LOGICAL device ids resolve."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from oncilla_tpu.parallel.mesh import NODE_AXIS
+
+    nblocks = nbytes // BLOCK
+    assert nblocks % 2 == 0
+    q = nblocks // 2
+
+    def kernel(meta_ref, buf_in, buf_out, send_sems, recv_sems):
+        del buf_in
+        me = meta_ref[0]
+
+        def dma(stream, i):
+            fwd = i % 2 == 0
+            base = stream * 2 * q
+            src = base + jnp.where(fwd, 0, q)
+            dst = base + jnp.where(fwd, q, 0)
+            return pltpu.make_async_remote_copy(
+                src_ref=buf_out.at[pl.ds(src, q)],
+                dst_ref=buf_out.at[pl.ds(dst, q)],
+                send_sem=send_sems.at[stream],
+                recv_sem=recv_sems.at[stream],
+                device_id=me,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        def wait(stream, i):
+            d = dma(stream, i)
+            d.wait_send()
+            d.wait_recv()
+
+        dma(0, 0).start()
+        dma(1, 0).start()
+
+        def body(i, _):
+            wait(0, i)
+            dma(0, i + 1).start()
+            wait(1, i)
+            dma(1, i + 1).start()
+            return 0
+
+        jax.lax.fori_loop(0, iters - 1, body, 0)
+        wait(0, iters - 1)
+        wait(1, iters - 1)
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((total_bytes // BLOCK, 32, 128), jnp.uint8),
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (NODE_AXIS,))
+
+    def shard_fn(b2):
+        me = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32)
+        out = call(me[None], b2[0].reshape(-1, 32, 128))
+        return out.reshape(1, total_bytes)
+
+    smapped = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=P(NODE_AXIS, None),
+        out_specs=P(NODE_AXIS, None), check_vma=False,
+    )
+
+    def run(b):
+        return smapped(b[None])[0]
+
+    return jax.jit(run, donate_argnums=0)
+
+
+def bench_pallas_remote(buf) -> tuple[float, jax.Array]:
+    iters = ITERS // 2
+    run = _pallas_remote_loop(buf.shape[0], NBYTES, iters)
+    buf = run(buf)
+    _sync(buf)
+    t0 = time.perf_counter()
+    buf = run(buf)
+    _sync(buf)
+    dt = time.perf_counter() - t0
+    return 2.0 * NBYTES * iters / dt / 1e9, buf
+
+
+def check_pallas_ici_copy(errors: dict) -> bool:
+    """Execute the production one-sided copy (ops/pallas_ici.py) on the real
+    chip: pattern-stamp + readback through both the local fast path and the
+    loopback remote-DMA path (the ib_client.c:144-188 idiom, one chip)."""
+    from jax.sharding import Mesh
+
+    from oncilla_tpu.ops.pallas_ici import BLOCK as PBLOCK
+    from oncilla_tpu.ops.pallas_ici import pallas_ici_copy
+    from oncilla_tpu.parallel import spmd_arena as sa
+    from oncilla_tpu.parallel.mesh import NODE_AXIS
+
+    try:
+        mesh = Mesh(np.asarray(jax.devices()[:1]), (NODE_AXIS,))
+        arena = sa.make_arena(mesh, 1 << 20)
+        pat = (np.arange(4 * PBLOCK, dtype=np.uint64) % 249).astype(np.uint8)
+        arena = sa.host_put(arena, 0, pat, 0, mesh=mesh)
+        arena = pallas_ici_copy(
+            arena, 0, 0, 0, 64 * PBLOCK, 4 * PBLOCK, mesh=mesh
+        )
+        arena = pallas_ici_copy(
+            arena, 0, 0, 0, 128 * PBLOCK, 4 * PBLOCK, mesh=mesh,
+            force_remote=True,
+        )
+        for off in (64 * PBLOCK, 128 * PBLOCK):
+            got = np.asarray(sa.host_get(arena, 0, 4 * PBLOCK, off, mesh=mesh))
+            if not np.array_equal(got, pat):
+                raise RuntimeError(f"mismatch at offset {off}")
+        return True
+    except Exception as e:  # noqa: BLE001
+        errors["pallas_ici_copy"] = f"{type(e).__name__}: {e}"
+        return False
+
+
 def bench_pallas_copy(buf) -> tuple[float, jax.Array]:
     # Warm up with the same executable that is timed. Running a separately
     # compiled warm-up loop first costs ~9% of steady-state bandwidth on the
@@ -163,12 +298,39 @@ def bench_pallas_copy(buf) -> tuple[float, jax.Array]:
     return 2.0 * NBYTES * ITERS / dt / 1e9, buf
 
 
-def main() -> None:
+def _init_with_retry(cfg, attempts: int = 5):
+    """Backend init can fail transiently ("Unable to initialize backend
+    'axon'", round-1 bench rc=1) when the tunneled chip is briefly held by
+    another process. jax caches the failed backend, so clear the cache
+    between attempts to make the retry real."""
+    delay = 2.0
+    for attempt in range(attempts):
+        try:
+            return ocm.ocm_init(cfg)
+        except Exception:  # noqa: BLE001 — backend init raises RuntimeError
+            if attempt == attempts - 1:
+                raise
+            try:
+                import jax._src.xla_bridge as xb
+
+                xb._clear_backends()
+                jax.clear_caches()
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(delay)
+            delay = min(delay * 2.0, 30.0)
+
+
+def _run(out: dict, errors: dict) -> None:
     cfg = ocm.OcmConfig(
         host_arena_bytes=1 << 20, device_arena_bytes=ARENA
     )
-    ctx = ocm.ocm_init(cfg)
-    p50_us = bench_alloc_p50(ctx)
+    ctx = _init_with_retry(cfg)
+    try:
+        p50_us = bench_alloc_p50(ctx)
+    except Exception as e:  # noqa: BLE001 — never lose the headline
+        errors["alloc_p50"] = f"{type(e).__name__}: {e}"
+        p50_us = 0.0
 
     # The copy loops donate the buffer, so they run through arena.update(),
     # which atomically rebinds the arena to the loop's output (holding the
@@ -198,10 +360,24 @@ def main() -> None:
         results["pallas"] = gbps
         return buf
 
+    def run_remote(buf):
+        gbps, buf = bench_pallas_remote(buf)
+        results["pallas_remote"] = gbps
+        return buf
+
     try:
         arena.update(run_pallas)
-    except Exception:  # noqa: BLE001 — pallas path needs real TPU
+    except Exception as e:  # noqa: BLE001 — pallas path needs real TPU
+        errors["pallas_copy"] = f"{type(e).__name__}: {e}"
         results["pallas"] = 0.0
+
+    # The one-sided fabric number (loopback remote DMA; VERDICT.md r2
+    # "no ICI-fabric number exists at any scale").
+    try:
+        arena.update(run_remote)
+    except Exception as e:  # noqa: BLE001
+        errors["pallas_remote"] = f"{type(e).__name__}: {e}"
+        results["pallas_remote"] = 0.0
 
     # Correctness: stamp four distinct quarter patterns across the handle
     # and re-run both copy paths untimed. The Pallas kernel's stream X
@@ -221,23 +397,55 @@ def main() -> None:
         return _pallas_copy_loop(buf.shape[0], NBYTES, 4)(buf)
 
     if results["pallas"]:  # skip where Pallas itself was unavailable
-        arena.update(run_pallas_check)
-        expect = [quarters[0], quarters[0], quarters[2], quarters[2]]
-        for i, want in enumerate(expect):
-            got = np.asarray(ctx.get(h, nbytes=1 << 20, offset=i * qb))
-            if not np.array_equal(got, want[: 1 << 20]):
-                raise SystemExit(
-                    f"pallas copy correctness failed at quarter {i}"
-                )
+        try:
+            arena.update(run_pallas_check)
+            expect = [quarters[0], quarters[0], quarters[2], quarters[2]]
+            for i, want in enumerate(expect):
+                got = np.asarray(ctx.get(h, nbytes=1 << 20, offset=i * qb))
+                if not np.array_equal(got, want[: 1 << 20]):
+                    raise RuntimeError(
+                        f"pallas copy correctness failed at quarter {i}"
+                    )
+        except Exception as e:  # noqa: BLE001 — drop the number, not the run
+            errors["pallas_correctness"] = f"{type(e).__name__}: {e}"
+            results["pallas"] = 0.0
 
-    arena.update(run_xla)
-    got = np.asarray(ctx.get(h, nbytes=1 << 20))
-    if not np.array_equal(got, quarters[0][: 1 << 20]):
-        raise SystemExit("xla copy correctness check failed")
+    if results.get("pallas_remote"):
+        # Same quarter semantics as the local loop (streams ping-pong
+        # Q0<->Q1 and Q2<->Q3), so after an even iteration count Q0/Q2 are
+        # intact and Q1/Q3 hold their copies.
+        try:
+            ctx.put(h, np.concatenate(quarters), 0)
+            arena.update(
+                lambda buf: _pallas_remote_loop(buf.shape[0], NBYTES, 4)(buf)
+            )
+            expect = [quarters[0], quarters[0], quarters[2], quarters[2]]
+            for i, want in enumerate(expect):
+                got = np.asarray(ctx.get(h, nbytes=1 << 20, offset=i * qb))
+                if not np.array_equal(got, want[: 1 << 20]):
+                    raise RuntimeError(
+                        f"remote-DMA copy correctness failed at quarter {i}"
+                    )
+        except Exception as e:  # noqa: BLE001
+            errors["pallas_remote_correctness"] = f"{type(e).__name__}: {e}"
+            results["pallas_remote"] = 0.0
+        ctx.put(h, np.concatenate(quarters), 0)
+
+    try:
+        arena.update(run_xla)
+        got = np.asarray(ctx.get(h, nbytes=1 << 20))
+        if not np.array_equal(got, quarters[0][: 1 << 20]):
+            raise RuntimeError("xla copy correctness check failed")
+    except Exception as e:  # noqa: BLE001
+        errors["xla_copy"] = f"{type(e).__name__}: {e}"
+        results["xla"] = 0.0
 
     xla_gbps, pallas_gbps = results["xla"], results["pallas"]
+    remote_gbps = results.get("pallas_remote", 0.0)
     # The arena is still fully usable after benchmarking:
     ctx.free(h)
+
+    ici_verified = check_pallas_ici_copy(errors)
 
     gbps = max(xla_gbps, pallas_gbps)
 
@@ -246,28 +454,43 @@ def main() -> None:
         from oncilla_tpu.benchmarks.gups import gups_single
 
         gups = gups_single(words=1 << 22, batch=1 << 20, steps=32)["gups"]
-    except Exception:  # noqa: BLE001 — never fail the headline metric
+    except Exception as e:  # noqa: BLE001 — never fail the headline metric
+        errors["gups"] = f"{type(e).__name__}: {e}"
         gups = 0.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "ocm alloc+copy loop: single-chip HBM arena copy "
-                "bandwidth (2x bytes, read+write)",
-                "value": round(gbps, 2),
-                "unit": "GB/s",
-                "vs_baseline": round(gbps / TARGET, 4),
-                "detail": {
-                    "xla_gbps": round(xla_gbps, 2),
-                    "pallas_gbps": round(pallas_gbps, 2),
-                    "alloc_p50_us": round(p50_us, 2),
-                    "gups": round(gups, 4),
-                    "copy_nbytes": NBYTES,
-                    "target_gbps": TARGET,
-                },
-            }
-        )
+    out["value"] = round(gbps, 2)
+    out["vs_baseline"] = round(gbps / TARGET, 4)
+    out["detail"].update(
+        {
+            "xla_gbps": round(xla_gbps, 2),
+            "pallas_gbps": round(pallas_gbps, 2),
+            "pallas_remote_gbps": round(remote_gbps, 2),
+            "pallas_ici_verified": ici_verified,
+            "alloc_p50_us": round(p50_us, 2),
+            "gups": round(gups, 4),
+        }
     )
+
+
+def main() -> None:
+    """Always print exactly one JSON line, whatever fails (round-1 bench
+    died rc=1 with no line at all; the line IS the deliverable)."""
+    out = {
+        "metric": "ocm alloc+copy loop: single-chip HBM arena copy "
+        "bandwidth (2x bytes, read+write)",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "detail": {"copy_nbytes": NBYTES, "target_gbps": TARGET},
+    }
+    errors: dict[str, str] = {}
+    try:
+        _run(out, errors)
+    except BaseException as e:  # noqa: BLE001 — emit the line regardless
+        errors["fatal"] = f"{type(e).__name__}: {e}"
+    if errors:
+        out["detail"]["errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -13,8 +13,12 @@ dimension is untiled, so dynamic block indices are free. ``OcmConfig.
 alignment = 4096`` guarantees every extent is whole blocks (the analogue of
 page-granular NIC registration, extoll_server.c:62 posix_memalign(4096)).
 
-These kernels require real TPU hardware; the portable CollectivePermute path
-lives in :mod:`oncilla_tpu.parallel.spmd_arena`.
+On real TPU the kernels drive the hardware DMA engines; everywhere else they
+run under the Pallas TPU interpret machine (``pltpu.InterpretParams``), which
+simulates the semaphore/DMA semantics on the virtual CPU mesh — so the same
+one-sided code path is exercised by CI (the in-process fake fabric SURVEY.md
+§4 calls for). The portable CollectivePermute path lives in
+:mod:`oncilla_tpu.parallel.spmd_arena`.
 """
 
 from __future__ import annotations
@@ -33,18 +37,33 @@ from oncilla_tpu.parallel.mesh import NODE_AXIS
 BLOCK = 4096  # bytes per DMA-addressable block = one (32, 128) uint8 tile
 
 
+def _interpret_mode() -> bool:
+    """Interpret (simulate) the kernels off-TPU so the one-sided path runs
+    on the virtual CPU mesh; real DMA engines on TPU."""
+    return jax.default_backend() != "tpu"
+
+
+def _interpret_arg(interpret: bool):
+    return pltpu.InterpretParams() if interpret else False
+
+
 def _as_blocks(arena_row: jax.Array) -> jax.Array:
     """(row_bytes,) uint8 -> (nblocks, 32, 128) block view."""
     assert arena_row.shape[-1] % BLOCK == 0, "arena must be BLOCK-aligned"
     return arena_row.reshape(-1, 32, 128)
 
 
-def _make_copy_kernel(nblocks: int):
+def _make_copy_kernel(nblocks: int, force_remote: bool):
     """One-sided arena->arena copy of ``nblocks`` blocks.
 
     meta = [me, src_dev, dst_dev, src_blk, dst_blk]; the output arena ref
     aliases the input (in-place HBM update). Only the src and dst devices
     act; every other device falls straight through.
+
+    ``force_remote`` routes even src_dev == dst_dev through
+    ``make_async_remote_copy`` (a loopback remote DMA: the chip sends to
+    itself over the same descriptor/semaphore machinery as a true ICI
+    transfer) — how the single-chip bench exercises the one-sided fabric.
     """
 
     def kernel(meta_ref, arena_in, arena_out, send_sem, recv_sem, local_sem):
@@ -54,17 +73,6 @@ def _make_copy_kernel(nblocks: int):
         dst_dev = meta_ref[2]
         src_blk = meta_ref[3]
         dst_blk = meta_ref[4]
-
-        # Same-device fast path: local DMA, no ICI.
-        @pl.when(jnp.logical_and(me == src_dev, src_dev == dst_dev))
-        def _():
-            dma = pltpu.make_async_copy(
-                arena_out.at[pl.ds(src_blk, nblocks)],
-                arena_out.at[pl.ds(dst_blk, nblocks)],
-                local_sem,
-            )
-            dma.start()
-            dma.wait()
 
         def rdma():
             return pltpu.make_async_remote_copy(
@@ -76,30 +84,48 @@ def _make_copy_kernel(nblocks: int):
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
 
+        remote_gate = jnp.bool_(True) if force_remote else src_dev != dst_dev
+
+        if not force_remote:
+            # Same-device fast path: local DMA, no ICI.
+            @pl.when(jnp.logical_and(me == src_dev, src_dev == dst_dev))
+            def _():
+                dma = pltpu.make_async_copy(
+                    arena_out.at[pl.ds(src_blk, nblocks)],
+                    arena_out.at[pl.ds(dst_blk, nblocks)],
+                    local_sem,
+                )
+                dma.start()
+                dma.wait()
+
         # Origin: post the remote DMA (ib_write analogue), await local send
         # completion (tx half of ib_poll).
-        @pl.when(jnp.logical_and(me == src_dev, src_dev != dst_dev))
+        @pl.when(jnp.logical_and(me == src_dev, remote_gate))
         def _():
             d = rdma()
             d.start()
             d.wait_send()
 
-        # Target: block until the bytes landed (rx half of ib_poll).
-        @pl.when(jnp.logical_and(me == dst_dev, src_dev != dst_dev))
+        # Target: block until the bytes landed (rx half of ib_poll). On a
+        # loopback transfer the same device runs both this and the origin
+        # branch, waiting each semaphore once.
+        @pl.when(jnp.logical_and(me == dst_dev, remote_gate))
         def _():
             rdma().wait_recv()
 
     return kernel
 
 
-def _make_copy_call(nblocks: int, row_blocks: int):
+def _make_copy_call(
+    nblocks: int, row_blocks: int, force_remote: bool, interpret: bool
+):
     return pl.pallas_call(
-        _make_copy_kernel(nblocks),
+        _make_copy_kernel(nblocks, force_remote),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(1,),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[
                 pltpu.SemaphoreType.DMA(()),   # send
                 pltpu.SemaphoreType.DMA(()),   # recv
@@ -109,6 +135,7 @@ def _make_copy_call(nblocks: int, row_blocks: int):
         out_shape=jax.ShapeDtypeStruct((row_blocks, 32, 128), jnp.uint8),
         input_output_aliases={1: 0},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=_interpret_arg(interpret),
     )
 
 
@@ -128,18 +155,25 @@ def pallas_ici_copy(
     nbytes: int,
     *,
     mesh,
+    force_remote: bool = False,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """Copy ``nbytes`` (BLOCK-aligned, as are the offsets) from device
     src_dev's arena row to dst_dev's over ICI. Device ids and offsets are
     dynamic scalars — one compiled executable serves every route, unlike
     the ppermute path's static routes (EXTOLL-style connectionless
-    addressing, SURVEY.md §7)."""
+    addressing, SURVEY.md §7). Off-TPU the kernel runs under the Pallas
+    interpret machine unless ``interpret`` overrides."""
     row_bytes = arena.shape[-1]
     assert pallas_supported(int(src_off), int(dst_off), nbytes), (
         "pallas path needs BLOCK-aligned offsets/size; use spmd_arena."
         "ici_copy which falls back to the ppermute path"
     )
-    fn = _cached_ici_copy(nbytes // BLOCK, row_bytes, mesh)
+    if interpret is None:
+        interpret = _interpret_mode()
+    fn = _cached_ici_copy(
+        nbytes // BLOCK, row_bytes, mesh, bool(force_remote), bool(interpret)
+    )
     return fn(
         arena,
         jnp.int32(src_dev),
@@ -150,7 +184,9 @@ def pallas_ici_copy(
 
 
 @lru_cache(maxsize=256)
-def _cached_ici_copy(nblocks: int, row_bytes: int, mesh):
+def _cached_ici_copy(
+    nblocks: int, row_bytes: int, mesh, force_remote: bool, interpret: bool
+):
     """One compiled executable per (transfer size, arena size, mesh); device
     ids and offsets stay dynamic, so every route shares it."""
     row_blocks = row_bytes // BLOCK
@@ -159,7 +195,9 @@ def _cached_ici_copy(nblocks: int, row_bytes: int, mesh):
         me = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32)
         meta = jnp.stack([me, s_dev, d_dev, s_blk, d_blk])
         blocks = _as_blocks(arena_shard[0])
-        out = _make_copy_call(nblocks, row_blocks)(meta, blocks)
+        out = _make_copy_call(nblocks, row_blocks, force_remote, interpret)(
+            meta, blocks
+        )
         return out.reshape(1, row_bytes)
 
     return jax.jit(
@@ -220,23 +258,26 @@ def pallas_local_copy(buf: jax.Array, src_off, dst_off, nbytes: int) -> jax.Arra
     ), "overlapping ranges are unsafe for raw DMA; use DeviceArena.move"
     total = buf.shape[-1]
     meta = jnp.stack([jnp.int32(src_off // BLOCK), jnp.int32(dst_off // BLOCK)])
-    return _cached_local_copy(nbytes // BLOCK, total)(meta, buf)
+    return _cached_local_copy(nbytes // BLOCK, total, _interpret_mode())(
+        meta, buf
+    )
 
 
 @lru_cache(maxsize=256)
-def _cached_local_copy(nblocks: int, total: int):
+def _cached_local_copy(nblocks: int, total: int, interpret: bool):
     call = pl.pallas_call(
         _make_local_copy_kernel(nblocks),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(1,),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
         ),
         out_shape=jax.ShapeDtypeStruct((total // BLOCK, 32, 128), jnp.uint8),
         input_output_aliases={1: 0},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=_interpret_arg(interpret),
     )
 
     def run(meta, b):

@@ -75,6 +75,12 @@ class ControlPlaneClient:
             ) from e
         self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._ctrl_lock = threading.Lock()
+        # Which ranks own this app's live remote allocations (rank -> count).
+        # Reported on HEARTBEAT/DISCONNECT so daemons relay/reclaim with
+        # O(owners) fan-out instead of broadcasting to every node; app-side
+        # because the handles live here and the set survives daemon restarts.
+        self._owner_ranks: dict[int, int] = {}
+        self._owner_lock = threading.Lock()
         # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132).
         r = self._request(Message(MsgType.CONNECT, {"pid": self.pid, "rank": rank}))
         if r.type != MsgType.CONNECT_CONFIRM:
@@ -92,21 +98,48 @@ class ControlPlaneClient:
         with self._ctrl_lock:
             return request(self._ctrl, msg)
 
+    def _owners_field(self) -> str:
+        with self._owner_lock:
+            return ",".join(str(r) for r in sorted(self._owner_ranks))
+
+    def _note_owner(self, rank: int, delta: int) -> None:
+        if rank == self.rank:
+            return
+        with self._owner_lock:
+            n = self._owner_ranks.get(rank, 0) + delta
+            if n > 0:
+                self._owner_ranks[rank] = n
+            else:
+                self._owner_ranks.pop(rank, None)
+
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.config.heartbeat_s):
             try:
                 self._request(
-                    Message(MsgType.HEARTBEAT, {"rank": self.rank, "pid": self.pid})
+                    Message(
+                        MsgType.HEARTBEAT,
+                        {"rank": self.rank, "pid": self.pid,
+                         "owners": self._owners_field()},
+                    )
                 )
             except (OSError, OcmProtocolError):
                 printd("client rank %d: heartbeat failed", self.rank)
 
-    def close(self) -> None:
+    def close(self, detach: bool = False) -> None:
+        """``detach=True`` skips the DISCONNECT notification: daemons keep
+        the app's allocations until the lease runs out (crash simulation /
+        intentional handoff within the lease window). The default notifies,
+        and the daemons reclaim this app's allocations immediately."""
         self._hb_stop.set()
-        try:
-            send_msg(self._ctrl, Message(MsgType.DISCONNECT, {"pid": self.pid}))
-        except OSError:
-            pass
+        if not detach:
+            try:
+                send_msg(
+                    self._ctrl,
+                    Message(MsgType.DISCONNECT,
+                            {"pid": self.pid, "owners": self._owners_field()}),
+                )
+            except OSError:
+                pass
         self._pool.close()
         try:
             self._ctrl.close()
@@ -145,6 +178,7 @@ class ControlPlaneClient:
             origin_rank=self.rank,
         )
         h.owner_addr = (f["owner_host"], f["owner_port"])  # for the DCN path
+        self._note_owner(h.rank, +1)
         return h
 
     def free(self, handle: OcmAlloc) -> None:
@@ -154,6 +188,7 @@ class ControlPlaneClient:
                 {"alloc_id": handle.alloc_id, "rank": handle.rank},
             )
         )
+        self._note_owner(handle.rank, -1)
 
     # -- RemoteBackend: one-sided data ----------------------------------
 

@@ -20,6 +20,7 @@ connectionless, so no such window exists.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -68,7 +69,7 @@ class Daemon:
         config: OcmConfig | None = None,
         policy: str = "capacity",
         ndevices: int = 1,
-        host: str = "0.0.0.0",
+        host: str | None = None,
         snapshot_path: str | None = None,
     ):
         self.snapshot_path = snapshot_path
@@ -76,6 +77,12 @@ class Daemon:
         self.entries = entries
         self.config = config or OcmConfig()
         self.ndevices = ndevices
+        # The control/data plane is unauthenticated (like the reference's,
+        # sock.c binds INADDR_ANY) — so default to loopback; exposing it on
+        # other interfaces is an explicit opt-in via the host= argument
+        # (typically the nodefile hostname) or OCM_BIND_HOST=0.0.0.0.
+        if host is None:
+            host = os.environ.get("OCM_BIND_HOST", "127.0.0.1")
         self.host = host
         self.port = entries[rank].port
         # Daemon-owned storage for the REMOTE_HOST arm (DCN fabric).
@@ -104,9 +111,10 @@ class Daemon:
     def start(self) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        # Bind the wildcard by default (the C++ daemon binds INADDR_ANY):
-        # peers dial the nodefile's addr column, which need not match what
-        # the local resolver maps our own hostname to.
+        # Loopback by default (see __init__); multi-host deployments pass the
+        # nodefile hostname or opt into the wildcard explicitly. Peers dial
+        # the nodefile's addr column, which need not match what the local
+        # resolver maps our own hostname to.
         self._listener.bind((self.host, self.port))
         if self.port == 0:  # ephemeral port (tests)
             self.port = self._listener.getsockname()[1]
@@ -405,7 +413,44 @@ class Daemon:
         )
 
     def _on_disconnect(self, msg: Message) -> Message:
+        """Immediate reclamation on app disconnect instead of waiting out the
+        lease (the reference daemon tracks connected apps and frees on
+        disconnect, main.c:46-47,58-103). The app reports which owner ranks
+        hold its remote allocations ("owners", tracked app-side where the
+        handles live), so the fan-out is O(owners); a crashed app never sends
+        DISCONNECT and falls back to the lease reaper."""
+        pid = msg.fields["pid"]
+        self._reclaim_app_local(pid, self.rank)
+        for r in _parse_owners(msg.fields.get("owners", "")):
+            if r == self.rank or not 0 <= r < len(self.entries):
+                continue
+            e = self.entries[r]
+            try:
+                self.peers.request(
+                    e.connect_host, e.port,
+                    Message(MsgType.RECLAIM_APP,
+                            {"pid": pid, "rank": self.rank}),
+                )
+            except (OSError, OcmError):
+                printd("daemon %d: RECLAIM_APP to %d failed (lease reaper "
+                       "is the backstop)", self.rank, r)
         return Message(MsgType.CONNECT_CONFIRM, {"rank": self.rank, "nnodes": 0})
+
+    def _on_reclaim_app(self, msg: Message) -> Message:
+        n = self._reclaim_app_local(msg.fields["pid"], msg.fields["rank"])
+        return Message(MsgType.RECLAIM_APP_OK, {"count": n})
+
+    def _reclaim_app_local(self, origin_pid: int, origin_rank: int) -> int:
+        n = 0
+        for e in self.registry.for_app(origin_pid, origin_rank):
+            printd("daemon %d reclaiming alloc %d of disconnected app %d",
+                   self.rank, e.alloc_id, origin_pid)
+            try:
+                self._do_free_local(e.alloc_id)
+                n += 1
+            except OcmInvalidHandle:  # raced with an explicit free
+                pass
+        return n
 
     # ADD_NODE: only the master records membership (alloc_add_node,
     # alloc.c:60-74).
@@ -619,9 +664,12 @@ class Daemon:
         f = msg.fields
         self.registry.renew_leases(f["pid"], f["rank"])
         if f["rank"] == self.rank:
-            for e in self.entries:
-                if e.rank == self.rank:
+            # Relay only to the ranks the app says own its allocations —
+            # O(owners) per beat, not an O(nnodes) broadcast per app.
+            for r in _parse_owners(f.get("owners", "")):
+                if r == self.rank or not 0 <= r < len(self.entries):
                     continue
+                e = self.entries[r]
                 try:
                     self.peers.request(e.connect_host, e.port, msg)
                 except (OSError, OcmConnectError):
@@ -646,6 +694,19 @@ class Daemon:
 
 def _err(code: ErrCode, detail: str) -> Message:
     return Message(MsgType.ERROR, {"code": int(code), "detail": detail})
+
+
+def _parse_owners(s: str) -> list[int]:
+    """Comma-separated rank list from the wire ("1,3" -> [1, 3])."""
+    out = []
+    for part in s.split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                continue
+    return out
 
 
 def main(argv=None) -> int:
@@ -687,6 +748,7 @@ _HANDLERS = {
     MsgType.DISCONNECT: Daemon._on_disconnect,
     MsgType.ADD_NODE: Daemon._on_add_node,
     MsgType.REQ_ALLOC: Daemon._on_req_alloc,
+    MsgType.RECLAIM_APP: Daemon._on_reclaim_app,
     MsgType.DO_ALLOC: Daemon._on_do_alloc,
     MsgType.REQ_FREE: Daemon._on_req_free,
     MsgType.DO_FREE: Daemon._on_do_free,

@@ -24,6 +24,7 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cstdlib>
 #include <condition_variable>
 #include <cerrno>
 #include <cstdio>
@@ -181,6 +182,17 @@ class Registry {
     return out;
   }
 
+  // Every allocation an app originated (disconnect-time reclamation — the
+  // reference's unresolved TODO, main.c:6-7,58-103).
+  std::vector<uint64_t> ids_for_app(int64_t pid, int64_t rank) const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<uint64_t> out;
+    for (auto& kv : entries_)
+      if (kv.second.origin_pid == pid && kv.second.origin_rank == rank)
+        out.push_back(kv.first);
+    return out;
+  }
+
   double new_deadline() const { return now_s() + lease_s_; }
   double lease_s() const { return lease_s_; }
 
@@ -333,6 +345,11 @@ class Placement {
 struct Config {
   std::string nodefile;
   std::string snapshot_path;
+  // Empty = bind the daemon's own nodefile hostname (routable to peers but
+  // not the wildcard; the plane is unauthenticated, so INADDR_ANY is an
+  // explicit opt-in via --bind-host 0.0.0.0 / OCM_BIND_HOST). Mirrors the
+  // Python CLI (daemon.py main() passes host=entries[rank].host).
+  std::string bind_host;
   int64_t rank = -1;
   bool capacity_policy = true;
   uint32_t ndevices = 1;
@@ -363,7 +380,22 @@ class Daemon {
     setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr = {};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (cfg_.bind_host.empty())
+      cfg_.bind_host = entries_[cfg_.rank].host;
+    if (cfg_.bind_host == "0.0.0.0") {
+      addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    } else if (inet_pton(AF_INET, cfg_.bind_host.c_str(), &addr.sin_addr) != 1) {
+      // Not a dotted quad (e.g. a nodefile hostname): resolve it.
+      addrinfo hints = {};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (getaddrinfo(cfg_.bind_host.c_str(), nullptr, &hints, &res) != 0 ||
+          res == nullptr)
+        throw std::runtime_error("cannot resolve bind host " + cfg_.bind_host);
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
     addr.sin_port = htons(uint16_t(entries_[cfg_.rank].port));
     if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
       throw std::runtime_error("bind failed on port " +
@@ -558,13 +590,20 @@ class Daemon {
 
   Message dispatch(const Message& m) {
     switch (m.type) {
-      case MsgType::CONNECT:
       case MsgType::DISCONNECT:
+        on_disconnect(m);
+        [[fallthrough]];
+      case MsgType::CONNECT:
         return {MsgType::CONNECT_CONFIRM,
                 {{"rank", Value::I(cfg_.rank)},
                  {"nnodes", Value::I(cfg_.rank == 0
                                          ? placement_.nnodes()
                                          : int64_t(entries_.size()))}},
+                {}};
+      case MsgType::RECLAIM_APP:
+        return {MsgType::RECLAIM_APP_OK,
+                {{"count",
+                  Value::U(reclaim_app_local(m.i("pid"), m.i("rank")))}},
                 {}};
       case MsgType::ADD_NODE: return on_add_node(m);
       case MsgType::REQ_ALLOC: return on_req_alloc(m);
@@ -901,13 +940,15 @@ class Daemon {
 
   Message on_heartbeat(const Message& m) {
     registry_.renew(m.i("pid"), m.i("rank"));
-    // Relay local-app heartbeats to peers (owners hold the leases); relayed
-    // copies have origin rank != receiver rank, so no forwarding loop.
+    // Relay local-app heartbeats only to the ranks the app reports as
+    // owners of its allocations — O(owners) per beat, not an O(nnodes)
+    // broadcast. Relayed copies have origin rank != receiver rank, so no
+    // forwarding loop.
     if (m.i("rank") == cfg_.rank) {
-      for (size_t r = 0; r < entries_.size(); ++r) {
-        if (int64_t(r) == cfg_.rank) continue;
+      for (int64_t r : parse_owners(m.s("owners"))) {
+        if (r == cfg_.rank || r < 0 || size_t(r) >= entries_.size()) continue;
         try {
-          NodeEntry e = entry(int64_t(r));
+          NodeEntry e = entry(r);
           peers_.request(e.caddr(), e.port, m);
         } catch (const ProtocolError&) {
         }
@@ -916,6 +957,57 @@ class Daemon {
     return {MsgType::HEARTBEAT_OK,
             {{"lease_s", Value::D(registry_.lease_s())}},
             {}};
+  }
+
+  // Immediate reclamation on app disconnect (main.c:46-47,58-103): free
+  // local allocations now, and fan RECLAIM_APP out to the owner ranks the
+  // app reported. A crashed app never disconnects — the lease reaper is the
+  // backstop.
+  void on_disconnect(const Message& m) {
+    int64_t pid = m.i("pid");
+    reclaim_app_local(pid, cfg_.rank);
+    for (int64_t r : parse_owners(m.s("owners"))) {
+      if (r == cfg_.rank || r < 0 || size_t(r) >= entries_.size()) continue;
+      try {
+        NodeEntry e = entry(r);
+        peers_.request(e.caddr(), e.port,
+                       {MsgType::RECLAIM_APP,
+                        {{"pid", Value::I(pid)}, {"rank", Value::I(cfg_.rank)}},
+                        {}});
+      } catch (const ProtocolError&) {
+      }
+    }
+  }
+
+  uint64_t reclaim_app_local(int64_t pid, int64_t origin_rank) {
+    uint64_t n = 0;
+    for (uint64_t id : registry_.ids_for_app(pid, origin_rank)) {
+      try {
+        do_free_local(id);
+        ++n;
+      } catch (const BadHandleError&) {  // raced with an explicit free
+      }
+    }
+    return n;
+  }
+
+  static std::vector<int64_t> parse_owners(const std::string& s) {
+    std::vector<int64_t> out;
+    size_t pos = 0;
+    while (pos <= s.size()) {
+      size_t comma = s.find(',', pos);
+      std::string part = s.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      if (!part.empty()) {
+        try {
+          out.push_back(std::stoll(part));
+        } catch (const std::exception&) {
+        }
+      }
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+    return out;
   }
 
   Message on_status() {
@@ -967,6 +1059,7 @@ void on_signal(int) {
 
 int main(int argc, char** argv) {
   ocm::Config cfg;
+  if (const char* bh = getenv("OCM_BIND_HOST")) cfg.bind_host = bh;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> std::string {
@@ -983,6 +1076,7 @@ int main(int argc, char** argv) {
     else if (a == "--lease-s") cfg.lease_s = std::stod(next());
     else if (a == "--heartbeat-s") cfg.heartbeat_s = std::stod(next());
     else if (a == "--snapshot") cfg.snapshot_path = next();
+    else if (a == "--bind-host") cfg.bind_host = next();
     else {
       std::fprintf(stderr, "unknown flag %s\n", a.c_str());
       return 2;

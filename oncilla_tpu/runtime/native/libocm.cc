@@ -11,6 +11,7 @@
 // Built as a shared library so C/C++/Fortran applications can drive the
 // same daemons as the Python binding.
 
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -61,7 +62,11 @@ struct ocmc_ctx {
   std::map<std::string, std::shared_ptr<DataConn>> data_conns;
   std::mutex data_mu;
   std::string last_error;
-  std::mutex err_mu;
+  mutable std::mutex err_mu;
+  // rank -> live remote-alloc count; reported as the "owners" field on
+  // HEARTBEAT/DISCONNECT so daemons relay/reclaim with O(owners) fan-out.
+  std::map<int64_t, int> owner_ranks;
+  std::mutex owners_mu;
   std::thread hb_thread;
   std::atomic<bool> hb_stop{false};
   std::condition_variable hb_cv;
@@ -70,20 +75,49 @@ struct ocmc_ctx {
   ~ocmc_ctx() {
     hb_stop = true;
     hb_cv.notify_all();
-    if (hb_thread.joinable()) hb_thread.join();
-    if (ctrl_fd >= 0) {
+    // Polite DISCONNECT while the fd is still whole. try_lock keeps
+    // teardown bounded: if a heartbeat is wedged inside ctrl_request on a
+    // dead daemon, skip the courtesy message rather than block on ctrl_mu.
+    if (ctrl_fd >= 0 && ctrl_mu.try_lock()) {
       try {
-        Message m{MsgType::DISCONNECT, {{"pid", Value::I(pid)}}, {}};
+        Message m{MsgType::DISCONNECT,
+                  {{"pid", Value::I(pid)}, {"owners", Value::S(owners_field())}},
+                  {}};
         send_msg(ctrl_fd, m);
       } catch (...) {
       }
-      ::close(ctrl_fd);
+      ctrl_mu.unlock();
     }
+    // Shut the socket down BEFORE joining: this unblocks a heartbeat stuck
+    // in send/recv on a wedged daemon (join-before-shutdown hung forever).
+    if (ctrl_fd >= 0) ::shutdown(ctrl_fd, SHUT_RDWR);
+    if (hb_thread.joinable()) hb_thread.join();
+    if (ctrl_fd >= 0) ::close(ctrl_fd);
   }
 
   void set_error(const std::string& e) {
     std::lock_guard<std::mutex> g(err_mu);
     last_error = e;
+  }
+
+  std::string owners_field() {
+    std::lock_guard<std::mutex> g(owners_mu);
+    std::string s;
+    for (auto& kv : owner_ranks) {
+      if (!s.empty()) s += ",";
+      s += std::to_string(kv.first);
+    }
+    return s;
+  }
+
+  void note_owner(int64_t owner_rank, int delta) {
+    if (owner_rank == rank) return;
+    std::lock_guard<std::mutex> g(owners_mu);
+    int n = owner_ranks[owner_rank] + delta;
+    if (n > 0)
+      owner_ranks[owner_rank] = n;
+    else
+      owner_ranks.erase(owner_rank);
   }
 
   Message ctrl_request(const Message& m) {
@@ -184,7 +218,8 @@ void heartbeat_loop(ocmc_ctx* ctx, double period_s) {
     try {
       ctx->ctrl_request(Message{MsgType::HEARTBEAT,
                                 {{"rank", Value::I(ctx->rank)},
-                                 {"pid", Value::I(ctx->pid)}},
+                                 {"pid", Value::I(ctx->pid)},
+                                 {"owners", Value::S(ctx->owners_field())}},
                                 {}});
     } catch (...) {  // transient: next beat retries
     }
@@ -254,6 +289,7 @@ int ocmc_alloc(ocmc_ctx* ctx, uint64_t nbytes, uint8_t kind,
     std::snprintf(out->owner_host, sizeof(out->owner_host), "%s",
                   r.s("owner_host").c_str());
     out->owner_port = uint32_t(r.u("owner_port"));
+    ctx->note_owner(out->rank, +1);
     return 0;
   } catch (const std::exception& e) {
     ctx->set_error(e.what());
@@ -268,6 +304,7 @@ int ocmc_free(ocmc_ctx* ctx, const ocmc_handle* h) {
                               {{"alloc_id", Value::U(h->alloc_id)},
                                {"rank", Value::I(h->rank)}},
                               {}});
+    ctx->note_owner(h->rank, -1);
     return 0;
   } catch (const std::exception& e) {
     ctx->set_error(e.what());
@@ -350,13 +387,19 @@ uint64_t ocmc_remote_sz(const ocmc_handle* h) {
 int64_t ocmc_nnodes(const ocmc_ctx* ctx) { return ctx ? ctx->nnodes : 0; }
 
 const char* ocmc_last_error(const ocmc_ctx* ctx) {
+  // Snapshot into thread-local storage under the lock: the returned pointer
+  // is stable for the calling thread until its next ocmc_last_error call,
+  // and never races a concurrent set_error (returning last_error.c_str()
+  // directly was a data race and a use-after-free hazard).
+  thread_local std::string tls;
   if (!ctx) {
     std::lock_guard<std::mutex> g(g_init_err_mu);
-    // Leaked copy is fine: init failures are rare and the caller needs a
-    // stable pointer with no context to own it.
-    return strdup(g_init_err.c_str());
+    tls = g_init_err;
+  } else {
+    std::lock_guard<std::mutex> g(ctx->err_mu);
+    tls = ctx->last_error;
   }
-  return ctx->last_error.c_str();
+  return tls.c_str();
 }
 
 }  // extern "C"

@@ -20,6 +20,7 @@ BINARY = BUILD_DIR / "oncillamemd"
 def _stale(target: Path) -> bool:
     srcs = [
         *NATIVE_DIR.glob("*.cc"),
+        *NATIVE_DIR.glob("*.c"),
         *NATIVE_DIR.glob("*.hh"),
         *NATIVE_DIR.glob("*.h"),
         NATIVE_DIR / "CMakeLists.txt",

@@ -9,7 +9,7 @@ const std::map<MsgType, std::vector<Field>>& schemas() {
   static const std::map<MsgType, std::vector<Field>> kSchemas = {
       {MsgType::CONNECT, {{"pid", 'q'}, {"rank", 'q'}}},
       {MsgType::CONNECT_CONFIRM, {{"rank", 'q'}, {"nnodes", 'q'}}},
-      {MsgType::DISCONNECT, {{"pid", 'q'}}},
+      {MsgType::DISCONNECT, {{"pid", 'q'}, {"owners", 's'}}},
       {MsgType::ADD_NODE,
        {{"rank", 'q'},
         {"host", 's'},
@@ -45,11 +45,13 @@ const std::map<MsgType, std::vector<Field>>& schemas() {
        {{"kind", 'B'}, {"rank", 'q'}, {"device_index", 'I'}, {"nbytes", 'Q'}}},
       {MsgType::DO_FREE, {{"alloc_id", 'Q'}}},
       {MsgType::FREE_OK, {{"alloc_id", 'Q'}}},
+      {MsgType::RECLAIM_APP, {{"pid", 'q'}, {"rank", 'q'}}},
+      {MsgType::RECLAIM_APP_OK, {{"count", 'Q'}}},
       {MsgType::DATA_PUT, {{"alloc_id", 'Q'}, {"offset", 'Q'}, {"nbytes", 'Q'}}},
       {MsgType::DATA_PUT_OK, {{"nbytes", 'Q'}}},
       {MsgType::DATA_GET, {{"alloc_id", 'Q'}, {"offset", 'Q'}, {"nbytes", 'Q'}}},
       {MsgType::DATA_GET_OK, {{"nbytes", 'Q'}}},
-      {MsgType::HEARTBEAT, {{"rank", 'q'}, {"pid", 'q'}}},
+      {MsgType::HEARTBEAT, {{"rank", 'q'}, {"pid", 'q'}, {"owners", 's'}}},
       {MsgType::HEARTBEAT_OK, {{"lease_s", 'd'}}},
       {MsgType::STATUS, {}},
       {MsgType::STATUS_OK,

@@ -18,7 +18,8 @@
 namespace ocm {
 
 constexpr char kMagic[4] = {'O', 'C', 'M', '1'};
-constexpr uint8_t kVersion = 1;
+// v2: owners field on DISCONNECT/HEARTBEAT, RECLAIM_APP (protocol.py).
+constexpr uint8_t kVersion = 2;
 constexpr size_t kHeaderSize = 12;
 constexpr uint64_t kMaxPayload = 64ull << 20;
 
@@ -38,6 +39,8 @@ enum class MsgType : uint8_t {
   ALLOC_RESULT = 19,
   NOTE_FREE = 20,
   NOTE_ALLOC = 21,
+  RECLAIM_APP = 22,
+  RECLAIM_APP_OK = 23,
   DATA_PUT = 30,
   DATA_PUT_OK = 31,
   DATA_GET = 32,

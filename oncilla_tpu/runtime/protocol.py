@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from oncilla_tpu.core.errors import OcmProtocolError, OcmRemoteError
 
 MAGIC = b"OCM1"
-VERSION = 1
+VERSION = 2  # v2: owners field on DISCONNECT/HEARTBEAT, RECLAIM_APP
 HEADER = struct.Struct("<4sBBHI")  # magic, version, type, flags, payload_len
 MAX_PAYLOAD = 64 << 20  # sanity cap; large transfers are chunked above this
 
@@ -51,6 +51,8 @@ class MsgType(enum.IntEnum):
     ALLOC_RESULT = 19       # local daemon -> app: the complete handle
     NOTE_FREE = 20          # owner -> rank 0: update placement accounting
     NOTE_ALLOC = 21         # restored owner -> rank 0: resync accounting
+    RECLAIM_APP = 22        # origin daemon -> owner: free a dead app's allocs
+    RECLAIM_APP_OK = 23
     # DCN data plane (reference: the per-fabric one-sided put/get)
     DATA_PUT = 30
     DATA_PUT_OK = 31
@@ -103,7 +105,12 @@ class Message:
 _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
     MsgType.CONNECT: [("pid", "q"), ("rank", "q")],
     MsgType.CONNECT_CONFIRM: [("rank", "q"), ("nnodes", "q")],
-    MsgType.DISCONNECT: [("pid", "q")],
+    # "owners" on DISCONNECT/HEARTBEAT is the comma-separated set of ranks
+    # holding this app's remote allocations, tracked app-side (the app is
+    # the source of truth for its own handles, and the set survives daemon
+    # restarts). Bounds reclamation/relay fan-out to O(owners), not
+    # O(nnodes).
+    MsgType.DISCONNECT: [("pid", "q"), ("owners", "s")],
     MsgType.ADD_NODE: [
         ("rank", "q"),
         ("host", "s"),
@@ -157,11 +164,13 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
     ],
     MsgType.DO_FREE: [("alloc_id", "Q")],
     MsgType.FREE_OK: [("alloc_id", "Q")],
+    MsgType.RECLAIM_APP: [("pid", "q"), ("rank", "q")],
+    MsgType.RECLAIM_APP_OK: [("count", "Q")],
     MsgType.DATA_PUT: [("alloc_id", "Q"), ("offset", "Q"), ("nbytes", "Q")],
     MsgType.DATA_PUT_OK: [("nbytes", "Q")],
     MsgType.DATA_GET: [("alloc_id", "Q"), ("offset", "Q"), ("nbytes", "Q")],
     MsgType.DATA_GET_OK: [("nbytes", "Q")],
-    MsgType.HEARTBEAT: [("rank", "q"), ("pid", "q")],
+    MsgType.HEARTBEAT: [("rank", "q"), ("pid", "q"), ("owners", "s")],
     MsgType.HEARTBEAT_OK: [("lease_s", "d")],
     MsgType.STATUS: [],
     MsgType.STATUS_OK: [

@@ -83,6 +83,16 @@ class AllocRegistry:
                 if e.origin_pid == origin_pid and e.origin_rank == origin_rank:
                     e.lease_expiry = deadline
 
+    def for_app(self, origin_pid: int, origin_rank: int) -> list[RegEntry]:
+        """Every allocation originated by an app — feeds the disconnect-time
+        reclamation the reference left as a TODO
+        (/root/reference/src/main.c:6-7,58-103)."""
+        with self._lock:
+            return [
+                e for e in self._entries.values()
+                if e.origin_pid == origin_pid and e.origin_rank == origin_rank
+            ]
+
     def expired(self, now: float | None = None) -> list[RegEntry]:
         now = time.monotonic() if now is None else now
         with self._lock:

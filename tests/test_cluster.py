@@ -168,6 +168,57 @@ def test_heartbeat_keeps_alive():
         client.free(h)
 
 
+def test_disconnect_reclaims_immediately():
+    # App closes cleanly -> its allocations are freed NOW, not after the
+    # lease runs out (main.c:46-47,58-103 disconnect processing; lease set
+    # far out so only the DISCONNECT path can explain the reclamation).
+    cfg = small_cfg(lease_s=300.0)
+    with local_cluster(3, config=cfg) as c:
+        client = c.client(0, heartbeat=False)
+        hs = [client.alloc(4096, OcmKind.REMOTE_HOST) for _ in range(3)]
+        assert sum(d.registry.live_count() for d in c.daemons) == 3
+        assert any(h.rank != 0 for h in hs)  # some are truly remote
+        client.close()
+        deadline = time.time() + 5.0
+        while (sum(d.registry.live_count() for d in c.daemons)
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert sum(d.registry.live_count() for d in c.daemons) == 0
+
+
+def test_heartbeat_fanout_bounded():
+    # An app with one remote allocation must not cause an O(nnodes)
+    # heartbeat broadcast: with 8 daemons, relays go only to the single
+    # owner rank.
+    from oncilla_tpu.runtime.protocol import MsgType
+
+    cfg = small_cfg(heartbeat_s=0.1)
+    with local_cluster(8, config=cfg) as c:
+        d0 = c.daemons[0]
+        relayed_ports = []
+        orig = d0.peers.request
+
+        def counting(host, port, msg, _orig=orig):
+            if msg.type == MsgType.HEARTBEAT:
+                relayed_ports.append(port)
+            return _orig(host, port, msg)
+
+        d0.peers.request = counting
+        client = c.client(0)
+        h = client.alloc(4096, OcmKind.REMOTE_HOST)
+        assert h.rank != 0
+        time.sleep(1.0)  # ~10 beats
+        assert relayed_ports, "no heartbeat was relayed at all"
+        owner_port = c.daemons[h.rank].port
+        assert set(relayed_ports) == {owner_port}
+        # The owner's lease stays renewed through the targeted relay.
+        assert c.daemons[h.rank].registry.live_count() == 1
+        client.free(h)
+        relayed_ports.clear()
+        time.sleep(0.5)
+        assert not relayed_ports  # no owners -> no relay at all
+
+
 def test_free_unknown_id_rejected():
     with local_cluster(2, config=small_cfg()) as c:
         client = c.client(0)

@@ -104,12 +104,15 @@ def test_spmd_arena_host_put_get(rng):
     assert not np.any(np.asarray(sa.host_get(arena, 2, 4096, 8192, mesh=mesh)))
 
 
-def test_spmd_arena_ici_copy(rng):
+@pytest.mark.parametrize("use_pallas", [False, True], ids=["ppermute", "pallas"])
+def test_spmd_arena_ici_copy(rng, use_pallas):
     mesh = node_mesh()
     arena = sa.make_arena(mesh, 64 << 10)
     data = rng.integers(0, 256, 4096, dtype=np.uint8)
     arena = sa.host_put(arena, 1, data, 0, mesh=mesh)
-    arena = sa.ici_copy(arena, 1, 6, 0, 4096, 4096, mesh=mesh, use_pallas=False)
+    arena = sa.ici_copy(
+        arena, 1, 6, 0, 4096, 4096, mesh=mesh, use_pallas=use_pallas
+    )
     got = np.asarray(sa.host_get(arena, 6, 4096, 4096, mesh=mesh))
     np.testing.assert_array_equal(got, data)
     # Source intact, sharding preserved.

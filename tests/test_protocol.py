@@ -22,6 +22,7 @@ def test_roundtrip_all_schemas():
         "detail": "boom", "lease_s": 30.0, "live_allocs": 7,
         "host_bytes_live": 11, "device_bytes_live": 22,
         "owner_host": "10.0.0.1", "owner_port": 18000,
+        "owners": "1,3,5", "count": 2,
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})
@@ -79,5 +80,5 @@ def test_header_layout_stable():
     assert P.HEADER.size == 12
     b = P.pack(P.Message(P.MsgType.CONNECT, {"pid": 1, "rank": 0}))
     magic, ver, typ, flags, plen = P.HEADER.unpack(b[:12])
-    assert (magic, ver, typ, flags, plen) == (b"OCM1", 1, 1, 0, 16)
+    assert (magic, ver, typ, flags, plen) == (b"OCM1", 2, 1, 0, 16)
     assert struct.unpack("<qq", b[12:28]) == (1, 0)

@@ -48,9 +48,11 @@ def test_daemon_restart_restores_allocations(tmp_path, rng):
         client.put(h_host, data, 0)
 
         # Daemon dies (snapshot written on stop) and a fresh one restores.
-        # Close the client first: its established data connections pin the
-        # port and would block the rebind.
-        client.close()
+        # Close the client first (detached: a plain close would DISCONNECT
+        # and the daemons would reclaim the very allocations the snapshot
+        # must restore); its established data connections pin the port and
+        # would block the rebind.
+        client.close(detach=True)
         cl.clients.remove(client)
         # Daemon 0's peer pool also holds connections into d1's port (from
         # the DO_ALLOC/heartbeat legs); drop them so the port frees up.
@@ -145,7 +147,7 @@ def test_native_daemon_snapshot_restart(tmp_path, rng):
         h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)  # demotes to LOCAL_HOST
         data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
         client.put(h, data, 0)
-        client.close()
+        client.close(detach=True)  # keep the alloc for the snapshot
         p.terminate()
         assert p.wait(timeout=5) is not None
         assert (tmp_path / "d0.ocms").exists()
@@ -193,7 +195,7 @@ def test_python_snapshot_restored_by_native_daemon(tmp_path, rng):
     h = client.alloc(512 << 10, OcmKind.REMOTE_HOST)
     data = rng.integers(0, 256, 512 << 10, dtype=np.uint8)
     client.put(h, data, 0)
-    client.close()
+    client.close(detach=True)  # keep the alloc for the snapshot
     pyd.stop()
 
     # Native daemon restores it.
