@@ -276,6 +276,35 @@ def check_pallas_ici_copy(errors: dict) -> bool:
             got = np.asarray(sa.host_get(arena, 0, 4 * PBLOCK, off, mesh=mesh))
             if not np.array_equal(got, pat):
                 raise RuntimeError(f"mismatch at offset {off}")
+
+        # Handle-level: ctx-style REMOTE_DEVICE handles riding the same
+        # one-sided fabric through SpmdIciPlane (VERDICT r2 item 2).
+        from oncilla_tpu.core.arena import Extent
+        from oncilla_tpu.core.handle import OcmAlloc
+        from oncilla_tpu.core.kinds import Fabric, OcmKind
+        from oncilla_tpu.ops.ici import SpmdIciPlane
+
+        plane = SpmdIciPlane(
+            config=ocm.OcmConfig(device_arena_bytes=1 << 20),
+            mesh=mesh, devices_per_rank=1,
+        )
+
+        def handle(aid, off, n):
+            return OcmAlloc(
+                alloc_id=aid, kind=OcmKind.REMOTE_DEVICE, fabric=Fabric.ICI,
+                nbytes=n, rank=0, device_index=0,
+                extent=Extent(offset=off, nbytes=n), origin_rank=0,
+            )
+
+        n = 8 * PBLOCK
+        h_src = handle(2, 0, n)
+        h_dst = handle(4, 128 * PBLOCK, n)  # in range: arena row is 256 blocks
+        plane.put(h_src, pat2 := (np.arange(n, dtype=np.uint64) % 241).astype(np.uint8))
+        plane.copy(h_dst, h_src, n)
+        if not np.array_equal(np.asarray(plane.get(h_dst, n)), pat2):
+            raise RuntimeError("handle-level one-sided copy mismatch")
+        if plane.stats["ici_copies"] != 1:
+            raise RuntimeError("handle copy did not ride ici_copy")
         return True
     except Exception as e:  # noqa: BLE001
         errors["pallas_ici_copy"] = f"{type(e).__name__}: {e}"

@@ -238,6 +238,18 @@ class Ocm:
                     src.extent, dst.extent, nbytes, src_offset, dst_offset
                 )
                 return
+            if (
+                src.kind == OcmKind.REMOTE_DEVICE
+                and dst.kind == OcmKind.REMOTE_DEVICE
+                and self._remote is not None
+            ):
+                # Device-to-device rides the ICI fabric directly (one-sided
+                # chip-to-chip on SpmdIciPlane — the ocm_copy RDMA×RDMA arm
+                # going straight to ib_write, lib.c:670-700), never the host.
+                plane = getattr(self._remote, "ici_plane", None)
+                if plane is not None:
+                    plane.copy(dst, src, nbytes, dst_offset, src_offset)
+                    return
             data = self.get(src, nbytes, src_offset)
             self.put(dst, data, dst_offset)
 

@@ -121,6 +121,134 @@ class IciDataPlane:
         return arena.read_as(handle.extent, shape, dtype, offset)
 
 
+class SpmdIciPlane:
+    """The one-sided flavor of the device data plane: handles resolve onto a
+    single mesh-sharded global arena (one row per chip's HBM), and
+    handle-to-handle copies are true chip-to-chip one-sided ops —
+    ``spmd_arena.ici_copy`` dispatching to the Pallas remote-DMA kernel
+    (``ops/pallas_ici.py``) on TPU, exactly as ``ocm_copy_onesided`` on an
+    RDMA handle goes straight to ``ib_write``
+    (/root/reference/src/lib.c:670-700, rdma.c:241-263).
+
+    Where :class:`IciDataPlane` holds independent per-chip arenas and
+    orchestrates movement from the controller, this plane's storage IS the
+    SPMD fabric, so the same arena rows are addressable both through
+    connectionless handles (rank, device_index, offset) and from inside
+    jitted SPMD steps (KV paging, ring attention). Implements the same
+    RemoteBackend data interface; pass as ``ici_plane=`` to the client.
+    """
+
+    def __init__(
+        self,
+        config: OcmConfig | None = None,
+        mesh=None,
+        devices_per_rank: int | None = None,
+    ):
+        from oncilla_tpu.parallel import spmd_arena as sa
+        from oncilla_tpu.parallel.mesh import node_mesh
+
+        self._sa = sa
+        self.config = config or OcmConfig()
+        self.mesh = mesh if mesh is not None else node_mesh()
+        ndev = int(self.mesh.devices.size)
+        self.devices_per_rank = devices_per_rank or ndev
+        self.arena = sa.make_arena(self.mesh, self.config.device_arena_bytes)
+        self.tracer = GLOBAL_TRACER
+        self.stats = {"ici_copies": 0, "puts": 0, "gets": 0}
+
+    def _gdev(self, handle: OcmAlloc) -> int:
+        if not 0 <= handle.device_index < self.devices_per_rank:
+            raise OcmInvalidHandle(
+                f"device_index {handle.device_index} out of range for "
+                f"{self.devices_per_rank} devices per rank"
+            )
+        g = global_index(handle.rank, handle.device_index, self.devices_per_rank)
+        if not 0 <= g < int(self.mesh.devices.size):
+            raise OcmInvalidHandle(
+                f"handle addresses device {g} but the mesh has "
+                f"{int(self.mesh.devices.size)} devices"
+            )
+        # The extent must fit this plane's rows: dynamic_slice/update CLAMP
+        # out-of-range offsets, so a daemon-issued extent sized for a bigger
+        # arena would silently land on another allocation's bytes.
+        end = handle.extent.offset + handle.extent.nbytes
+        if end > self.config.device_arena_bytes:
+            from oncilla_tpu.core.errors import OcmBoundsError
+
+            raise OcmBoundsError(
+                f"extent [{handle.extent.offset}, {end}) exceeds the plane's "
+                f"{self.config.device_arena_bytes} B arena rows (plane and "
+                "daemon device_arena_bytes must match)"
+            )
+        return g
+
+    # -- RemoteBackend data interface ------------------------------------
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        from oncilla_tpu.core.arena import check_bounds
+
+        n = _nbytes(data)
+        check_bounds(handle.extent, offset, n)
+        g = self._gdev(handle)
+        with self.tracer.span("spmd_ici_put", nbytes=n):
+            self.arena = self._sa.host_put(
+                self.arena, g, data, handle.extent.offset + offset,
+                mesh=self.mesh,
+            )
+        self.stats["puts"] += 1
+
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0) -> jax.Array:
+        from oncilla_tpu.core.arena import check_bounds
+
+        check_bounds(handle.extent, offset, nbytes)
+        g = self._gdev(handle)
+        with self.tracer.span("spmd_ici_get", nbytes=nbytes):
+            out = self._sa.host_get(
+                self.arena, g, nbytes, handle.extent.offset + offset,
+                mesh=self.mesh,
+            )
+        self.stats["gets"] += 1
+        return out
+
+    def copy(
+        self,
+        dst: OcmAlloc,
+        src: OcmAlloc,
+        nbytes: int,
+        dst_offset: int = 0,
+        src_offset: int = 0,
+        use_pallas: bool | None = None,
+    ) -> None:
+        """True one-sided chip-to-chip copy: the origin chip's DMA engine
+        writes into the target chip's arena row over ICI (no host hop, no
+        per-chunk controller round-trips)."""
+        from oncilla_tpu.core.arena import check_bounds
+
+        check_bounds(src.extent, src_offset, nbytes)
+        check_bounds(dst.extent, dst_offset, nbytes)
+        g_src, g_dst = self._gdev(src), self._gdev(dst)
+        with self.tracer.span("spmd_ici_copy", nbytes=nbytes):
+            self.arena = self._sa.ici_copy(
+                self.arena,
+                g_src,
+                g_dst,
+                src.extent.offset + src_offset,
+                dst.extent.offset + dst_offset,
+                nbytes,
+                mesh=self.mesh,
+                use_pallas=use_pallas,
+            )
+        self.stats["ici_copies"] += 1
+
+    # -- typed helpers ----------------------------------------------------
+
+    def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0) -> jax.Array:
+        from oncilla_tpu.core.hbm import from_bytes
+
+        nbytes = int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+        return from_bytes(self.get(handle, nbytes, offset), shape, dtype)
+
+
 def _nbytes(data) -> int:
     if isinstance(data, np.ndarray):
         return data.nbytes

@@ -17,7 +17,11 @@ On real TPU the kernels drive the hardware DMA engines; everywhere else they
 run under the Pallas TPU interpret machine (``pltpu.InterpretParams``), which
 simulates the semaphore/DMA semantics on the virtual CPU mesh — so the same
 one-sided code path is exercised by CI (the in-process fake fabric SURVEY.md
-§4 calls for). The portable CollectivePermute path lives in
+§4 calls for). Caveat: on a single-core host the interpret machine's
+cross-device barrier starves once per-device arena rows reach ~128 KiB
+(empirically; ≤96 KiB is reliable), so interpret-mode tests use small
+arenas — handle translation and DMA semantics are size-independent. The
+portable CollectivePermute path lives in
 :mod:`oncilla_tpu.parallel.spmd_arena`.
 """
 

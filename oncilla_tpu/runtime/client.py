@@ -132,14 +132,23 @@ class ControlPlaneClient:
         and the daemons reclaim this app's allocations immediately."""
         self._hb_stop.set()
         if not detach:
-            try:
-                send_msg(
-                    self._ctrl,
-                    Message(MsgType.DISCONNECT,
-                            {"pid": self.pid, "owners": self._owners_field()}),
-                )
-            except OSError:
-                pass
+            # Bounded lock (mirrors libocm.cc's try_lock teardown): a beat
+            # already inside _request holds _ctrl_lock mid send/recv, and an
+            # unlocked send here would interleave frames and corrupt the
+            # stream, losing the DISCONNECT. If the lock stays held (daemon
+            # wedged), skip the courtesy message — the lease reaper covers it.
+            if self._ctrl_lock.acquire(timeout=2.0):
+                try:
+                    send_msg(
+                        self._ctrl,
+                        Message(MsgType.DISCONNECT,
+                                {"pid": self.pid,
+                                 "owners": self._owners_field()}),
+                    )
+                except OSError:
+                    pass
+                finally:
+                    self._ctrl_lock.release()
         self._pool.close()
         try:
             self._ctrl.close()

@@ -9,7 +9,7 @@ import pytest
 
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
-from oncilla_tpu.ops.ici import IciDataPlane
+from oncilla_tpu.ops.ici import IciDataPlane, SpmdIciPlane
 from oncilla_tpu.parallel import spmd_arena as sa
 from oncilla_tpu.parallel.mesh import node_mesh
 from oncilla_tpu.runtime.cluster import local_cluster
@@ -87,6 +87,92 @@ def test_device_arm_needs_ici_plane(cluster2x4):
     h = client.alloc(4096, OcmKind.REMOTE_DEVICE)
     with pytest.raises(ocm.OcmInvalidHandle, match="ICI plane"):
         client.put(h, np.zeros(16, np.uint8), 0)
+    client.free(h)
+
+
+# -- SpmdIciPlane: handles wired to the one-sided fabric ------------------
+
+
+@pytest.fixture
+def spmd_cluster():
+    # 2 "hosts" x 4 chips; handles resolve onto the mesh-sharded arena.
+    # Small rows: the interpret machine's cross-device barrier starves on a
+    # single-core host with rows >= ~128 KiB (ops/pallas_ici.py caveat);
+    # handle translation and DMA semantics are size-independent.
+    c = OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=64 << 10)
+    with local_cluster(2, config=c, ndevices=4) as cl:
+        plane = SpmdIciPlane(config=c, devices_per_rank=4)
+        yield cl, plane
+
+
+def test_spmd_plane_put_get_roundtrip(spmd_cluster, rng):
+    cl, plane = spmd_cluster
+    ctx = cl.context(0, ici_plane=plane)
+    h = ctx.alloc(16 << 10, OcmKind.REMOTE_DEVICE)
+    assert h.rank == 1
+    data = rng.integers(0, 256, 16 << 10, dtype=np.uint8)
+    ctx.put(h, data)
+    np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+    ctx.free(h)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True], ids=["ppermute", "pallas"])
+def test_spmd_plane_one_sided_copy(spmd_cluster, rng, use_pallas):
+    """ctx-level handle→handle copy rides the one-sided fabric — the
+    analogue of ocm_copy between two RDMA allocations going straight to
+    ib_write (/root/reference/src/lib.c:670-700). With use_pallas the
+    transfer executes the remote-DMA kernel (interpret mode on CPU)."""
+    cl, plane = spmd_cluster
+    ctx0 = cl.context(0, ici_plane=plane)
+    ctx1 = cl.context(1, ici_plane=plane)
+    h_on_r0 = ctx1.alloc(16 << 10, OcmKind.REMOTE_DEVICE)
+    h_on_r1 = ctx0.alloc(16 << 10, OcmKind.REMOTE_DEVICE)
+    assert (h_on_r0.rank, h_on_r1.rank) == (0, 1)
+    data = rng.integers(0, 256, 16 << 10, dtype=np.uint8)
+    plane.put(h_on_r0, data)
+    plane.copy(h_on_r1, h_on_r0, 16 << 10, use_pallas=use_pallas)
+    np.testing.assert_array_equal(
+        np.asarray(plane.get(h_on_r1, 16 << 10)), data
+    )
+    assert plane.stats["ici_copies"] == 1
+    ctx0.free(h_on_r1)
+    ctx1.free(h_on_r0)
+
+
+def test_ctx_copy_remote_device_rides_ici(spmd_cluster, rng):
+    """ctx.copy(dst, src) between two REMOTE_DEVICE handles must go through
+    the plane's one-sided copy, not a host get→put round-trip."""
+    cl, plane = spmd_cluster
+    ctx0 = cl.context(0, ici_plane=plane)
+    ctx1 = cl.context(1, ici_plane=plane)
+    src = ctx1.alloc(16 << 10, OcmKind.REMOTE_DEVICE)   # lives on rank 0
+    dst = ctx0.alloc(16 << 10, OcmKind.REMOTE_DEVICE)   # lives on rank 1
+    data = rng.integers(0, 256, 16 << 10, dtype=np.uint8)
+    ctx1.put(src, data)
+    gets_before = plane.stats["gets"]
+    ctx0.copy(dst, src)
+    assert plane.stats["ici_copies"] == 1
+    assert plane.stats["gets"] == gets_before  # no host round-trip
+    np.testing.assert_array_equal(np.asarray(ctx0.get(dst)), data)
+    ctx0.free(dst)
+    ctx1.free(src)
+
+
+def test_spmd_plane_typed_and_bounds(spmd_cluster):
+    import jax.numpy as jnp
+
+    cl, plane = spmd_cluster
+    client = cl.client(0, ici_plane=plane)
+    h = client.alloc(8 << 10, OcmKind.REMOTE_DEVICE)
+    x = jnp.arange(2048, dtype=jnp.float32)
+    client.put(h, x, 0)
+    np.testing.assert_allclose(
+        np.asarray(plane.get_as(h, (2048,), jnp.float32)), np.asarray(x)
+    )
+    with pytest.raises(ocm.OcmBoundsError):
+        plane.get(h, (8 << 10) + 1, 0)
+    with pytest.raises(ocm.OcmBoundsError):
+        plane.put(h, np.zeros(16, np.uint8), (8 << 10) - 8)
     client.free(h)
 
 
