@@ -23,12 +23,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from oncilla_tpu.core.errors import OcmInvalidHandle
+from oncilla_tpu.core.errors import OcmError, OcmInvalidHandle
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.hbm import DeviceArena
 from oncilla_tpu.parallel.mesh import global_index
 from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import GLOBAL_TRACER
+
+
+def resolve_global_device(handle: OcmAlloc, devices_per_rank: int, ndevices: int) -> int:
+    """(rank, device_index) -> global device id with range validation —
+    shared by both device data planes."""
+    if not 0 <= handle.device_index < devices_per_rank:
+        raise OcmInvalidHandle(
+            f"device_index {handle.device_index} out of range for "
+            f"{devices_per_rank} devices per rank"
+        )
+    g = global_index(handle.rank, handle.device_index, devices_per_rank)
+    if not 0 <= g < ndevices:
+        raise OcmInvalidHandle(
+            f"handle addresses device {g} but only {ndevices} devices "
+            "are attached"
+        )
+    return g
 
 
 class IciDataPlane:
@@ -57,17 +74,7 @@ class IciDataPlane:
         self.tracer = GLOBAL_TRACER
 
     def _arena(self, handle: OcmAlloc) -> DeviceArena:
-        if not 0 <= handle.device_index < self.devices_per_rank:
-            raise OcmInvalidHandle(
-                f"device_index {handle.device_index} out of range for "
-                f"{self.devices_per_rank} devices per rank"
-            )
-        g = global_index(handle.rank, handle.device_index, self.devices_per_rank)
-        if not 0 <= g < len(self.arenas):
-            raise OcmInvalidHandle(
-                f"handle addresses device {g} but only "
-                f"{len(self.arenas)} devices are attached"
-            )
+        g = resolve_global_device(handle, self.devices_per_rank, len(self.arenas))
         return self.arenas[g]
 
     # -- RemoteBackend data interface ------------------------------------
@@ -147,27 +154,36 @@ class SpmdIciPlane:
         from oncilla_tpu.parallel import spmd_arena as sa
         from oncilla_tpu.parallel.mesh import node_mesh
 
+        import threading
+
         self._sa = sa
         self.config = config or OcmConfig()
+        # Rows are addressed with flat int32 traced offsets inside the
+        # shard_map programs (spmd_arena), so the per-chip row must stay
+        # below the int32 cliff — unlike DeviceArena, which switches to
+        # blocked addressing above it.
+        if self.config.device_arena_bytes > 2**31 - 1:
+            raise OcmError(
+                "SpmdIciPlane rows are int32-addressed; device_arena_bytes "
+                f"must be < 2 GiB (got {self.config.device_arena_bytes}). "
+                "Use multiple device arenas or DeviceArena's blocked mode."
+            )
         self.mesh = mesh if mesh is not None else node_mesh()
         ndev = int(self.mesh.devices.size)
         self.devices_per_rank = devices_per_rank or ndev
         self.arena = sa.make_arena(self.mesh, self.config.device_arena_bytes)
         self.tracer = GLOBAL_TRACER
         self.stats = {"ici_copies": 0, "puts": 0, "gets": 0}
+        # Serializes the donated-arena rebind (same hazard DeviceArena._mu
+        # guards): two unlocked concurrent ops would both capture the same
+        # buffer, and the loser dispatches on a deleted (donated) array or
+        # silently drops the winner's write.
+        self._mu = threading.Lock()
 
     def _gdev(self, handle: OcmAlloc) -> int:
-        if not 0 <= handle.device_index < self.devices_per_rank:
-            raise OcmInvalidHandle(
-                f"device_index {handle.device_index} out of range for "
-                f"{self.devices_per_rank} devices per rank"
-            )
-        g = global_index(handle.rank, handle.device_index, self.devices_per_rank)
-        if not 0 <= g < int(self.mesh.devices.size):
-            raise OcmInvalidHandle(
-                f"handle addresses device {g} but the mesh has "
-                f"{int(self.mesh.devices.size)} devices"
-            )
+        g = resolve_global_device(
+            handle, self.devices_per_rank, int(self.mesh.devices.size)
+        )
         # The extent must fit this plane's rows: dynamic_slice/update CLAMP
         # out-of-range offsets, so a daemon-issued extent sized for a bigger
         # arena would silently land on another allocation's bytes.
@@ -190,24 +206,26 @@ class SpmdIciPlane:
         n = _nbytes(data)
         check_bounds(handle.extent, offset, n)
         g = self._gdev(handle)
-        with self.tracer.span("spmd_ici_put", nbytes=n):
+        with self.tracer.span("spmd_ici_put", nbytes=n), self._mu:
             self.arena = self._sa.host_put(
                 self.arena, g, data, handle.extent.offset + offset,
                 mesh=self.mesh,
             )
-        self.stats["puts"] += 1
+            self.stats["puts"] += 1
 
     def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0) -> jax.Array:
         from oncilla_tpu.core.arena import check_bounds
 
         check_bounds(handle.extent, offset, nbytes)
         g = self._gdev(handle)
-        with self.tracer.span("spmd_ici_get", nbytes=nbytes):
+        with self.tracer.span("spmd_ici_get", nbytes=nbytes), self._mu:
+            # Dispatch under the lock: a concurrent donated put would delete
+            # the buffer this read is about to consume.
             out = self._sa.host_get(
                 self.arena, g, nbytes, handle.extent.offset + offset,
                 mesh=self.mesh,
             )
-        self.stats["gets"] += 1
+            self.stats["gets"] += 1
         return out
 
     def copy(
@@ -227,7 +245,7 @@ class SpmdIciPlane:
         check_bounds(src.extent, src_offset, nbytes)
         check_bounds(dst.extent, dst_offset, nbytes)
         g_src, g_dst = self._gdev(src), self._gdev(dst)
-        with self.tracer.span("spmd_ici_copy", nbytes=nbytes):
+        with self.tracer.span("spmd_ici_copy", nbytes=nbytes), self._mu:
             self.arena = self._sa.ici_copy(
                 self.arena,
                 g_src,
@@ -238,7 +256,7 @@ class SpmdIciPlane:
                 mesh=self.mesh,
                 use_pallas=use_pallas,
             )
-        self.stats["ici_copies"] += 1
+            self.stats["ici_copies"] += 1
 
     # -- typed helpers ----------------------------------------------------
 

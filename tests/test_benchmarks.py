@@ -59,3 +59,54 @@ def test_gups_mesh_conserves_updates():
     assert out["updates"] == 4 * d * d * per_dest
     assert out["table_sum"] == out["updates"]
     assert out["gups"] > 0
+
+
+def test_mfu_flops_formula_matches_xla():
+    # The analytic matmul count must agree with XLA's own cost analysis to
+    # within the elementwise-op noise (norms, rope, softmax).
+    import jax
+    import numpy as np
+
+    from oncilla_tpu.benchmarks import mfu
+    from oncilla_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.device_put(np.zeros((2, 64), np.int32))
+    cost = (
+        jax.jit(lambda p, t: llama.forward(p, t, cfg))
+        .lower(params, tokens)
+        .compile()
+        .cost_analysis()
+    )
+    analytic = mfu.forward_flops(cfg, 2, 64)
+    xla = float(cost["flops"])
+    assert analytic <= xla <= 1.15 * analytic, (analytic, xla)
+    assert mfu.train_flops(cfg, 2, 64) == 3 * analytic
+
+
+def test_mfu_measurement_runs():
+    from oncilla_tpu.benchmarks import mfu
+    from oncilla_tpu.models.llama import LlamaConfig
+
+    r = mfu.mfu_forward(LlamaConfig.tiny(), batch=2, seq=32, steps=2)
+    assert r["tflops"] > 0 and 0 <= r["mfu"] < 1
+    r2 = mfu.mfu_train(LlamaConfig.tiny(), batch=2, seq=32, steps=1)
+    assert r2["tflops"] > 0 and np.isfinite(r2["loss"])
+
+
+def test_size_sweep_blocked_arena():
+    # The sweep composes with blocked (>2 GiB) device arenas — the config
+    # that unlocks the reference's GB-scale regions (ocm_test.c:329).
+    cfg = OcmConfig(
+        host_arena_bytes=1 << 20,
+        device_arena_bytes=(2 << 30) + (8 << 20),
+    )
+    ctx = ocm.ocm_init(cfg)
+    res = size_sweep(
+        ctx, OcmKind.LOCAL_DEVICE, min_bytes=1 << 10, max_bytes=1 << 20,
+        iters=2,
+    )
+    assert len(res.points) == 11
+    assert all(p.write_gbps > 0 and p.read_gbps > 0 for p in res.points)
+    ocm.ocm_tini(ctx)

@@ -43,15 +43,13 @@ def test_concurrent_puts_same_device_arena():
     ctx.tini()
 
 
-def test_large_arena_requires_x64():
-    import jax
-
-    if jax.config.jax_enable_x64:
-        pytest.skip("x64 enabled; large arenas are allowed")
-    with pytest.raises(ocm.OcmError, match="64-bit offsets"):
+def test_large_arena_rejects_unaligned_capacity():
+    # > 2 GiB arenas use blocked addressing (tests/test_hbm_blocked.py);
+    # the capacity must be whole 4 KiB blocks.
+    with pytest.raises(ocm.OcmError, match="multiples of 4096"):
         from oncilla_tpu.core.hbm import DeviceArena
 
-        DeviceArena(3 << 30)
+        DeviceArena((3 << 30) + 17)
 
 
 def test_remote_handle_ops_raise_connect_error():

@@ -1,0 +1,75 @@
+"""Blocked (>2 GiB) device arenas: GB-scale regions with int32 tracing.
+
+The reference registers 2-4 GiB buffers and sweeps transfers up to 1-4 GB
+over them (/root/reference/test/ocm_test.c:329-330, test/ib_client.c:85-131);
+DeviceArena supports the same scale via (nblocks, 4096) blocked addressing —
+no JAX_ENABLE_X64, no int64 traced offsets.
+"""
+
+import numpy as np
+import pytest
+
+from oncilla_tpu.core.hbm import _BLOCK, DeviceArena
+
+GIB = 1 << 30
+CAP = 2 * GIB + (4 << 20)  # just past the int32 cliff
+
+
+@pytest.fixture(scope="module")
+def big_arena():
+    # ~2 GiB of host RAM on the CPU test backend; one per module.
+    return DeviceArena(CAP)
+
+
+def test_blocked_layout(big_arena):
+    assert big_arena.buffer.shape == (CAP // _BLOCK, _BLOCK)
+    assert big_arena.capacity == CAP
+
+
+def test_write_read_beyond_int32(big_arena, rng):
+    # An extent whose absolute offsets exceed 2**31 — the case the flat
+    # int32 path cannot address.
+    a = big_arena
+    first = a.alloc(2 * GIB)      # pushes the next extent past the cliff
+    ext = a.alloc(1 << 20)
+    assert ext.offset + ext.nbytes > 2**31
+    data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    a.write(ext, data)
+    np.testing.assert_array_equal(np.asarray(a.read(ext, 1 << 20)), data)
+    a.free(ext)
+    a.free(first)
+
+
+def test_unaligned_window_write_read(big_arena, rng):
+    # Byte ranges straddling block boundaries go through the window path.
+    a = big_arena
+    ext = a.alloc(64 << 10)
+    n = 3 * _BLOCK + 513
+    data = rng.integers(0, 256, n, dtype=np.uint8)
+    a.write(ext, data, offset=_BLOCK - 257)   # crosses 4+ block boundaries
+    got = np.asarray(a.read(ext, n, offset=_BLOCK - 257))
+    np.testing.assert_array_equal(got, data)
+    # Neighbouring bytes untouched.
+    assert not np.any(np.asarray(a.read(ext, _BLOCK - 257, 0)))
+    a.free(ext)
+
+
+def test_blocked_move_aligned_and_unaligned(big_arena, rng):
+    a = big_arena
+    src = a.alloc(1 << 20)
+    dst = a.alloc(1 << 20)
+    data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    a.write(src, data)
+    a.move(src, dst, 1 << 20)                       # block-aligned rows path
+    np.testing.assert_array_equal(np.asarray(a.read(dst, 1 << 20)), data)
+    a.move(src, dst, 999, src_offset=17, dst_offset=33)  # window path
+    np.testing.assert_array_equal(
+        np.asarray(a.read(dst, 999, 33)), data[17:17 + 999]
+    )
+    a.free(src)
+    a.free(dst)
+
+
+def test_small_arena_still_flat():
+    a = DeviceArena(1 << 20)
+    assert a.buffer.shape == (1 << 20,)
