@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oncilla_tpu.core.arena import Extent, check_bounds
 from oncilla_tpu.core.errors import (
     OcmConnectError,
     OcmInvalidHandle,
@@ -71,6 +72,9 @@ class Ocm:
         # daemon's even pod-wide ids (rem_alloc_id analogue, mem.c:45).
         self._next_id = itertools.count(1, 2)
         self._allocs: dict[int, OcmAlloc] = {}  # the lib.c:84 allocs list
+        # Lazy app-side staging buffers for remote handles (the lib.c:255
+        # malloc'd local arm); released on free.
+        self._stagebufs: dict[int, np.ndarray] = {}
         self._lock = threading.Lock()
         self.tracer = GLOBAL_TRACER
 
@@ -144,6 +148,7 @@ class Ocm:
             if handle.freed or handle.alloc_id not in self._allocs:
                 raise OcmInvalidHandle(f"double free of alloc {handle.alloc_id}")
             del self._allocs[handle.alloc_id]
+            self._stagebufs.pop(handle.alloc_id, None)
         if handle.kind == OcmKind.LOCAL_HOST:
             self.host_arena.free(handle.extent)
         elif handle.kind == OcmKind.LOCAL_DEVICE:
@@ -197,10 +202,15 @@ class Ocm:
         return from_bytes(raw, shape, dtype)
 
     def localbuf(self, handle: OcmAlloc):
-        """``ocm_localbuf`` (/root/reference/src/lib.c:425): the app-side
-        window. Zero-copy numpy view for LOCAL_HOST; materialized jax.Array
-        for LOCAL_DEVICE; None for remote kinds (whose local staging is the
-        caller's own array)."""
+        """``ocm_localbuf`` (/root/reference/src/lib.c:425-460): the app-side
+        window onto an allocation. Zero-copy numpy view for LOCAL_HOST;
+        materialized jax.Array for LOCAL_DEVICE. For remote kinds the
+        reference mallocs a staging buffer into the handle at alloc time
+        (lib.c:255-269) and one-sided ops move between it and the remote
+        memory; here the equivalent host staging array is created lazily on
+        first request, cached per handle, and released by ``free``. Mutate
+        it in place, then ``push``/``pull`` (or ``ocm_copy_onesided`` with
+        ``local=None``) to move it over the fabric."""
         self._check_live(handle)
         if handle.kind == OcmKind.LOCAL_HOST:
             return self.host_arena.view(handle.extent)
@@ -208,7 +218,43 @@ class Ocm:
             return self.device_arenas[handle.device_index].read(
                 handle.extent, handle.nbytes
             )
-        return None
+        with self._lock:
+            # Re-check liveness under the lock: a free() racing in between
+            # _check_live and here would otherwise let us cache a buffer for
+            # a dead id that nothing ever removes (ids are never reused).
+            if handle.alloc_id not in self._allocs:
+                raise OcmInvalidHandle(
+                    f"alloc {handle.alloc_id} freed during localbuf"
+                )
+            buf = self._stagebufs.get(handle.alloc_id)
+            if buf is None:
+                buf = np.zeros(handle.nbytes, dtype=np.uint8)
+                self._stagebufs[handle.alloc_id] = buf
+        return buf
+
+    def _staging_range(self, handle: OcmAlloc, nbytes: int | None,
+                       offset: int) -> int:
+        if not handle.is_remote:
+            raise OcmInvalidHandle("push/pull is for remote-kind handles")
+        n = handle.nbytes - offset if nbytes is None else nbytes
+        check_bounds(Extent(0, handle.nbytes), offset, n)
+        return n
+
+    def push(self, handle: OcmAlloc, nbytes: int | None = None,
+             offset: int = 0) -> None:
+        """One-sided write of the staging buffer into a remote allocation
+        (the ocm_copy_onesided op_flag=1 leg over the handle's own local
+        buffer, lib.c:670-700)."""
+        n = self._staging_range(handle, nbytes, offset)
+        buf = self.localbuf(handle)
+        self.put(handle, np.asarray(buf)[offset:offset + n], offset)
+
+    def pull(self, handle: OcmAlloc, nbytes: int | None = None,
+             offset: int = 0) -> None:
+        """One-sided read of a remote allocation into the staging buffer."""
+        n = self._staging_range(handle, nbytes, offset)
+        buf = self.localbuf(handle)
+        buf[offset:offset + n] = np.asarray(self.get(handle, n, offset))
 
     # -- two-sided copy matrix ------------------------------------------
 
@@ -328,14 +374,25 @@ def ocm_copy(ctx: Ocm, dst: OcmAlloc, src: OcmAlloc, **kw) -> None:
 
 
 def ocm_copy_onesided(
-    ctx: Ocm, handle: OcmAlloc, local, op: str, offset: int = 0
+    ctx: Ocm, handle: OcmAlloc, local=None, op: str = "write", offset: int = 0
 ):
     """``ocm_copy_onesided`` (/root/reference/src/lib.c:670): op is "write"
-    (push ``local`` into the allocation) or "read" (return bytes)."""
+    (push ``local`` into the allocation) or "read" (return bytes). With
+    ``local=None`` on a remote handle, the op moves the handle's own
+    staging buffer (``ctx.localbuf``) — the reference's semantics, where
+    one-sided ops always use the handle's malloc'd local arm."""
     if op == "write":
-        ctx.put(handle, local, offset)
+        if local is None and handle.is_remote:
+            ctx.push(handle, offset=offset)
+        else:
+            ctx.put(handle, local, offset)
         return None
     if op == "read":
+        if local is None and handle.is_remote:
+            ctx.pull(handle, offset=offset)
+            # Same shape as the plain-get path: element 0 is the byte at
+            # ``offset`` (a view into the staging buffer).
+            return ctx.localbuf(handle)[offset:]
         n = _nbytes_of(local) if local is not None else None
         return ctx.get(handle, n, offset)
     raise ValueError(f"op must be 'read' or 'write', got {op!r}")

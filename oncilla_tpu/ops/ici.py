@@ -100,8 +100,23 @@ class IciDataPlane:
         src_offset: int = 0,
     ) -> None:
         """Chip-to-chip extent copy. Same chip fuses on-device; different
-        chips ride ICI via device-to-device transfer, chunked with the
-        reference's pipeline scheme (8 MB x 2 in flight, extoll.c:47-51)."""
+        chips ride ICI via chunked device-to-device transfers.
+
+        How this pipelines (and what the window is for): every operation in
+        the loop — source slice, D2D ``device_put``, destination update —
+        is an *async dispatch*; the host thread never waits on data, so
+        chunk i+1's read and ICI transfer execute on the source chip while
+        the destination chip is still applying chunk i (PJRT schedules
+        them on independent streams; the only true serialization is the
+        destination arena's in-place update chain, which is inherent to
+        in-place writes and exists on the hardware regardless of issue
+        order). ``inflight_ops`` therefore does NOT gate concurrency — it
+        bounds how many staged chunk buffers exist at once, the same role
+        the reference's 2-posted-commands limit plays for NIC queue depth
+        (extoll.c:44-51): without it a GB-sized copy would stage every
+        chunk in HBM simultaneously. tests/test_ici.py checks every chunk
+        goes through an async D2D dispatch and that no module-level sync
+        entry point (jax.block_until_ready / jax.device_get) is reached."""
         a_src, a_dst = self._arena(src), self._arena(dst)
         with self.tracer.span("ici_copy", nbytes=nbytes):
             if a_src is a_dst:

@@ -297,3 +297,60 @@ def test_malformed_request_gets_typed_error_not_dead_thread():
             )
         # Control connection still alive:
         assert client.status()["rank"] == 0
+
+
+def test_localbuf_staging_for_remote_kinds(rng):
+    # ocm_localbuf on a remote handle returns a persistent app-side staging
+    # buffer (reference lib.c:255-269,425-460); push/pull and the
+    # local=None ocm_copy_onesided flavor move it over the fabric.
+    with local_cluster(2, config=small_cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        buf = ctx.localbuf(h)
+        assert buf is not None and buf.nbytes == 64 << 10
+        assert ctx.localbuf(h) is buf  # stable across calls
+        data = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        buf[:] = data
+        ctx.push(h)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+
+        # Remote side changes; pull refreshes the same staging buffer.
+        data2 = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        ctx.put(h, data2)
+        ctx.pull(h)
+        np.testing.assert_array_equal(buf, data2)
+
+        # ocm_copy_onesided with local=None uses the staging buffer.
+        buf[:1024] = 7
+        ocm.ocm_copy_onesided(ctx, h, op="write")
+        out = ocm.ocm_copy_onesided(ctx, h, op="read")
+        assert np.all(out[:1024] == 7)
+
+        ctx.free(h)
+        with pytest.raises(ocm.OcmInvalidHandle):
+            ctx.localbuf(h)  # freed handle has no window
+
+
+def test_localbuf_push_pull_rejected_for_local(rng):
+    with local_cluster(1, config=small_cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(4096, OcmKind.LOCAL_HOST)
+        with pytest.raises(ocm.OcmInvalidHandle, match="remote-kind"):
+            ctx.push(h)
+        with pytest.raises(ocm.OcmInvalidHandle, match="remote-kind"):
+            ctx.pull(h)
+        ctx.free(h)
+
+
+def test_push_bounds_enforced(rng):
+    with local_cluster(2, config=small_cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(4096, OcmKind.REMOTE_HOST)
+        ctx.localbuf(h)
+        with pytest.raises(ocm.OcmBoundsError):
+            ctx.push(h, nbytes=8192)
+        with pytest.raises(ocm.OcmBoundsError):
+            ctx.push(h, offset=5000)
+        with pytest.raises(ocm.OcmBoundsError):
+            ctx.pull(h, nbytes=100, offset=4090)
+        ctx.free(h)

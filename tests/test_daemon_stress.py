@@ -1,0 +1,150 @@
+"""Dispatch-layer stress on the Python daemon cluster: many clients and
+threads racing alloc/free/put/get through the full control plane (dispatch,
+registry, placement accounting, DCN data path) — the coverage the reference
+could never have without hardware (SURVEY.md §4), and the Python twin of the
+TSan workload the C++ daemon gets (tests/test_native_tsan.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def cfg(**kw):
+    d = dict(
+        host_arena_bytes=16 << 20,
+        device_arena_bytes=8 << 20,
+        chunk_bytes=32 << 10,
+        heartbeat_s=0.2,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+def _assert_quiescent(cl):
+    """After every handle is freed, no daemon holds state: registries empty,
+    arena bytes returned, rank-0 placement accounting back to zero."""
+    for d in cl.daemons:
+        assert d.registry.live_count() == 0, f"rank {d.rank} leaked entries"
+        assert d.host_arena.allocator.bytes_live == 0, f"rank {d.rank} leaked host bytes"
+        assert all(b.bytes_live == 0 for b in d.device_books), (
+            f"rank {d.rank} leaked device bytes"
+        )
+
+
+def test_multiclient_multithread_alloc_put_get_free():
+    with local_cluster(3, config=cfg()) as cl:
+        errs = []
+
+        def worker(rank, tid):
+            try:
+                client = cl.client(rank)
+                rng = np.random.default_rng(rank * 100 + tid)
+                for _ in range(8):
+                    nbytes = int(rng.integers(1, 96)) << 10
+                    h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+                    data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+                    client.put(h, data, 0)
+                    out = np.asarray(client.get(h, nbytes, 0))
+                    np.testing.assert_array_equal(out, data)
+                    client.free(h)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"r{rank}t{tid}: {type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=worker, args=(r, t))
+            for r in range(3) for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker wedged"
+        assert not errs, errs
+        _assert_quiescent(cl)
+
+
+def test_concurrent_errors_do_not_corrupt_dispatch():
+    """Bounds violations, double frees, and valid traffic race on the same
+    daemons; every error must surface as a typed error on the offending op
+    only, and the cluster must stay fully functional and leak-free."""
+    with local_cluster(2, config=cfg()) as cl:
+        errs = []
+
+        def well_behaved(tid):
+            try:
+                client = cl.client(0)
+                rng = np.random.default_rng(tid)
+                for _ in range(6):
+                    h = client.alloc(32 << 10, OcmKind.REMOTE_HOST)
+                    data = rng.integers(0, 256, 32 << 10, dtype=np.uint8)
+                    client.put(h, data, 0)
+                    np.testing.assert_array_equal(
+                        np.asarray(client.get(h, 32 << 10, 0)), data
+                    )
+                    client.free(h)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"good t{tid}: {type(e).__name__}: {e}")
+
+        def misbehaved(tid):
+            try:
+                client = cl.client(1)
+                for _ in range(6):
+                    h = client.alloc(4 << 10, OcmKind.REMOTE_HOST)
+                    with pytest.raises(ocm.OcmError):
+                        client.put(h, np.zeros(8 << 10, np.uint8), 0)  # bounds
+                    with pytest.raises(ocm.OcmError):
+                        client.get(h, 4 << 10, 1 << 10)  # bounds
+                    client.free(h)
+                    with pytest.raises(ocm.OcmError):
+                        client.free(h)  # double free
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"bad t{tid}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=well_behaved, args=(t,)) for t in range(2)]
+        threads += [threading.Thread(target=misbehaved, args=(t,)) for t in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker wedged"
+        assert not errs, errs
+        _assert_quiescent(cl)
+
+
+def test_alloc_storm_capacity_accounting():
+    """A storm of allocations racing into a small arena: some succeed, some
+    OOM; afterwards the books must balance exactly (no phantom reservations
+    from failed placements — the reference's root_allocs leak, alloc.c:134)."""
+    with local_cluster(2, config=cfg(host_arena_bytes=1 << 20)) as cl:
+        held, errs = [], []
+        lock = threading.Lock()
+
+        def worker(tid):
+            client = cl.client(0)
+            for _ in range(10):
+                try:
+                    h = client.alloc(128 << 10, OcmKind.REMOTE_HOST)
+                    with lock:
+                        held.append((client, h))
+                except ocm.OcmError:
+                    pass  # OOM under pressure is expected
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"t{tid}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        live = sum(d.registry.live_count() for d in cl.daemons)
+        assert live == len(held)
+        for client, h in held:
+            client.free(h)
+        _assert_quiescent(cl)

@@ -90,6 +90,47 @@ def test_device_arm_needs_ici_plane(cluster2x4):
     client.free(h)
 
 
+def test_ici_copy_dispatch_is_async(cluster2x4, rng):
+    """The chunk loop's pipelining mechanism is async dispatch (PJRT
+    overlaps chunk i+1's read/transfer with chunk i's destination update).
+    Enforced here: every chunk goes through an async D2D device_put, and
+    the module-level sync entry points (jax.block_until_ready /
+    jax.device_get) are unreachable. (Method-level .block_until_ready()
+    lives on an unpatchable C type and is covered by code review, not this
+    test.)"""
+    from unittest import mock
+
+    cl, plane = cluster2x4
+    ctx = cl.context(0, ici_plane=plane)
+    ctx1 = cl.context(1, ici_plane=plane)
+    h0 = ctx1.alloc(96 << 10, OcmKind.REMOTE_DEVICE)
+    h1 = ctx.alloc(96 << 10, OcmKind.REMOTE_DEVICE)
+    data = rng.integers(0, 256, 96 << 10, dtype=np.uint8)
+    plane.put(h0, data)
+
+    # Chunked: 16 KB chunks over 96 KB => 6 chunks through a 2-deep window.
+    plane.config.chunk_bytes = 16 << 10
+    calls = {"n": 0}
+    real_dp = jax.device_put
+
+    def counting_device_put(x, *a, **k):
+        calls["n"] += 1
+        return real_dp(x, *a, **k)
+
+    def no_sync(*a, **k):
+        raise AssertionError("copy loop synchronized on data")
+
+    with mock.patch.object(jax, "device_put", counting_device_put), \
+         mock.patch.object(jax, "block_until_ready", no_sync), \
+         mock.patch.object(jax, "device_get", no_sync):
+        plane.copy(h1, h0, 96 << 10)
+    assert calls["n"] >= 6  # every chunk went through an async D2D dispatch
+
+    np.testing.assert_array_equal(np.asarray(plane.get(h1, 96 << 10)), data)
+    ctx.free(h1)
+    ctx1.free(h0)
+
+
 # -- SpmdIciPlane: handles wired to the one-sided fabric ------------------
 
 

@@ -241,3 +241,60 @@ def test_c_demo_program(cluster):
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "pass:" in r.stdout
+
+
+def test_c_client_multithreaded(lib, cluster):
+    """libocm_tpu.so under real thread concurrency: ctypes releases the GIL
+    for the duration of each C call, so 8 Python threads drive the library's
+    ctrl/data paths (ctrl_mu, per-connection mu, owners map, last_error TLS)
+    concurrently. Each thread does its own alloc -> pattern put/get -> free
+    loop; any lost update, cross-talk, or error-state bleed fails the
+    assertions."""
+    import threading
+
+    ctx = lib.ocmc_init(cluster.encode(), 0, 0.05)  # heartbeats on too
+    assert ctx, lib.ocmc_last_error(None)
+    errs = []
+
+    def worker(tid):
+        try:
+            rng = np.random.default_rng(tid)
+            for it in range(6):
+                h = OcmcHandle()
+                nbytes = int(rng.integers(1, 64)) << 10
+                assert lib.ocmc_alloc(ctx, nbytes, 3, ctypes.byref(h)) == 0, \
+                    lib.ocmc_last_error(ctx)
+                data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+                assert lib.ocmc_put(
+                    ctx, ctypes.byref(h),
+                    data.ctypes.data_as(ctypes.c_void_p), nbytes, 0,
+                ) == 0, lib.ocmc_last_error(ctx)
+                out = np.zeros_like(data)
+                assert lib.ocmc_get(
+                    ctx, ctypes.byref(h),
+                    out.ctypes.data_as(ctypes.c_void_p), nbytes, 0,
+                ) == 0, lib.ocmc_last_error(ctx)
+                np.testing.assert_array_equal(out, data)
+                # Every other iteration, provoke an error to stress the
+                # thread-local last_error snapshotting under concurrency.
+                if it % 2 == 0:
+                    bad = np.zeros(nbytes + 4096, dtype=np.uint8)
+                    rc = lib.ocmc_put(
+                        ctx, ctypes.byref(h),
+                        bad.ctypes.data_as(ctypes.c_void_p), nbytes + 4096, 0,
+                    )
+                    assert rc == -1
+                    assert b"daemon error" in lib.ocmc_last_error(ctx)
+                assert lib.ocmc_free(ctx, ctypes.byref(h)) == 0, \
+                    lib.ocmc_last_error(ctx)
+        except Exception as e:  # noqa: BLE001
+            errs.append(f"thread {tid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "worker wedged"
+    lib.ocmc_tini(ctx)
+    assert not errs, errs
