@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from oncilla_tpu.parallel.mesh import NODE_AXIS, arena_sharding
+from oncilla_tpu.parallel.mesh import NODE_AXIS, arena_sharding, replicated
 
 
 def make_arena(mesh: Mesh, arena_bytes: int) -> jax.Array:
@@ -43,6 +43,10 @@ def host_put(arena: jax.Array, dev: int, data, offset, *, mesh: Mesh) -> jax.Arr
     from oncilla_tpu.core.hbm import to_bytes
 
     raw = to_bytes(jnp.asarray(data))
+    # Replicate onto the mesh: data committed to a single device (e.g. read
+    # out of a local DeviceArena by the copy matrix) cannot enter a jit
+    # whose other operand is sharded across all mesh devices.
+    raw = jax.device_put(raw, replicated(mesh))
     return _host_put(arena, raw, dev, jnp.int32(offset), mesh)
 
 

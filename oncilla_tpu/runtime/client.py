@@ -129,7 +129,13 @@ class ControlPlaneClient:
         """``detach=True`` skips the DISCONNECT notification: daemons keep
         the app's allocations until the lease runs out (crash simulation /
         intentional handoff within the lease window). The default notifies,
-        and the daemons reclaim this app's allocations immediately."""
+        and the daemons reclaim this app's allocations immediately.
+
+        App identity is (pid, rank) — per OS process, as in the reference,
+        where one app process owns one mailbox (pmsg.c). Multiple clients
+        in one process at the same rank share that identity: closing one
+        (without detach) reclaims the process's allocations at that rank.
+        """
         self._hb_stop.set()
         if not detach:
             # Bounded lock (mirrors libocm.cc's try_lock teardown): a beat

@@ -127,7 +127,24 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
+        def leaver():
+            # Allocates, heartbeats a few owner-bearing beats, then
+            # disconnects WITHOUT freeing: exercises the RECLAIM_APP
+            # reclamation fan-out racing the other clients' traffic.
+            # Attached to rank 1: app identity is (pid, rank) and every
+            # client here shares the test process's pid, so a rank-0 leaver
+            # would reclaim the rank-0 workers' live allocations mid-flight.
+            try:
+                client = ControlPlaneClient(entries, 1, config=cfg)
+                for _ in range(4):
+                    client.alloc(128 << 10, OcmKind.REMOTE_HOST)
+                time.sleep(0.3)
+                client.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
         threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+        threads += [threading.Thread(target=leaver) for _ in range(2)]
         threads.append(threading.Thread(target=poller))
         for t in threads:
             t.start()
@@ -136,6 +153,18 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
         hung = [t.name for t in threads if t.is_alive()]
         assert not hung, f"workers hung (daemon deadlock?): {hung}"
         assert not errors, errors
+
+        # Every allocation was freed or disconnect-reclaimed: quiescent.
+        probe = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (probe.status()["live_allocs"] == 0
+                    and probe.status(rank=1)["live_allocs"] == 0):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("daemons not quiescent after disconnect reclamation")
+        probe.close()
     finally:
         for p in procs:
             p.terminate()
