@@ -85,21 +85,22 @@ def bench_xla_copy(buf) -> tuple[float, jax.Array]:
     return 2.0 * NBYTES * xla_iters / dt / 1e9, buf
 
 
-def _pallas_copy_loop(total_bytes, nbytes, iters):
-    """A ping-pong extent copy iterated inside one kernel as two independent
-    streams with persistent in-flight DMAs (the extoll.c:44-51 2-overlapped
-    scheme on the on-chip DMA engine): stream X ping-pongs quarters Q0<->Q1,
-    stream Y quarters Q2<->Q3, and each stream's iteration i+1 descriptor is
-    started before waiting on the other stream's iteration i, so the engine
-    always has two descriptors queued and no inter-iteration bubble.
-    Measured on v5e this saturates the local DMA copy engine (~584 GB/s of
-    HBM traffic vs ~531 GB/s for paired-descriptor + wait-both)."""
+def _pallas_copy_loop(total_bytes, nbytes, iters, streams: int = 2):
+    """A ping-pong extent copy iterated inside one kernel as ``streams``
+    independent streams with persistent in-flight DMAs (the extoll.c:44-51
+    overlapped scheme on the on-chip DMA engine): stream s ping-pongs its
+    own segment pair, and each stream's iteration i+1 descriptor is started
+    before waiting on the next stream's iteration i, so the engine always
+    has ``streams`` descriptors queued and no inter-iteration bubble.
+    Measured on v5e, 2 streams saturate the local DMA copy engine
+    (~584 GB/s of HBM traffic vs ~531 GB/s for paired-descriptor +
+    wait-both); the bench also tries 4 and reports the best."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     nblocks = nbytes // BLOCK
-    assert nblocks % 2 == 0, "nbytes must be an even number of 4 KiB blocks"
-    q = nblocks // 2  # per-stream extent (two streams move nbytes/iteration)
+    assert nblocks % (2 * streams) == 0, "nbytes must split across streams"
+    q = nblocks // streams  # per-stream extent (all streams move nbytes/iter)
 
     def kernel(buf_in, buf_out, sems):
         del buf_in
@@ -115,26 +116,25 @@ def _pallas_copy_loop(total_bytes, nbytes, iters):
                 sems.at[stream],
             )
 
-        dma(0, 0).start()
-        dma(1, 0).start()
+        for s in range(streams):
+            dma(s, 0).start()
 
         def body(i, _):
-            dma(0, i).wait()
-            dma(0, i + 1).start()
-            dma(1, i).wait()
-            dma(1, i + 1).start()
+            for s in range(streams):
+                dma(s, i).wait()
+                dma(s, i + 1).start()
             return 0
 
         jax.lax.fori_loop(0, iters - 1, body, 0)
-        dma(0, iters - 1).wait()
-        dma(1, iters - 1).wait()
+        for s in range(streams):
+            dma(s, iters - 1).wait()
 
     call = pl.pallas_call(
         kernel,
         grid=(1,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        scratch_shapes=[pltpu.SemaphoreType.DMA((streams,))],
         out_shape=jax.ShapeDtypeStruct((total_bytes // BLOCK, 32, 128), jnp.uint8),
         input_output_aliases={0: 0},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
@@ -311,13 +311,13 @@ def check_pallas_ici_copy(errors: dict) -> bool:
         return False
 
 
-def bench_pallas_copy(buf) -> tuple[float, jax.Array]:
+def bench_pallas_copy(buf, streams: int = 2) -> tuple[float, jax.Array]:
     # Warm up with the same executable that is timed. Running a separately
     # compiled warm-up loop first costs ~9% of steady-state bandwidth on the
     # timed run (empirically, on v5e via the dev tunnel: the timed
     # executable's buffer ends up in a slower HBM placement when its input
     # came through another executable's donation).
-    run = _pallas_copy_loop(buf.shape[0], NBYTES, ITERS)
+    run = _pallas_copy_loop(buf.shape[0], NBYTES, ITERS, streams)
     buf = run(buf)
     _sync(buf)
     t0 = time.perf_counter()
@@ -387,21 +387,47 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
         results["xla"] = gbps
         return buf
 
-    def run_pallas(buf):
-        gbps, buf = bench_pallas_copy(buf)
-        results["pallas"] = gbps
-        return buf
+    def run_pallas(streams):
+        def go(buf):
+            gbps, buf = bench_pallas_copy(buf, streams)
+            results[f"pallas_s{streams}"] = gbps
+            return buf
+
+        return go
 
     def run_remote(buf):
         gbps, buf = bench_pallas_remote(buf)
         results["pallas_remote"] = gbps
         return buf
 
-    try:
-        arena.update(run_pallas)
-    except Exception as e:  # noqa: BLE001 — pallas path needs real TPU
-        errors["pallas_copy"] = f"{type(e).__name__}: {e}"
-        results["pallas"] = 0.0
+    def bank_pallas():
+        """Bank the best measured number so far into the output NOW — if a
+        later stage wedges past the watchdog deadline, the line still
+        carries this result (it may predate its correctness check; a check
+        failure re-banks zeros)."""
+        s2 = results.get("pallas_s2", 0.0)
+        s4 = results.get("pallas_s4", 0.0)
+        best = max((2, 4), key=lambda s: results.get(f"pallas_s{s}", 0.0))
+        results["pallas"] = results.get(f"pallas_s{best}", 0.0)
+        gbps = max(results["pallas"], results.get("xla", 0.0))
+        out["value"] = round(gbps, 2)
+        out["vs_baseline"] = round(gbps / TARGET, 4)
+        out["detail"]["pallas_gbps"] = round(results["pallas"], 2)
+        out["detail"]["pallas_gbps_s2"] = round(s2, 2)
+        out["detail"]["pallas_gbps_s4"] = round(s4, 2)
+        out["detail"]["pallas_streams"] = best
+        return best
+
+    # Try 2 and 4 DMA streams; the engine's sweet spot can differ by chip
+    # generation, so measure both and keep the best.
+    for streams in (2, 4):
+        try:
+            arena.update(run_pallas(streams))
+        except Exception as e:  # noqa: BLE001 — pallas path needs real TPU
+            errors[f"pallas_copy_s{streams}"] = f"{type(e).__name__}: {e}"
+            results[f"pallas_s{streams}"] = 0.0
+        bank_pallas()
+    best_streams = bank_pallas()
 
     # The one-sided fabric number (loopback remote DMA; VERDICT.md r2
     # "no ICI-fabric number exists at any scale").
@@ -411,62 +437,66 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
         errors["pallas_remote"] = f"{type(e).__name__}: {e}"
         results["pallas_remote"] = 0.0
 
-    # Correctness: stamp four distinct quarter patterns across the handle
-    # and re-run both copy paths untimed. The Pallas kernel's stream X
-    # ping-pongs quarters Q0<->Q1 and stream Y Q2<->Q3, so after any even
-    # number of iterations Q0/Q2 are intact and Q1/Q3 hold copies of
-    # Q0/Q2 — distinct patterns catch stream aliasing or dropped-extent
-    # bugs in the kernel that produced the headline number. The XLA loop
-    # then ping-pongs halves, which leaves the first half intact.
-    qb = NBYTES // 2  # quarter of the handle == per-stream extent
-    quarters = [
-        (np.arange(qb, dtype=np.uint64) * mult % 251).astype(np.uint8)
-        for mult in (1, 3, 7, 11)
-    ]
-    ctx.put(h, np.concatenate(quarters), 0)
+    # Correctness: stamp 2S distinct segment patterns across the handle and
+    # re-run the winning copy path untimed. Stream s ping-pongs segments
+    # 2s <-> 2s+1, so after any even number of iterations the even segments
+    # are intact and each odd segment holds its partner's copy — distinct
+    # patterns catch stream aliasing or dropped-extent bugs in the kernel
+    # that produced the headline number. (The XLA check further down uses
+    # its own independent seg0/zeros restore, not these patterns.)
+    def stamp(nsegs):
+        seg = 2 * NBYTES // nsegs
+        pats = [
+            (np.arange(seg, dtype=np.uint64) * m % 251).astype(np.uint8)
+            for m in (1, 3, 7, 11, 13, 17, 19, 23)[:nsegs]
+        ]
+        ctx.put(h, np.concatenate(pats), 0)
+        return seg, pats
 
-    def run_pallas_check(buf):
-        return _pallas_copy_loop(buf.shape[0], NBYTES, 4)(buf)
+    def verify_segments(seg, pats, label):
+        probe = min(seg, 1 << 20)
+        for i, pat in enumerate(pats):
+            want = pat if i % 2 == 0 else pats[i - 1]
+            got = np.asarray(ctx.get(h, nbytes=probe, offset=i * seg))
+            if not np.array_equal(got, want[:probe]):
+                raise RuntimeError(f"{label} mismatch at segment {i}")
 
     if results["pallas"]:  # skip where Pallas itself was unavailable
         try:
-            arena.update(run_pallas_check)
-            expect = [quarters[0], quarters[0], quarters[2], quarters[2]]
-            for i, want in enumerate(expect):
-                got = np.asarray(ctx.get(h, nbytes=1 << 20, offset=i * qb))
-                if not np.array_equal(got, want[: 1 << 20]):
-                    raise RuntimeError(
-                        f"pallas copy correctness failed at quarter {i}"
-                    )
-        except Exception as e:  # noqa: BLE001 — drop the number, not the run
+            seg, pats = stamp(2 * best_streams)
+            arena.update(
+                lambda buf: _pallas_copy_loop(
+                    buf.shape[0], NBYTES, 4, best_streams
+                )(buf)
+            )
+            verify_segments(seg, pats, "pallas copy")
+        except Exception as e:  # noqa: BLE001 — drop the numbers, not the run
             errors["pallas_correctness"] = f"{type(e).__name__}: {e}"
-            results["pallas"] = 0.0
+            # Both stream counts ran the same kernel code: none of its
+            # numbers are publishable once its output is provably wrong.
+            results["pallas"] = results["pallas_s2"] = results["pallas_s4"] = 0.0
+            bank_pallas()
 
     if results.get("pallas_remote"):
-        # Same quarter semantics as the local loop (streams ping-pong
-        # Q0<->Q1 and Q2<->Q3), so after an even iteration count Q0/Q2 are
-        # intact and Q1/Q3 hold their copies.
+        # The remote loop is fixed at 2 streams (4 segments).
         try:
-            ctx.put(h, np.concatenate(quarters), 0)
+            seg, pats = stamp(4)
             arena.update(
                 lambda buf: _pallas_remote_loop(buf.shape[0], NBYTES, 4)(buf)
             )
-            expect = [quarters[0], quarters[0], quarters[2], quarters[2]]
-            for i, want in enumerate(expect):
-                got = np.asarray(ctx.get(h, nbytes=1 << 20, offset=i * qb))
-                if not np.array_equal(got, want[: 1 << 20]):
-                    raise RuntimeError(
-                        f"remote-DMA copy correctness failed at quarter {i}"
-                    )
+            verify_segments(seg, pats, "remote-DMA copy")
         except Exception as e:  # noqa: BLE001
             errors["pallas_remote_correctness"] = f"{type(e).__name__}: {e}"
             results["pallas_remote"] = 0.0
-        ctx.put(h, np.concatenate(quarters), 0)
+
+    # Restore a known first half for the XLA check below.
+    seg0 = (np.arange(NBYTES, dtype=np.uint64) % 251).astype(np.uint8)
+    ctx.put(h, np.concatenate([seg0, np.zeros(NBYTES, np.uint8)]), 0)
 
     try:
         arena.update(run_xla)
         got = np.asarray(ctx.get(h, nbytes=1 << 20))
-        if not np.array_equal(got, quarters[0][: 1 << 20]):
+        if not np.array_equal(got, seg0[: 1 << 20]):
             raise RuntimeError("xla copy correctness check failed")
     except Exception as e:  # noqa: BLE001
         errors["xla_copy"] = f"{type(e).__name__}: {e}"
@@ -486,6 +516,9 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
         {
             "xla_gbps": round(xla_gbps, 2),
             "pallas_gbps": round(pallas_gbps, 2),
+            "pallas_gbps_s2": round(results.get("pallas_s2", 0.0), 2),
+            "pallas_gbps_s4": round(results.get("pallas_s4", 0.0), 2),
+            "pallas_streams": best_streams,
             "pallas_remote_gbps": round(remote_gbps, 2),
             "alloc_p50_us": round(p50_us, 2),
         }
