@@ -75,14 +75,18 @@ class Ocm:
         # Lazy app-side staging buffers for remote handles (the lib.c:255
         # malloc'd local arm); released on free.
         self._stagebufs: dict[int, np.ndarray] = {}
+        # True only when ocm_init created the backend for this context
+        # (tini then closes it); injected backends stay the caller's.
+        self._owns_remote = False
         self._lock = threading.Lock()
         self.tracer = GLOBAL_TRACER
 
     # -- lifecycle -------------------------------------------------------
 
     def tini(self) -> None:
-        """Free every live handle (``ocm_tini``; also covers the reference's
-        missing app-death reclamation, main.c:6-7)."""
+        """Free every live handle and detach from the daemon (``ocm_tini``,
+        lib.c:160; also covers the reference's missing app-death
+        reclamation, main.c:6-7)."""
         with self._lock:
             handles = list(self._allocs.values())
         for h in handles:
@@ -90,6 +94,14 @@ class Ocm:
                 self.free(h)
             except OcmInvalidHandle:
                 pass
+        # Only close a backend this context created for itself (ocm_init's
+        # nodefile auto-attach): an injected client may be shared by other
+        # contexts at the same (pid, rank) identity, and closing it would
+        # DISCONNECT-reclaim their live allocations too.
+        if self._owns_remote:
+            close = getattr(self._remote, "close", None)
+            if close is not None:
+                close()
 
     # -- alloc / free ----------------------------------------------------
 
@@ -337,8 +349,34 @@ def ocm_init(
     config: OcmConfig | None = None,
     remote: RemoteBackend | None = None,
     devices=None,
+    ici_plane=None,
 ) -> Ocm:
-    return Ocm(config=config, remote=remote, devices=devices)
+    """``ocm_init`` (/root/reference/src/lib.c:98-132): when the config
+    names a nodefile (or ``OCM_NODEFILE`` is set) and no remote backend is
+    given, attach to the local daemon automatically — the reference's
+    mailbox CONNECT handshake, here the loopback-TCP control plane. Rank
+    comes from ``config.rank`` or hostname/``jax.process_index`` detection
+    (nodefile.c:92-103). ``ici_plane`` (e.g. ``ops.ici.SpmdIciPlane``)
+    enables the REMOTE_DEVICE arm."""
+    config = config or OcmConfig()
+    owns_remote = False
+    if remote is None and config.nodefile:
+        from oncilla_tpu.runtime.client import ControlPlaneClient
+        from oncilla_tpu.runtime.membership import detect_rank, parse_nodefile
+
+        entries = parse_nodefile(config.nodefile)
+        rank = config.rank if config.rank is not None else detect_rank(entries)
+        if not 0 <= rank < len(entries):
+            raise OcmConnectError(
+                f"rank {rank} out of range for the {len(entries)}-node nodefile"
+            )
+        remote = ControlPlaneClient(
+            entries, rank, config=config, ici_plane=ici_plane
+        )
+        owns_remote = True
+    ctx = Ocm(config=config, remote=remote, devices=devices)
+    ctx._owns_remote = owns_remote
+    return ctx
 
 
 def ocm_tini(ctx: Ocm) -> None:

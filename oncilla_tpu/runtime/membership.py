@@ -81,11 +81,21 @@ def parse_nodefile(path: str) -> list[NodeEntry]:
 
 
 def detect_rank(entries: list[NodeEntry]) -> int:
-    """Self-rank by hostname match (nodefile.c:92-103 behavior)."""
+    """Self-rank by hostname match (nodefile.c:92-103 behavior), falling
+    back to ``jax.process_index()`` when the nodefile hosts don't resolve
+    to this machine but the pod shape matches (multi-host TPU pods, where
+    nodefile hosts may be pod DNS names the VM's gethostname won't match)."""
     hostname = socket.gethostname()
     for e in entries:
         if e.host in (hostname, hostname.split(".")[0], "localhost", "127.0.0.1"):
             return e.rank
+    try:
+        import jax
+
+        if jax.process_count() == len(entries):
+            return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no initialized distributed runtime
+        pass
     raise OcmError(f"hostname {hostname!r} not present in nodefile")
 
 

@@ -354,3 +354,24 @@ def test_push_bounds_enforced(rng):
         with pytest.raises(ocm.OcmBoundsError):
             ctx.pull(h, nbytes=100, offset=4090)
         ctx.free(h)
+
+
+def test_ocm_init_attaches_via_nodefile(tmp_path, rng):
+    # The reference's ocm_init auto-attach (lib.c:98-132): a config naming
+    # a nodefile is all an app needs — no manual client wiring.
+    with local_cluster(2, config=small_cfg()) as c:
+        nf = tmp_path / "nodefile"
+        nf.write_text("".join(
+            f"{e.rank} 127.0.0.1 {c.daemons[e.rank].port}\n" for e in c.entries
+        ))
+        cfg = small_cfg()
+        cfg.nodefile = str(nf)
+        cfg.rank = 0
+        ctx = ocm.ocm_init(cfg)
+        h = ctx.alloc(32 << 10, OcmKind.REMOTE_HOST)
+        assert h.rank == 1
+        data = rng.integers(0, 256, 32 << 10, dtype=np.uint8)
+        ctx.put(h, data)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+        ocm.ocm_tini(ctx)  # frees the handle and detaches
+        assert sum(d.registry.live_count() for d in c.daemons) == 0
