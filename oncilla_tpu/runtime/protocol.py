@@ -87,6 +87,8 @@ def _pack_str(s: str) -> bytes:
 def _unpack_str(buf: bytes, off: int) -> tuple[str, int]:
     (n,) = struct.unpack_from("<H", buf, off)
     off += 2
+    if off + n > len(buf):  # a silent short slice would hide truncation
+        raise OcmProtocolError("truncated string field")
     return buf[off : off + n].decode("utf-8"), off + n
 
 
@@ -213,7 +215,10 @@ def pack(msg: Message) -> bytes:
 
 
 def unpack(header: bytes, payload: bytes) -> Message:
-    magic, version, mtype, _flags, plen = HEADER.unpack(header)
+    try:
+        magic, version, mtype, _flags, plen = HEADER.unpack(header)
+    except struct.error as e:
+        raise OcmProtocolError(f"short header: {e}") from e
     if magic != MAGIC:
         raise OcmProtocolError(f"bad magic {magic!r}")
     if version != VERSION:
@@ -227,13 +232,20 @@ def unpack(header: bytes, payload: bytes) -> Message:
     schema = _SCHEMAS[mtype]
     fields = {}
     off = 0
-    for name, fmt in schema:
-        if fmt == "s":
-            fields[name], off = _unpack_str(payload, off)
-        else:
-            st = struct.Struct("<" + fmt)
-            (fields[name],) = st.unpack_from(payload, off)
-            off += st.size
+    # The payload is untrusted wire input: truncated fields and invalid
+    # UTF-8 must surface as protocol errors, not struct/unicode internals.
+    try:
+        for name, fmt in schema:
+            if fmt == "s":
+                fields[name], off = _unpack_str(payload, off)
+            else:
+                st = struct.Struct("<" + fmt)
+                (fields[name],) = st.unpack_from(payload, off)
+                off += st.size
+    except (struct.error, UnicodeDecodeError) as e:
+        raise OcmProtocolError(
+            f"malformed {mtype.name} payload: {e}"
+        ) from e
     return Message(mtype, fields, payload[off:])
 
 

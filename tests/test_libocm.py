@@ -298,3 +298,36 @@ def test_c_client_multithreaded(lib, cluster):
         assert not t.is_alive(), "worker wedged"
     lib.ocmc_tini(ctx)
     assert not errs, errs
+
+
+def test_daemon_survives_garbage_bytes(cluster):
+    """Random bytes on the control port must not take the daemon down
+    (untrusted wire input): the connection may drop, but a well-formed
+    request on a fresh connection still works."""
+    import numpy as np
+
+    from oncilla_tpu.runtime.membership import parse_nodefile
+    from oncilla_tpu.runtime.protocol import Message, MsgType, request
+
+    e = parse_nodefile(cluster)[0]
+    rng = np.random.default_rng(99)
+    for _ in range(20):
+        s = socket.create_connection((e.connect_host, e.port), timeout=2.0)
+        try:
+            s.sendall(bytes(rng.integers(0, 256, int(rng.integers(1, 200)),
+                                         dtype=np.uint8)))
+        finally:
+            s.close()
+    # Valid magic + version but malformed payload too.
+    s = socket.create_connection((e.connect_host, e.port), timeout=2.0)
+    try:
+        s.sendall(b"OCM1" + bytes([2, 1, 0, 0]) + (5).to_bytes(4, "little") + b"abc")
+    finally:
+        s.close()
+
+    s = socket.create_connection((e.connect_host, e.port), timeout=5.0)
+    try:
+        st = request(s, Message(MsgType.STATUS, {}))
+        assert st.type == MsgType.STATUS_OK
+    finally:
+        s.close()

@@ -82,3 +82,30 @@ def test_header_layout_stable():
     magic, ver, typ, flags, plen = P.HEADER.unpack(b[:12])
     assert (magic, ver, typ, flags, plen) == (b"OCM1", 2, 1, 0, 16)
     assert struct.unpack("<qq", b[12:28]) == (1, 0)
+
+
+def test_unpack_fuzz_never_crashes():
+    # Arbitrary garbage must surface as OcmProtocolError (or parse cleanly),
+    # never as an unhandled exception — the wire is untrusted input.
+    import numpy as np
+
+    rng = np.random.default_rng(0xFC)
+    for _ in range(500):
+        n = int(rng.integers(0, 64))
+        payload = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        hdr = bytes(rng.integers(0, 256, P.HEADER.size, dtype=np.uint8))
+        try:
+            P.unpack(hdr, payload)
+        except OcmProtocolError:
+            pass
+
+    # Valid header, garbage payload.
+    for mtype in (P.MsgType.CONNECT, P.MsgType.DATA_PUT, P.MsgType.ERROR):
+        for _ in range(200):
+            n = int(rng.integers(0, 48))
+            payload = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+            hdr = P.HEADER.pack(P.MAGIC, P.VERSION, int(mtype), 0, len(payload))
+            try:
+                P.unpack(hdr, payload)
+            except OcmProtocolError:
+                pass
