@@ -349,8 +349,15 @@ class Daemon:
             while self._running.is_set():
                 try:
                     msg = recv_msg(conn)
-                except OcmProtocolError:
-                    return  # peer closed
+                except OcmProtocolError as e:
+                    # Clean EOF between frames is normal disconnect; any
+                    # other decode failure (truncated frame, bad magic,
+                    # malformed payload) is hostile/broken input worth a
+                    # diagnostic before dropping the connection.
+                    if str(e) != "peer closed":
+                        printd("daemon %d: dropping conn on malformed "
+                               "input: %s", self.rank, e)
+                    return
                 try:
                     reply = self._dispatch(msg)
                 except OcmOutOfMemory as e:

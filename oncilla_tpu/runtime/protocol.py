@@ -256,19 +256,27 @@ def send_msg(sock: socket.socket, msg: Message) -> None:
     sock.sendall(pack(msg))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, eof_ok: bool = False) -> bytes:
+    """Read exactly n bytes. ``eof_ok`` permits a clean EOF *before the
+    first byte* (returning b"") — EOF mid-message always raises."""
     chunks = []
-    while n:
-        b = sock.recv(min(n, 1 << 20))
+    want = n
+    while want:
+        b = sock.recv(min(want, 1 << 20))
         if not b:
+            if eof_ok and want == n:
+                return b""
             raise OcmProtocolError("peer closed mid-message")
         chunks.append(b)
-        n -= len(b)
+        want -= len(b)
     return b"".join(chunks)
 
 
 def recv_msg(sock: socket.socket) -> Message:
-    header = _recv_exact(sock, HEADER.size)
+    header = _recv_exact(sock, HEADER.size, eof_ok=True)
+    if not header:
+        # Clean disconnect at a frame boundary — ordinary, not an anomaly.
+        raise OcmProtocolError("peer closed")
     _, _, _, _, plen = HEADER.unpack(header)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"advertised payload {plen} exceeds cap")

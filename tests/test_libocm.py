@@ -318,10 +318,14 @@ def test_daemon_survives_garbage_bytes(cluster):
                                          dtype=np.uint8)))
         finally:
             s.close()
-    # Valid magic + version but malformed payload too.
+    # A complete frame whose payload is truncated for its schema (CONNECT
+    # needs 16 bytes of fields): this drives the decoder's
+    # malformed-payload path, not the short-read path.
     s = socket.create_connection((e.connect_host, e.port), timeout=2.0)
     try:
-        s.sendall(b"OCM1" + bytes([2, 1, 0, 0]) + (5).to_bytes(4, "little") + b"abc")
+        s.sendall(
+            b"OCM1" + bytes([2, 1, 0, 0]) + (3).to_bytes(4, "little") + b"abc"
+        )
     finally:
         s.close()
 
