@@ -527,7 +527,12 @@ class Daemon {
       Message msg;
       try {
         msg = recv_msg(fd);
-      } catch (const ProtocolError&) {
+      } catch (const ProtocolError& e) {
+        // Clean close at a frame boundary is normal; anything else —
+        // malformed wire input, truncation, a reset from a crashed peer —
+        // is worth a diagnostic saying which (daemon.py twin).
+        if (std::string(e.what()) != "peer closed" && getenv("OCM_VERBOSE"))
+          std::fprintf(stderr, "oncillamemd: dropping conn: %s\n", e.what());
         break;
       }
       Message reply;

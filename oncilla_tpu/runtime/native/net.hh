@@ -10,6 +10,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
+#include <cstring>
 #include <string>
 #include <vector>
 
@@ -26,13 +28,24 @@ inline void send_all(int fd, const uint8_t* p, size_t n) {
   }
 }
 
-inline void recv_all(int fd, uint8_t* p, size_t n) {
-  while (n) {
-    ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) throw ProtocolError("peer closed");
+// Read exactly n bytes. eof_ok permits a clean EOF *before the first
+// byte* (returns false); EOF mid-read always throws (protocol.py
+// _recv_exact semantics). Socket errors (r < 0) are reported with errno —
+// a reset from a crashed peer is not "malformed input".
+inline bool recv_all(int fd, uint8_t* p, size_t n, bool eof_ok = false) {
+  size_t want = n;
+  while (want) {
+    ssize_t r = ::recv(fd, p, want, 0);
+    if (r < 0)
+      throw ProtocolError(std::string("recv failed: ") + strerror(errno));
+    if (r == 0) {
+      if (eof_ok && want == n) return false;
+      throw ProtocolError(want == n ? "peer closed" : "peer closed mid-message");
+    }
     p += r;
-    n -= size_t(r);
+    want -= size_t(r);
   }
+  return true;
 }
 
 inline void send_msg(int fd, const Message& m) {
@@ -42,7 +55,8 @@ inline void send_msg(int fd, const Message& m) {
 
 inline Message recv_msg(int fd) {
   uint8_t header[kHeaderSize];
-  recv_all(fd, header, kHeaderSize);
+  if (!recv_all(fd, header, kHeaderSize, /*eof_ok=*/true))
+    throw ProtocolError("peer closed");
   uint64_t plen = 0;
   for (int i = 0; i < 4; ++i) plen |= uint64_t(header[8 + i]) << (8 * i);
   if (plen > kMaxPayload) throw ProtocolError("advertised payload too large");
