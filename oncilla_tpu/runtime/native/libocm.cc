@@ -14,6 +14,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -21,6 +22,7 @@
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <future>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -67,6 +69,10 @@ struct ocmc_ctx {
   // HEARTBEAT/DISCONNECT so daemons relay/reclaim with O(owners) fan-out.
   std::map<int64_t, int> owner_ranks;
   std::mutex owners_mu;
+  // Per-handle app-side staging buffers (ocm_localbuf; the reference
+  // mallocs one into the handle at alloc time, lib.c:255-269).
+  std::map<uint64_t, std::vector<uint8_t>> stagebufs;
+  std::mutex stage_mu;
   std::thread hb_thread;
   std::atomic<bool> hb_stop{false};
   std::condition_variable hb_cv;
@@ -305,6 +311,10 @@ int ocmc_free(ocmc_ctx* ctx, const ocmc_handle* h) {
                                {"rank", Value::I(h->rank)}},
                               {}});
     ctx->note_owner(h->rank, -1);
+    {
+      std::lock_guard<std::mutex> g(ctx->stage_mu);
+      ctx->stagebufs.erase(h->alloc_id);
+    }
     return 0;
   } catch (const std::exception& e) {
     ctx->set_error(e.what());
@@ -370,6 +380,83 @@ int ocmc_get(ocmc_ctx* ctx, const ocmc_handle* h, void* buf, uint64_t nbytes,
     ctx->set_error(e.what());
     return -1;
   }
+}
+
+void* ocmc_localbuf(ocmc_ctx* ctx, const ocmc_handle* h) {
+  if (!ctx || !h) return nullptr;
+  try {
+    std::lock_guard<std::mutex> g(ctx->stage_mu);
+    auto it = ctx->stagebufs.find(h->alloc_id);
+    if (it == ctx->stagebufs.end())
+      it = ctx->stagebufs
+               .emplace(h->alloc_id, std::vector<uint8_t>(h->nbytes, 0))
+               .first;
+    return it->second.data();
+  } catch (const std::exception& e) {  // bad_alloc must not cross the C ABI
+    ctx->set_error(std::string("localbuf allocation failed: ") + e.what());
+    return nullptr;
+  }
+}
+
+int ocmc_copy_onesided(ocmc_ctx* ctx, const ocmc_handle* h, int op_flag) {
+  if (!ctx || !h) return -1;
+  void* buf = ocmc_localbuf(ctx, h);
+  if (!buf) return -1;
+  // The staging vector is stable (never resized after creation), so using
+  // the pointer outside stage_mu is safe until ocmc_free/ocmc_tini.
+  return op_flag ? ocmc_put(ctx, h, buf, h->nbytes, 0)
+                 : ocmc_get(ctx, h, buf, h->nbytes, 0);
+}
+
+int ocmc_copy(ocmc_ctx* ctx, const ocmc_handle* dst, const ocmc_handle* src,
+              uint64_t nbytes) {
+  if (!ctx || !dst || !src) return -1;
+  if (nbytes == 0) nbytes = std::min(src->nbytes, dst->nbytes);
+  if (nbytes > src->nbytes || nbytes > dst->nbytes) {
+    ctx->set_error("ocmc_copy size exceeds an allocation");
+    return -1;
+  }
+  // Double-buffered stream through the app: the get of chunk N+1 overlaps
+  // the put of chunk N (the extoll.c:44-51 overlap idea at the copy level;
+  // 2 x chunk_bytes of memory). ocmc_get/ocmc_put are thread-safe — data
+  // connections carry their own mutexes.
+  try {
+    std::vector<uint8_t> cur(std::min(ctx->chunk_bytes, nbytes));
+    std::vector<uint8_t> next;
+    uint64_t pos = 0;
+    if (ocmc_get(ctx, src, cur.data(), cur.size(), pos) != 0) return -1;
+    while (pos < nbytes) {
+      uint64_t n = cur.size();
+      uint64_t next_pos = pos + n;
+      std::future<int> fut;
+      if (next_pos < nbytes) {
+        uint64_t next_n = std::min(ctx->chunk_bytes, nbytes - next_pos);
+        next.resize(next_n);
+        fut = std::async(std::launch::async, [&, next_pos, next_n] {
+          return ocmc_get(ctx, src, next.data(), next_n, next_pos);
+        });
+      }
+      int put_rc = ocmc_put(ctx, dst, cur.data(), n, pos);
+      int get_rc = fut.valid() ? fut.get() : 0;
+      if (put_rc != 0 || get_rc != 0) return -1;
+      cur.swap(next);
+      pos = next_pos;
+    }
+    return 0;
+  } catch (const std::exception& e) {  // allocation/thread failure
+    ctx->set_error(std::string("ocmc_copy failed: ") + e.what());
+    return -1;
+  }
+}
+
+int ocmc_copy_out(ocmc_ctx* ctx, void* dst, const ocmc_handle* src,
+                  uint64_t nbytes, uint64_t offset) {
+  return ocmc_get(ctx, src, dst, nbytes, offset);
+}
+
+int ocmc_copy_in(ocmc_ctx* ctx, const ocmc_handle* dst, const void* src,
+                 uint64_t nbytes, uint64_t offset) {
+  return ocmc_put(ctx, dst, src, nbytes, offset);
 }
 
 int ocmc_is_remote(const ocmc_handle* h) {

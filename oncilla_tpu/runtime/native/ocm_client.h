@@ -70,6 +70,32 @@ int ocmc_put(ocmc_ctx* ctx, const ocmc_handle* h, const void* buf,
 int ocmc_get(ocmc_ctx* ctx, const ocmc_handle* h, void* buf, uint64_t nbytes,
              uint64_t offset);
 
+/* ocm_localbuf analogue (lib.c:425-460): the app-side staging window onto
+ * an allocation. Lazily allocated (h->nbytes bytes, zero-initialised) and
+ * owned by the context; stable for the handle's lifetime, released by
+ * ocmc_free/ocmc_tini. Mutate it in place, then move it with
+ * ocmc_copy_onesided. Returns NULL on failure. */
+void* ocmc_localbuf(ocmc_ctx* ctx, const ocmc_handle* h);
+
+/* ocm_copy_onesided analogue (lib.c:670): move the handle's OWN staging
+ * buffer (ocmc_localbuf) over the fabric. op_flag = 1 writes the staging
+ * buffer into the allocation, op_flag = 0 reads the allocation into it —
+ * the reference's op_flag convention. */
+int ocmc_copy_onesided(ocmc_ctx* ctx, const ocmc_handle* h, int op_flag);
+
+/* ocm_copy analogue (lib.c:502-665): copy min(src->nbytes, dst->nbytes)
+ * bytes (or `nbytes` if nonzero) between two host-kind allocations,
+ * streamed through the app in pipeline chunks. */
+int ocmc_copy(ocmc_ctx* ctx, const ocmc_handle* dst, const ocmc_handle* src,
+              uint64_t nbytes);
+
+/* ocm_copy_out / ocm_copy_in — unimplemented -1 stubs in the reference
+ * (lib.c:491-499); working here as named aliases of get/put. */
+int ocmc_copy_out(ocmc_ctx* ctx, void* dst, const ocmc_handle* src,
+                  uint64_t nbytes, uint64_t offset);
+int ocmc_copy_in(ocmc_ctx* ctx, const ocmc_handle* dst, const void* src,
+                 uint64_t nbytes, uint64_t offset);
+
 /* ocm_is_remote / ocm_remote_sz analogues (truth table correct; the
  * reference's ocm_is_remote is buggy, lib.c:461). */
 int ocmc_is_remote(const ocmc_handle* h);

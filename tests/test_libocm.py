@@ -69,6 +69,23 @@ def lib():
     L.ocmc_nnodes.argtypes = [ctypes.c_void_p]
     L.ocmc_last_error.restype = ctypes.c_char_p
     L.ocmc_last_error.argtypes = [ctypes.c_void_p]
+    L.ocmc_localbuf.restype = ctypes.c_void_p
+    L.ocmc_localbuf.argtypes = [ctypes.c_void_p, ctypes.POINTER(OcmcHandle)]
+    L.ocmc_copy_onesided.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(OcmcHandle), ctypes.c_int,
+    ]
+    L.ocmc_copy.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(OcmcHandle),
+        ctypes.POINTER(OcmcHandle), ctypes.c_uint64,
+    ]
+    L.ocmc_copy_out.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(OcmcHandle),
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    L.ocmc_copy_in.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(OcmcHandle), ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
     return L
 
 
@@ -335,3 +352,60 @@ def test_daemon_survives_garbage_bytes(cluster):
         assert st.type == MsgType.STATUS_OK
     finally:
         s.close()
+
+
+def test_c_client_localbuf_copy_surface(lib, cluster, rng):
+    """The rest of the oncillamem.h surface from C: localbuf staging +
+    copy_onesided (op_flag convention), handle-to-handle ocmc_copy, and the
+    copy_out/copy_in pair the reference left as -1 stubs."""
+    ctx = lib.ocmc_init(cluster.encode(), 0, 0.0)
+    assert ctx, lib.ocmc_last_error(None)
+    try:
+        n = 256 << 10
+        h1, h2 = OcmcHandle(), OcmcHandle()
+        assert lib.ocmc_alloc(ctx, n, 3, ctypes.byref(h1)) == 0
+        assert lib.ocmc_alloc(ctx, n, 3, ctypes.byref(h2)) == 0
+
+        # localbuf: stable staging window; write through it with
+        # copy_onesided(op_flag=1), read back with op_flag=0.
+        p = lib.ocmc_localbuf(ctx, ctypes.byref(h1))
+        assert p and p == lib.ocmc_localbuf(ctx, ctypes.byref(h1))
+        stage = (ctypes.c_uint8 * n).from_address(p)
+        data = rng.integers(0, 256, n, dtype=np.uint8)
+        stage[:] = data.tolist()
+        assert lib.ocmc_copy_onesided(ctx, ctypes.byref(h1), 1) == 0
+        ctypes.memset(p, 0, n)
+        assert lib.ocmc_copy_onesided(ctx, ctypes.byref(h1), 0) == 0
+        np.testing.assert_array_equal(np.ctypeslib.as_array(stage), data)
+
+        # Handle-to-handle copy, then read the destination out.
+        assert lib.ocmc_copy(ctx, ctypes.byref(h2), ctypes.byref(h1), 0) == 0
+        out = np.zeros(n, dtype=np.uint8)
+        assert lib.ocmc_copy_out(
+            ctx, out.ctypes.data_as(ctypes.c_void_p), ctypes.byref(h2), n, 0,
+        ) == 0
+        np.testing.assert_array_equal(out, data)
+
+        # copy_in at an offset.
+        patch = rng.integers(0, 256, 1024, dtype=np.uint8)
+        assert lib.ocmc_copy_in(
+            ctx, ctypes.byref(h2),
+            patch.ctypes.data_as(ctypes.c_void_p), 1024, 4096,
+        ) == 0
+        out2 = np.zeros(1024, dtype=np.uint8)
+        assert lib.ocmc_copy_out(
+            ctx, out2.ctypes.data_as(ctypes.c_void_p), ctypes.byref(h2),
+            1024, 4096,
+        ) == 0
+        np.testing.assert_array_equal(out2, patch)
+
+        # Oversized copy is rejected with a message, not clamped.
+        small = OcmcHandle()
+        assert lib.ocmc_alloc(ctx, 4096, 3, ctypes.byref(small)) == 0
+        assert lib.ocmc_copy(ctx, ctypes.byref(small), ctypes.byref(h1), n) == -1
+        assert b"exceeds" in lib.ocmc_last_error(ctx)
+
+        for h in (h1, h2, small):
+            assert lib.ocmc_free(ctx, ctypes.byref(h)) == 0
+    finally:
+        lib.ocmc_tini(ctx)
