@@ -1,9 +1,12 @@
 /* ocm_c_demo — a pure-C application driving the oncilla-tpu cluster
- * through libocm_tpu.so (the shape of the reference's test/ocm_test.c
- * test 2: allocate remote, one-sided write, read back, verify, free).
+ * through libocm_tpu.so, covering the shapes of the reference's
+ * test/ocm_test.c: test 1 (alloc lifecycle + localbuf + introspection),
+ * test 2 (one-sided write + read-back verify, both through explicit
+ * buffers and through the handle's localbuf via ocmc_copy_onesided), and
+ * test 3's host arm (handle-to-handle ocmc_copy).
  *
  * Usage: ocm_c_demo NODEFILE RANK [NBYTES]
- * Exit code 0 and a "pass:" line on success, -1/"FAIL:" otherwise. */
+ * Exit code 0 and "pass:" lines on success, -1/"FAIL:" otherwise. */
 
 #include <stdio.h>
 #include <stdlib.h>
@@ -55,11 +58,70 @@ int main(int argc, char** argv) {
     fprintf(stderr, "FAIL: readback mismatch\n");
     goto done;
   }
+  printf("pass: %llu-byte remote put/get round trip\n", n);
+
+  /* Staging-window flavor (ocm_localbuf + op_flag semantics,
+   * lib.c:425-460,670): mutate the handle's own buffer in place, push it,
+   * clobber it, pull it back. */
+  {
+    unsigned char* stage = (unsigned char*)ocmc_localbuf(ctx, &h);
+    if (!stage) {
+      fprintf(stderr, "FAIL: localbuf: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    for (unsigned long long i = 0; i < n; ++i)
+      stage[i] = (unsigned char)(i * 40503u >> 8);
+    if (ocmc_copy_onesided(ctx, &h, 1) != 0) { /* write staging -> remote */
+      fprintf(stderr, "FAIL: copy_onesided write: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    memset(stage, 0, n);
+    if (ocmc_copy_onesided(ctx, &h, 0) != 0) { /* read remote -> staging */
+      fprintf(stderr, "FAIL: copy_onesided read: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    for (unsigned long long i = 0; i < n; ++i) {
+      if (stage[i] != (unsigned char)(i * 40503u >> 8)) {
+        fprintf(stderr, "FAIL: staging readback mismatch at %llu\n", i);
+        goto done;
+      }
+    }
+    printf("pass: localbuf staging round trip\n");
+  }
+
+  /* Handle-to-handle copy (ocm_copy host arm, lib.c:502-665). */
+  {
+    ocmc_handle h2;
+    if (ocmc_alloc(ctx, n, OCMC_KIND_REMOTE_HOST, &h2) != 0) {
+      fprintf(stderr, "FAIL: alloc2: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    if (ocmc_copy(ctx, &h2, &h, 0) != 0) {
+      fprintf(stderr, "FAIL: copy: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    memset(dst, 0, n);
+    if (ocmc_copy_out(ctx, dst, &h2, n, 0) != 0) {
+      fprintf(stderr, "FAIL: copy_out: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    for (unsigned long long i = 0; i < n; ++i) {
+      if (dst[i] != (unsigned char)(i * 40503u >> 8)) {
+        fprintf(stderr, "FAIL: copy mismatch at %llu\n", i);
+        goto done;
+      }
+    }
+    if (ocmc_free(ctx, &h2) != 0) {
+      fprintf(stderr, "FAIL: free2: %s\n", ocmc_last_error(ctx));
+      goto done;
+    }
+    printf("pass: handle-to-handle copy + copy_out\n");
+  }
+
   if (ocmc_free(ctx, &h) != 0) {
     fprintf(stderr, "FAIL: free: %s\n", ocmc_last_error(ctx));
     goto done;
   }
-  printf("pass: %llu-byte remote put/get round trip\n", n);
   rc = 0;
 
 done:

@@ -257,7 +257,7 @@ def test_c_demo_program(cluster):
         capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "pass:" in r.stdout
+    assert r.stdout.count("pass:") == 3, r.stdout  # put/get, localbuf, copy
 
 
 def test_c_client_multithreaded(lib, cluster):
