@@ -3,7 +3,7 @@
 The reference cannot test its multi-node logic without two hosts with real
 IB/EXTOLL NICs (SURVEY.md §4 "gap to close"); this harness runs the entire
 control plane — placement, ids, leases, DCN data — inside one process (or
-with daemons as real subprocesses, see tests/test_daemon_proc.py), so the
+with daemons as real subprocesses, see tests/test_daemon_cli.py), so the
 protocol is unit-testable on any machine.
 """
 

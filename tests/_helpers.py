@@ -1,0 +1,48 @@
+"""Shared plumbing for daemon-process tests (single home for the port
+helpers that were previously copy-pasted per suite)."""
+
+import socket
+import time
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_port(port: int, deadline_s: float = 30.0, host: str = "127.0.0.1") -> bool:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            socket.create_connection((host, port), timeout=0.5).close()
+            return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def wait_nnodes(port: int, n: int, deadline_s: float = 30.0) -> bool:
+    """Wait until the daemon on ``port`` reports a cluster of >= n nodes —
+    an open listen socket does not imply the ADD_NODE join completed."""
+    from oncilla_tpu.runtime.protocol import Message, MsgType, request
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=1.0)
+            try:
+                if request(s, Message(MsgType.STATUS, {})).fields["nnodes"] >= n:
+                    return True
+            finally:
+                s.close()
+        except Exception:  # noqa: BLE001 — daemon still starting
+            pass
+        time.sleep(0.05)
+    return False

@@ -9,19 +9,8 @@ import time
 import numpy as np
 import pytest
 
+from _helpers import free_ports, wait_nnodes
 from oncilla_tpu.runtime.membership import NodeEntry
-
-
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 class OcmcHandle(ctypes.Structure):
@@ -90,28 +79,14 @@ def lib():
 
 
 def _wait_cluster(ports, n=2, deadline_s=15.0):
-    from oncilla_tpu.runtime.protocol import Message, MsgType, request
-
-    deadline = time.time() + deadline_s
-    while time.time() < deadline:
-        try:
-            s = socket.create_connection(("127.0.0.1", ports[0]), timeout=1.0)
-            try:
-                st = request(s, Message(MsgType.STATUS, {}))
-            finally:
-                s.close()
-            if st.fields["nnodes"] >= n:
-                return
-        except OSError:
-            pass
-        time.sleep(0.05)
-    pytest.fail("daemons did not form a cluster")
+    if not wait_nnodes(ports[0], n, deadline_s):
+        pytest.fail("daemons did not form a cluster")
 
 
 @pytest.fixture(params=["native", "python"])
 def cluster(request, tmp_path):
     """Two daemons (C++ or Python) + the nodefile path."""
-    ports = _free_ports(2)
+    ports = free_ports(2)
     nodefile = tmp_path / "nodefile"
     nodefile.write_text(
         "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))

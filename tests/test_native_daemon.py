@@ -8,6 +8,8 @@ import time
 import numpy as np
 import pytest
 
+from _helpers import free_ports
+
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
 from oncilla_tpu.core.context import Ocm
@@ -15,18 +17,6 @@ from oncilla_tpu.runtime.client import ControlPlaneClient
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.native import native
 from oncilla_tpu.utils.config import OcmConfig
-
-
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 @pytest.fixture(scope="module")
@@ -39,7 +29,7 @@ def binary():
 
 @pytest.fixture
 def native_cluster(binary, tmp_path):
-    ports = _free_ports(2)
+    ports = free_ports(2)
     nodefile = tmp_path / "nodefile"
     nodefile.write_text(
         "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
@@ -192,7 +182,7 @@ def test_native_pipelined_error_does_not_desync(native_cluster, rng):
 
 
 def test_native_lease_reaping(binary, tmp_path):
-    ports = _free_ports(2)
+    ports = free_ports(2)
     nodefile = tmp_path / "nf"
     nodefile.write_text(
         "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))

@@ -11,6 +11,8 @@ import time
 import numpy as np
 import pytest
 
+from _helpers import free_ports
+
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
 from oncilla_tpu.core.context import Ocm
@@ -22,18 +24,6 @@ from oncilla_tpu.utils.config import OcmConfig
 TSAN_EXIT = 66
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
 @pytest.fixture(scope="module")
 def tsan_binary():
     try:
@@ -43,7 +33,7 @@ def tsan_binary():
 
 
 def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
-    ports = _free_ports(2)
+    ports = free_ports(2)
     nodefile = tmp_path / "nodefile"
     nodefile.write_text(
         "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))

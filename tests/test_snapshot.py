@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import oncilla_tpu as ocm
+from _helpers import wait_port
 from oncilla_tpu import OcmKind
 from oncilla_tpu.runtime import snapshot as snap
 from oncilla_tpu.runtime.cluster import LocalCluster
@@ -106,17 +107,8 @@ def test_restore_wrong_rank_rejected(tmp_path):
 
 
 def _wait_port(host, port, timeout=10):
-    import socket as sk
-    import time as t
-
-    deadline = t.time() + timeout
-    while t.time() < deadline:
-        try:
-            sk.create_connection((host, port), timeout=0.5).close()
-            return
-        except OSError:
-            t.sleep(0.05)
-    raise TimeoutError(f"{host}:{port} never came up")
+    if not wait_port(port, timeout, host=host):
+        raise TimeoutError(f"{host}:{port} never came up")
 
 
 def test_native_daemon_snapshot_restart(tmp_path, rng):
