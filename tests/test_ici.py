@@ -303,3 +303,38 @@ def test_full_copy_matrix(spmd_cluster, rng, src_kind, dst_kind):
     np.testing.assert_array_equal(np.asarray(ctx.get(src)), data)
     ctx.free(src)
     ctx.free(dst)
+
+
+def test_spmd_plane_concurrent_ops(spmd_cluster, rng):
+    """Racing puts/gets/copies through the plane's donated-arena rebind:
+    the per-plane mutex must serialize rebinds (a lost update or a
+    dispatch on a deleted donated buffer fails this)."""
+    import threading
+
+    cl, plane = spmd_cluster
+    ctx = cl.context(0, ici_plane=plane)
+    handles = [ctx.alloc(4 << 10, OcmKind.REMOTE_DEVICE) for _ in range(4)]
+    datas = [rng.integers(0, 256, 4 << 10, dtype=np.uint8) for _ in range(4)]
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(6):
+                plane.put(handles[i], datas[i])
+                got = np.asarray(plane.get(handles[i], 4 << 10))
+                np.testing.assert_array_equal(got, datas[i])
+        except Exception as e:  # noqa: BLE001
+            errs.append(f"t{i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert not errs, errs
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(
+            np.asarray(plane.get(h, 4 << 10)), datas[i]
+        )
+        ctx.free(h)

@@ -114,3 +114,25 @@ def test_capture_trace(tmp_path):
         jnp.ones(8).sum().block_until_ready()
     assert any((tmp_path / "trace").rglob("*")), "no trace output written"
     del jax
+
+
+def test_detect_rank_jax_fallback(monkeypatch):
+    # Pod DNS names in the nodefile won't match gethostname(); when the
+    # jax distributed runtime's shape matches, process_index is the rank.
+    import jax
+
+    from oncilla_tpu.runtime.membership import NodeEntry, detect_rank
+
+    entries = [NodeEntry(r, f"tpu-pod-host-{r}", 17980) for r in range(4)]
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert detect_rank(entries) == 2
+
+    # Shape mismatch: no fallback, the hostname error surfaces.
+    monkeypatch.setattr(jax, "process_count", lambda: 8)
+    import pytest as _pytest
+
+    import oncilla_tpu as ocm
+
+    with _pytest.raises(ocm.OcmError, match="not present"):
+        detect_rank(entries)
