@@ -533,20 +533,10 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
     if budgeted("pallas_ici_copy", 90):
         out["detail"]["pallas_ici_verified"] = check_pallas_ici_copy(errors)
 
-    # GUPS random-access over the chip's HBM (BASELINE.md config 4).
-    if budgeted("gups", 90):
-        try:
-            from oncilla_tpu.benchmarks.gups import gups_single
-
-            out["detail"]["gups"] = round(
-                gups_single(words=1 << 22, batch=1 << 20, steps=32)["gups"], 4
-            )
-        except Exception as e:  # noqa: BLE001 — never fail the headline
-            errors["gups"] = f"{type(e).__name__}: {e}"
-
     # Single-chip MFU on the flagship model (forward on a chip-filling
     # ~1.1B config; full train step on a ~0.4B config so fp32 Adam moments
-    # fit) — the judged compute metric. Before the GB sweep: worth more.
+    # fit) — the judged compute metric, so it outranks GUPS and the sweep
+    # in the budget queue.
     if budgeted("mfu_forward", 240):
         try:
             from oncilla_tpu.benchmarks import mfu as mfu_mod
@@ -565,6 +555,17 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
             out["detail"]["mfu_train_tflops"] = round(mfu_trn["tflops"], 2)
         except Exception as e:  # noqa: BLE001
             errors["mfu_train"] = f"{type(e).__name__}: {e}"
+
+    # GUPS random-access over the chip's HBM (BASELINE.md config 4).
+    if budgeted("gups", 90):
+        try:
+            from oncilla_tpu.benchmarks.gups import gups_single
+
+            out["detail"]["gups"] = round(
+                gups_single(words=1 << 22, batch=1 << 20, steps=32)["gups"], 4
+            )
+        except Exception as e:  # noqa: BLE001 — never fail the headline
+            errors["gups"] = f"{type(e).__name__}: {e}"
 
     # GB-scale sweep over a blocked (>2 GiB) arena (VERDICT r2 item 5).
     if budgeted("gb_sweep", 180):
