@@ -556,6 +556,19 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
         except Exception as e:  # noqa: BLE001
             errors["mfu_train"] = f"{type(e).__name__}: {e}"
 
+    # Paged-KV decode tokens/s (BASELINE.md config 5): the application-level
+    # number — KV pages ride the OCM data plane out and back per page.
+    if budgeted("kv_decode", 180):
+        try:
+            from oncilla_tpu.benchmarks.kv_decode import run_bench
+
+            kv = run_bench(tokens_n=256, page_tokens=128)
+            out["detail"]["kv_decode_tok_s"] = kv["tok_s"]
+            if "paging_overhead" in kv:
+                out["detail"]["kv_paging_overhead"] = kv["paging_overhead"]
+        except Exception as e:  # noqa: BLE001
+            errors["kv_decode"] = f"{type(e).__name__}: {e}"
+
     # GUPS random-access over the chip's HBM (BASELINE.md config 4).
     if budgeted("gups", 90):
         try:

@@ -84,11 +84,71 @@ def bench_paged(params, cfg, tokens, ctx, kind, page_tokens) -> float:
     return tokens.shape[1] / (time.perf_counter() - t0)
 
 
+def run_bench(
+    tokens_n: int = 384,
+    page_tokens: int = 128,
+    modes: tuple = ("plain", "device", "host"),
+    config: str = "small",
+) -> dict:
+    """Programmatic entry (bench.py and the CLI share it): tokens/s per
+    mode plus the paging overhead vs the in-HBM ceiling."""
+    import oncilla_tpu as ocm
+
+    cfg = llama.LlamaConfig() if config == "small" else llama.LlamaConfig.tiny()
+    params = llama.init_params_host(0, cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(1, tokens_n), dtype=np.int32)
+    )
+
+    # Arena sized for all pages of the run (both timed + warmup sessions
+    # free their pages on close).
+    page_bytes = (
+        2 * cfg.n_layers * cfg.n_kv_heads * page_tokens * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    npages = tokens_n // page_tokens
+    arena = max(64 << 20, 2 * npages * page_bytes)
+    ctx = ocm.ocm_init(
+        ocm.OcmConfig(host_arena_bytes=arena, device_arena_bytes=arena)
+    )
+
+    out = {"config": config, "tokens": tokens_n,
+           "page_tokens": page_tokens, "tok_s": {}}
+    try:
+        _run_modes(out, modes, params, cfg, tokens, ctx, page_tokens)
+    finally:
+        ocm.ocm_tini(ctx)  # never leak the arenas into the caller's process
+    return out
+
+
+def _run_modes(out, modes, params, cfg, tokens, ctx, page_tokens):
+    for mode in modes:
+        if mode == "plain":
+            tps = bench_plain(params, cfg, tokens)
+        elif mode == "device":
+            tps = bench_paged(
+                params, cfg, tokens, ctx, OcmKind.LOCAL_DEVICE, page_tokens
+            )
+        elif mode == "host":
+            tps = bench_paged(
+                params, cfg, tokens, ctx, OcmKind.LOCAL_HOST, page_tokens
+            )
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        out["tok_s"][mode] = round(tps, 2)
+
+    if "plain" in out["tok_s"]:
+        base = out["tok_s"]["plain"]
+        out["paging_overhead"] = {
+            m: round(base / v - 1.0, 4)
+            for m, v in out["tok_s"].items() if m != "plain" and v
+        }
+
+
 def main() -> None:
     import argparse
     import json
-
-    import oncilla_tpu as ocm
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--tokens", type=int, default=384)
@@ -99,55 +159,15 @@ def main() -> None:
     )
     ap.add_argument("--config", choices=["small", "tiny"], default="small")
     args = ap.parse_args()
-
-    cfg = (
-        llama.LlamaConfig() if args.config == "small"
-        else llama.LlamaConfig.tiny()
-    )
-    params = llama.init_params_host(0, cfg)
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(
-        rng.integers(0, cfg.vocab, size=(1, args.tokens), dtype=np.int32)
-    )
-
-    # Arena sized for all pages of the run (both timed + warmup sessions
-    # free their pages on close).
-    page_bytes = (
-        2 * cfg.n_layers * cfg.n_kv_heads * args.page_tokens * cfg.head_dim
-        * jnp.dtype(cfg.dtype).itemsize
-    )
-    npages = args.tokens // args.page_tokens
-    arena = max(64 << 20, 2 * npages * page_bytes)
-    ctx = ocm.ocm_init(
-        ocm.OcmConfig(host_arena_bytes=arena, device_arena_bytes=arena)
-    )
-
-    out = {"config": args.config, "tokens": args.tokens,
-           "page_tokens": args.page_tokens, "tok_s": {}}
-    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
-    for mode in modes:
-        if mode == "plain":
-            tps = bench_plain(params, cfg, tokens)
-        elif mode == "device":
-            tps = bench_paged(
-                params, cfg, tokens, ctx, OcmKind.LOCAL_DEVICE,
-                args.page_tokens,
-            )
-        elif mode == "host":
-            tps = bench_paged(
-                params, cfg, tokens, ctx, OcmKind.LOCAL_HOST,
-                args.page_tokens,
-            )
-        else:
-            raise SystemExit(f"unknown mode {mode!r}")
-        out["tok_s"][mode] = round(tps, 2)
-
-    if "plain" in out["tok_s"]:
-        base = out["tok_s"]["plain"]
-        out["paging_overhead"] = {
-            m: round(base / v - 1.0, 4)
-            for m, v in out["tok_s"].items() if m != "plain" and v
-        }
+    try:
+        out = run_bench(
+            tokens_n=args.tokens,
+            page_tokens=args.page_tokens,
+            modes=tuple(m.strip() for m in args.modes.split(",") if m.strip()),
+            config=args.config,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
     print(json.dumps(out))
 
 
