@@ -27,11 +27,17 @@ from oncilla_tpu.benchmarks._util import fence as _fence
 from oncilla_tpu.parallel.mesh import NODE_AXIS, arena_sharding, node_mesh
 
 
-@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4))
-def _gups_single_run(table, steps: int, batch: int, words: int, seed: int):
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4, 5))
+def _gups_single_run(table, steps: int, batch: int, words: int, seed: int,
+                     method: str):
     def body(i, t):
         key = jax.random.fold_in(jax.random.key(seed), i)
         idx = jax.random.randint(key, (batch,), 0, words, dtype=jnp.int32)
+        if method == "bincount":
+            # Histogram formulation: XLA lowers bincount via sort/segment
+            # machinery, which can beat the serialized scatter on TPU for
+            # dense batches; same semantics (+1 per drawn index).
+            return t + jnp.bincount(idx, length=words).astype(jnp.uint32)
         return t.at[idx].add(jnp.uint32(1))
 
     return jax.lax.fori_loop(0, steps, body, table)
@@ -43,30 +49,53 @@ def gups_single(
     steps: int = 64,
     seed: int = 0,
     device=None,
+    method: str = "scatter",
 ) -> dict:
-    """Single-chip GUPS on a ``words``-word uint32 HBM table."""
+    """Single-chip GUPS on a ``words``-word uint32 HBM table. ``method``
+    picks the update lowering ("scatter" or "bincount"); both are exact."""
     def fresh():
         t = jnp.zeros((words,), dtype=jnp.uint32)
         return jax.device_put(t, device) if device is not None else t
 
     # Warm up with the SAME static args (steps is a static argnum — a
     # different value would recompile inside the timed region).
-    _fence(_gups_single_run(fresh(), steps, batch, words, seed))
+    _fence(_gups_single_run(fresh(), steps, batch, words, seed, method))
     table = fresh()
     _fence(table)
     t0 = time.perf_counter()
-    table = _gups_single_run(table, steps, batch, words, seed)
+    table = _gups_single_run(table, steps, batch, words, seed, method)
     _fence(table)
     dt = time.perf_counter() - t0
     updates = steps * batch
     total = int(np.asarray(table).astype(np.uint64).sum())
     return {
-        "mode": "single",
+        "mode": f"single:{method}",
         "gups": updates / dt / 1e9,
         "updates": updates,
         "seconds": dt,
         "table_sum": total,  # == updates (duplicates accumulate)
     }
+
+
+def gups_single_best(
+    words: int = 1 << 20,
+    batch: int = 1 << 14,
+    steps: int = 64,
+    seed: int = 0,
+) -> dict:
+    """Measure both lowerings, verify conservation on each, keep the best
+    (the engine sweet spot differs by backend/generation)."""
+    best = None
+    for method in ("scatter", "bincount"):
+        r = gups_single(words=words, batch=batch, steps=steps, seed=seed,
+                        method=method)
+        if r["table_sum"] != r["updates"]:
+            continue  # wrong results are not publishable
+        if best is None or r["gups"] > best["gups"]:
+            best = r
+    if best is None:
+        raise RuntimeError("no GUPS method produced conserved updates")
+    return best
 
 
 @partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4, 5))

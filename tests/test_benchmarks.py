@@ -110,3 +110,14 @@ def test_size_sweep_blocked_arena():
     assert len(res.points) == 11
     assert all(p.write_gbps > 0 and p.read_gbps > 0 for p in res.points)
     ocm.ocm_tini(ctx)
+
+
+def test_gups_methods_agree_and_conserve():
+    from oncilla_tpu.benchmarks.gups import gups_single, gups_single_best
+
+    for method in ("scatter", "bincount"):
+        out = gups_single(words=1 << 10, batch=256, steps=4, method=method)
+        assert out["table_sum"] == out["updates"] == 1024, out
+    best = gups_single_best(words=1 << 10, batch=256, steps=4)
+    assert best["table_sum"] == best["updates"]
+    assert best["mode"] in ("single:scatter", "single:bincount")
