@@ -92,11 +92,10 @@ def param_spec(cfg: LlamaConfig) -> dict:
     }
 
 
-def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
-    """Scaled-normal init; layers stacked along a leading axis so the whole
-    model is a handful of leaves (sharding-friendly)."""
-    spec = param_spec(cfg)
-    dt = jnp.dtype(cfg.dtype)
+def init_from_spec(key: jax.Array, spec: dict, dtype) -> dict:
+    """Scaled-normal init of a {name: (shape, scale|None)} spec; None means
+    a ones-initialized norm gain. Shared by the dense and MoE families."""
+    dt = jnp.dtype(dtype)
     keys = jax.random.split(key, len(spec))
     out = {}
     for k, (name, (shape, scale)) in zip(keys, spec.items()):
@@ -107,6 +106,12 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
                 jax.random.normal(k, shape, dtype=jnp.float32) * scale
             ).astype(dt)
     return out
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Scaled-normal init; layers stacked along a leading axis so the whole
+    model is a handful of leaves (sharding-friendly)."""
+    return init_from_spec(key, param_spec(cfg), cfg.dtype)
 
 
 def init_params_host(seed: int, cfg: LlamaConfig) -> dict:
@@ -180,13 +185,15 @@ def causal_mask(sq: int, sk: int) -> jax.Array:
     return jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
 
 
-def block(cfg: LlamaConfig, x, lp, positions, attend):
+def block(cfg: LlamaConfig, x, lp, positions, attend, mlp=None):
     """One transformer block — the single implementation every path uses.
 
     x: (B, S, D); lp: this layer's params; ``attend(q, kn, vn)`` receives
     this block's fresh rotary-embedded q (B, H, S, Hd) and *unexpanded* KV
     (B, KV, S, Hd) and returns the attention output (B, H, S, Hd) — the
-    callback decides dense/ring/cached attention.
+    callback decides dense/ring/cached attention. ``mlp(h)`` (if given)
+    replaces the dense SwiGLU FFN on the rmsnorm'd residual — the hook the
+    MoE family (:mod:`oncilla_tpu.models.moe`) plugs its expert layer into.
     """
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -203,6 +210,8 @@ def block(cfg: LlamaConfig, x, lp, positions, attend):
     x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"])
 
     h = rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+    if mlp is not None:
+        return x + mlp(h)
     gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
     return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
@@ -211,6 +220,22 @@ def block(cfg: LlamaConfig, x, lp, positions, attend):
 def final_logits(params, x, cfg: LlamaConfig) -> jax.Array:
     x = rmsnorm(x, params["ln_out"], cfg.norm_eps)
     return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+
+def make_attend(S: int, mesh=None, seq_axis: str | None = None):
+    """The dense-vs-ring attention dispatch shared by every model family:
+    with ``mesh`` + ``seq_axis`` the callback runs ring attention over the
+    sequence-sharded axis, else causal dense attention over S keys."""
+    if seq_axis is not None:
+        from oncilla_tpu.parallel.ring_attention import ring_attention
+
+        def attend(q, kn, vn):
+            return ring_attention(q, kn, vn, mesh, axis_name=seq_axis, causal=True)
+    else:
+        def attend(q, kn, vn):
+            return grouped_attention(q, kn, vn, causal_mask(S, S))
+
+    return attend
 
 
 def forward(
@@ -226,15 +251,7 @@ def forward(
     B, S = tokens.shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     positions = jnp.arange(S)
-
-    if seq_axis is not None:
-        from oncilla_tpu.parallel.ring_attention import ring_attention
-
-        def attend(q, kn, vn):
-            return ring_attention(q, kn, vn, mesh, axis_name=seq_axis, causal=True)
-    else:
-        def attend(q, kn, vn):
-            return grouped_attention(q, kn, vn, causal_mask(S, S))
+    attend = make_attend(S, mesh, seq_axis)
 
     for i in range(cfg.n_layers):
         x = block(cfg, x, layer_params(params, i), positions, attend)

@@ -1,15 +1,16 @@
-"""Sharded training step for the flagship model.
+"""Sharded training steps for the model families.
 
 Mesh axes: ``dp`` (batch data parallel), ``tp`` (tensor parallel over
-heads/ffn), ``sp`` (sequence parallel — ring attention). Parameters are
-sharded with NamedSharding and GSPMD inserts the collectives over ICI
-(all-reduce for dp grads, all-gather/reduce-scatter for tp) — the
-"pick a mesh, annotate shardings, let XLA insert collectives" recipe.
+heads/ffn), ``sp`` (sequence parallel — ring attention), ``ep`` (expert
+parallel — MoE all-to-all), ``pp`` (pipeline parallel — GPipe over
+ppermute). Parameters are sharded with NamedSharding and GSPMD inserts the
+collectives over ICI (all-reduce for dp grads, all-gather/reduce-scatter
+for tp, all-to-all for ep) — the "pick a mesh, annotate shardings, let XLA
+insert collectives" recipe; pp alone is explicit
+(:mod:`oncilla_tpu.parallel.pipeline`).
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -17,9 +18,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from oncilla_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn
+from oncilla_tpu.models.llama import LlamaConfig, init_params, loss_fn
 
-DP, TP, SP = "dp", "tp", "sp"
+DP, TP, SP, EP, PP = "dp", "tp", "sp", "ep", "pp"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -67,33 +68,52 @@ def data_spec() -> P:
     return P(DP, SP)
 
 
-def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
-    params = shard_params(init_params(key, cfg), mesh, cfg)
+def _sharded_state(params_host: dict, specs: dict, mesh: Mesh, lr: float):
+    """Shared state factory: device_put each leaf under its spec + adamw."""
+    params = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params_host.items()
+    }
     tx = optax.adamw(lr, weight_decay=0.01)
-    opt_state = tx.init(params)
-    return params, opt_state, tx
+    return params, tx.init(params), tx
+
+
+def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx):
+    """Shared step factory: jit value_and_grad + adamw update with the
+    params' in/out shardings pinned. Output params MUST be pinned to the
+    input specs, or the compiler may pick different output shardings and
+    step N+1's input contract breaks (observed on the ep mesh). opt_state
+    is deliberately unpinned on both sides: with no input constraint there
+    is no contract to break, and the compiler keeps it consistent with the
+    params it mirrors."""
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(lambda p: loss_of(p, tokens))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    pshard = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    dshard = NamedSharding(mesh, data_pspec)
+    return jax.jit(
+        step,
+        in_shardings=(pshard, None, dshard),
+        out_shardings=(pshard, None, None),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
+    return _sharded_state(init_params(key, cfg), param_specs(cfg), mesh, lr)
 
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True):
     """The jitted full training step (forward + backward + adamw update),
     sharded over the (dp, tp, sp) mesh."""
     seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
-
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg, mesh=mesh, seq_axis=seq_axis)
-        )(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    specs = param_specs(cfg)
-    pshard = {k: NamedSharding(mesh, s) for k, s in specs.items()}
-    dshard = NamedSharding(mesh, data_spec())
-    return jax.jit(
-        step,
-        in_shardings=(pshard, None, dshard),
-        donate_argnums=(0, 1),
+    return _jit_step(
+        lambda p, tokens: loss_fn(p, tokens, cfg, mesh=mesh, seq_axis=seq_axis),
+        param_specs(cfg), mesh, data_spec(), tx,
     )
 
 
@@ -101,3 +121,125 @@ def sample_batch(rng: np.random.Generator, cfg: LlamaConfig, batch: int, seq: in
     return jnp.asarray(
         rng.integers(0, cfg.vocab, size=(batch, seq), dtype=np.int32)
     )
+
+
+# -- expert parallelism (MoE family) ---------------------------------------
+
+
+def make_moe_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Factor devices into a (dp, ep, tp) mesh: ep first (the MoE axis),
+    then tp, rest dp."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    ep = 2 if n % 2 == 0 else 1
+    tp = 2 if (n // ep) % 2 == 0 else 1
+    dp = n // (ep * tp)
+    arr = np.asarray(devices).reshape(dp, ep, tp)
+    return Mesh(arr, (DP, EP, TP))
+
+
+def moe_param_specs(cfg) -> dict:
+    """PartitionSpecs for the MoE family: experts over ep, heads/ffn over
+    tp, router replicated (it is small and every token needs it)."""
+    specs = dict(param_specs(cfg))
+    for k in ("w_gate", "w_up", "w_down"):
+        del specs[k]
+    specs["w_router"] = P(None, None, None)
+    specs["w_gate_e"] = P(None, EP, None, TP)
+    specs["w_up_e"] = P(None, EP, None, TP)
+    specs["w_down_e"] = P(None, EP, TP, None)
+    return specs
+
+
+def make_moe_train_state(key, cfg, mesh: Mesh, lr: float = 3e-4):
+    from oncilla_tpu.models.moe import init_moe_params
+
+    return _sharded_state(
+        init_moe_params(key, cfg), moe_param_specs(cfg), mesh, lr
+    )
+
+
+def make_moe_train_step(cfg, mesh: Mesh, tx):
+    """Jitted MoE training step over the (dp, ep, tp) mesh: GSPMD lowers
+    the dispatch/combine einsums to all-to-alls over the ep axis."""
+    from oncilla_tpu.models import moe
+
+    return _jit_step(
+        lambda p, tokens: moe.loss_fn(p, tokens, cfg, mesh=mesh, ep_axis=EP),
+        moe_param_specs(cfg), mesh, P(DP, None), tx,
+    )
+
+
+# -- pipeline parallelism --------------------------------------------------
+
+
+def make_pp_mesh(
+    n_devices: int | None = None, devices=None, n_layers: int = 4
+) -> Mesh:
+    """Factor devices into a (dp, pp) mesh: pp = the largest power of two
+    ≤ 4 dividing both the device count and the layer count; rest dp."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    pp = 1
+    for cand in (4, 2):
+        if n % cand == 0 and n_layers % cand == 0:
+            pp = cand
+            break
+    arr = np.asarray(devices).reshape(n // pp, pp)
+    return Mesh(arr, (DP, PP))
+
+
+def pp_param_specs(cfg: LlamaConfig) -> dict:
+    """Layer-stacked leaves sharded over pp on the stacked axis; embed/
+    norm/head replicated (they run outside the pipeline)."""
+    from oncilla_tpu.models.llama import LAYER_KEYS, param_spec
+
+    return {
+        k: (P(PP) if k in LAYER_KEYS else P())
+        for k in param_spec(cfg)
+    }
+
+
+def make_pp_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
+    return _sharded_state(init_params(key, cfg), pp_param_specs(cfg), mesh, lr)
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2):
+    """Jitted GPipe training step over the (dp, pp) mesh: the stacked layer
+    axis is sharded over pp; activations move stage-to-stage via ppermute
+    (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated."""
+    from oncilla_tpu.models.llama import (
+        LAYER_KEYS, block, final_logits, make_attend,
+    )
+    from oncilla_tpu.parallel.pipeline import pipeline_apply
+
+    def stage_fn(stage_params, x):
+        S = x.shape[1]
+        positions = jnp.arange(S)
+        attend = make_attend(S)
+
+        def body(xc, lp):
+            return block(cfg, xc, lp, positions, attend), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    def pp_loss(params, tokens):
+        x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+        blocks = {k: params[k] for k in LAYER_KEYS}
+        x = pipeline_apply(
+            stage_fn, blocks, x,
+            mesh=mesh, axis_name=PP, batch_axis=DP,
+            microbatches=microbatches,
+        )
+        logits = final_logits(params, x, cfg)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    return _jit_step(pp_loss, pp_param_specs(cfg), mesh, P(DP, None), tx)

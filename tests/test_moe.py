@@ -1,0 +1,120 @@
+"""MoE family: routing invariants, dense-dispatch equivalence vs a naive
+per-token loop, and the expert-parallel train step on the virtual mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.models import moe, train
+from oncilla_tpu.models.moe import MoeConfig
+
+
+def test_route_invariants(rng):
+    T, E, k, cap = 32, 4, 2, 64  # capacity ample: nothing drops
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    dispatch, combine, aux = moe.route(logits, k, cap)
+
+    d = np.asarray(dispatch)
+    c = np.asarray(combine)
+    assert set(np.unique(d)) <= {0.0, 1.0}
+    # Every token placed exactly k times, each in a distinct (e, slot).
+    assert np.all(d.reshape(T, -1).sum(-1) == k)
+    # No slot double-booked.
+    assert np.all(d.sum(0) <= 1.0 + 1e-6)
+    # Combine weights renormalized over the top-k: sum to 1 per token.
+    np.testing.assert_allclose(c.reshape(T, -1).sum(-1), 1.0, rtol=1e-5)
+    # Aux ≥ 1 (its uniform-routing minimum) for any routing.
+    assert float(aux) >= 1.0 - 1e-5
+
+
+def test_route_overflow_drops_secondary_first():
+    # All tokens want expert 0 first, expert 1 second; capacity 2.
+    T, E, cap = 4, 3, 2
+    logits = jnp.tile(jnp.asarray([[3.0, 2.0, -5.0]]), (T, 1))
+    dispatch, combine, _ = moe.route(logits, 2, cap)
+    d = np.asarray(dispatch)
+    # Expert 0 takes tokens 0,1 (choice-major priority); 2,3 overflow.
+    assert d[:, 0].sum() == cap
+    assert np.all(d[0, 0].sum() == 1) and np.all(d[1, 0].sum() == 1)
+    # Expert 1 (everyone's 2nd choice) also fills to capacity with the
+    # first two tokens' secondary picks.
+    assert d[:, 1].sum() == cap
+    # Dropped picks contribute zero combine weight.
+    c = np.asarray(combine)
+    assert c[2].sum() < 1.0 and c[3].sum() < 1.0
+
+
+def test_moe_ffn_matches_naive_loop(rng):
+    cfg = MoeConfig.tiny()
+    B, S = 2, 8
+    T = B * S
+    key = jax.random.key(0)
+    params = moe.init_moe_params(key, cfg)
+    lp = moe.moe_layer_params(params, 0)
+    h = jnp.asarray(rng.standard_normal((B, S, cfg.dim)), jnp.float32)
+
+    # Capacity at tiny shapes: ceil(2*16/4 * 1.25) = 10 ≥ max per-expert
+    # load only if routing is balanced — force ample capacity instead.
+    big = dataclasses.replace(cfg, capacity_factor=float(T))
+    y, aux = moe.moe_ffn(h, lp, big)
+
+    # Naive: per token, sum of gate_k * SwiGLU_{expert_k}(x).
+    x = np.asarray(h.reshape(T, cfg.dim), np.float64)
+    wr = np.asarray(lp["w_router"], np.float64)
+    probs = jax.nn.softmax(jnp.asarray(x @ wr), axis=-1)
+    gv, gi = jax.lax.top_k(probs, cfg.top_k)
+    gv = np.asarray(gv / gv.sum(-1, keepdims=True), np.float64)
+    gi = np.asarray(gi)
+    want = np.zeros((T, cfg.dim))
+    for t in range(T):
+        for j in range(cfg.top_k):
+            e = gi[t, j]
+            wg = np.asarray(lp["w_gate_e"][e], np.float64)
+            wu = np.asarray(lp["w_up_e"][e], np.float64)
+            wd = np.asarray(lp["w_down_e"][e], np.float64)
+            g = x[t] @ wg
+            u = x[t] @ wu
+            silu = g / (1.0 + np.exp(-g)) * u
+            want[t] += gv[t, j] * (silu @ wd)
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(T, cfg.dim), want, rtol=2e-4, atol=2e-5
+    )
+    assert np.isfinite(float(aux))
+
+
+def test_moe_forward_shapes_and_loss(rng):
+    cfg = MoeConfig.tiny()
+    params = moe.init_moe_params(jax.random.key(1), cfg)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (2, 16)), jnp.int32)
+    logits, aux = moe.forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab)
+    loss = moe.loss_fn(params, tokens, cfg)
+    assert np.isfinite(float(loss))
+    assert float(aux) >= cfg.n_layers * (1.0 - 1e-4)
+
+
+def test_moe_train_step_ep_mesh(rng):
+    """Full expert-parallel train step on the 8-device (dp=2, ep=2, tp=2)
+    mesh: runs, loss finite and decreasing, shardings as specified."""
+    cfg = MoeConfig.tiny()
+    mesh = train.make_moe_mesh(8)
+    assert dict(mesh.shape) == {"dp": 2, "ep": 2, "tp": 2}
+    params, opt_state, tx = train.make_moe_train_state(
+        jax.random.key(2), cfg, mesh, lr=1e-2
+    )
+    step = train.make_moe_train_step(cfg, mesh, tx)
+    tokens = jax.device_put(
+        jnp.asarray(rng.integers(0, cfg.vocab, (4, 32)), jnp.int32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp", None)),
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # Expert weights really live sharded over ep.
+    sh = params["w_gate_e"].sharding
+    assert sh.spec == train.moe_param_specs(cfg)["w_gate_e"]
