@@ -533,8 +533,8 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
     if budgeted("pallas_ici_copy", 90):
         out["detail"]["pallas_ici_verified"] = check_pallas_ici_copy(errors)
 
-    # Single-chip MFU on the flagship model (forward on a chip-filling
-    # ~1.1B config; full train step on a ~0.4B config so fp32 Adam moments
+    # Single-chip MFU on the flagship model (the chip-filling ~1.1B
+    # config; the train step at a smaller batch so grads + Adam moments
     # fit) — the judged compute metric, so it outranks GUPS and the sweep
     # in the budget queue.
     if budgeted("mfu_forward", 240):
