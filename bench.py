@@ -556,19 +556,6 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
         except Exception as e:  # noqa: BLE001
             errors["mfu_train"] = f"{type(e).__name__}: {e}"
 
-    # Paged-KV decode tokens/s (BASELINE.md config 5): the application-level
-    # number — KV pages ride the OCM data plane out and back per page.
-    if budgeted("kv_decode", 180):
-        try:
-            from oncilla_tpu.benchmarks.kv_decode import run_bench
-
-            kv = run_bench(tokens_n=256, page_tokens=128)
-            out["detail"]["kv_decode_tok_s"] = kv["tok_s"]
-            if "paging_overhead" in kv:
-                out["detail"]["kv_paging_overhead"] = kv["paging_overhead"]
-        except Exception as e:  # noqa: BLE001
-            errors["kv_decode"] = f"{type(e).__name__}: {e}"
-
     # GUPS random-access over the chip's HBM (BASELINE.md config 4);
     # measures both the scatter and bincount lowerings, keeps the best.
     if budgeted("gups", 120):
@@ -584,6 +571,22 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
     # GB-scale sweep over a blocked (>2 GiB) arena (VERDICT r2 item 5).
     if budgeted("gb_sweep", 180):
         out["detail"]["gb_sweep"] = bench_gb_sweep(errors)
+
+    # Paged-KV decode tokens/s (BASELINE.md config 5): the application-level
+    # number — KV pages ride the OCM data plane out and back per page.
+    # Runs LAST: its fused mode leaves the chip in a state where per-step
+    # dispatch in other executables loses 2-3x throughput (see
+    # kv_decode.run_bench), which would deflate any benchmark after it.
+    if budgeted("kv_decode", 240):
+        try:
+            from oncilla_tpu.benchmarks.kv_decode import run_bench
+
+            kv = run_bench(tokens_n=256, page_tokens=128)
+            out["detail"]["kv_decode_tok_s"] = kv["tok_s"]
+            if "paging_overhead" in kv:
+                out["detail"]["kv_paging_overhead"] = kv["paging_overhead"]
+        except Exception as e:  # noqa: BLE001
+            errors["kv_decode"] = f"{type(e).__name__}: {e}"
 
 
 def bench_gb_sweep(errors: dict) -> dict:

@@ -314,3 +314,26 @@ def make_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
     dt = jnp.dtype(dtype or cfg.dtype)
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, cfg.max_seq, cfg.head_dim)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def decode_loop(params, tokens: jax.Array, kv_cache: tuple, cfg: LlamaConfig):
+    """Whole-sequence decode as ONE compiled program: ``lax.scan`` over the
+    token positions with the KV cache threaded (and donated) through the
+    carry — the static-control-flow formulation XLA wants, and the true
+    single-chip decode ceiling (the per-step :func:`decode_step` loop pays
+    one host dispatch per token; this pays one per sequence).
+
+    tokens: (B, N) teacher-forced ids, N ≤ cfg.max_seq. Returns
+    (logits (B, N, vocab), final kv_cache). jit with
+    ``static_argnames=("cfg",)`` and ``donate_argnums=(2,)``.
+    """
+
+    def body(carry, tok):
+        kv, pos = carry
+        logits, kv = decode_step(params, tok, pos, kv, cfg)
+        return (kv, pos + 1), logits
+
+    (kv_cache, _), logits = jax.lax.scan(
+        body, (kv_cache, jnp.int32(0)), tokens.T
+    )
+    return logits.transpose(1, 0, 2), kv_cache

@@ -107,3 +107,23 @@ def test_init_params_host_matches_pytree():
     sa = jax.tree.map(lambda x: (x.shape, str(x.dtype)), a)
     sb = jax.tree.map(lambda x: (x.shape, str(x.dtype)), b)
     assert sa == sb
+
+
+def test_decode_loop_matches_forward(rng):
+    """The single-dispatch scan decode (llama.decode_loop) reproduces the
+    teacher-forced logits — same contract as the per-step decode."""
+    params = llama.init_params(jax.random.key(4), CFG)
+    tokens = train.sample_batch(rng, CFG, 2, 16)
+    full = llama.forward(params, tokens, CFG)  # (2, 16, V)
+
+    kv = llama.make_kv_cache(CFG, 2, dtype="float32")
+    loop = jax.jit(
+        lambda p, t, kv: llama.decode_loop(p, t, kv, CFG)
+    )
+    logits, kv_out = loop(params, tokens, kv)
+    assert logits.shape == full.shape
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full), atol=2e-3, rtol=2e-3
+    )
+    # The final cache holds every position's K/V (non-zero through pos 15).
+    assert float(jnp.abs(kv_out[0][:, :, :, 15, :]).max()) > 0.0
