@@ -605,10 +605,12 @@ def bench_gb_sweep(errors: dict) -> dict:
         )
         ctx = ocm.ocm_init(cfg)
         points = []
-        # Fewer iterations at GB sizes to bound wall time.
+        # Fewer iterations at GB sizes to bound wall time (the write leg
+        # runs ~0.03 GB/s over the tunneled host link, so every GB-size
+        # iteration costs tens of seconds).
         for lo, hi, iters in (
             (1 << 10, 64 << 20, 4),
-            (128 << 20, 1 << 30, 2),
+            (128 << 20, 1 << 30, 1),
         ):
             res = size_sweep(
                 ctx, OcmKind.LOCAL_DEVICE, min_bytes=lo, max_bytes=hi,

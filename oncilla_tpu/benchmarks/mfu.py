@@ -84,7 +84,10 @@ def mfu_forward(
 
     if cfg is None:
         cfg, batch, seq = chip_filling_config()
-    params = llama.init_params(jax.random.key(0), cfg)
+    # Host-side init: the jax.random path compiles one kernel per weight
+    # shape (~1 min of wall time on a tunneled chip) and the exact init
+    # values are irrelevant to a FLOP/s measurement.
+    params = llama.init_params_host(0, cfg)
     tokens = jax.device_put(
         np.random.default_rng(0).integers(0, cfg.vocab, (batch, seq),
                                           dtype=np.int32)
@@ -123,7 +126,9 @@ def mfu_train(
     if cfg is None:
         cfg, batch, seq = train_sized_config()
     mesh = train.make_mesh(1)
-    params, opt_state, tx = train.make_train_state(jax.random.key(0), cfg, mesh)
+    # Host-side init (same rationale as mfu_forward); the optimizer is the
+    # production one from train.py, so this measures the real train step.
+    params, opt_state, tx = train.make_train_state_host(0, cfg, mesh)
     step = train.make_train_step(cfg, mesh, tx, use_ring=False)
     rng = np.random.default_rng(0)
     tokens = jax.device_put(

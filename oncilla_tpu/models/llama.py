@@ -337,3 +337,57 @@ def decode_loop(params, tokens: jax.Array, kv_cache: tuple, cfg: LlamaConfig):
         body, (kv_cache, jnp.int32(0)), tokens.T
     )
     return logits.transpose(1, 0, 2), kv_cache
+
+
+def generate(
+    params,
+    prompt: jax.Array,
+    kv_cache: tuple,
+    cfg: LlamaConfig,
+    steps: int,
+    *,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+):
+    """Autoregressive continuation as ONE compiled program: teacher-forced
+    prefill over the prompt (scan), then ``steps`` sampled tokens (scan),
+    greedy when ``temperature`` == 0 else softmax sampling with ``key``.
+
+    prompt: (B, P) ids; P + steps ≤ cfg.max_seq. Returns ((B, steps)
+    sampled ids, final kv_cache) — the cache covers every *consumed*
+    token (prompt + the first steps-1 samples; the final sample is
+    output-only), so a caller can keep decoding from position
+    P + steps - 1, and the recommended jit config
+    ``static_argnames=("cfg", "steps", "temperature")`` +
+    ``donate_argnums=(2,)`` can reuse the donated cache buffers for the
+    output.
+    """
+    B, P = prompt.shape
+    logits, kv_cache = decode_loop(params, prompt, kv_cache, cfg)
+
+    if key is None:
+        key = jax.random.key(0)
+
+    def pick(logits_b, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits_b, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits_b / jnp.float32(temperature), axis=-1
+        ).astype(prompt.dtype)
+
+    first = pick(logits[:, -1], key)
+
+    def body(carry, k_i):
+        kv, pos, tok = carry
+        step_logits, kv = decode_step(params, tok, pos, kv, cfg)
+        nxt = pick(step_logits, k_i)
+        return (kv, pos + 1, nxt), tok
+
+    # first is sample 1; the scan produces the remaining steps-1, each tick
+    # feeding the previous sample and emitting it into `out`.
+    keys = jax.random.split(jax.random.fold_in(key, 1), steps - 1)
+    (kv_cache, _, last), out = jax.lax.scan(
+        body, (kv_cache, jnp.int32(P), first), keys
+    )
+    seq = jnp.concatenate([out, last[None]], axis=0)  # (steps, B)
+    return seq.transpose(1, 0), kv_cache

@@ -107,6 +107,19 @@ def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
     return _sharded_state(init_params(key, cfg), param_specs(cfg), mesh, lr)
 
 
+def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
+                          lr: float = 3e-4):
+    """Same state as :func:`make_train_state` but with numpy host-side
+    param init (init values differ; optimizer identical) — the jax.random
+    path compiles one kernel per weight shape, minutes of wall time on a
+    tunneled dev chip. Benchmarks use this."""
+    from oncilla_tpu.models.llama import init_params_host
+
+    return _sharded_state(
+        init_params_host(seed, cfg), param_specs(cfg), mesh, lr
+    )
+
+
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True):
     """The jitted full training step (forward + backward + adamw update),
     sharded over the (dp, tp, sp) mesh."""

@@ -127,3 +127,58 @@ def test_decode_loop_matches_forward(rng):
     )
     # The final cache holds every position's K/V (non-zero through pos 15).
     assert float(jnp.abs(kv_out[0][:, :, :, 15, :]).max()) > 0.0
+
+
+def test_generate_greedy_matches_stepwise(rng):
+    """generate() (prefill scan + sample scan, one program) reproduces the
+    hand-rolled greedy loop over decode_step."""
+    params = llama.init_params(jax.random.key(5), CFG)
+    prompt = train.sample_batch(rng, CFG, 2, 8)
+    steps = 6
+
+    # Hand-rolled greedy reference.
+    kv = llama.make_kv_cache(CFG, 2, dtype="float32")
+    logits = None
+    for i in range(8):
+        logits, kv = llama.decode_step(params, prompt[:, i], jnp.int32(i), kv, CFG)
+    want = []
+    tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    for j in range(steps):
+        want.append(tok)
+        if j < steps - 1:
+            logits, kv = llama.decode_step(
+                params, tok, jnp.int32(8 + j), kv, CFG
+            )
+            tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    want = jnp.stack(want, axis=1)  # (B, steps)
+
+    kv2 = llama.make_kv_cache(CFG, 2, dtype="float32")
+    got, kv_out = jax.jit(
+        llama.generate,
+        static_argnames=("cfg", "steps", "temperature"),
+        donate_argnums=(2,),
+    )(params, prompt, kv2, CFG, steps)
+    assert got.shape == (2, steps)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # The returned cache covers every consumed token: prompt + the first
+    # steps-1 samples (the final sample is output-only).
+    assert float(jnp.abs(kv_out[0][:, :, :, 8 + steps - 2, :]).max()) > 0.0
+    assert float(jnp.abs(kv_out[0][:, :, :, 8 + steps - 1, :]).max()) == 0.0
+
+
+def test_generate_temperature_sampling_valid(rng):
+    """Temperature sampling returns in-vocab ids and is deterministic for
+    a fixed key."""
+    params = llama.init_params(jax.random.key(6), CFG)
+    prompt = train.sample_batch(rng, CFG, 1, 4)
+    kv = llama.make_kv_cache(CFG, 1, dtype="float32")
+    a, _ = llama.generate(
+        params, prompt, kv, CFG, 5, key=jax.random.key(7), temperature=1.0
+    )
+    kv = llama.make_kv_cache(CFG, 1, dtype="float32")
+    b, _ = llama.generate(
+        params, prompt, kv, CFG, 5, key=jax.random.key(7), temperature=1.0
+    )
+    assert a.shape == (1, 5)
+    assert np.all((np.asarray(a) >= 0) & (np.asarray(a) < CFG.vocab))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
