@@ -245,16 +245,25 @@ def forward(
     *,
     mesh=None,
     seq_axis: str | None = None,
+    remat: bool = False,
 ) -> jax.Array:
     """Logits for a token batch (B, S). With ``mesh`` + ``seq_axis``,
-    attention runs as ring attention over the sequence-sharded axis."""
+    attention runs as ring attention over the sequence-sharded axis. With
+    ``remat``, each block is wrapped in ``jax.checkpoint`` so the backward
+    pass recomputes block activations instead of storing them — the
+    FLOPs-for-HBM trade that makes long-context training fit."""
     B, S = tokens.shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     positions = jnp.arange(S)
     attend = make_attend(S, mesh, seq_axis)
 
+    def one_block(x, lp):
+        return block(cfg, x, lp, positions, attend)
+
+    if remat:
+        one_block = jax.checkpoint(one_block)
     for i in range(cfg.n_layers):
-        x = block(cfg, x, layer_params(params, i), positions, attend)
+        x = one_block(x, layer_params(params, i))
     return final_logits(params, x, cfg)
 
 

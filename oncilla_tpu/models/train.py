@@ -120,12 +120,16 @@ def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
     )
 
 
-def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True):
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
+                    remat: bool = False):
     """The jitted full training step (forward + backward + adamw update),
-    sharded over the (dp, tp, sp) mesh."""
+    sharded over the (dp, tp, sp) mesh. ``remat`` checkpoints each block
+    (recompute-in-backward) to fit longer sequences / bigger batches."""
     seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
     return _jit_step(
-        lambda p, tokens: loss_fn(p, tokens, cfg, mesh=mesh, seq_axis=seq_axis),
+        lambda p, tokens: loss_fn(
+            p, tokens, cfg, mesh=mesh, seq_axis=seq_axis, remat=remat
+        ),
         param_specs(cfg), mesh, data_spec(), tx,
     )
 

@@ -114,3 +114,31 @@ def test_train_resume_equivalence(ctx, rng):
         p2, o2, loss2 = step(p2, o2, tokens)
     assert float(loss2) == pytest.approx(loss_a, rel=1e-6)
     ctx.free(h)
+
+
+def test_checkpoint_to_remote_host(rng):
+    """Checkpoint into a REMOTE node's DRAM through the live control plane
+    (daemon placement + chunked DCN puts/gets) and restore it — the
+    disaggregated-memory version of a training checkpoint."""
+    from oncilla_tpu.runtime.cluster import local_cluster
+
+    cfg = ocm.OcmConfig(
+        host_arena_bytes=8 << 20, device_arena_bytes=1 << 20,
+        chunk_bytes=64 << 10, heartbeat_s=0.2, lease_s=30.0,
+    )
+    tree = {
+        "w": jnp.asarray(rng.standard_normal((128, 64)), jnp.bfloat16),
+        "opt": {"mu": jnp.asarray(rng.standard_normal((128, 64)), jnp.float32),
+                "count": jnp.int32(11)},
+    }
+    with local_cluster(2, config=cfg) as c:
+        ctx2 = c.context(0)
+        h = ckpt.save(ctx2, tree, OcmKind.REMOTE_HOST)
+        assert h.is_remote and h.rank == 1  # physically on the other node
+        back = ckpt.load(ctx2, h, like=tree)
+        np.testing.assert_array_equal(back["w"], np.asarray(tree["w"]))
+        np.testing.assert_array_equal(
+            back["opt"]["mu"], np.asarray(tree["opt"]["mu"])
+        )
+        assert int(back["opt"]["count"]) == 11
+        ctx2.free(h)

@@ -182,3 +182,25 @@ def test_generate_temperature_sampling_valid(rng):
     assert a.shape == (1, 5)
     assert np.all((np.asarray(a) >= 0) & (np.asarray(a) < CFG.vocab))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_remat_train_step_matches_plain(rng):
+    """remat=True (jax.checkpoint per block) must not change the math —
+    same loss trajectory as the plain step from the same init."""
+    mesh = train.make_mesh(8)
+    tokens = jax.device_put(
+        train.sample_batch(rng, CFG, 4, 32),
+        jax.sharding.NamedSharding(mesh, train.data_spec()),
+    )
+    losses = {}
+    for remat in (False, True):
+        params, opt_state, tx = train.make_train_state(
+            jax.random.key(9), CFG, mesh, lr=1e-2
+        )
+        step = train.make_train_step(CFG, mesh, tx, remat=remat)
+        ls = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            ls.append(float(loss))
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
