@@ -100,6 +100,8 @@ def paged_decode_step(
     k_ctx: jax.Array | None,
     v_ctx: jax.Array | None,
     cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
 ):
     """Decode one token attending over the full valid context.
 
@@ -109,10 +111,14 @@ def paged_decode_step(
     are this token's (L, B, KV, 1, Hd) cache entries.
 
     Reuses :func:`llama.block` — one transformer-block implementation for
-    training, cached decode, and paged decode.
+    training, cached decode, and paged decode. ``layer_params_fn``/
+    ``mlp_of`` are the family hooks (see ``llama.decode_step``): the MoE
+    family passes its slicer + expert-FFN factory and pages its KV the
+    same way.
     """
     from oncilla_tpu.models import llama
 
+    lp_fn = layer_params_fn or llama.layer_params
     x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
     positions = jnp.asarray([pos])
     new_k, new_v = [], []
@@ -132,13 +138,15 @@ def paged_decode_step(
                 k_all, v_all = kn.astype(q.dtype), vn.astype(q.dtype)
             return llama.grouped_attention(q, k_all, v_all)
 
-        x = llama.block(cfg, x, llama.layer_params(params, i), positions, attend)
+        lp = lp_fn(params, i)
+        x = llama.block(cfg, x, lp, positions, attend,
+                        mlp=mlp_of(lp) if mlp_of else None)
 
     logits = llama.final_logits(params, x, cfg)
     return logits[:, 0], (jnp.stack(new_k), jnp.stack(new_v))
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "layer_params_fn", "mlp_of"))
 def paged_decode_step_jit(
     params: dict,
     token: jax.Array,      # (B,) current token ids
@@ -149,6 +157,8 @@ def paged_decode_step_jit(
     tail_v: jax.Array,
     tail_len: jax.Array,   # scalar: valid tail entries before this step
     cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
 ):
     """Shape-bucketed jitted paged decode.
 
@@ -161,10 +171,12 @@ def paged_decode_step_jit(
     usable as a real-chip benchmark (BASELINE.md config 5).
 
     Returns (logits, new_tail_k, new_tail_v); the caller owns tail_len
-    bookkeeping and page shipping.
+    bookkeeping and page shipping. ``layer_params_fn``/``mlp_of`` are the
+    family hooks (static under jit) — see :func:`paged_decode_step`.
     """
     from oncilla_tpu.models import llama
 
+    lp_fn = layer_params_fn or llama.layer_params
     x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
     positions = pos[None] if pos.ndim == 0 else pos
     P = tail_k.shape[3]
@@ -194,7 +206,9 @@ def paged_decode_step_jit(
             )
             return llama.grouped_attention(q, k_all, v_all, valid)
 
-        x = llama.block(cfg, x, llama.layer_params(params, i), positions, attend)
+        lp = lp_fn(params, i)
+        x = llama.block(cfg, x, lp, positions, attend,
+                        mlp=mlp_of(lp) if mlp_of else None)
         tail_k = tail_k.at[i].set(state["tk"])
         tail_v = tail_v.at[i].set(state["tv"])
 
@@ -220,6 +234,8 @@ class BucketedPagedDecoder:
         kind: OcmKind = OcmKind.REMOTE_DEVICE,
         dtype: str = "float32",
         refetch: bool = False,
+        layer_params_fn=None,
+        mlp_of=None,
     ):
         """``refetch=True`` re-reads the *whole* paged context through the
         OCM data plane (one-sided gets) at every page boundary instead of
@@ -231,6 +247,7 @@ class BucketedPagedDecoder:
         self.cache = PagedKVCache(backend, cfg, batch, page_tokens, kind, dtype)
         self.page_tokens = page_tokens
         self.refetch = refetch
+        self._hooks = dict(layer_params_fn=layer_params_fn, mlp_of=mlp_of)
         self.pos = 0
         shape = (cfg.n_layers, batch, cfg.n_kv_heads, page_tokens, cfg.head_dim)
         dt = jnp.dtype(cfg.dtype)
@@ -246,6 +263,7 @@ class BucketedPagedDecoder:
             self.params, token, jnp.int32(self.pos),
             self._fetched[0], self._fetched[1],
             self._tail_k, self._tail_v, jnp.int32(self._tail_len), self.cfg,
+            **self._hooks,
         )
         self.pos += 1
         self._tail_len += 1
@@ -295,6 +313,8 @@ class PagedDecoder:
         page_tokens: int = 16,
         kind: OcmKind = OcmKind.REMOTE_DEVICE,
         dtype: str = "float32",
+        layer_params_fn=None,
+        mlp_of=None,
     ):
         self.params = params
         self.cfg = cfg
@@ -302,6 +322,7 @@ class PagedDecoder:
             backend, cfg, batch, page_tokens, kind, dtype
         )
         self.page_tokens = page_tokens
+        self._hooks = dict(layer_params_fn=layer_params_fn, mlp_of=mlp_of)
         self.pos = 0
         self._tail_k: list = []  # per-step (L, B, KV, 1, Hd)
         self._tail_v: list = []
@@ -328,7 +349,8 @@ class PagedDecoder:
     def step(self, token: jax.Array) -> jax.Array:
         k_ctx, v_ctx = self._context()
         logits, (nk, nv) = paged_decode_step(
-            self.params, token, self.pos, k_ctx, v_ctx, self.cfg
+            self.params, token, self.pos, k_ctx, v_ctx, self.cfg,
+            **self._hooks,
         )
         self._tail_k.append(nk)
         self._tail_v.append(nv)

@@ -286,9 +286,17 @@ def decode_step(
     pos: jax.Array,           # scalar current position
     kv_cache: tuple,          # (k, v) each (L, B, KV, max_seq, Hd)
     cfg: LlamaConfig,
+    *,
+    layer_params_fn=layer_params,
+    mlp_of=None,
 ):
     """Single-token decode: returns (logits, new_kv_cache). The cache layout
-    is the one :mod:`oncilla_tpu.models.kv_paging` pages through OCM."""
+    is the one :mod:`oncilla_tpu.models.kv_paging` pages through OCM.
+
+    ``layer_params_fn`` / ``mlp_of`` are the family hooks: the MoE family
+    passes its layer-slicer and an ``mlp_of(lp) -> mlp`` factory so the
+    same cache machinery decodes a sparse-FFN model
+    (:func:`oncilla_tpu.models.moe.decode_step`)."""
     x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))  # (B,1,D)
     k_cache, v_cache = kv_cache
     positions = pos[None] if pos.ndim == 0 else pos
@@ -296,7 +304,7 @@ def decode_step(
     valid = (jnp.arange(T)[None, :] <= pos)  # (1, T)
 
     for i in range(cfg.n_layers):
-        lp = layer_params(params, i)
+        lp = layer_params_fn(params, i)
         state = {}
 
         def attend(q, kn, vn, i=i, state=state):
@@ -311,7 +319,8 @@ def decode_step(
                 q, kc.astype(q.dtype), vc.astype(q.dtype), valid
             )
 
-        x = block(cfg, x, lp, positions, attend)
+        x = block(cfg, x, lp, positions, attend,
+                  mlp=mlp_of(lp) if mlp_of else None)
         k_cache = k_cache.at[i].set(state["kc"])
         v_cache = v_cache.at[i].set(state["vc"])
 
@@ -325,7 +334,8 @@ def make_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
-def decode_loop(params, tokens: jax.Array, kv_cache: tuple, cfg: LlamaConfig):
+def decode_loop(params, tokens: jax.Array, kv_cache: tuple, cfg: LlamaConfig,
+                *, step_fn=None):
     """Whole-sequence decode as ONE compiled program: ``lax.scan`` over the
     token positions with the KV cache threaded (and donated) through the
     carry — the static-control-flow formulation XLA wants, and the true
@@ -334,12 +344,14 @@ def decode_loop(params, tokens: jax.Array, kv_cache: tuple, cfg: LlamaConfig):
 
     tokens: (B, N) teacher-forced ids, N ≤ cfg.max_seq. Returns
     (logits (B, N, vocab), final kv_cache). jit with
-    ``static_argnames=("cfg",)`` and ``donate_argnums=(2,)``.
+    ``static_argnames=("cfg",)`` and ``donate_argnums=(2,)``. ``step_fn``
+    swaps in another family's decode step (e.g. the MoE one).
     """
+    step_fn = step_fn or decode_step
 
     def body(carry, tok):
         kv, pos = carry
-        logits, kv = decode_step(params, tok, pos, kv, cfg)
+        logits, kv = step_fn(params, tok, pos, kv, cfg)
         return (kv, pos + 1), logits
 
     (kv_cache, _), logits = jax.lax.scan(
@@ -357,10 +369,12 @@ def generate(
     *,
     key: jax.Array | None = None,
     temperature: float = 0.0,
+    step_fn=None,
 ):
     """Autoregressive continuation as ONE compiled program: teacher-forced
     prefill over the prompt (scan), then ``steps`` sampled tokens (scan),
     greedy when ``temperature`` == 0 else softmax sampling with ``key``.
+    ``step_fn`` swaps in another family's decode step (e.g. the MoE one).
 
     prompt: (B, P) ids; P + steps ≤ cfg.max_seq. Returns ((B, steps)
     sampled ids, final kv_cache) — the cache covers every *consumed*
@@ -372,7 +386,9 @@ def generate(
     output.
     """
     B, P = prompt.shape
-    logits, kv_cache = decode_loop(params, prompt, kv_cache, cfg)
+    step_fn = step_fn or decode_step
+    logits, kv_cache = decode_loop(params, prompt, kv_cache, cfg,
+                                   step_fn=step_fn)
 
     if key is None:
         key = jax.random.key(0)
@@ -388,7 +404,7 @@ def generate(
 
     def body(carry, k_i):
         kv, pos, tok = carry
-        step_logits, kv = decode_step(params, tok, pos, kv, cfg)
+        step_logits, kv = step_fn(params, tok, pos, kv, cfg)
         nxt = pick(step_logits, k_i)
         return (kv, pos + 1, nxt), tok
 

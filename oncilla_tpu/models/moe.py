@@ -26,6 +26,7 @@ runtime and the parallelism surface (dp/tp/sp/ep here, pp in
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 
@@ -235,3 +236,78 @@ def loss_fn(params, tokens, cfg: MoeConfig, **kw) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll) + cfg.router_aux_weight * aux
+
+
+# -- decode (same KV-cache machinery as the dense family) ------------------
+
+
+@functools.lru_cache(maxsize=64)
+def mlp_of(cfg: MoeConfig, mesh=None, ep_axis: str | None = None):
+    """``mlp_of(lp) -> mlp`` family hook for the dense decode/paging
+    machinery (``llama.decode_step``, ``kv_paging.paged_decode_step*``).
+    With ``mesh`` + ``ep_axis`` the expert batch is sharding-constrained
+    so decode dispatch/combine also ride the ep all-to-all.
+
+    Memoized on (cfg, mesh, ep_axis): the paged jit step declares the
+    hook STATIC (identity-hashed), so equal configs must share one
+    callable or every decoder instance would retrace and recompile all
+    its shape buckets."""
+
+    def of(lp):
+        def mlp(hn):
+            return moe_ffn(hn, lp, cfg, mesh=mesh, ep_axis=ep_axis)[0]
+
+        return mlp
+
+    return of
+
+
+def paged_hooks(cfg: MoeConfig, mesh=None, ep_axis: str | None = None) -> dict:
+    """kwargs for the paged decoders
+    (:class:`oncilla_tpu.models.kv_paging.BucketedPagedDecoder` /
+    ``PagedDecoder``) so MoE KV history pages through OCM like the dense
+    family's: ``BucketedPagedDecoder(params, cfg, ctx,
+    **moe.paged_hooks(cfg))``."""
+    return dict(
+        layer_params_fn=moe_layer_params,
+        mlp_of=mlp_of(cfg, mesh, ep_axis),
+    )
+
+
+def decode_step(params, token, pos, kv_cache, cfg: MoeConfig,
+                *, mesh=None, ep_axis: str | None = None):
+    """Single-token MoE decode: the dense family's cache machinery
+    (:func:`oncilla_tpu.models.llama.decode_step`) with the expert FFN
+    plugged in per layer. The (L, B, KV, max_seq, Hd) cache layout is the
+    dense one, and the paged decoders accept the same hooks
+    (:func:`paged_hooks`), so OCM KV paging applies to this family too.
+    ``mesh``/``ep_axis`` opt decode into expert-parallel dispatch.
+
+    Routing note: at decode T = B tokens route per step, so per-expert
+    capacity rarely binds — a token that would have been capacity-dropped
+    during teacher-forced prefill (where all B·S tokens compete) keeps
+    its expert here. Decode logits therefore match the teacher-forced
+    forward exactly only when capacity is ample (no drops); under drops
+    the two are legitimately different computations."""
+    from oncilla_tpu.models import llama
+
+    return llama.decode_step(
+        params, token, pos, kv_cache, cfg,
+        layer_params_fn=moe_layer_params,
+        mlp_of=mlp_of(cfg, mesh, ep_axis),
+    )
+
+
+def generate(params, prompt, kv_cache, cfg: MoeConfig, steps: int,
+             *, mesh=None, ep_axis: str | None = None, **kw):
+    """MoE autoregressive continuation — the dense family's compiled
+    prefill+sample program with the MoE decode step. ``mesh``/``ep_axis``
+    opt the decode FFNs into expert-parallel dispatch."""
+    from functools import partial
+
+    from oncilla_tpu.models import llama
+
+    return llama.generate(
+        params, prompt, kv_cache, cfg, steps,
+        step_fn=partial(decode_step, mesh=mesh, ep_axis=ep_axis), **kw
+    )
