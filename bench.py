@@ -593,9 +593,12 @@ def bench_gb_sweep(errors: dict) -> dict:
     """BASELINE.md config-3 shape on the hardware available: a 1 KB -> 1 GB
     size-doubling write/read sweep over a > 2 GiB device arena (blocked
     addressing, core/hbm.py), matching the reference's GB-scale regions
-    (/root/reference/test/ocm_test.c:329-330, test/ib_client.c:85). Note the
-    put/get legs traverse the host link (the app-side view, protocol
-    included); the DMA-engine figure is the headline pallas number."""
+    (/root/reference/test/ocm_test.c:329-330, test/ib_client.c:85). Leg
+    semantics (see benchmarks/sweep.py): the write leg stages host bytes
+    over the (tunnel-bound) host link; the read leg is the on-device
+    extent read into the app's device-resident buffer — hence the strong
+    write/read asymmetry. The DMA-engine figure is the headline pallas
+    number."""
     try:
         from oncilla_tpu.benchmarks.sweep import size_sweep
 

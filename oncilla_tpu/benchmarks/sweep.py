@@ -62,7 +62,16 @@ def size_sweep(
     device_index: int = 0,
 ) -> SweepResult:
     """Alloc one ``max_bytes`` region of ``kind``; per size, a write pass then
-    a read pass of ``iters`` one-sided ops each (ocm_test.c:362-402 shape)."""
+    a read pass of ``iters`` one-sided ops each (ocm_test.c:362-402 shape).
+
+    Leg semantics for LOCAL_DEVICE: the write leg stages host bytes into
+    the arena extent (host→device link on the path, tunnel-bound on a dev
+    chip), while the read leg lands in the app-side buffer — which for a
+    TPU-native consumer is a device-resident ``jax.Array``, so it measures
+    the on-device extent read, NOT a device→host transfer. The legs are
+    deliberately asymmetric because the app's buffers live on opposite
+    sides of the link; expect write ≪ read on a tunneled dev setup.
+    """
     h = ctx.alloc(max_bytes, kind, device_index=device_index) \
         if kind == OcmKind.LOCAL_DEVICE else ctx.alloc(max_bytes, kind)
     res = SweepResult(label=f"size_sweep:{kind.name}")
