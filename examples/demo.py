@@ -23,11 +23,10 @@ import jax.numpy as jnp
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     # A sitecustomize in some images imports jax AND initializes a backend
     # before this script runs; force the CPU platform with 8 virtual
-    # devices so the sharded sections demo a real mesh (the recipe of
-    # __graft_entry__._ensure_virtual_devices: if the config update is
-    # rejected because a backend already exists, drop the cached backends
-    # and re-apply — the next jax.devices() re-initializes under the new
-    # config).
+    # devices so the sharded sections demo a real mesh. If the config
+    # update is rejected because a backend already exists, drop the cached
+    # backends and re-apply — the next jax.devices() re-initializes under
+    # the new config.
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.config.update("jax_num_cpu_devices", 8)

@@ -87,6 +87,18 @@ class PagedKVCache:
                 vs.append(packed[1])
         return jnp.concatenate(ks, axis=3), jnp.concatenate(vs, axis=3)
 
+    def drop_oldest(self) -> None:
+        """Free the oldest page (sliding-window eviction).
+
+        The caller MUST track the global position of the first retained
+        page and feed it to the decode step (``ctx_start`` in
+        :func:`paged_decode_step_jit`, as :class:`BucketedPagedDecoder`
+        does) — after an eviction, retained pages no longer start at
+        absolute position 0, and a decoder that assumes they do
+        (:class:`PagedDecoder` / :func:`paged_decode_step`) would
+        attribute wrong positions to every key."""
+        self.backend.free(self.pages.pop(0))
+
     def free(self) -> None:
         for h in self.pages:
             self.backend.free(h)
@@ -136,7 +148,11 @@ def paged_decode_step(
                 )
             else:
                 k_all, v_all = kn.astype(q.dtype), vn.astype(q.dtype)
-            return llama.grouped_attention(q, k_all, v_all)
+            mask = None
+            if cfg.window is not None:
+                # Keys are laid out by absolute position 0..pos.
+                mask = (jnp.arange(k_all.shape[2]) > pos - cfg.window)[None, :]
+            return llama.grouped_attention(q, k_all, v_all, mask)
 
         lp = lp_fn(params, i)
         x = llama.block(cfg, x, lp, positions, attend,
@@ -159,6 +175,7 @@ def paged_decode_step_jit(
     cfg: LlamaConfig,
     layer_params_fn=None,
     mlp_of=None,
+    ctx_start: jax.Array | int = 0,  # global position of k_ctx[..., 0, :]
 ):
     """Shape-bucketed jitted paged decode.
 
@@ -186,6 +203,14 @@ def paged_decode_step_jit(
     valid = jnp.concatenate(
         [jnp.ones((C,), bool), jnp.arange(P) <= tail_len]
     )[None, :]
+    if cfg.window is not None:
+        # Global key positions: paged context starts at ctx_start (pages
+        # before it may have been evicted), tail slot j holds position
+        # pos - tail_len + j; band-limit to the query's last `window`.
+        gk = jnp.concatenate(
+            [ctx_start + jnp.arange(C), (pos - tail_len) + jnp.arange(P)]
+        )
+        valid &= (gk > pos - cfg.window)[None, :]
 
     for i in range(cfg.n_layers):
         state = {}
@@ -249,6 +274,7 @@ class BucketedPagedDecoder:
         self.refetch = refetch
         self._hooks = dict(layer_params_fn=layer_params_fn, mlp_of=mlp_of)
         self.pos = 0
+        self._ctx_start = 0  # global position of the first retained page
         shape = (cfg.n_layers, batch, cfg.n_kv_heads, page_tokens, cfg.head_dim)
         dt = jnp.dtype(cfg.dtype)
         self._tail_k = jnp.zeros(shape, dt)
@@ -263,6 +289,7 @@ class BucketedPagedDecoder:
             self.params, token, jnp.int32(self.pos),
             self._fetched[0], self._fetched[1],
             self._tail_k, self._tail_v, jnp.int32(self._tail_len), self.cfg,
+            ctx_start=jnp.int32(self._ctx_start),
             **self._hooks,
         )
         self.pos += 1
@@ -274,6 +301,21 @@ class BucketedPagedDecoder:
             v_page = self._tail_v.astype(jnp.dtype(self.cache.dtype))
             self.cache.store_page(k_page, v_page)
             dt = jnp.dtype(self.cfg.dtype)
+            # Sliding-window eviction: a page whose every key is outside
+            # the window of all future queries (>= self.pos) is freed from
+            # OCM and dropped from the local concat, keeping the working
+            # set O(window) instead of O(pos) — the rolling-buffer
+            # semantics of the Mistral scheme, on paged storage.
+            if self.cfg.window is not None:
+                while (self.cache.pages and self._ctx_start
+                       + self.page_tokens <= self.pos - self.cfg.window):
+                    self.cache.drop_oldest()
+                    self._ctx_start += self.page_tokens
+                    if not self.refetch:
+                        self._fetched = (
+                            self._fetched[0][:, :, :, self.page_tokens:],
+                            self._fetched[1][:, :, :, self.page_tokens:],
+                        )
             if self.refetch:
                 fk, fv = self.cache.fetch_pages()
                 self._fetched = (fk.astype(dt), fv.astype(dt))

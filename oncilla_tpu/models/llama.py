@@ -39,6 +39,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # Sliding-window attention (Mistral scheme): each token attends to at
+    # most its last `window` positions. None = full causal attention.
+    window: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -58,6 +61,15 @@ class LlamaConfig:
         return LlamaConfig(
             vocab=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
             ffn_hidden=14336, max_seq=8192, rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def mistral_7b() -> "LlamaConfig":
+        """Mistral-7B v0.1 geometry — the sliding-window flagship shape
+        (v0.2 dropped the window and raised rope_theta)."""
+        return LlamaConfig(
+            vocab=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_hidden=14336, max_seq=8192, rope_theta=10000.0, window=4096,
         )
 
 
@@ -179,10 +191,16 @@ def grouped_attention(q, k, v, mask=None):
     return o.reshape(B, H, Sq, D).astype(q.dtype)
 
 
-def causal_mask(sq: int, sk: int) -> jax.Array:
+def causal_mask(sq: int, sk: int, window: int | None = None) -> jax.Array:
     """Lower-triangular mask aligned to the *end* of the key axis (the self-
-    attention case where the last sq keys are the queries' own positions)."""
-    return jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+    attention case where the last sq keys are the queries' own positions).
+    With ``window``, additionally band-limits each query to its last
+    ``window`` keys (sliding-window attention, the Mistral long-context
+    scheme): key j attends to query i iff i-window < j-(sk-sq) ≤ i."""
+    m = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+    if window is not None:
+        m &= jnp.triu(jnp.ones((sq, sk), dtype=bool), k=sk - sq - window + 1)
+    return m
 
 
 def block(cfg: LlamaConfig, x, lp, positions, attend, mlp=None):
@@ -222,18 +240,28 @@ def final_logits(params, x, cfg: LlamaConfig) -> jax.Array:
     return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
-def make_attend(S: int, mesh=None, seq_axis: str | None = None):
+def make_attend(S: int, mesh=None, seq_axis: str | None = None,
+                window: int | None = None):
     """The dense-vs-ring attention dispatch shared by every model family:
     with ``mesh`` + ``seq_axis`` the callback runs ring attention over the
-    sequence-sharded axis, else causal dense attention over S keys."""
+    sequence-sharded axis, else causal dense attention over S keys.
+    ``window`` band-limits the dense path (sliding-window attention); the
+    ring path does not support it (a window shorter than the sequence
+    makes whole ring steps no-ops — use the dense path, which a window
+    already makes memory-feasible at long S)."""
     if seq_axis is not None:
+        if window is not None:
+            raise NotImplementedError(
+                "sliding-window attention is not supported on the ring "
+                "(sp) path; use the dense path"
+            )
         from oncilla_tpu.parallel.ring_attention import ring_attention
 
         def attend(q, kn, vn):
             return ring_attention(q, kn, vn, mesh, axis_name=seq_axis, causal=True)
     else:
         def attend(q, kn, vn):
-            return grouped_attention(q, kn, vn, causal_mask(S, S))
+            return grouped_attention(q, kn, vn, causal_mask(S, S, window))
 
     return attend
 
@@ -255,7 +283,7 @@ def forward(
     B, S = tokens.shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     positions = jnp.arange(S)
-    attend = make_attend(S, mesh, seq_axis)
+    attend = make_attend(S, mesh, seq_axis, window=cfg.window)
 
     def one_block(x, lp):
         return block(cfg, x, lp, positions, attend)
@@ -302,6 +330,8 @@ def decode_step(
     positions = pos[None] if pos.ndim == 0 else pos
     T = k_cache.shape[3]
     valid = (jnp.arange(T)[None, :] <= pos)  # (1, T)
+    if cfg.window is not None:
+        valid &= jnp.arange(T)[None, :] > pos - cfg.window
 
     for i in range(cfg.n_layers):
         lp = layer_params_fn(params, i)

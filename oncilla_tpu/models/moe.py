@@ -211,7 +211,7 @@ def forward(
     B, S = tokens.shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     positions = jnp.arange(S)
-    attend = make_attend(S, mesh, seq_axis)
+    attend = make_attend(S, mesh, seq_axis, window=cfg.window)
 
     aux_total = jnp.float32(0.0)
     for i in range(cfg.n_layers):

@@ -237,7 +237,7 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2):
     def stage_fn(stage_params, x):
         S = x.shape[1]
         positions = jnp.arange(S)
-        attend = make_attend(S)
+        attend = make_attend(S, window=cfg.window)
 
         def body(xc, lp):
             return block(cfg, xc, lp, positions, attend), None

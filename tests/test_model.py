@@ -204,3 +204,143 @@ def test_remat_train_step_matches_plain(rng):
             ls.append(float(loss))
         losses[remat] = ls
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+
+def test_sliding_window_masks_history(rng):
+    """Windowed forward: logits differ from full-causal once S > window,
+    and match a hand-built band mask exactly."""
+    from dataclasses import replace
+
+    cfg_w = replace(CFG, window=4)
+    params = llama.init_params(jax.random.key(11), CFG)
+    tokens = train.sample_batch(rng, CFG, 1, 12)
+    full = llama.forward(params, tokens, CFG)
+    windowed = llama.forward(params, tokens, cfg_w)
+    # Positions < window see identical context; later ones must differ.
+    np.testing.assert_allclose(
+        np.asarray(windowed[0, :4]), np.asarray(full[0, :4]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(windowed[0, -1]), np.asarray(full[0, -1]))
+    # The mask itself: band of width `window` under the diagonal.
+    m = np.asarray(llama.causal_mask(6, 6, window=3))
+    want = np.array([[j <= i and j > i - 3 for j in range(6)] for i in range(6)])
+    np.testing.assert_array_equal(m, want)
+
+
+def test_sliding_window_decode_matches_forward(rng):
+    """Windowed cached decode (and the scan decode) reproduce the windowed
+    teacher-forced logits."""
+    from dataclasses import replace
+
+    cfg_w = replace(CFG, window=4)
+    params = llama.init_params(jax.random.key(12), CFG)
+    tokens = train.sample_batch(rng, CFG, 1, 10)
+    full = llama.forward(params, tokens, cfg_w)
+
+    kv = llama.make_kv_cache(cfg_w, 1, dtype="float32")
+    for i in range(10):
+        logits, kv = llama.decode_step(
+            params, tokens[:, i], jnp.int32(i), kv, cfg_w
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(full[0, i]),
+            atol=2e-3, rtol=2e-3, err_msg=f"pos {i}",
+        )
+
+    kv = llama.make_kv_cache(cfg_w, 1, dtype="float32")
+    loop_logits, _ = llama.decode_loop(params, tokens, kv, cfg_w)
+    np.testing.assert_allclose(
+        np.asarray(loop_logits), np.asarray(full), atol=2e-3, rtol=2e-3
+    )
+
+
+def test_sliding_window_paged_decode(rng):
+    """Windowed decode with KV paged through OCM matches windowed cached
+    decode."""
+    from dataclasses import replace
+
+    import oncilla_tpu as ocm_pkg
+    from oncilla_tpu.models.kv_paging import BucketedPagedDecoder
+
+    cfg_w = replace(CFG, window=4, max_seq=32)
+    params = llama.init_params(jax.random.key(13), CFG)
+    tokens = train.sample_batch(rng, cfg_w, 1, 12)
+
+    kv = llama.make_kv_cache(cfg_w, 1, dtype="float32")
+    want = []
+    for i in range(12):
+        logits, kv = llama.decode_step(
+            params, tokens[:, i], jnp.int32(i), kv, cfg_w
+        )
+        want.append(np.asarray(logits[0]))
+
+    ctx = ocm_pkg.ocm_init(ocm_pkg.OcmConfig(
+        host_arena_bytes=16 << 20, device_arena_bytes=1 << 20,
+    ))
+    try:
+        dec = BucketedPagedDecoder(
+            params, cfg_w, ctx, batch=1, page_tokens=4,
+            kind=ocm_pkg.OcmKind.LOCAL_HOST, dtype="float32",
+        )
+        for i in range(12):
+            logits = dec.step(tokens[:, i])
+            np.testing.assert_allclose(
+                np.asarray(logits[0]), want[i], atol=2e-3, rtol=2e-3,
+                err_msg=f"pos {i}",
+            )
+        dec.close()
+    finally:
+        ctx.tini()
+
+
+def test_sliding_window_ring_raises():
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        llama.make_attend(32, mesh=object(), seq_axis="sp", window=4)
+
+
+def test_sliding_window_paged_eviction(rng):
+    """Long windowed paged decode: out-of-window pages are freed from OCM
+    (O(window) working set) and logits still match plain windowed decode."""
+    from dataclasses import replace
+
+    import oncilla_tpu as ocm_pkg
+    from oncilla_tpu.models.kv_paging import BucketedPagedDecoder
+
+    cfg_w = replace(CFG, window=6, max_seq=64)
+    params = llama.init_params(jax.random.key(14), CFG)
+    N, page = 40, 4
+    tokens = train.sample_batch(rng, cfg_w, 1, N)
+
+    kv = llama.make_kv_cache(cfg_w, 1, dtype="float32")
+    want = []
+    for i in range(N):
+        logits, kv = llama.decode_step(
+            params, tokens[:, i], jnp.int32(i), kv, cfg_w
+        )
+        want.append(np.asarray(logits[0]))
+
+    ctx = ocm_pkg.ocm_init(ocm_pkg.OcmConfig(
+        host_arena_bytes=16 << 20, device_arena_bytes=1 << 20,
+    ))
+    try:
+        dec = BucketedPagedDecoder(
+            params, cfg_w, ctx, batch=1, page_tokens=page,
+            kind=ocm_pkg.OcmKind.LOCAL_HOST, dtype="float32",
+        )
+        for i in range(N):
+            logits = dec.step(tokens[:, i])
+            np.testing.assert_allclose(
+                np.asarray(logits[0]), want[i], atol=2e-3, rtol=2e-3,
+                err_msg=f"pos {i}",
+            )
+        # Retained pages cover at most window + one page of slack, not the
+        # whole history (N/page = 10 pages were shipped).
+        assert len(dec.cache.pages) <= (cfg_w.window // page) + 2, \
+            len(dec.cache.pages)
+        # The evicted pages' memory really went back to the arena.
+        assert dec._ctx_start > 0
+        dec.close()
+    finally:
+        ctx.tini()
