@@ -68,29 +68,81 @@ def data_spec() -> P:
     return P(DP, SP)
 
 
-def _sharded_state(params_host: dict, specs: dict, mesh: Mesh, lr: float):
-    """Shared state factory: device_put each leaf under its spec + adamw."""
+def _sharded_state(params_host: dict, specs: dict, mesh: Mesh, lr: float,
+                   offload_opt: bool = False):
+    """Shared state factory: device_put each leaf under its spec + adamw.
+    With ``offload_opt``, the optimizer state lives in the TPU-VM host's
+    pinned memory (same partition specs, ``memory_kind="pinned_host"``) —
+    the HBM footprint drops by ~2 weight copies and the step pays a
+    host<->HBM round-trip for the moments (the ZeRO-offload trade, here a
+    first-class placement like every other OCM memory kind)."""
     params = {
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params_host.items()
     }
     tx = optax.adamw(lr, weight_decay=0.01)
-    return params, tx.init(params), tx
+    opt_state = tx.init(params)
+    if offload_opt:
+        opt_state = jax.tree.map(
+            lambda x: jax.device_put(
+                x,
+                NamedSharding(
+                    mesh, _spec_of(x), memory_kind="pinned_host"
+                ),
+            ),
+            opt_state,
+        )
+    return params, opt_state, tx
 
 
-def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx):
+def _spec_of(x) -> P:
+    """The PartitionSpec a state leaf carries (replicated for leaves whose
+    sharding type has no spec, e.g. scalars committed to one device)."""
+    return getattr(x.sharding, "spec", P())
+
+
+def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx,
+              offload_opt: bool = False, opt_state_example=None):
     """Shared step factory: jit value_and_grad + adamw update with the
     params' in/out shardings pinned. Output params MUST be pinned to the
     input specs, or the compiler may pick different output shardings and
     step N+1's input contract breaks (observed on the ep mesh). opt_state
     is deliberately unpinned on both sides: with no input constraint there
     is no contract to break, and the compiler keeps it consistent with the
-    params it mirrors."""
+    params it mirrors. With ``offload_opt``, ``opt_state_example`` (the
+    host-resident state from the matching ``offload_opt=True`` state
+    factory) supplies the per-leaf specs for the in-jit host<->device
+    transfers around the optimizer update."""
+    if not offload_opt and opt_state_example is not None:
+        raise ValueError(
+            "an opt_state example was passed but offload_opt is False — "
+            "the offloaded (pinned_host) state needs offload_opt=True on "
+            "the step too, or tx.update would run on host-resident moments"
+        )
+    if offload_opt:
+        if opt_state_example is None:
+            raise ValueError(
+                "offload_opt needs opt_state_example (the state built by "
+                "the matching make_*_train_state(offload_opt=True))"
+            )
+        opt_dev = jax.tree.map(
+            lambda x: NamedSharding(mesh, _spec_of(x)), opt_state_example
+        )
+        opt_host = jax.tree.map(
+            lambda x: NamedSharding(
+                mesh, _spec_of(x), memory_kind="pinned_host"
+            ),
+            opt_state_example,
+        )
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(lambda p: loss_of(p, tokens))(params)
+        if offload_opt:
+            opt_state = jax.tree.map(jax.device_put, opt_state, opt_dev)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if offload_opt:
+            opt_state = jax.tree.map(jax.device_put, opt_state, opt_host)
         return params, opt_state, loss
 
     pshard = {k: NamedSharding(mesh, s) for k, s in specs.items()}
@@ -103,12 +155,16 @@ def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx):
     )
 
 
-def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
-    return _sharded_state(init_params(key, cfg), param_specs(cfg), mesh, lr)
+def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4,
+                     offload_opt: bool = False):
+    return _sharded_state(
+        init_params(key, cfg), param_specs(cfg), mesh, lr,
+        offload_opt=offload_opt,
+    )
 
 
 def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
-                          lr: float = 3e-4):
+                          lr: float = 3e-4, offload_opt: bool = False):
     """Same state as :func:`make_train_state` but with numpy host-side
     param init (init values differ; optimizer identical) — the jax.random
     path compiles one kernel per weight shape, minutes of wall time on a
@@ -116,21 +172,34 @@ def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
     from oncilla_tpu.models.llama import init_params_host
 
     return _sharded_state(
-        init_params_host(seed, cfg), param_specs(cfg), mesh, lr
+        init_params_host(seed, cfg), param_specs(cfg), mesh, lr,
+        offload_opt=offload_opt,
     )
 
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
-                    remat: bool = False):
+                    remat: bool = False, offload_opt: bool = False,
+                    opt_state=None):
     """The jitted full training step (forward + backward + adamw update),
     sharded over the (dp, tp, sp) mesh. ``remat`` checkpoints each block
-    (recompute-in-backward) to fit longer sequences / bigger batches."""
+    (recompute-in-backward) to fit longer sequences / bigger batches;
+    ``offload_opt`` keeps Adam state in TPU-VM host memory — pass the
+    state built by ``make_train_state*(offload_opt=True)`` as
+    ``opt_state`` so the step knows its leaf specs.
+
+    offload_opt platform note: select the platform via the JAX_PLATFORMS
+    env var, not ``jax.config.update("jax_platforms", ...)`` — on a
+    multi-device CPU mesh the latter routes compilation through the
+    legacy SPMD partitioner, which rejects the memory-kind placement
+    annotation ("Side-effect HLO must have sharding"). Verified working:
+    env-var CPU meshes and the real TPU chip."""
     seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
     return _jit_step(
         lambda p, tokens: loss_fn(
             p, tokens, cfg, mesh=mesh, seq_axis=seq_axis, remat=remat
         ),
         param_specs(cfg), mesh, data_spec(), tx,
+        offload_opt=offload_opt, opt_state_example=opt_state,
     )
 
 
@@ -170,15 +239,18 @@ def moe_param_specs(cfg) -> dict:
     return specs
 
 
-def make_moe_train_state(key, cfg, mesh: Mesh, lr: float = 3e-4):
+def make_moe_train_state(key, cfg, mesh: Mesh, lr: float = 3e-4,
+                         offload_opt: bool = False):
     from oncilla_tpu.models.moe import init_moe_params
 
     return _sharded_state(
-        init_moe_params(key, cfg), moe_param_specs(cfg), mesh, lr
+        init_moe_params(key, cfg), moe_param_specs(cfg), mesh, lr,
+        offload_opt=offload_opt,
     )
 
 
-def make_moe_train_step(cfg, mesh: Mesh, tx):
+def make_moe_train_step(cfg, mesh: Mesh, tx, offload_opt: bool = False,
+                        opt_state=None):
     """Jitted MoE training step over the (dp, ep, tp) mesh: GSPMD lowers
     the dispatch/combine einsums to all-to-alls over the ep axis."""
     from oncilla_tpu.models import moe
@@ -186,6 +258,7 @@ def make_moe_train_step(cfg, mesh: Mesh, tx):
     return _jit_step(
         lambda p, tokens: moe.loss_fn(p, tokens, cfg, mesh=mesh, ep_axis=EP),
         moe_param_specs(cfg), mesh, P(DP, None), tx,
+        offload_opt=offload_opt, opt_state_example=opt_state,
     )
 
 
@@ -221,11 +294,16 @@ def pp_param_specs(cfg: LlamaConfig) -> dict:
     }
 
 
-def make_pp_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4):
-    return _sharded_state(init_params(key, cfg), pp_param_specs(cfg), mesh, lr)
+def make_pp_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4,
+                        offload_opt: bool = False):
+    return _sharded_state(
+        init_params(key, cfg), pp_param_specs(cfg), mesh, lr,
+        offload_opt=offload_opt,
+    )
 
 
-def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2):
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
+                       offload_opt: bool = False, opt_state=None):
     """Jitted GPipe training step over the (dp, pp) mesh: the stacked layer
     axis is sharded over pp; activations move stage-to-stage via ppermute
     (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated."""
@@ -259,4 +337,7 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2):
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 
-    return _jit_step(pp_loss, pp_param_specs(cfg), mesh, P(DP, None), tx)
+    return _jit_step(
+        pp_loss, pp_param_specs(cfg), mesh, P(DP, None), tx,
+        offload_opt=offload_opt, opt_state_example=opt_state,
+    )

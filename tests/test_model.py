@@ -344,3 +344,74 @@ def test_sliding_window_paged_eviction(rng):
         dec.close()
     finally:
         ctx.tini()
+
+
+def test_offloaded_optimizer_matches_plain():
+    """offload_opt=True (Adam state in pinned host memory, in-jit
+    transfers around the update) must not change the math, and the state
+    must really live in pinned_host.
+
+    Runs in a subprocess with env-var platform selection: under this
+    process's `jax.config.update("jax_platforms", "cpu")` (conftest), the
+    legacy SPMD partitioner rejects the memory-kind placement annotation
+    ("Side-effect HLO must have sharding") on a multi-device CPU mesh —
+    see make_train_step's offload_opt note. Env-var selection (the normal
+    user path, and the real-TPU path) works.
+    """
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import sys; sys.path.insert(0, %r)
+import numpy as np, jax
+from oncilla_tpu.models import llama, train
+CFG = llama.LlamaConfig.tiny()
+mesh = train.make_mesh(8)
+tokens = jax.device_put(
+    train.sample_batch(np.random.default_rng(1234), CFG, 4, 32),
+    jax.sharding.NamedSharding(mesh, train.data_spec()),
+)
+losses = {}
+for off in (False, True):
+    params, opt_state, tx = train.make_train_state(
+        jax.random.key(9), CFG, mesh, lr=1e-2, offload_opt=off
+    )
+    step = train.make_train_step(
+        CFG, mesh, tx, offload_opt=off,
+        opt_state=opt_state if off else None,
+    )
+    ls = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        ls.append(float(loss))
+    losses[off] = ls
+    kinds = {x.sharding.memory_kind for x in jax.tree.leaves(opt_state)}
+    assert kinds == ({"pinned_host"} if off else {"device"}), kinds
+np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+print("OFFLOAD_OK")
+""" % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OFFLOAD_OK" in out.stdout
+
+
+def test_offload_flag_state_mismatch_raises():
+    import optax
+    import pytest
+
+    mesh = train.make_mesh(8)
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    with pytest.raises(ValueError, match="opt_state_example"):
+        train.make_train_step(CFG, mesh, tx, offload_opt=True)
+    with pytest.raises(ValueError, match="offload_opt is False"):
+        train.make_train_step(CFG, mesh, tx, opt_state=object())
