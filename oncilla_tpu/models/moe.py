@@ -202,10 +202,12 @@ def forward(
     mesh=None,
     seq_axis: str | None = None,
     ep_axis: str | None = None,
+    remat: bool = False,
 ):
     """Logits + summed router aux loss for a (B, S) token batch. Attention
     is the dense family's (optionally ring over ``seq_axis``); every FFN is
-    the expert layer."""
+    the expert layer. ``remat`` checkpoints each block (recompute in the
+    backward pass), same trade as the dense family's."""
     from oncilla_tpu.models.llama import make_attend
 
     B, S = tokens.shape
@@ -213,9 +215,7 @@ def forward(
     positions = jnp.arange(S)
     attend = make_attend(S, mesh, seq_axis, window=cfg.window)
 
-    aux_total = jnp.float32(0.0)
-    for i in range(cfg.n_layers):
-        lp = moe_layer_params(params, i)
+    def one_block(x, lp):
         box = {}
 
         def mlp(hn, lp=lp, box=box):
@@ -223,8 +223,16 @@ def forward(
             box["aux"] = aux
             return y
 
-        x = block(cfg, x, lp, positions, attend, mlp=mlp)
-        aux_total = aux_total + box["aux"]
+        out = block(cfg, x, lp, positions, attend, mlp=mlp)
+        return out, box["aux"]
+
+    if remat:
+        one_block = jax.checkpoint(one_block)
+
+    aux_total = jnp.float32(0.0)
+    for i in range(cfg.n_layers):
+        x, aux = one_block(x, moe_layer_params(params, i))
+        aux_total = aux_total + aux
     return final_logits(params, x, cfg), aux_total
 
 

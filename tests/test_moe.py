@@ -250,3 +250,63 @@ def test_moe_paged_decode_matches_stepwise(rng):
         dec.close()
     finally:
         ctx.tini()
+
+
+def test_moe_remat_and_offload_match_plain(rng):
+    """MoE remat (jax.checkpoint per block) and optimizer offload must not
+    change the loss trajectory. Runs in a subprocess with env-var platform
+    selection (see test_model.test_offloaded_optimizer_matches_plain)."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import sys; sys.path.insert(0, %r)
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from oncilla_tpu.models import moe, train
+cfg = moe.MoeConfig.tiny()
+mesh = train.make_moe_mesh(8)
+tokens = jax.device_put(
+    jnp.asarray(np.random.default_rng(1234).integers(0, cfg.vocab, (4, 32)),
+                jnp.int32),
+    NamedSharding(mesh, P("dp", None)),
+)
+losses = {}
+for name, kw in (
+    ("plain", {}),
+    ("remat", dict(remat=True)),
+    ("offload", dict(offload_opt=True)),
+):
+    off = kw.get("offload_opt", False)
+    params, opt, tx = train.make_moe_train_state(
+        jax.random.key(2), cfg, mesh, lr=1e-2, offload_opt=off
+    )
+    step = train.make_moe_train_step(
+        cfg, mesh, tx, **kw, opt_state=opt if off else None
+    )
+    ls = []
+    for _ in range(3):
+        params, opt, loss = step(params, opt, tokens)
+        ls.append(float(loss))
+    losses[name] = ls
+    kinds = {x.sharding.memory_kind for x in jax.tree.leaves(opt)}
+    assert kinds == ({"pinned_host"} if off else {"device"}), (name, kinds)
+# remat recompute can flip borderline top-k routing picks (discrete),
+# so trajectories track but are not bit-identical like the dense family.
+np.testing.assert_allclose(losses["remat"], losses["plain"], rtol=5e-3)
+np.testing.assert_allclose(losses["offload"], losses["plain"], rtol=1e-5)
+print("MOE_MEMTRADES_OK")
+""" % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "MOE_MEMTRADES_OK" in out.stdout
