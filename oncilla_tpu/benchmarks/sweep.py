@@ -37,11 +37,16 @@ class SweepPoint:
 class SweepResult:
     label: str
     points: list[SweepPoint] = field(default_factory=list)
+    # Sizes dropped because the sweep's wall-clock budget ran out —
+    # recorded, never silent (a truncated sweep must not read as a
+    # complete one).
+    dropped: list[int] = field(default_factory=list)
 
     def as_dict(self) -> dict:
         return {
             "label": self.label,
             "points": [vars(p) for p in self.points],
+            "dropped": list(self.dropped),
         }
 
 
@@ -60,9 +65,13 @@ def size_sweep(
     max_bytes: int = 1 << 20,
     iters: int = 8,
     device_index: int = 0,
+    budget_s: float | None = None,
 ) -> SweepResult:
     """Alloc one ``max_bytes`` region of ``kind``; per size, a write pass then
     a read pass of ``iters`` one-sided ops each (ocm_test.c:362-402 shape).
+    With ``budget_s``, sizes whose turn comes after the budget is spent are
+    skipped and listed in ``result.dropped`` (per-size compiles plus
+    GB-scale writes over a slow host link can cost minutes).
 
     Leg semantics for LOCAL_DEVICE: the write leg stages host bytes into
     the arena extent (host→device link on the path, tunnel-bound on a dev
@@ -76,8 +85,13 @@ def size_sweep(
         if kind == OcmKind.LOCAL_DEVICE else ctx.alloc(max_bytes, kind)
     res = SweepResult(label=f"size_sweep:{kind.name}")
     rng = np.random.default_rng(0xB0)
+    t_start = time.perf_counter()
     try:
         for nbytes in _doubling_sizes(min_bytes, max_bytes):
+            if (budget_s is not None
+                    and time.perf_counter() - t_start > budget_s):
+                res.dropped.append(nbytes)
+                continue
             data = rng.integers(0, 256, nbytes, dtype=np.uint8)
             ctx.put(h, data)  # warm caches / compile this size
             _force(ctx.get(h, 8))

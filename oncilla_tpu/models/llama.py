@@ -245,20 +245,16 @@ def make_attend(S: int, mesh=None, seq_axis: str | None = None,
     """The dense-vs-ring attention dispatch shared by every model family:
     with ``mesh`` + ``seq_axis`` the callback runs ring attention over the
     sequence-sharded axis, else causal dense attention over S keys.
-    ``window`` band-limits the dense path (sliding-window attention); the
-    ring path does not support it (a window shorter than the sequence
-    makes whole ring steps no-ops — use the dense path, which a window
-    already makes memory-feasible at long S)."""
+    ``window`` band-limits either path (sliding-window attention; the ring
+    applies it from global positions inside each ring step)."""
     if seq_axis is not None:
-        if window is not None:
-            raise NotImplementedError(
-                "sliding-window attention is not supported on the ring "
-                "(sp) path; use the dense path"
-            )
         from oncilla_tpu.parallel.ring_attention import ring_attention
 
         def attend(q, kn, vn):
-            return ring_attention(q, kn, vn, mesh, axis_name=seq_axis, causal=True)
+            return ring_attention(
+                q, kn, vn, mesh, axis_name=seq_axis, causal=True,
+                window=window,
+            )
     else:
         def attend(q, kn, vn):
             return grouped_attention(q, kn, vn, causal_mask(S, S, window))

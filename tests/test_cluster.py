@@ -375,3 +375,37 @@ def test_ocm_init_attaches_via_nodefile(tmp_path, rng):
         np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
         ocm.ocm_tini(ctx)  # frees the handle and detaches
         assert sum(d.registry.live_count() for d in c.daemons) == 0
+
+
+def test_handle_sharing_between_apps(rng):
+    """Connectionless handles are addresses, not sessions: a handle
+    serialized by the allocating app and handed to ANOTHER app (even one
+    attached to a different daemon) supports one-sided put/get — the
+    producer/consumer pattern disaggregated memory exists for (the EXTOLL
+    model: anyone holding (node, vpid, NLA) can address the region,
+    /root/reference/inc/io/extoll.h:31-44)."""
+    import pickle
+
+    with local_cluster(3, config=small_cfg()) as c:
+        producer = c.context(0)
+        consumer = c.context(2)  # different app, different local daemon
+
+        h = producer.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        producer.put(h, data)
+
+        # The handle crosses process boundaries as plain bytes.
+        h2 = pickle.loads(pickle.dumps(h))
+        got = np.asarray(consumer.get(h2))
+        assert np.array_equal(got, data)
+
+        # And the consumer can write back one-sided; the producer sees it.
+        reply = rng.integers(0, 256, 4096, dtype=np.uint8)
+        consumer.put(h2, reply, offset=1024)
+        back = np.asarray(producer.get(h, nbytes=4096, offset=1024))
+        assert np.array_equal(back, reply)
+
+        # Freeing by the owner invalidates the address for everyone.
+        producer.free(h)
+        with pytest.raises(ocm.OcmProtocolError):
+            consumer.get(h2, nbytes=16)
