@@ -187,12 +187,13 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
     state built by ``make_train_state*(offload_opt=True)`` as
     ``opt_state`` so the step knows its leaf specs.
 
-    offload_opt platform note: select the platform via the JAX_PLATFORMS
-    env var, not ``jax.config.update("jax_platforms", ...)`` — on a
-    multi-device CPU mesh the latter routes compilation through the
-    legacy SPMD partitioner, which rejects the memory-kind placement
-    annotation ("Side-effect HLO must have sharding"). Verified working:
-    env-var CPU meshes and the real TPU chip."""
+    offload_opt platform note: TPU-only in the current jax/XLA build.
+    The CPU backend cannot execute the memory-kind placement custom call
+    at all — single-device CPU fails with "No registered implementation
+    for ... annotate_device_placement for Host", and multi-device CPU
+    trips a legacy SPMD-partitioner RET_CHECK ("Side-effect HLO must
+    have sharding"). Verified working on the real chip (see
+    tests/test_model.py's real-chip subprocess test)."""
     seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
     return _jit_step(
         lambda p, tokens: loss_fn(

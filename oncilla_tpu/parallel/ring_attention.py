@@ -59,6 +59,11 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = True,
     KV dividing H. ``window`` band-limits each query to its last ``window``
     global positions (sliding-window attention composed with the ring).
     Returns (B, H, S_local, D) in q's dtype."""
+    if window is not None and not causal:
+        raise ValueError(
+            "window requires causal=True (the band is defined over past "
+            "positions; a non-causal window is ambiguous)"
+        )
     n = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
     B, H, s_local, D = q.shape

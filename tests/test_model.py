@@ -367,23 +367,28 @@ def test_offloaded_optimizer_matches_plain():
     transfers around the update) must not change the math, and the state
     must really live in pinned_host.
 
-    Runs in a subprocess with env-var platform selection: under this
-    process's `jax.config.update("jax_platforms", "cpu")` (conftest), the
-    legacy SPMD partitioner rejects the memory-kind placement annotation
-    ("Side-effect HLO must have sharding") on a multi-device CPU mesh —
-    see make_train_step's offload_opt note. Env-var selection (the normal
-    user path, and the real-TPU path) works.
+    This is a REAL-CHIP test run in a subprocess with the default
+    (TPU-tunnel) environment: in this jax/XLA build the memory-kind
+    placement custom call has no CPU implementation at all (single-device
+    CPU dies with "No registered implementation for ... 
+    annotate_device_placement for Host"; multi-device CPU trips a legacy
+    SPMD-partitioner RET_CHECK), so offload is a TPU-only feature. Skips
+    when the chip is unavailable.
     """
     import os
     import subprocess
     import sys
 
+    import pytest
+
     script = r"""
 import sys; sys.path.insert(0, %r)
 import numpy as np, jax
+if jax.default_backend() == "cpu":
+    print("SKIP_NO_TPU"); raise SystemExit(0)
 from oncilla_tpu.models import llama, train
 CFG = llama.LlamaConfig.tiny()
-mesh = train.make_mesh(8)
+mesh = train.make_mesh(1)
 tokens = jax.device_put(
     train.sample_batch(np.random.default_rng(1234), CFG, 4, 32),
     jax.sharding.NamedSharding(mesh, train.data_spec()),
@@ -403,20 +408,42 @@ for off in (False, True):
         ls.append(float(loss))
     losses[off] = ls
     kinds = {x.sharding.memory_kind for x in jax.tree.leaves(opt_state)}
-    assert kinds == ({"pinned_host"} if off else {"device"}), kinds
+    assert kinds == ({"pinned_host"} if off else {"device"}), (off, kinds)
 np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 print("OFFLOAD_OK")
 """ % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),)
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
-    out = subprocess.run(
-        [sys.executable, "-c", script], env=env,
-        capture_output=True, text=True, timeout=240,
-    )
+    # Default platform resolution (the axon sitecustomize overrides any
+    # JAX_PLATFORMS env var anyway): the subprocess lands on the real
+    # chip when it is reachable, cpu otherwise.
+    env.pop("JAX_PLATFORMS", None)
+    # Fast pre-probe: a wedged tunnel hangs backend init indefinitely —
+    # bound the cost of discovering that to one minute, not the full
+    # test timeout.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU tunnel unavailable (probe timed out)")
+    if probe.returncode != 0 or probe.stdout.strip() == "cpu":
+        pytest.skip(f"no TPU backend ({probe.stdout.strip() or 'init failed'})")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script], env=env,
+            capture_output=True, text=True, timeout=420,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU tunnel unavailable (subprocess timed out)")
+    if "SKIP_NO_TPU" in out.stdout:
+        pytest.skip("no TPU backend in subprocess")
+    if out.returncode != 0 and (
+        "Unable to initialize backend" in out.stderr
+        or "DEADLINE_EXCEEDED" in out.stderr
+    ):
+        pytest.skip("TPU backend failed to initialize")
     assert out.returncode == 0, out.stderr[-2000:]
     assert "OFFLOAD_OK" in out.stdout
 

@@ -252,10 +252,11 @@ def test_moe_paged_decode_matches_stepwise(rng):
         ctx.tini()
 
 
-def test_moe_remat_and_offload_match_plain(rng):
-    """MoE remat (jax.checkpoint per block) and optimizer offload must not
-    change the loss trajectory. Runs in a subprocess with env-var platform
-    selection (see test_model.test_offloaded_optimizer_matches_plain)."""
+def test_moe_remat_matches_plain(rng):
+    """MoE remat (jax.checkpoint per block) must track the plain loss
+    trajectory. Runs in a subprocess on the 8-device CPU mesh (the
+    offload variant is TPU-only in this build — covered for the shared
+    step factory by tests/test_model.py's real-chip test)."""
     import os
     import subprocess
     import sys
@@ -263,6 +264,10 @@ def test_moe_remat_and_offload_match_plain(rng):
     script = r"""
 import sys; sys.path.insert(0, %r)
 import numpy as np, jax, jax.numpy as jnp
+import jax._src.xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)  # wedged-tunnel immunity
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 from jax.sharding import NamedSharding, PartitionSpec as P
 from oncilla_tpu.models import moe, train
 cfg = moe.MoeConfig.tiny()
@@ -273,37 +278,22 @@ tokens = jax.device_put(
     NamedSharding(mesh, P("dp", None)),
 )
 losses = {}
-for name, kw in (
-    ("plain", {}),
-    ("remat", dict(remat=True)),
-    ("offload", dict(offload_opt=True)),
-):
-    off = kw.get("offload_opt", False)
+for name, kw in (("plain", {}), ("remat", dict(remat=True))):
     params, opt, tx = train.make_moe_train_state(
-        jax.random.key(2), cfg, mesh, lr=1e-2, offload_opt=off
+        jax.random.key(2), cfg, mesh, lr=1e-2
     )
-    step = train.make_moe_train_step(
-        cfg, mesh, tx, **kw, opt_state=opt if off else None
-    )
+    step = train.make_moe_train_step(cfg, mesh, tx, **kw)
     ls = []
     for _ in range(3):
         params, opt, loss = step(params, opt, tokens)
         ls.append(float(loss))
     losses[name] = ls
-    kinds = {x.sharding.memory_kind for x in jax.tree.leaves(opt)}
-    assert kinds == ({"pinned_host"} if off else {"device"}), (name, kinds)
 # remat recompute can flip borderline top-k routing picks (discrete),
 # so trajectories track but are not bit-identical like the dense family.
 np.testing.assert_allclose(losses["remat"], losses["plain"], rtol=5e-3)
-np.testing.assert_allclose(losses["offload"], losses["plain"], rtol=1e-5)
 print("MOE_MEMTRADES_OK")
 """ % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),)
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
     out = subprocess.run(
         [sys.executable, "-c", script], env=env,
         capture_output=True, text=True, timeout=300,
