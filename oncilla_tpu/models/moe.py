@@ -186,12 +186,17 @@ def moe_ffn(
     return y.reshape(B, S, D), aux
 
 
+# Per-layer (stacked) leaves of the MoE family — the single source of
+# truth for layer slicing, pp sharding specs, and pipeline block dicts
+# (the dense family's counterpart is llama.LAYER_KEYS).
+MOE_LAYER_KEYS = (
+    "wq", "wk", "wv", "wo", "ln_attn", "ln_mlp",
+    "w_router", "w_gate_e", "w_up_e", "w_down_e",
+)
+
+
 def moe_layer_params(params: dict, i: int) -> dict:
-    keys = (
-        "wq", "wk", "wv", "wo", "ln_attn", "ln_mlp",
-        "w_router", "w_gate_e", "w_up_e", "w_down_e",
-    )
-    return {k: params[k][i] for k in keys}
+    return {k: params[k][i] for k in MOE_LAYER_KEYS}
 
 
 def forward(

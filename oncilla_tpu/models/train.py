@@ -306,20 +306,57 @@ def make_pp_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4,
     )
 
 
-def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
-                       offload_opt: bool = False, opt_state=None):
-    """Jitted GPipe training step over the (dp, pp) mesh: the stacked layer
-    axis is sharded over pp; activations move stage-to-stage via ppermute
-    (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated."""
-    from oncilla_tpu.models.llama import (
-        LAYER_KEYS, block, final_logits, make_attend,
+def moe_pp_param_specs(cfg) -> dict:
+    """MoE leaves for the (dp, pp) mesh: layer-stacked leaves (attention +
+    router + expert weights) sharded over pp; embed/norm/head replicated."""
+    from oncilla_tpu.models.moe import MOE_LAYER_KEYS, moe_param_spec
+
+    return {
+        k: (P(PP) if k in MOE_LAYER_KEYS else P())
+        for k in moe_param_spec(cfg)
+    }
+
+
+def make_moe_pp_train_state(key, cfg, mesh: Mesh, lr: float = 3e-4,
+                            offload_opt: bool = False):
+    from oncilla_tpu.models.moe import init_moe_params
+
+    return _sharded_state(
+        init_moe_params(key, cfg), moe_pp_param_specs(cfg), mesh, lr,
+        offload_opt=offload_opt,
     )
-    from oncilla_tpu.parallel.pipeline import pipeline_apply
+
+
+def make_pp_stage_fn(cfg, moe_aux: bool = False):
+    """The per-stage GPipe body shared by both families: a lax.scan over
+    this stage's layer stack. With ``moe_aux`` the FFN is the expert
+    layer and the stage returns (activations, summed router aux)."""
+    from oncilla_tpu.models.llama import block, make_attend
 
     def stage_fn(stage_params, x):
         S = x.shape[1]
         positions = jnp.arange(S)
         attend = make_attend(S, window=cfg.window)
+
+        if moe_aux:
+            from oncilla_tpu.models.moe import moe_ffn
+
+            def body(carry, lp):
+                xc, aux = carry
+                box = {}
+
+                def mlp(hn, lp=lp, box=box):
+                    y, a = moe_ffn(hn, lp, cfg)
+                    box["aux"] = a
+                    return y
+
+                out = block(cfg, xc, lp, positions, attend, mlp=mlp)
+                return (out, aux + box["aux"]), None
+
+            (out, aux), _ = jax.lax.scan(
+                body, (x, jnp.float32(0.0)), stage_params
+            )
+            return out, aux
 
         def body(xc, lp):
             return block(cfg, xc, lp, positions, attend), None
@@ -327,21 +364,66 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
         out, _ = jax.lax.scan(body, x, stage_params)
         return out
 
+    return stage_fn
+
+
+def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
+                  moe_aux: bool = False):
+    """Shared GPipe loss: embed -> pipelined layer stack -> head -> CE
+    (+ the scale-matched router aux for the MoE family)."""
+    from oncilla_tpu.models.llama import final_logits
+    from oncilla_tpu.parallel.pipeline import pipeline_apply
+
+    stage_fn = make_pp_stage_fn(cfg, moe_aux=moe_aux)
+
     def pp_loss(params, tokens):
         x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
-        blocks = {k: params[k] for k in LAYER_KEYS}
-        x = pipeline_apply(
+        blocks = {k: params[k] for k in layer_keys}
+        res = pipeline_apply(
             stage_fn, blocks, x,
             mesh=mesh, axis_name=PP, batch_axis=DP,
-            microbatches=microbatches,
+            microbatches=microbatches, with_aux=moe_aux,
         )
+        x, aux = res if moe_aux else (res, None)
         logits = final_logits(params, x, cfg)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        ce = -jnp.mean(ll)
+        if moe_aux:
+            # aux sums one O(1) load-balance term per (layer, microbatch);
+            # divide by microbatches so the regularizer scale matches the
+            # non-pipelined moe.loss_fn (one term per layer).
+            ce = ce + cfg.router_aux_weight * aux / microbatches
+        return ce
+
+    return pp_loss
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
+                       offload_opt: bool = False, opt_state=None):
+    """Jitted GPipe training step over the (dp, pp) mesh: the stacked layer
+    axis is sharded over pp; activations move stage-to-stage via ppermute
+    (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated."""
+    from oncilla_tpu.models.llama import LAYER_KEYS
 
     return _jit_step(
-        pp_loss, pp_param_specs(cfg), mesh, P(DP, None), tx,
+        _make_pp_loss(cfg, mesh, microbatches, LAYER_KEYS),
+        pp_param_specs(cfg), mesh, P(DP, None), tx,
+        offload_opt=offload_opt, opt_state_example=opt_state,
+    )
+
+
+def make_moe_pp_train_step(cfg, mesh: Mesh, tx, microbatches: int = 2,
+                           offload_opt: bool = False, opt_state=None):
+    """GPipe training step for the MoE family over the (dp, pp) mesh: the
+    expert layers ride the pipeline like dense blocks, and the router
+    load-balancing aux loss crosses it through the executor's aux channel
+    (each stage contributes its layers' aux per real microbatch)."""
+    from oncilla_tpu.models.moe import MOE_LAYER_KEYS
+
+    return _jit_step(
+        _make_pp_loss(cfg, mesh, microbatches, MOE_LAYER_KEYS, moe_aux=True),
+        moe_pp_param_specs(cfg), mesh, P(DP, None), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
     )

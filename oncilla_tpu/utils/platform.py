@@ -1,0 +1,28 @@
+"""Platform plumbing for hostile/partial environments.
+
+One concern today: dev images route the TPU through a tunnel plugin that
+force-registers itself in every python process; when the tunnel is
+wedged, jax initializes the plugin during backend discovery and hangs
+``jax.devices()`` on EVERY platform — CPU-only code included. Paths that
+never need the chip (test suites, multichip dryruns on virtual devices)
+drop the plugin's backend factory before any device init.
+"""
+
+from __future__ import annotations
+
+
+def drop_tunnel_plugin(name: str = "axon") -> None:
+    """Remove a PJRT plugin's backend factory so a wedged tunnel cannot
+    hang device discovery. Only the tunnel-dialing plugin may be dropped
+    — removing builtin platforms (e.g. 'tpu') breaks MLIR platform
+    registration downstream. Call BEFORE the first ``jax.devices()``.
+
+    Best effort by design: the registry is private jax API, and a layout
+    change must degrade to the old (hang-prone) behavior, not an error.
+    """
+    try:
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop(name, None)
+    except Exception:  # noqa: BLE001 — registry layout changed
+        pass

@@ -23,19 +23,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Drop non-CPU backend factories before any device init: jax initializes
-# every registered PJRT plugin during discovery regardless of the platform
-# filter, so a wedged TPU tunnel would hang the whole CPU-only suite at
-# the first jax.devices() (observed live). Tests never need the chip.
-try:
-    import jax._src.xla_bridge as _xb
+# A wedged TPU tunnel hangs device discovery in every process; the suite
+# never needs the chip (see oncilla_tpu/utils/platform.py).
+from oncilla_tpu.utils.platform import drop_tunnel_plugin  # noqa: E402
 
-    # Only the tunnel-dialing plugin ('axon' here) is dropped: removing
-    # the builtin 'tpu' factory breaks MLIR platform registration
-    # ("unknown platform tpu") at import time.
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # noqa: BLE001 — registry layout changed; best effort
-    pass
+drop_tunnel_plugin()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -264,8 +264,8 @@ def test_moe_remat_matches_plain(rng):
     script = r"""
 import sys; sys.path.insert(0, %r)
 import numpy as np, jax, jax.numpy as jnp
-import jax._src.xla_bridge as _xb
-_xb._backend_factories.pop("axon", None)  # wedged-tunnel immunity
+from oncilla_tpu.utils.platform import drop_tunnel_plugin
+drop_tunnel_plugin()  # wedged-tunnel immunity
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 from jax.sharding import NamedSharding, PartitionSpec as P
