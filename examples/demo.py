@@ -21,21 +21,12 @@ import jax
 import jax.numpy as jnp
 
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    # A sitecustomize in some images imports jax AND initializes a backend
-    # before this script runs; force the CPU platform with 8 virtual
-    # devices so the sharded sections demo a real mesh. If the config
-    # update is rejected because a backend already exists, drop the cached
-    # backends and re-apply — the next jax.devices() re-initializes under
-    # the new config.
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", 8)
-    except RuntimeError:  # backend already initialized
-        import jax._src.xla_bridge as xb
+    # Force the CPU platform with 8 virtual devices so the sharded
+    # sections demo a real mesh (robust to this image's early-jax-import
+    # sitecustomize and to a wedged TPU tunnel).
+    from oncilla_tpu.utils.platform import force_cpu_devices
 
-        xb._clear_backends()
-        jax.clear_caches()
-        jax.config.update("jax_num_cpu_devices", 8)
+    force_cpu_devices(8)
 
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
