@@ -745,7 +745,37 @@ def main() -> None:
         os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True, name="bench-watchdog").start()
+
+    # Fast wedge probe: a dead TPU tunnel hangs jax device discovery
+    # indefinitely in-process; spend up to 3 minutes in a subprocess to
+    # find out (healthy tunneled init is ~20-40 s, so 180 s is generous —
+    # a probe timeout means the in-process init would hang past the
+    # watchdog anyway; emitting now is the same zeros, earlier and with
+    # the cause named). The probe's own cost (~10-20 s healthy) comes out
+    # of the stage budget's ~240 s margin. Runs inside the emit guard so
+    # a probe-spawn failure still produces the one JSON line.
     try:
+        import subprocess
+        import sys
+
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=180,
+            )
+            if probe.returncode != 0 or not probe.stdout.strip():
+                errors["tunnel_probe"] = (
+                    f"backend init failed: {probe.stderr[-300:]}"
+                )
+        except subprocess.TimeoutExpired:
+            errors["tunnel_probe"] = (
+                "TPU tunnel wedged: device discovery hung >180s; no chip "
+                "benchmarks possible this run"
+            )
+            done.set()
+            emit()
+            return
         _run(out, errors, deadline)
     except BaseException as e:  # noqa: BLE001 — emit the line regardless
         errors["fatal"] = f"{type(e).__name__}: {e}"
