@@ -1,0 +1,117 @@
+"""Input pipeline: sharded prefetch correctness and pipelining contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from oncilla_tpu.models import train
+from oncilla_tpu.utils.data import prefetch_sharded, prefetch_to_mesh
+
+
+def test_prefetch_values_and_sharding(rng):
+    mesh = train.make_mesh(8)
+    batches = [rng.standard_normal((8, 16)).astype(np.float32)
+               for _ in range(5)]
+    out = list(prefetch_to_mesh(iter(batches), mesh, P("dp", None)))
+    assert len(out) == 5
+    for got, want in zip(out, batches):
+        assert isinstance(got, jax.Array)
+        assert got.sharding.spec == P("dp", None)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_prefetch_pytree_batches(rng):
+    mesh = train.make_mesh(8)
+    batches = [
+        {"x": rng.standard_normal((8, 4)).astype(np.float32),
+         "y": rng.integers(0, 10, (8,)).astype(np.int32)}
+        for _ in range(3)
+    ]
+    out = list(prefetch_to_mesh(iter(batches), mesh, P("dp")))
+    for got, want in zip(out, batches):
+        np.testing.assert_array_equal(np.asarray(got["x"]), want["x"])
+        np.testing.assert_array_equal(np.asarray(got["y"]), want["y"])
+
+
+def test_prefetch_stays_ahead():
+    """The producer must be pulled `depth` batches ahead of the consumer —
+    that's the whole latency-hiding contract."""
+    mesh = train.make_mesh(8)
+    pulled = []
+
+    def producer():
+        for i in range(6):
+            pulled.append(i)
+            yield np.full((8, 2), i, np.float32)
+
+    it = prefetch_to_mesh(producer(), mesh, P("dp", None), depth=3)
+    first = next(it)
+    # After yielding batch 0, batches 0..3 must have been pulled (depth=3
+    # in flight beyond the consumed one).
+    assert pulled == [0, 1, 2, 3]
+    np.testing.assert_array_equal(np.asarray(first), np.zeros((8, 2)))
+    rest = list(it)
+    assert len(rest) == 5
+    assert pulled == list(range(6))
+
+
+def test_prefetch_mixed_shardings_per_leaf(rng):
+    """prefetch_sharded's per-leaf dispatch: different leaves land under
+    different shardings in one batched transfer."""
+    mesh = train.make_mesh(8)
+    sh2d = NamedSharding(mesh, P("dp", None))
+    sh1d = NamedSharding(mesh, P("dp"))
+
+    def sharding_of(leaf):
+        return sh2d if leaf.ndim == 2 else sh1d
+
+    batches = [
+        {"x": rng.standard_normal((8, 4)).astype(np.float32),
+         "y": rng.integers(0, 10, (8,)).astype(np.int32)}
+        for _ in range(2)
+    ]
+    out = list(prefetch_sharded(iter(batches), sharding_of))
+    for got, want in zip(out, batches):
+        assert got["x"].sharding.spec == P("dp", None)
+        assert got["y"].sharding.spec == P("dp")
+        np.testing.assert_array_equal(np.asarray(got["x"]), want["x"])
+        np.testing.assert_array_equal(np.asarray(got["y"]), want["y"])
+
+
+def test_prefetch_short_stream_and_errors(rng):
+    mesh = train.make_mesh(8)
+    # Fewer batches than depth: everything still comes through.
+    out = list(prefetch_to_mesh(
+        iter([np.ones((8, 2), np.float32)]), mesh, P("dp", None), depth=4
+    ))
+    assert len(out) == 1
+    # depth validation fires at construction, not first iteration.
+    with pytest.raises(ValueError, match="depth"):
+        prefetch_sharded(iter([]), lambda x: None, depth=0)
+
+
+def test_prefetch_feeds_train_step(rng):
+    """End-to-end: the pipeline feeds the jitted train step directly (the
+    arrays arrive pre-placed under the step's input sharding)."""
+    from oncilla_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    mesh = train.make_mesh(8)
+    params, opt_state, tx = train.make_train_state(
+        jax.random.key(0), cfg, mesh, lr=1e-2
+    )
+    step = train.make_train_step(cfg, mesh, tx)
+
+    def batches():
+        for i in range(4):
+            yield np.asarray(train.sample_batch(rng, cfg, 4, 32))
+
+    losses = []
+    for tokens in prefetch_to_mesh(batches(), mesh, train.data_spec()):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
