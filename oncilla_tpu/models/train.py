@@ -210,6 +210,44 @@ def sample_batch(rng: np.random.Generator, cfg: LlamaConfig, batch: int, seq: in
     )
 
 
+def make_eval_step(cfg: LlamaConfig, mesh: Mesh, use_ring: bool = True):
+    """Jitted evaluation step: mean next-token cross entropy for a (B, S)
+    batch, sharded like the train step (no grads, params donated never)."""
+    seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
+
+    def step(params, tokens):
+        return loss_fn(params, tokens, cfg, mesh=mesh, seq_axis=seq_axis)
+
+    pshard = {k: NamedSharding(mesh, s) for k, s in param_specs(cfg).items()}
+    return jax.jit(
+        step,
+        in_shardings=(pshard, NamedSharding(mesh, data_spec())),
+    )
+
+
+def evaluate(params, batches, eval_step) -> dict:
+    """Token-weighted mean loss and perplexity over an iterable of token
+    batches (e.g. from :func:`oncilla_tpu.utils.data.prefetch_to_mesh`).
+
+    Per-batch losses are weighted by their predicted-token count, so a
+    smaller remainder batch doesn't bias the corpus perplexity; the
+    device scalars accumulate asynchronously and materialize once at the
+    end (no per-batch host sync — dispatch keeps overlapping compute)."""
+    losses, weights = [], []
+    n = 0
+    for tokens in batches:
+        losses.append(eval_step(params, tokens))
+        # loss_fn averages over B*(S-1) predicted tokens.
+        weights.append(tokens.shape[0] * (tokens.shape[1] - 1))
+        n += 1
+    if n == 0:
+        raise ValueError("evaluate() got an empty batch iterable")
+    w = np.asarray(weights, np.float64)
+    ls = np.asarray([float(x) for x in losses], np.float64)
+    mean = float((ls * w).sum() / w.sum())
+    return {"loss": mean, "perplexity": float(np.exp(mean)), "batches": n}
+
+
 # -- expert parallelism (MoE family) ---------------------------------------
 
 

@@ -458,3 +458,53 @@ def test_offload_flag_state_mismatch_raises():
         train.make_train_step(CFG, mesh, tx, offload_opt=True)
     with pytest.raises(ValueError, match="offload_opt is False"):
         train.make_train_step(CFG, mesh, tx, opt_state=object())
+
+
+def test_eval_step_and_perplexity(rng):
+    """make_eval_step matches loss_fn; evaluate() aggregates correctly and
+    training reduces eval perplexity on the training batch."""
+    mesh = train.make_mesh(8)
+    params, opt_state, tx = train.make_train_state(
+        jax.random.key(30), CFG, mesh, lr=1e-2
+    )
+    step = train.make_train_step(CFG, mesh, tx)
+    eval_step = train.make_eval_step(CFG, mesh)
+    tokens = jax.device_put(
+        train.sample_batch(rng, CFG, 4, 32),
+        jax.sharding.NamedSharding(mesh, train.data_spec()),
+    )
+
+    before = train.evaluate(params, [tokens, tokens], eval_step)
+    assert before["batches"] == 2
+    np.testing.assert_allclose(
+        before["loss"], float(llama.loss_fn(params, tokens, CFG)), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        before["perplexity"], np.exp(before["loss"]), rtol=1e-6
+    )
+
+    for _ in range(5):
+        params, opt_state, _ = step(params, opt_state, tokens)
+    after = train.evaluate(params, [tokens], eval_step)
+    assert after["perplexity"] < before["perplexity"]
+
+    import pytest
+
+    with pytest.raises(ValueError, match="empty"):
+        train.evaluate(params, [], eval_step)
+
+
+def test_evaluate_token_weighted(rng):
+    """Uneven batch sizes: evaluate() weights by predicted-token count."""
+    mesh = train.make_mesh(8)
+    params = train.shard_params(llama.init_params(jax.random.key(31), CFG),
+                                mesh, CFG)
+    eval_step = train.make_eval_step(CFG, mesh)
+    sh = jax.sharding.NamedSharding(mesh, train.data_spec())
+    big = jax.device_put(train.sample_batch(rng, CFG, 8, 32), sh)
+    small = jax.device_put(train.sample_batch(rng, CFG, 2, 32), sh)
+    res = train.evaluate(params, [big, small], eval_step)
+    l_big = float(llama.loss_fn(params, big, CFG))
+    l_small = float(llama.loss_fn(params, small, CFG))
+    want = (l_big * 8 * 31 + l_small * 2 * 31) / (8 * 31 + 2 * 31)
+    np.testing.assert_allclose(res["loss"], want, rtol=1e-4)
