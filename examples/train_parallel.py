@@ -18,14 +18,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     from oncilla_tpu.utils.platform import force_cpu_devices
 
     force_cpu_devices(8)
 
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from oncilla_tpu.models import train  # noqa: E402
 from oncilla_tpu.models.llama import LlamaConfig  # noqa: E402

@@ -2,8 +2,6 @@
 bfloat16 and optimizer pytrees), resume-equivalence of a real train state,
 and error paths."""
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,7 +11,7 @@ import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
 from oncilla_tpu.models import checkpoint as ckpt
 from oncilla_tpu.models import train
-from oncilla_tpu.models.llama import LlamaConfig, init_params
+from oncilla_tpu.models.llama import LlamaConfig
 
 
 @pytest.fixture

@@ -9,7 +9,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 from _helpers import free_ports, wait_nnodes, wait_port
 from oncilla_tpu.runtime.client import ControlPlaneClient
