@@ -156,6 +156,38 @@ def load(ctx, handle: OcmAlloc, like=None):
     return jax.tree_util.tree_unflatten(treedef, ordered)
 
 
+def save_async(ctx, tree, kind: OcmKind = OcmKind.LOCAL_HOST, **alloc_kw):
+    """Checkpoint without stalling the training loop: start the
+    device→host pulls for every leaf asynchronously, then pack and ship
+    the region on a background thread. Returns a
+    ``concurrent.futures.Future`` resolving to the OcmAlloc handle.
+
+    The leaves are SNAPSHOTTED at call time (jax arrays are immutable, so
+    a training step that subsequently donates/replaces the state cannot
+    corrupt the checkpoint — but the caller must not explicitly
+    ``delete()`` the passed arrays before the future resolves).
+    """
+    import concurrent.futures
+
+    # Snapshot the pytree NOW: capture the leaf references and rebuild an
+    # independent container, so in-place mutation of the caller's dict
+    # between submit and execution cannot change (or tear) what gets
+    # saved. Kick off all device->host copies up front; the thread's
+    # numpy materialization then overlaps the caller's compute.
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+    snapshot = jax.tree_util.tree_unflatten(treedef, leaves)
+
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    try:
+        fut = ex.submit(save, ctx, snapshot, kind, **alloc_kw)
+    finally:
+        ex.shutdown(wait=False)
+    return fut
+
+
 def load_sharded(ctx, handle: OcmAlloc, like, shardings):
     """Restore and re-place each leaf under ``shardings`` (a pytree of
     ``jax.sharding.Sharding`` matching ``like``'s structure) — resuming a

@@ -140,3 +140,33 @@ def test_checkpoint_to_remote_host(rng):
         )
         assert int(back["opt"]["count"]) == 11
         ctx2.free(h)
+
+
+def test_save_async_during_training(ctx, rng):
+    """save_async snapshots the state at call time and does not stall (or
+    corrupt under) continued donated training steps."""
+    cfg = LlamaConfig.tiny()
+    mesh = train.make_mesh(8)
+    params, opt_state, tx = train.make_train_state(
+        jax.random.key(40), cfg, mesh, lr=1e-2
+    )
+    step = train.make_train_step(cfg, mesh, tx)
+    tokens = jax.device_put(
+        train.sample_batch(rng, cfg, 4, 32),
+        jax.sharding.NamedSharding(mesh, train.data_spec()),
+    )
+
+    snap_wq = np.asarray(params["wq"])  # reference copy of the snapshot
+    like = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+    fut = ckpt.save_async(ctx, params, OcmKind.LOCAL_HOST)
+    # Keep training while the checkpoint writes (donates params).
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    h = fut.result(timeout=120)
+    back = ckpt.load(ctx, h, like=like)
+    # The checkpoint holds the PRE-training snapshot, not the mutated state.
+    np.testing.assert_array_equal(back["wq"], snap_wq)
+    assert not np.array_equal(np.asarray(params["wq"]), snap_wq)
+    ctx.free(h)

@@ -6,7 +6,9 @@ import numpy as np
 import pytest
 
 from oncilla_tpu.parallel.mesh import node_mesh
-from oncilla_tpu.parallel.ring_attention import ring_attention
+from oncilla_tpu.parallel.ring_attention import (
+    ring_attention, ring_attention_shard,
+)
 
 
 def dense_attention(q, k, v, causal):
@@ -69,3 +71,9 @@ def test_ring_grad_finite(rng):
 
     gd = jax.grad(dense_loss)(q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gd), atol=1e-4)
+
+
+def test_ring_window_non_causal_rejected():
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention_shard(None, None, None, axis_name="sp",
+                            causal=False, window=4)
