@@ -170,3 +170,29 @@ def test_save_async_during_training(ctx, rng):
     np.testing.assert_array_equal(back["wq"], snap_wq)
     assert not np.array_equal(np.asarray(params["wq"]), snap_wq)
     ctx.free(h)
+
+
+def test_checkpoint_roundtrip_fuzz(ctx, rng):
+    """Property check: random pytrees of random shapes/dtypes round-trip
+    bit-exactly through the packed-region format."""
+    dtypes = [np.float32, np.int32, np.uint8, np.float64, np.int8]
+    for trial in range(10):
+        nleaves = int(rng.integers(1, 6))
+        tree = {}
+        for i in range(nleaves):
+            ndim = int(rng.integers(0, 4))
+            shape = tuple(int(rng.integers(1, 9)) for _ in range(ndim))
+            dt = dtypes[int(rng.integers(0, len(dtypes)))]
+            if np.issubdtype(dt, np.floating):
+                leaf = rng.standard_normal(shape).astype(dt)
+            else:
+                leaf = rng.integers(-100, 100, shape).astype(dt)
+            tree[f"leaf{i}"] = leaf
+        h = ckpt.save(ctx, tree, OcmKind.LOCAL_HOST)
+        back = ckpt.load(ctx, h, like=tree)
+        for k, want in tree.items():
+            got = back[k]
+            assert got.dtype == want.dtype, (trial, k)
+            assert got.shape == want.shape, (trial, k)
+            np.testing.assert_array_equal(got, want, err_msg=f"{trial}/{k}")
+        ctx.free(h)
