@@ -406,13 +406,18 @@ def make_pp_stage_fn(cfg, moe_aux: bool = False):
 
 
 def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
-                  moe_aux: bool = False):
+                  moe_aux: bool = False, remat: bool = False):
     """Shared GPipe loss: embed -> pipelined layer stack -> head -> CE
-    (+ the scale-matched router aux for the MoE family)."""
+    (+ the scale-matched router aux for the MoE family). ``remat``
+    checkpoints each stage application (recompute-in-backward per
+    microbatch tick) — the same FLOPs-for-memory trade as the other
+    families, applied at stage granularity."""
     from oncilla_tpu.models.llama import final_logits
     from oncilla_tpu.parallel.pipeline import pipeline_apply
 
     stage_fn = make_pp_stage_fn(cfg, moe_aux=moe_aux)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def pp_loss(params, tokens):
         x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
@@ -439,21 +444,25 @@ def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
 
 
 def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
-                       offload_opt: bool = False, opt_state=None):
+                       remat: bool = False, offload_opt: bool = False,
+                       opt_state=None):
     """Jitted GPipe training step over the (dp, pp) mesh: the stacked layer
     axis is sharded over pp; activations move stage-to-stage via ppermute
-    (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated."""
+    (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated.
+    Supports the same ``remat``/``offload_opt`` memory trades as the other
+    step families."""
     from oncilla_tpu.models.llama import LAYER_KEYS
 
     return _jit_step(
-        _make_pp_loss(cfg, mesh, microbatches, LAYER_KEYS),
+        _make_pp_loss(cfg, mesh, microbatches, LAYER_KEYS, remat=remat),
         pp_param_specs(cfg), mesh, P(DP, None), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
     )
 
 
 def make_moe_pp_train_step(cfg, mesh: Mesh, tx, microbatches: int = 2,
-                           offload_opt: bool = False, opt_state=None):
+                           remat: bool = False, offload_opt: bool = False,
+                           opt_state=None):
     """GPipe training step for the MoE family over the (dp, pp) mesh: the
     expert layers ride the pipeline like dense blocks, and the router
     load-balancing aux loss crosses it through the executor's aux channel
@@ -461,7 +470,8 @@ def make_moe_pp_train_step(cfg, mesh: Mesh, tx, microbatches: int = 2,
     from oncilla_tpu.models.moe import MOE_LAYER_KEYS
 
     return _jit_step(
-        _make_pp_loss(cfg, mesh, microbatches, MOE_LAYER_KEYS, moe_aux=True),
+        _make_pp_loss(cfg, mesh, microbatches, MOE_LAYER_KEYS, moe_aux=True,
+                      remat=remat),
         moe_pp_param_specs(cfg), mesh, P(DP, None), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
     )

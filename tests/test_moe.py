@@ -261,109 +261,6 @@ def test_moe_paged_decode_matches_stepwise(rng, decoder_cls_name):
         ctx.tini()
 
 
-import pytest
-
-
-@pytest.mark.parametrize("top_k", [1, 2])
-def test_moe_decode_matches_forward(rng, top_k):
-    """MoE decode with a KV cache reproduces the teacher-forced logits,
-    for both Switch-style top-1 and the default top-2 routing.
-
-    Capacity is set ample: with drops possible, teacher-forced routing
-    (T=B*S tokens compete per expert) and decode routing (T=1, never
-    drops) legitimately differ — see moe.decode_step's docstring."""
-    from oncilla_tpu.models import llama
-
-    cfg = dataclasses.replace(
-        MoeConfig.tiny(), capacity_factor=64.0, top_k=top_k
-    )
-    params = moe.init_moe_params(jax.random.key(8), cfg)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (1, 12)), jnp.int32)
-    full, _ = moe.forward(params, tokens, cfg)
-
-    kv = llama.make_kv_cache(cfg, 1, dtype="float32")
-    for i in range(12):
-        logits, kv = moe.decode_step(
-            params, tokens[:, i], jnp.int32(i), kv, cfg
-        )
-        np.testing.assert_allclose(
-            np.asarray(logits[0]), np.asarray(full[0, i]),
-            atol=2e-3, rtol=2e-3,
-        )
-
-
-def test_moe_generate_greedy(rng):
-    """MoE generate: compiled prefill + greedy continuation, in-vocab ids,
-    deterministic, and consistent with stepwise greedy decode."""
-    from oncilla_tpu.models import llama
-
-    cfg = MoeConfig.tiny()
-    params = moe.init_moe_params(jax.random.key(9), cfg)
-    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (1, 6)), jnp.int32)
-    steps = 4
-
-    kv = llama.make_kv_cache(cfg, 1, dtype="float32")
-    got, _ = moe.generate(params, prompt, kv, cfg, steps)
-    assert got.shape == (1, steps)
-    assert np.all((np.asarray(got) >= 0) & (np.asarray(got) < cfg.vocab))
-
-    # Stepwise greedy reference.
-    kv = llama.make_kv_cache(cfg, 1, dtype="float32")
-    logits = None
-    for i in range(6):
-        logits, kv = moe.decode_step(params, prompt[:, i], jnp.int32(i), kv, cfg)
-    want = []
-    tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-    for j in range(steps):
-        want.append(tok)
-        if j < steps - 1:
-            logits, kv = moe.decode_step(params, tok, jnp.int32(6 + j), kv, cfg)
-            tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-    np.testing.assert_array_equal(
-        np.asarray(got), np.asarray(jnp.stack(want, axis=1))
-    )
-
-
-def test_moe_paged_decode_matches_stepwise(rng):
-    """MoE KV history paged through OCM (BucketedPagedDecoder with the
-    moe.paged_hooks family hooks) reproduces plain MoE cached decode."""
-    import oncilla_tpu as ocm_pkg
-    from oncilla_tpu.models import llama
-    from oncilla_tpu.models.kv_paging import BucketedPagedDecoder
-
-    cfg = dataclasses.replace(
-        MoeConfig.tiny(), capacity_factor=64.0, max_seq=32
-    )
-    params = moe.init_moe_params(jax.random.key(10), cfg)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (1, 12)), jnp.int32)
-
-    # Plain cached decode reference.
-    kv = llama.make_kv_cache(cfg, 1, dtype="float32")
-    want = []
-    for i in range(12):
-        logits, kv = moe.decode_step(params, tokens[:, i], jnp.int32(i), kv, cfg)
-        want.append(np.asarray(logits[0]))
-
-    ctx = ocm_pkg.ocm_init(ocm_pkg.OcmConfig(
-        host_arena_bytes=16 << 20, device_arena_bytes=1 << 20,
-    ))
-    try:
-        dec = BucketedPagedDecoder(
-            params, cfg, ctx, batch=1, page_tokens=4,
-            kind=ocm_pkg.OcmKind.LOCAL_HOST, dtype="float32",
-            **moe.paged_hooks(cfg),
-        )
-        for i in range(12):
-            logits = dec.step(tokens[:, i])
-            np.testing.assert_allclose(
-                np.asarray(logits[0]), want[i], atol=2e-3, rtol=2e-3,
-                err_msg=f"pos {i}",
-            )
-        dec.close()
-    finally:
-        ctx.tini()
-
-
 def test_moe_remat_matches_plain(rng):
     """MoE remat (jax.checkpoint per block) must track the plain loss
     trajectory. Runs in a subprocess on the 8-device CPU mesh (the

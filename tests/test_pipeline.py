@@ -238,3 +238,43 @@ def test_moe_pp_train_step(rng):
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
     assert params["w_gate_e"].sharding.spec == P("pp")
+
+
+import pytest
+
+
+@pytest.mark.parametrize("family", ["dense", "moe"])
+def test_pp_remat_matches_plain(rng, family):
+    """Stage-level remat must not change the GPipe math — same loss
+    trajectory as the plain pipeline step, for the dense stage body AND
+    the MoE one (whose checkpointed stage_fn returns (acts, aux) through
+    the executor's aux channel)."""
+    from oncilla_tpu.models.moe import MoeConfig
+
+    if family == "dense":
+        cfg = _cfg4()
+        make_state, make_step = (
+            train.make_pp_train_state, train.make_pp_train_step,
+        )
+        rtol = 1e-5
+    else:
+        cfg = MoeConfig.tiny()
+        make_state, make_step = (
+            train.make_moe_pp_train_state, train.make_moe_pp_train_step,
+        )
+        rtol = 5e-3  # remat recompute can flip borderline top-k picks
+    mesh = train.make_pp_mesh(8, n_layers=cfg.n_layers)
+    tokens = jax.device_put(
+        jnp.asarray(rng.integers(0, cfg.vocab, (8, 32)), jnp.int32),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    losses = {}
+    for remat in (False, True):
+        params, opt_state, tx = make_state(jax.random.key(7), cfg, mesh, lr=1e-2)
+        step = make_step(cfg, mesh, tx, microbatches=2, remat=remat)
+        ls = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            ls.append(float(loss))
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[True], losses[False], rtol=rtol)
