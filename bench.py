@@ -594,13 +594,16 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
             errors["mfu_train"] = f"{type(e).__name__}: {e}"
     mark("mfu_train")
 
-    # GUPS random-access over the chip's HBM (BASELINE.md config 4);
-    # measures both the scatter and bincount lowerings, keeps the best.
+    # GUPS random-access (BASELINE.md config 4): the table is an OcmAlloc
+    # extent inside the one-sided plane's arena and every update batch
+    # lands in that handle-addressed HBM (loopback row on the single chip);
+    # conservation is verified back through the handle. Both lowerings
+    # (scatter / bincount) are measured, best wins.
     if budgeted("gups", 120):
         try:
-            from oncilla_tpu.benchmarks.gups import gups_single_best
+            from oncilla_tpu.benchmarks.gups import gups_handle_best
 
-            g = gups_single_best(words=1 << 22, batch=1 << 20, steps=32)
+            g = gups_handle_best(words=1 << 22, batch=1 << 20, steps=32)
             out["detail"]["gups"] = round(g["gups"], 4)
             out["detail"]["gups_method"] = g["mode"]
         except Exception as e:  # noqa: BLE001 — never fail the headline

@@ -273,6 +273,14 @@ class SpmdIciPlane:
             )
             self.stats["ici_copies"] += 1
 
+    def update(self, fn) -> None:
+        """Atomically rebind ``self.arena = fn(self.arena)`` under the plane
+        lock — for in-mesh jitted programs that donate the arena (the
+        :meth:`oncilla_tpu.core.hbm.DeviceArena.update` analogue). The
+        callable must return a new global arena of identical shape/sharding."""
+        with self._mu:
+            self.arena = fn(self.arena)
+
     # -- typed helpers ----------------------------------------------------
 
     def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0) -> jax.Array:

@@ -121,3 +121,51 @@ def test_gups_methods_agree_and_conserve():
     best = gups_single_best(words=1 << 10, batch=256, steps=4)
     assert best["table_sum"] == best["updates"]
     assert best["mode"] in ("single:scatter", "single:bincount")
+
+
+def test_gups_handles_conserves_through_handle():
+    """The handle/arena GUPS flavor (BASELINE config 4 'via ocm handles'):
+    updates land inside an OcmAlloc extent of the one-sided plane's arena
+    and the conservation readback goes through plane.get_as."""
+    from oncilla_tpu.benchmarks.gups import gups_handle_best, gups_handles
+
+    for method in ("scatter", "bincount"):
+        out = gups_handles(words=1 << 10, batch=256, steps=4, method=method)
+        assert out["table_sum"] == out["updates"] == 4 * 256
+        assert out["gups"] > 0
+    best = gups_handle_best(words=1 << 10, batch=256, steps=4)
+    assert best["mode"].startswith("handle:")
+    assert best["table_sum"] == best["updates"]
+
+
+def test_gups_handles_multidevice_plane_rows_untouched():
+    """On a multi-device plane only the handle's row mutates: bystander
+    rows keep their bytes and the conservation count stays exact."""
+    import jax
+
+    from oncilla_tpu.benchmarks.gups import gups_handles
+    from oncilla_tpu.ops.ici import SpmdIciPlane
+    from oncilla_tpu.parallel.mesh import node_mesh
+    from oncilla_tpu.utils.config import OcmConfig
+    import numpy as np
+
+    mesh = node_mesh()
+    plane = SpmdIciPlane(
+        config=OcmConfig(device_arena_bytes=1 << 20),
+        mesh=mesh, devices_per_rank=int(mesh.devices.size),
+    )
+    ndev = int(mesh.devices.size)
+    from oncilla_tpu.parallel import spmd_arena as sa
+
+    stamps = {}
+    for d in range(1, ndev):
+        stamp = np.full(64, d, dtype=np.uint8)
+        stamps[d] = stamp
+        plane.update(
+            lambda a, d=d, s=stamp: sa.host_put(a, d, s, 4096, mesh=mesh)
+        )
+    out = gups_handles(words=1 << 8, batch=128, steps=2, plane=plane)
+    assert out["table_sum"] == out["updates"] == 2 * 128
+    for d in range(1, ndev):
+        got = np.asarray(sa.host_get(plane.arena, d, 64, 4096, mesh=mesh))
+        np.testing.assert_array_equal(got, stamps[d])
