@@ -8,6 +8,13 @@ placement, ICI (Pallas remote DMA / ppermute) and DCN data planes.
 Public API mirrors inc/oncillamem.h:69-89 of the reference.
 """
 
+from oncilla_tpu.utils.platform import honor_cpu_env as _honor_cpu_env
+
+# An explicit JAX_PLATFORMS=cpu must win over this image's sitecustomize
+# (which force-registers the TPU tunnel backend in every process and can
+# hang device discovery when the tunnel is down). No-op otherwise.
+_honor_cpu_env()
+
 from oncilla_tpu.core.arena import ArenaAllocator, Extent
 from oncilla_tpu.core.context import (
     Ocm,

@@ -16,6 +16,10 @@ Measures single-chip tokens/s for a Llama-style decoder in four modes:
 - ``host``: pages ride to host DRAM (``OcmKind.LOCAL_HOST``) — the
   device->host->device round trip is the single-chip analogue of the DCN
   arm.
+- ``device_fused``: OCM-paged like ``device`` but ONE dispatch per page
+  (``BucketedPagedDecoder.step_page`` — a lax.scan over the page), the
+  per-page serving-loop shape that closes most of the dispatch gap to
+  ``fused`` while keeping the data plane on the path.
 
 The bucketed decoder keeps shapes static per page (O(tokens/page)
 compilations), which is what makes this measurable on real hardware: the
@@ -119,14 +123,41 @@ def bench_paged(params, cfg, tokens, ctx, kind, page_tokens) -> float:
     return tokens.shape[1] / (time.perf_counter() - t0)
 
 
+def bench_paged_fused(params, cfg, tokens, ctx, kind, page_tokens) -> float:
+    """Tokens/s with OCM-paged KV and ONE dispatch per page
+    (BucketedPagedDecoder.step_page): the per-page serving loop — page
+    decode scans on-chip, page put/get through the data plane between
+    dispatches (still refetch=True, so both directions are measured)."""
+    n_pages = tokens.shape[1] // page_tokens
+
+    def run():
+        dec = BucketedPagedDecoder(
+            params, cfg, ctx, batch=1, page_tokens=page_tokens, kind=kind,
+            dtype=cfg.dtype, refetch=True,
+        )
+        logits = None
+        for p in range(n_pages):
+            logits = dec.step_page(
+                tokens[:, p * page_tokens:(p + 1) * page_tokens]
+            )
+        _sync(logits)
+        dec.close()
+
+    run()  # compile all page buckets
+    t0 = time.perf_counter()
+    run()
+    return n_pages * page_tokens / (time.perf_counter() - t0)
+
+
 def run_bench(
     tokens_n: int = 384,
     page_tokens: int = 128,
-    # fused runs LAST: donating buffers through the big scan executable
-    # leaves the chip in a state where subsequent per-step dispatch loses
-    # 2-3x throughput (same stickiness bench.py documents for the DMA
-    # loops) — measured: plain reads 196 tok/s before fused, 73 after.
-    modes: tuple = ("plain", "device", "host", "fused"),
+    # Scan-heavy modes run LAST: donating buffers through a big scan
+    # executable leaves the chip in a state where subsequent per-step
+    # dispatch loses 2-3x throughput (same stickiness bench.py documents
+    # for the DMA loops) — measured: plain reads 196 tok/s before fused,
+    # 73 after. device_fused (one scan per page) sits just before fused.
+    modes: tuple = ("plain", "device", "host", "device_fused", "fused"),
     config: str = "small",
 ) -> dict:
     """Programmatic entry (bench.py and the CLI share it): tokens/s per
@@ -175,6 +206,10 @@ def _run_modes(out, modes, params, cfg, tokens, ctx, page_tokens):
             tps = bench_paged(
                 params, cfg, tokens, ctx, OcmKind.LOCAL_HOST, page_tokens
             )
+        elif mode == "device_fused":
+            tps = bench_paged_fused(
+                params, cfg, tokens, ctx, OcmKind.LOCAL_DEVICE, page_tokens
+            )
         else:
             raise ValueError(f"unknown mode {mode!r}")
         out["tok_s"][mode] = round(tps, 2)
@@ -190,7 +225,7 @@ def _run_modes(out, modes, params, cfg, tokens, ctx, page_tokens):
         out["paging_overhead"] = {
             m: round(base / v - 1.0, 4)
             for m, v in out["tok_s"].items()
-            if m in ("device", "host") and v
+            if m in ("device", "host", "device_fused") and v
         }
 
 
@@ -202,9 +237,9 @@ def main() -> None:
     ap.add_argument("--tokens", type=int, default=384)
     ap.add_argument("--page-tokens", type=int, default=128)
     ap.add_argument(
-        "--modes", default="plain,device,host,fused",
-        help="comma list of plain|device|host|fused (fused last: see "
-             "run_bench on measurement-order sensitivity)",
+        "--modes", default="plain,device,host,device_fused,fused",
+        help="comma list of plain|device|host|device_fused|fused (scan "
+             "modes last: see run_bench on measurement-order sensitivity)",
     )
     ap.add_argument("--config", choices=["small", "tiny"], default="small")
     args = ap.parse_args()

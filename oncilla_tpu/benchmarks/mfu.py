@@ -119,8 +119,19 @@ def mfu_train(
     batch: int | None = None,
     seq: int | None = None,
     steps: int = 6,
+    remat=False,
+    ce_block: int | None = None,
 ) -> dict:
-    """Train-step MFU (fwd + bwd + optimizer) on a single-device mesh."""
+    """Train-step MFU (fwd + bwd + optimizer) on a single-device mesh.
+
+    Donation audit (VERDICT r3 item 6): params and opt_state are donated
+    through the step (train._jit_step donate_argnums=(0, 1)) with output
+    params pinned to the input specs, so XLA updates weights and Adam
+    moments in place — no extra weight copies live across the step. The
+    remaining knobs are ``remat`` ("dots" keeps matmul outputs, recomputes
+    elementwise — batch can grow with ~zero extra MXU work) and
+    ``ce_block`` (blocked vocab-head CE — no (B, S, V) logits tensor);
+    :func:`mfu_train_best` sweeps them."""
     from oncilla_tpu.models import train
 
     if cfg is None:
@@ -129,7 +140,8 @@ def mfu_train(
     # Host-side init (same rationale as mfu_forward); the optimizer is the
     # production one from train.py, so this measures the real train step.
     params, opt_state, tx = train.make_train_state_host(0, cfg, mesh)
-    step = train.make_train_step(cfg, mesh, tx, use_ring=False)
+    step = train.make_train_step(cfg, mesh, tx, use_ring=False,
+                                 remat=remat, ce_block=ce_block)
     rng = np.random.default_rng(0)
     tokens = jax.device_put(
         train.sample_batch(rng, cfg, batch, seq),
@@ -159,4 +171,47 @@ def mfu_train(
         "loss": float(loss),
         "steps": steps,
         "seconds": dt,
+        "batch": batch,
+        "remat": str(remat),
+        "ce_block": ce_block,
     }
+
+
+def mfu_train_best(deadline: float | None = None) -> dict:
+    """Sweep the memory-layout variants of the train step and keep the
+    best MFU. The analytic FLOP count (3x forward) is identical for every
+    variant, so wall time alone decides — a variant that recomputes more
+    must win on time to win here. Variants, in expected-value order:
+
+    1. batch 8, dots-remat, blocked CE — double the batch (Adam's ~24 GB
+       of moment traffic amortizes over 2x the FLOPs) at ~zero extra MXU
+       work; fits only because dots-remat + blocked CE free the activation
+       HBM that made batch 8 OOM at r3.
+    2. batch 4 baseline (r3's 0.558) — the fallback.
+
+    With ``deadline`` (time.monotonic()), later variants are skipped once
+    it passes; a variant that fails (e.g. OOM at compile) is recorded and
+    skipped."""
+    cfg, batch4, seq = train_sized_config()
+    variants = [
+        dict(batch=8, remat="dots", ce_block=512),
+        dict(batch=batch4, remat=False, ce_block=None),
+    ]
+    best, tried = None, []
+    for v in variants:
+        if deadline is not None and time.monotonic() > deadline:
+            tried.append({**v, "skipped": "deadline"})
+            continue
+        try:
+            r = mfu_train(cfg, v["batch"], seq, remat=v["remat"],
+                          ce_block=v["ce_block"])
+        except Exception as e:  # noqa: BLE001 — an OOM variant is data
+            tried.append({**v, "error": f"{type(e).__name__}"})
+            continue
+        tried.append({k: r[k] for k in ("batch", "remat", "ce_block", "mfu")})
+        if best is None or r["mfu"] > best["mfu"]:
+            best = r
+    if best is None:
+        raise RuntimeError(f"every mfu_train variant failed: {tried}")
+    best["variants"] = tried
+    return best

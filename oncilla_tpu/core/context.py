@@ -128,8 +128,24 @@ class Ocm:
         nbytes: int,
         kind: OcmKind = OcmKind.LOCAL_HOST,
         device_index: int = 0,
+        local_nbytes: int | None = None,
     ) -> OcmAlloc:
-        """``ocm_alloc`` (/root/reference/src/lib.c:175)."""
+        """``ocm_alloc`` (/root/reference/src/lib.c:175). ``local_nbytes``
+        (remote kinds only) sizes the app-side staging window smaller than
+        the remote region — the reference's asymmetric
+        ``local_alloc_bytes`` idiom (/root/reference/test/ocm_test.c:35-47,
+        mismatch handshake test ib_client.c:194-242); one-sided push/pull
+        then move window-sized pieces at explicit remote offsets."""
+        if local_nbytes is not None:
+            if kind in (OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE):
+                raise OcmInvalidHandle(
+                    "local_nbytes applies to remote kinds (local arms have "
+                    "no staging window)"
+                )
+            if not 0 < local_nbytes <= nbytes:
+                raise OcmInvalidHandle(
+                    f"local_nbytes {local_nbytes} must be in (0, {nbytes}]"
+                )
         with self.tracer.span("alloc"):
             if kind in (OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE):
                 di = 0 if kind == OcmKind.LOCAL_HOST else device_index
@@ -146,6 +162,7 @@ class Ocm:
                 )
             else:
                 h = self._remote_or_raise(kind).alloc(nbytes, kind)
+                h.local_nbytes = local_nbytes
             with self._lock:
                 self._allocs[h.alloc_id] = h
             printd("alloc id=%d kind=%s nbytes=%d", h.alloc_id, kind, nbytes)
@@ -213,7 +230,7 @@ class Ocm:
             return raw.view(dtype).reshape(shape)
         return from_bytes(raw, shape, dtype)
 
-    def localbuf(self, handle: OcmAlloc):
+    def localbuf(self, handle: OcmAlloc, nbytes: int | None = None):
         """``ocm_localbuf`` (/root/reference/src/lib.c:425-460): the app-side
         window onto an allocation. Zero-copy numpy view for LOCAL_HOST;
         materialized jax.Array for LOCAL_DEVICE. For remote kinds the
@@ -222,8 +239,30 @@ class Ocm:
         memory; here the equivalent host staging array is created lazily on
         first request, cached per handle, and released by ``free``. Mutate
         it in place, then ``push``/``pull`` (or ``ocm_copy_onesided`` with
-        ``local=None``) to move it over the fabric."""
+        ``local=None``) to move it over the fabric.
+
+        ``nbytes`` sizes the window smaller than the remote region (the
+        ``alloc(local_nbytes=...)`` idiom, settable here instead as long
+        as the window has not been created yet); asymmetric windows slide
+        over the region via push/pull offsets."""
         self._check_live(handle)
+        if nbytes is not None:
+            if not handle.is_remote:
+                raise OcmInvalidHandle(
+                    "a sized staging window applies to remote kinds only"
+                )
+            if not 0 < nbytes <= handle.nbytes:
+                raise OcmInvalidHandle(
+                    f"window {nbytes} must be in (0, {handle.nbytes}]"
+                )
+            with self._lock:
+                existing = self._stagebufs.get(handle.alloc_id)
+                if existing is not None and existing.nbytes != nbytes:
+                    raise OcmInvalidHandle(
+                        f"staging window already created at "
+                        f"{existing.nbytes} B; cannot resize to {nbytes}"
+                    )
+                handle.local_nbytes = nbytes
         if handle.kind == OcmKind.LOCAL_HOST:
             return self.host_arena.view(handle.extent)
         if handle.kind == OcmKind.LOCAL_DEVICE:
@@ -240,33 +279,49 @@ class Ocm:
                 )
             buf = self._stagebufs.get(handle.alloc_id)
             if buf is None:
-                buf = np.zeros(handle.nbytes, dtype=np.uint8)
+                window = handle.local_nbytes or handle.nbytes
+                buf = np.zeros(window, dtype=np.uint8)
                 self._stagebufs[handle.alloc_id] = buf
         return buf
 
     def _staging_range(self, handle: OcmAlloc, nbytes: int | None,
-                       offset: int) -> int:
+                       offset: int, local_offset: int | None) -> tuple:
+        """Resolve (n, local_offset) for a push/pull: bounds-checked
+        against BOTH the staging window and the remote region. With a
+        full-size window and no explicit local_offset, the window mirrors
+        the region (local_offset = offset, the original symmetric
+        semantics); a smaller window defaults to local_offset 0 — its
+        whole content moves to/from the remote ``offset``."""
         if not handle.is_remote:
             raise OcmInvalidHandle("push/pull is for remote-kind handles")
-        n = handle.nbytes - offset if nbytes is None else nbytes
+        window = handle.local_nbytes or handle.nbytes
+        if local_offset is None:
+            local_offset = offset if window == handle.nbytes else 0
+        if nbytes is None:
+            n = min(window - local_offset, handle.nbytes - offset)
+        else:
+            n = nbytes
+        check_bounds(Extent(0, window), local_offset, n)
         check_bounds(Extent(0, handle.nbytes), offset, n)
-        return n
+        return n, local_offset
 
     def push(self, handle: OcmAlloc, nbytes: int | None = None,
-             offset: int = 0) -> None:
+             offset: int = 0, local_offset: int | None = None) -> None:
         """One-sided write of the staging buffer into a remote allocation
         (the ocm_copy_onesided op_flag=1 leg over the handle's own local
-        buffer, lib.c:670-700)."""
-        n = self._staging_range(handle, nbytes, offset)
+        buffer, lib.c:670-700). ``offset`` addresses the remote region;
+        ``local_offset`` the staging window (see ``_staging_range`` for
+        the defaults)."""
+        n, lo = self._staging_range(handle, nbytes, offset, local_offset)
         buf = self.localbuf(handle)
-        self.put(handle, np.asarray(buf)[offset:offset + n], offset)
+        self.put(handle, np.asarray(buf)[lo:lo + n], offset)
 
     def pull(self, handle: OcmAlloc, nbytes: int | None = None,
-             offset: int = 0) -> None:
+             offset: int = 0, local_offset: int | None = None) -> None:
         """One-sided read of a remote allocation into the staging buffer."""
-        n = self._staging_range(handle, nbytes, offset)
+        n, lo = self._staging_range(handle, nbytes, offset, local_offset)
         buf = self.localbuf(handle)
-        buf[offset:offset + n] = np.asarray(self.get(handle, n, offset))
+        buf[lo:lo + n] = np.asarray(self.get(handle, n, offset))
 
     # -- two-sided copy matrix ------------------------------------------
 
@@ -391,8 +446,8 @@ def ocm_free(ctx: Ocm, handle: OcmAlloc) -> None:
     ctx.free(handle)
 
 
-def ocm_localbuf(ctx: Ocm, handle: OcmAlloc):
-    return ctx.localbuf(handle)
+def ocm_localbuf(ctx: Ocm, handle: OcmAlloc, nbytes: int | None = None):
+    return ctx.localbuf(handle, nbytes)
 
 
 def ocm_is_remote(handle: OcmAlloc) -> bool:
@@ -429,8 +484,11 @@ def ocm_copy_onesided(
         if local is None and handle.is_remote:
             ctx.pull(handle, offset=offset)
             # Same shape as the plain-get path: element 0 is the byte at
-            # ``offset`` (a view into the staging buffer).
-            return ctx.localbuf(handle)[offset:]
+            # ``offset`` (a view into the staging buffer). With an
+            # asymmetric (smaller) window the pull landed at window
+            # position 0, so the whole window is that view.
+            buf = ctx.localbuf(handle)
+            return buf[offset:] if buf.nbytes == handle.nbytes else buf
         n = _nbytes_of(local) if local is not None else None
         return ctx.get(handle, n, offset)
     raise ValueError(f"op must be 'read' or 'write', got {op!r}")

@@ -46,6 +46,11 @@ class OcmAlloc:
     # (host, port) of the owner daemon, filled for DCN-reachable arms —
     # the connectionless address the ALLOC_RESULT reply carries.
     owner_addr: tuple[str, int] | None = field(default=None, compare=False)
+    # App-side staging-window size for remote arms, when smaller than the
+    # remote region — the reference's ``ocm_alloc_params.local_alloc_bytes``
+    # (/root/reference/test/ocm_test.c:35-47): a small local window onto a
+    # large remote allocation. None = window matches ``nbytes``.
+    local_nbytes: int | None = field(default=None, compare=False)
 
     @property
     def is_remote(self) -> bool:

@@ -33,7 +33,8 @@ import numpy as np
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import OcmKind
 
-_MAGIC = b"OCMCKPT1"
+_MAGIC = b"OCMCKPT2"
+_MAGIC_V1 = b"OCMCKPT1"  # legacy: data_start recomputed from _ALIGN
 _ALIGN = 128  # leaf data alignment inside the region
 
 
@@ -82,7 +83,7 @@ def _layout(flat):
         })
         off = _aligned(off + a.nbytes)
     manifest = json.dumps({"leaves": entries}, sort_keys=True).encode()
-    data_start = _aligned(len(_MAGIC) + 8 + len(manifest))
+    data_start = _aligned(len(_MAGIC) + 16 + len(manifest))
     return manifest, data_start, off
 
 
@@ -94,7 +95,12 @@ def save(ctx, tree, kind: OcmKind = OcmKind.LOCAL_HOST, **alloc_kw) -> OcmAlloc:
     # Pack the whole region on the host, then ship it with ONE put — the
     # single large sequential transfer the fabrics move at peak.
     region = np.zeros(data_start + data_len, np.uint8)
-    head = _MAGIC + len(manifest).to_bytes(8, "little") + manifest
+    # data_start is WRITTEN into the header (not recomputed at load), so
+    # checkpoints stay readable even if the alignment policy changes.
+    head = (
+        _MAGIC + len(manifest).to_bytes(8, "little")
+        + data_start.to_bytes(8, "little") + manifest
+    )
     region[: len(head)] = np.frombuffer(head, np.uint8)
     mf = json.loads(manifest)
     for (key, a), ent in zip(flat, mf["leaves"]):
@@ -111,18 +117,25 @@ def load(ctx, handle: OcmAlloc, like=None):
     """Read a checkpoint back. With ``like`` (a pytree of the same
     structure), returns that structure with numpy leaves; otherwise
     returns ``{key: array}`` keyed by flattened tree paths."""
-    head = np.asarray(ctx.get(handle, nbytes=len(_MAGIC) + 8, offset=0))
-    magic, (mlen,) = head[:8].tobytes(), np.frombuffer(
-        head[8:].tobytes(), "<u8"
-    )
-    if magic != _MAGIC:
+    head = np.asarray(ctx.get(handle, nbytes=len(_MAGIC) + 16, offset=0))
+    magic = head[:8].tobytes()
+    (mlen,) = np.frombuffer(head[8:16].tobytes(), "<u8")
+    if magic == _MAGIC:
+        # v2: data_start comes from the header — the writer's alignment
+        # policy at save time is authoritative, not this module's.
+        (data_start,) = np.frombuffer(head[16:24].tobytes(), "<u8")
+        data_start = int(data_start)
+        manifest_off = len(_MAGIC) + 16
+    elif magic == _MAGIC_V1:
+        data_start = _aligned(len(_MAGIC) + 8 + int(mlen))
+        manifest_off = len(_MAGIC) + 8
+    else:
         raise ValueError(f"not an OCM checkpoint (magic {magic!r})")
     manifest = json.loads(
         np.asarray(
-            ctx.get(handle, nbytes=int(mlen), offset=len(_MAGIC) + 8)
+            ctx.get(handle, nbytes=int(mlen), offset=manifest_off)
         ).tobytes()
     )
-    data_start = _aligned(len(_MAGIC) + 8 + int(mlen))
     # ONE get for the whole data region, then slice per manifest entry
     # (offsets are stored, not recomputed — old checkpoints stay readable
     # even if the writer's alignment policy changes).

@@ -80,8 +80,11 @@ class PagedKVCache:
         ):
             for h in self.pages:
                 raw = self.backend.get(h, self.page_bytes, 0)
+                # jnp.asarray: device-resident gets stay on device (a
+                # numpy round-trip here cost a sync + two transfers per
+                # page on the tunneled chip); host-arm gets upload once.
                 packed = from_bytes(
-                    jnp.asarray(np.asarray(raw)), self.page_shape, self.dtype
+                    jnp.asarray(raw), self.page_shape, self.dtype
                 )
                 ks.append(packed[0])
                 vs.append(packed[1])
@@ -162,20 +165,22 @@ def paged_decode_step(
     return logits[:, 0], (jnp.stack(new_k), jnp.stack(new_v))
 
 
-@partial(jax.jit, static_argnames=("cfg", "layer_params_fn", "mlp_of"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "layer_params_fn", "mlp_of"),
+    donate_argnums=(5, 6),
+)
 def paged_decode_step_jit(
     params: dict,
     token: jax.Array,      # (B,) current token ids
-    pos: jax.Array,        # scalar current absolute position
+    meta: jax.Array,       # (3,) int32 [pos, tail_len, ctx_start]
     k_ctx: jax.Array,      # (L, B, KV, C, Hd) paged context; C may be 0
     v_ctx: jax.Array,
-    tail_k: jax.Array,     # (L, B, KV, P, Hd) local tail buffer
+    tail_k: jax.Array,     # (L, B, KV, P, Hd) local tail buffer (donated)
     tail_v: jax.Array,
-    tail_len: jax.Array,   # scalar: valid tail entries before this step
     cfg: LlamaConfig,
     layer_params_fn=None,
     mlp_of=None,
-    ctx_start: jax.Array | int = 0,  # global position of k_ctx[..., 0, :]
 ):
     """Shape-bucketed jitted paged decode.
 
@@ -187,6 +192,13 @@ def paged_decode_step_jit(
     static-shape formulation TPU/XLA wants and what makes paged decode
     usable as a real-chip benchmark (BASELINE.md config 5).
 
+    Per-step host traffic is ONE packed (3,) int32 transfer: ``meta``
+    carries [pos, tail_len, ctx_start] (ctx_start = global position of
+    ``k_ctx[..., 0, :]`` after evictions). Three separate scalar uploads
+    cost ~a dispatch each on a tunneled chip — the bulk of r3's paged
+    per-token deficit vs the plain loop. The tail buffers are donated:
+    XLA updates them in place instead of allocating fresh ones per step.
+
     Returns (logits, new_tail_k, new_tail_v); the caller owns tail_len
     bookkeeping and page shipping. ``layer_params_fn``/``mlp_of`` are the
     family hooks (static under jit) — see :func:`paged_decode_step`.
@@ -194,8 +206,22 @@ def paged_decode_step_jit(
     from oncilla_tpu.models import llama
 
     lp_fn = layer_params_fn or llama.layer_params
+    return _paged_token(
+        params, token, meta[0], meta[1], meta[2], k_ctx, v_ctx,
+        tail_k, tail_v, cfg, lp_fn, mlp_of,
+    )
+
+
+def _paged_token(params, token, pos, tail_len, ctx_start, k_ctx, v_ctx,
+                 tail_k, tail_v, cfg, lp_fn, mlp_of):
+    """One paged-decode token: the traced body shared by the per-token jit
+    (:func:`paged_decode_step_jit`) and the page-fused scan
+    (:func:`paged_decode_page_jit`). All of pos/tail_len/ctx_start are
+    traced scalars."""
+    from oncilla_tpu.models import llama
+
     x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
-    positions = pos[None] if pos.ndim == 0 else pos
+    positions = pos[None]
     P = tail_k.shape[3]
     C = k_ctx.shape[3]
     # Keys = [paged context (all valid) | tail slots (valid through this
@@ -239,6 +265,57 @@ def paged_decode_step_jit(
 
     logits = llama.final_logits(params, x, cfg)
     return logits[:, 0], tail_k, tail_v
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "layer_params_fn", "mlp_of"),
+    donate_argnums=(5, 6),
+)
+def paged_decode_page_jit(
+    params: dict,
+    tokens_page: jax.Array,  # (B, P) one full page of token ids
+    meta: jax.Array,         # (2,) int32 [pos0, ctx_start]
+    k_ctx: jax.Array,        # (L, B, KV, C, Hd) paged context; C may be 0
+    v_ctx: jax.Array,
+    tail_k: jax.Array,       # (L, B, KV, P, Hd) tail buffer (donated)
+    tail_v: jax.Array,
+    cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """One full page of paged decode as ONE compiled program: a
+    ``lax.scan`` over the page's P tokens with the tail buffers threaded
+    (and donated) through the carry — the per-page-dispatch formulation a
+    TPU serving loop wants (the per-token loop pays one host dispatch per
+    token; this pays one per page, the same trade as
+    :func:`llama.decode_loop` at page granularity, with the paged OCM
+    context still on the attention path).
+
+    Starts from an empty tail (tail_len 0); token j of the page decodes
+    at absolute position pos0 + j with tail_len j. Returns
+    (logits (B, P, vocab), new_tail_k, new_tail_v) — the caller ships the
+    now-full tail as a page.
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    pos0, ctx_start = meta[0], meta[1]
+    P = tail_k.shape[3]
+
+    def body(carry, inp):
+        tail_k, tail_v = carry
+        tok, j = inp
+        logits, tail_k, tail_v = _paged_token(
+            params, tok, pos0 + j, j, ctx_start, k_ctx, v_ctx,
+            tail_k, tail_v, cfg, lp_fn, mlp_of,
+        )
+        return (tail_k, tail_v), logits
+
+    (tail_k, tail_v), logits = jax.lax.scan(
+        body, (tail_k, tail_v), (tokens_page.T, jnp.arange(P))
+    )
+    return logits.transpose(1, 0, 2), tail_k, tail_v
 
 
 class BucketedPagedDecoder:
@@ -285,53 +362,89 @@ class BucketedPagedDecoder:
         self._fetched = (jnp.zeros(empty, dt), jnp.zeros(empty, dt))
 
     def step(self, token: jax.Array) -> jax.Array:
+        meta = jnp.asarray(
+            [self.pos, self._tail_len, self._ctx_start], dtype=jnp.int32
+        )
         logits, self._tail_k, self._tail_v = paged_decode_step_jit(
-            self.params, token, jnp.int32(self.pos),
+            self.params, token, meta,
             self._fetched[0], self._fetched[1],
-            self._tail_k, self._tail_v, jnp.int32(self._tail_len), self.cfg,
-            ctx_start=jnp.int32(self._ctx_start),
+            self._tail_k, self._tail_v, self.cfg,
             **self._hooks,
         )
         self.pos += 1
         self._tail_len += 1
         if self._tail_len == self.page_tokens:
-            # Ship the full tail into the pod and extend the local concat
-            # (same O(pages) traffic policy as PagedDecoder.step).
-            k_page = self._tail_k.astype(jnp.dtype(self.cache.dtype))
-            v_page = self._tail_v.astype(jnp.dtype(self.cache.dtype))
-            self.cache.store_page(k_page, v_page)
-            dt = jnp.dtype(self.cfg.dtype)
-            # Sliding-window eviction: a page whose every key is outside
-            # the window of all future queries (>= self.pos) is freed from
-            # OCM and dropped from the local concat, keeping the working
-            # set O(window) instead of O(pos) — the rolling-buffer
-            # semantics of the Mistral scheme, on paged storage.
-            if self.cfg.window is not None:
-                while (self.cache.pages and self._ctx_start
-                       + self.page_tokens <= self.pos - self.cfg.window):
-                    self.cache.drop_oldest()
-                    self._ctx_start += self.page_tokens
-                    if not self.refetch:
-                        self._fetched = (
-                            self._fetched[0][:, :, :, self.page_tokens:],
-                            self._fetched[1][:, :, :, self.page_tokens:],
-                        )
-            if self.refetch:
-                fk, fv = self.cache.fetch_pages()
-                self._fetched = (fk.astype(dt), fv.astype(dt))
-            else:
-                self._fetched = (
-                    jnp.concatenate(
-                        [self._fetched[0], k_page.astype(dt)], axis=3
-                    ),
-                    jnp.concatenate(
-                        [self._fetched[1], v_page.astype(dt)], axis=3
-                    ),
-                )
-            # Stale tail contents are masked out by tail_len; no need to
-            # zero the buffers.
-            self._tail_len = 0
+            self._ship_page()
         return logits
+
+    def step_page(self, tokens_page: jax.Array) -> jax.Array:
+        """Decode one FULL page of teacher-forced tokens in a single
+        compiled dispatch (:func:`paged_decode_page_jit`), then ship the
+        page — the per-page-dispatch serving loop. Requires an empty tail
+        (step/step_page calls must align to page boundaries) and
+        ``tokens_page.shape[-1] == page_tokens``. Returns per-token logits
+        (B, P, vocab)."""
+        if self._tail_len != 0:
+            raise ValueError(
+                f"step_page needs an empty tail (tail_len="
+                f"{self._tail_len}); align step()/step_page() calls to "
+                "page boundaries"
+            )
+        if tokens_page.shape[-1] != self.page_tokens:
+            raise ValueError(
+                f"step_page wants exactly page_tokens="
+                f"{self.page_tokens} ids, got {tokens_page.shape[-1]}"
+            )
+        meta = jnp.asarray([self.pos, self._ctx_start], dtype=jnp.int32)
+        logits, self._tail_k, self._tail_v = paged_decode_page_jit(
+            self.params, tokens_page, meta,
+            self._fetched[0], self._fetched[1],
+            self._tail_k, self._tail_v, self.cfg,
+            **self._hooks,
+        )
+        self.pos += self.page_tokens
+        self._tail_len = self.page_tokens
+        self._ship_page()
+        return logits
+
+    def _ship_page(self) -> None:
+        """Page boundary: ship the full tail into the pod and extend the
+        local concat (same O(pages) traffic policy as PagedDecoder.step);
+        with ``refetch`` re-read the whole paged context instead."""
+        k_page = self._tail_k.astype(jnp.dtype(self.cache.dtype))
+        v_page = self._tail_v.astype(jnp.dtype(self.cache.dtype))
+        self.cache.store_page(k_page, v_page)
+        dt = jnp.dtype(self.cfg.dtype)
+        # Sliding-window eviction: a page whose every key is outside
+        # the window of all future queries (>= self.pos) is freed from
+        # OCM and dropped from the local concat, keeping the working
+        # set O(window) instead of O(pos) — the rolling-buffer
+        # semantics of the Mistral scheme, on paged storage.
+        if self.cfg.window is not None:
+            while (self.cache.pages and self._ctx_start
+                   + self.page_tokens <= self.pos - self.cfg.window):
+                self.cache.drop_oldest()
+                self._ctx_start += self.page_tokens
+                if not self.refetch:
+                    self._fetched = (
+                        self._fetched[0][:, :, :, self.page_tokens:],
+                        self._fetched[1][:, :, :, self.page_tokens:],
+                    )
+        if self.refetch:
+            fk, fv = self.cache.fetch_pages()
+            self._fetched = (fk.astype(dt), fv.astype(dt))
+        else:
+            self._fetched = (
+                jnp.concatenate(
+                    [self._fetched[0], k_page.astype(dt)], axis=3
+                ),
+                jnp.concatenate(
+                    [self._fetched[1], v_page.astype(dt)], axis=3
+                ),
+            )
+        # Stale tail contents are masked out by tail_len; no need to
+        # zero the buffers.
+        self._tail_len = 0
 
     def close(self) -> None:
         self.cache.free()

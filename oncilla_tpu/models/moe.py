@@ -264,7 +264,14 @@ def mlp_of(cfg: MoeConfig, mesh=None, ep_axis: str | None = None):
     Memoized on (cfg, mesh, ep_axis): the paged jit step declares the
     hook STATIC (identity-hashed), so equal configs must share one
     callable or every decoder instance would retrace and recompile all
-    its shape buckets."""
+    its shape buckets.
+
+    Retention: the lru_cache keeps strong references to up to 64
+    (cfg, Mesh) keys for process lifetime — a Mesh pinned here (and its
+    devices) outlives the session that created it. Deliberate: jax's own
+    jit caches retain the same objects anyway, the bound is small, and a
+    weak-keyed cache would break the identity contract above whenever
+    the caller drops its Mesh between decode sessions."""
 
     def of(lp):
         def mlp(hn):

@@ -178,11 +178,14 @@ def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
 
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
-                    remat: bool = False, offload_opt: bool = False,
-                    opt_state=None):
+                    remat=False, offload_opt: bool = False,
+                    opt_state=None, ce_block: int | None = None):
     """The jitted full training step (forward + backward + adamw update),
     sharded over the (dp, tp, sp) mesh. ``remat`` checkpoints each block
-    (recompute-in-backward) to fit longer sequences / bigger batches;
+    (recompute-in-backward) to fit longer sequences / bigger batches —
+    ``True`` for the full checkpoint, ``"dots"`` for the dots-saveable
+    policy (elementwise-only recompute); ``ce_block`` switches the loss to
+    the blocked vocab-head CE (no (B, S, V) logits materialized);
     ``offload_opt`` keeps Adam state in TPU-VM host memory — pass the
     state built by ``make_train_state*(offload_opt=True)`` as
     ``opt_state`` so the step knows its leaf specs.
@@ -197,7 +200,8 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
     seq_axis = SP if use_ring and mesh.shape[SP] > 1 else None
     return _jit_step(
         lambda p, tokens: loss_fn(
-            p, tokens, cfg, mesh=mesh, seq_axis=seq_axis, remat=remat
+            p, tokens, cfg, mesh=mesh, seq_axis=seq_axis, remat=remat,
+            ce_block=ce_block,
         ),
         param_specs(cfg), mesh, data_spec(), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
@@ -251,14 +255,24 @@ def evaluate(params, batches, eval_step) -> dict:
 # -- expert parallelism (MoE family) ---------------------------------------
 
 
-def make_moe_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+def make_moe_mesh(n_devices: int | None = None, devices=None,
+                  n_experts: int | None = None) -> Mesh:
     """Factor devices into a (dp, ep, tp) mesh: ep first (the MoE axis),
-    then tp, rest dp."""
+    then tp, rest dp.
+
+    Without ``n_experts`` the factory keeps ep ≤ 2 (a balanced default
+    that leaves devices for dp and tp on small meshes). Pass the model's
+    expert count to let ep grow to the largest power-of-two divisor of
+    the device count that does not exceed it — e.g. 8 experts on 8
+    devices gives an (1, 8, 1) mesh with one expert shard per device."""
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
-    ep = 2 if n % 2 == 0 else 1
+    ep_cap = 2 if n_experts is None else n_experts
+    ep = 1
+    while ep * 2 <= ep_cap and n % (ep * 2) == 0:
+        ep *= 2
     tp = 2 if (n // ep) % 2 == 0 else 1
     dp = n // (ep * tp)
     arr = np.asarray(devices).reshape(dp, ep, tp)
@@ -436,7 +450,14 @@ def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
         if moe_aux:
             # aux sums one O(1) load-balance term per (layer, microbatch);
             # divide by microbatches so the regularizer scale matches the
-            # non-pipelined moe.loss_fn (one term per layer).
+            # non-pipelined moe.loss_fn (one term per layer). Scale, not
+            # value: under dp the pipelined aux is a pmean of per-dp-shard
+            # load-balance terms (each over its local tokens), while the
+            # non-pipelined family computes the term over the global
+            # batch — a mean of ratios vs a ratio of means. Same
+            # magnitude and gradient direction, not bit-identical; fine
+            # for a regularizer, but don't assert numeric equality of the
+            # two families' losses under dp.
             ce = ce + cfg.router_aux_weight * aux / microbatches
         return ce
 

@@ -59,6 +59,23 @@ def force_cpu_devices(n_devices: int) -> None:
     )
 
 
+def honor_cpu_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS=cpu`` request stick. This image's
+    sitecustomize force-sets jax_platforms to "axon,cpu" in every process,
+    so the env var alone is silently overridden — and with a wedged
+    tunnel, ANY device discovery then hangs. Entry points that users run
+    with JAX_PLATFORMS=cpu (the daemon CLI, examples) call this before
+    first device use; a no-op unless the env var says exactly "cpu"."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    drop_tunnel_plugin()
+
+
 def drop_tunnel_plugin(name: str = "axon") -> None:
     """Remove a PJRT plugin's backend factory so a wedged tunnel cannot
     hang device discovery. Only the tunnel-dialing plugin may be dropped

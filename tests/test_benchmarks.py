@@ -169,3 +169,35 @@ def test_gups_handles_multidevice_plane_rows_untouched():
     for d in range(1, ndev):
         got = np.asarray(sa.host_get(plane.arena, d, 64, 4096, mesh=mesh))
         np.testing.assert_array_equal(got, stamps[d])
+
+
+def test_ceiling_probes_interpret():
+    """The HBM ceiling probes at toy sizes under the interpret machine:
+    rates positive, the read-only stream leaves the buffer untouched, the
+    VMEM round-trip moves the right bytes (ping-pong parity)."""
+    import jax
+
+    from oncilla_tpu.benchmarks import ceiling
+
+    assert ceiling.hbm_read_gbps(512 << 10, 128 << 10, iters=2) > 0
+    assert ceiling.copy_gbps(2, total_bytes=256 << 10, nbytes=64 << 10,
+                             iters=4) > 0
+    assert ceiling.vmem_roundtrip_gbps(
+        total_bytes=256 << 10, nbytes=64 << 10, iters=2, chunk_bytes=32 << 10
+    ) > 0
+
+    # Correctness of the round-trip loop: after an even number of
+    # ping-pong iterations segment 0 is intact and segment 1 holds its
+    # copy; bytes past 2*nbytes are untouched.
+    rng2 = np.random.default_rng(7)
+    buf = rng2.integers(0, 256, 256 << 10, dtype=np.uint8)
+    run = ceiling._vmem_roundtrip_loop(256 << 10, 64 << 10, 2, 32 << 10)
+    out = np.asarray(run(jax.device_put(buf))).reshape(-1)
+    np.testing.assert_array_equal(out[: 64 << 10], buf[: 64 << 10])
+    np.testing.assert_array_equal(out[64 << 10: 128 << 10], buf[: 64 << 10])
+    np.testing.assert_array_equal(out[128 << 10:], buf[128 << 10:])
+
+    # The read-only stream writes nothing back to HBM.
+    run = ceiling._read_stream_loop(256 << 10, 64 << 10, iters=2)
+    out = np.asarray(run(jax.device_put(buf))).reshape(-1)
+    np.testing.assert_array_equal(out, buf)

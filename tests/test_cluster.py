@@ -409,3 +409,100 @@ def test_handle_sharing_between_apps(rng):
         producer.free(h)
         with pytest.raises(ocm.OcmProtocolError):
             consumer.get(h2, nbytes=16)
+
+
+def test_localbuf_size_asymmetry(rng):
+    """Local/remote allocation-size asymmetry (the reference's
+    local_alloc_bytes idiom, /root/reference/test/ocm_test.c:35-47 and the
+    buffer-size-mismatch handshake test ib_client.c:194-242): a small
+    staging window slides over a larger remote region via explicit
+    offsets."""
+    with local_cluster(2, config=small_cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(64 << 10, OcmKind.REMOTE_HOST, local_nbytes=4 << 10)
+        buf = ctx.localbuf(h)
+        assert buf.nbytes == 4 << 10        # window, not region
+        assert ctx.remote_sz(h) == 64 << 10  # region unchanged
+
+        # Window-sized pieces land at different remote offsets (the
+        # strings-at-offsets exchange of the mismatch test).
+        pieces = {}
+        for off in (0, 4 << 10, 32 << 10, 60 << 10):
+            piece = rng.integers(0, 256, 4 << 10, dtype=np.uint8)
+            pieces[off] = piece
+            buf[:] = piece
+            ctx.push(h, offset=off)
+        for off, piece in pieces.items():
+            np.testing.assert_array_equal(
+                np.asarray(ctx.get(h, nbytes=4 << 10, offset=off)), piece
+            )
+
+        # Pull a remote slice back through the window at a local offset.
+        buf[:] = 0
+        ctx.pull(h, nbytes=1 << 10, offset=32 << 10, local_offset=2 << 10)
+        np.testing.assert_array_equal(
+            buf[2 << 10: 3 << 10], pieces[32 << 10][: 1 << 10]
+        )
+
+        # Mismatch is bounded: window overflow and region overflow raise.
+        with pytest.raises(ocm.OcmBoundsError):
+            ctx.push(h, nbytes=8 << 10)             # > window
+        # With nbytes=None a near-the-end push clamps to what fits (the
+        # window slides off the region tail); an explicit nbytes that
+        # overflows the region raises.
+        tail = rng.integers(0, 256, 4 << 10, dtype=np.uint8)
+        buf[:] = tail
+        ctx.push(h, offset=(63 << 10) + 100)
+        np.testing.assert_array_equal(
+            np.asarray(ctx.get(h, nbytes=924, offset=(63 << 10) + 100)),
+            tail[:924],
+        )
+        with pytest.raises(ocm.OcmBoundsError):
+            ctx.push(h, nbytes=4 << 10, offset=(63 << 10) + 100)
+        with pytest.raises(ocm.OcmBoundsError):
+            ctx.pull(h, nbytes=1 << 10, local_offset=3584)  # window tail
+
+        ctx.free(h)
+
+        # local_nbytes is remote-only and must fit the region.
+        with pytest.raises(ocm.OcmInvalidHandle):
+            ctx.alloc(4096, OcmKind.LOCAL_HOST, local_nbytes=1024)
+        with pytest.raises(ocm.OcmInvalidHandle):
+            ctx.alloc(4096, OcmKind.REMOTE_HOST, local_nbytes=8192)
+
+
+def test_localbuf_nbytes_window(rng):
+    """localbuf(handle, nbytes=) sets the window without the alloc-time
+    kwarg; resizing an existing window is rejected."""
+    with local_cluster(2, config=small_cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(16 << 10, OcmKind.REMOTE_HOST)
+        buf = ctx.localbuf(h, nbytes=2 << 10)
+        assert buf.nbytes == 2 << 10
+        assert ctx.localbuf(h) is buf
+        piece = rng.integers(0, 256, 2 << 10, dtype=np.uint8)
+        buf[:] = piece
+        ctx.push(h, offset=8 << 10)
+        np.testing.assert_array_equal(
+            np.asarray(ctx.get(h, nbytes=2 << 10, offset=8 << 10)), piece
+        )
+        with pytest.raises(ocm.OcmInvalidHandle, match="resize"):
+            ctx.localbuf(h, nbytes=4 << 10)
+        with pytest.raises(ocm.OcmInvalidHandle):
+            lh = ctx.alloc(4096, OcmKind.LOCAL_HOST)
+            ctx.localbuf(lh, nbytes=1024)
+        ctx.free(h)
+
+
+def test_copy_onesided_read_with_window(rng):
+    """ocm_copy_onesided(op='read', local=None) on an asymmetric window:
+    the returned view starts at the pulled remote offset (the window
+    itself), not a symmetric slice past the window's end."""
+    with local_cluster(2, config=small_cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(64 << 10, OcmKind.REMOTE_HOST, local_nbytes=4 << 10)
+        piece = rng.integers(0, 256, 4 << 10, dtype=np.uint8)
+        ctx.put(h, piece, offset=8 << 10)
+        out = ocm.ocm_copy_onesided(ctx, h, op="read", offset=8 << 10)
+        np.testing.assert_array_equal(out[: 4 << 10], piece)
+        ctx.free(h)

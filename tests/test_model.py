@@ -508,3 +508,101 @@ def test_evaluate_token_weighted(rng):
     l_small = float(llama.loss_fn(params, small, CFG))
     want = (l_big * 8 * 31 + l_small * 2 * 31) / (8 * 31 + 2 * 31)
     np.testing.assert_allclose(res["loss"], want, rtol=1e-4)
+
+
+def test_blocked_ce_matches_plain(rng):
+    """blocked_cross_entropy (no (B,S,V) logits tensor) must equal the
+    plain log_softmax CE, including when the sequence doesn't divide the
+    block (padding + mask), and its gradients must match."""
+    params = llama.init_params(jax.random.key(3), CFG)
+    for seq in (32, 27):  # 27: pad path (block 8 -> pad 5)
+        tokens = train.sample_batch(rng, CFG, 3, seq)
+        plain = llama.loss_fn(params, tokens, CFG)
+        blocked = llama.loss_fn(params, tokens, CFG, ce_block=8)
+        np.testing.assert_allclose(
+            float(blocked), float(plain), rtol=2e-6
+        )
+    g_plain = jax.grad(lambda p: llama.loss_fn(p, tokens, CFG))(params)
+    g_blk = jax.grad(
+        lambda p: llama.loss_fn(p, tokens, CFG, ce_block=8)
+    )(params)
+    for k in g_plain:
+        np.testing.assert_allclose(
+            np.asarray(g_blk[k], np.float32),
+            np.asarray(g_plain[k], np.float32),
+            rtol=5e-5, atol=1e-6, err_msg=k,
+        )
+
+
+def test_dots_remat_and_blocked_ce_train_step(rng):
+    """remat="dots" + ce_block: same loss trajectory as the plain step
+    (the variant mfu_train_best sweeps on the chip)."""
+    mesh = train.make_mesh(8)
+    tokens = jax.device_put(
+        train.sample_batch(rng, CFG, 4, 32),
+        jax.sharding.NamedSharding(mesh, train.data_spec()),
+    )
+    losses = {}
+    for mode in ("plain", "dots"):
+        params, opt_state, tx = train.make_train_state(
+            jax.random.key(9), CFG, mesh, lr=1e-2
+        )
+        step = train.make_train_step(
+            CFG, mesh, tx,
+            remat="dots" if mode == "dots" else False,
+            ce_block=8 if mode == "dots" else None,
+        )
+        ls = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            ls.append(float(loss))
+        losses[mode] = ls
+    np.testing.assert_allclose(losses["dots"], losses["plain"], rtol=1e-5)
+
+
+def test_step_page_matches_per_token(rng):
+    """The page-fused decode (one scan dispatch per page) produces the
+    same logits as the per-token bucketed decoder, with and without a
+    sliding window, and interleaves with per-token steps at page
+    boundaries."""
+    from dataclasses import replace
+
+    import oncilla_tpu as ocm_pkg
+    from oncilla_tpu.models.kv_paging import BucketedPagedDecoder
+
+    for window in (None, 4):
+        cfg_w = replace(CFG, window=window, max_seq=32)
+        params = llama.init_params(jax.random.key(13), CFG)
+        tokens = train.sample_batch(rng, cfg_w, 1, 12)
+        ctx = ocm_pkg.ocm_init(ocm_pkg.OcmConfig(
+            host_arena_bytes=16 << 20, device_arena_bytes=1 << 20,
+        ))
+        try:
+            kw = dict(batch=1, page_tokens=4,
+                      kind=ocm_pkg.OcmKind.LOCAL_HOST, dtype="float32")
+            ref = BucketedPagedDecoder(params, cfg_w, ctx, **kw)
+            want = [np.asarray(ref.step(tokens[:, i])[0]) for i in range(12)]
+            ref.close()
+
+            dec = BucketedPagedDecoder(params, cfg_w, ctx, **kw)
+            got = []
+            # Page 0 fused, page 1 per-token, page 2 fused: both APIs
+            # compose across boundaries.
+            lg = dec.step_page(tokens[:, 0:4])
+            got += [np.asarray(lg[0, j]) for j in range(4)]
+            for i in range(4, 8):
+                got.append(np.asarray(dec.step(tokens[:, i])[0]))
+            lg = dec.step_page(tokens[:, 8:12])
+            got += [np.asarray(lg[0, j]) for j in range(4)]
+            dec.close()
+            for i in range(12):
+                np.testing.assert_allclose(
+                    got[i], want[i], atol=2e-3, rtol=2e-3,
+                    err_msg=f"window={window} pos {i}",
+                )
+            with np.testing.assert_raises(Exception):
+                dec2 = BucketedPagedDecoder(params, cfg_w, ctx, **kw)
+                dec2.step(tokens[:, 0])
+                dec2.step_page(tokens[:, 1:5])  # tail not empty
+        finally:
+            ctx.tini()
