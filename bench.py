@@ -331,6 +331,33 @@ def check_pallas_ici_copy(errors: dict) -> bool:
         return False
 
 
+def check_dma_row_kernels(errors: dict) -> bool:
+    """The DMA row kernels behind DeviceArena's aligned >=1 MiB extent path
+    (pallas_write_rows / pallas_read_rows / pallas_local_copy — what the
+    gb_sweep read leg measures): pattern roundtrip + on-chip move through a
+    LOCAL_DEVICE context on the real chip."""
+    try:
+        dctx = ocm.ocm_init(ocm.OcmConfig(device_arena_bytes=16 << 20))
+        try:
+            hd = dctx.alloc(4 << 20, OcmKind.LOCAL_DEVICE)
+            pat3 = (np.arange(2 << 20, dtype=np.uint64) % 239).astype(np.uint8)
+            dctx.put(hd, pat3)                       # DMA write path
+            got = np.asarray(dctx.get(hd, nbytes=2 << 20))   # DMA read path
+            if not np.array_equal(got, pat3):
+                raise RuntimeError("DMA row write/read mismatch")
+            hd2 = dctx.alloc(2 << 20, OcmKind.LOCAL_DEVICE)
+            dctx.copy(hd2, hd, 1 << 20)              # DMA move path
+            got = np.asarray(dctx.get(hd2, nbytes=1 << 20))
+            if not np.array_equal(got, pat3[: 1 << 20]):
+                raise RuntimeError("DMA row move mismatch")
+        finally:
+            dctx.tini()
+        return True
+    except Exception as e:  # noqa: BLE001
+        errors["dma_row_kernels"] = f"{type(e).__name__}: {e}"
+        return False
+
+
 def bench_pallas_copy(buf, streams: int = 2) -> tuple[float, jax.Array]:
     # Warm up with the same executable that is timed. Running a separately
     # compiled warm-up loop first costs ~9% of steady-state bandwidth on the
@@ -571,6 +598,9 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
     if budgeted("pallas_ici_copy", 90):
         out["detail"]["pallas_ici_verified"] = check_pallas_ici_copy(errors)
     mark("pallas_ici")
+    if budgeted("dma_row_kernels", 80):
+        out["detail"]["dma_rows_verified"] = check_dma_row_kernels(errors)
+    mark("dma_rows")
 
     # Single-chip MFU on the flagship model (the chip-filling ~1.1B
     # config; the train step at a smaller batch so grads + Adam moments

@@ -187,7 +187,9 @@ def mfu_train_best(deadline: float | None = None) -> dict:
        of moment traffic amortizes over 2x the FLOPs) at ~zero extra MXU
        work; fits only because dots-remat + blocked CE free the activation
        HBM that made batch 8 OOM at r3.
-    2. batch 4 baseline (r3's 0.558) — the fallback.
+    2. batch 8, blocked CE only — if the (B, S, V) logits tensor was the
+       OOM driver, this wins over 1 (no recompute at all).
+    3. batch 4 baseline (r3's 0.558) — the fallback.
 
     With ``deadline`` (time.monotonic()), later variants are skipped once
     it passes; a variant that fails (e.g. OOM at compile) is recorded and
@@ -195,6 +197,7 @@ def mfu_train_best(deadline: float | None = None) -> dict:
     cfg, batch4, seq = train_sized_config()
     variants = [
         dict(batch=8, remat="dots", ce_block=512),
+        dict(batch=8, remat=False, ce_block=512),
         dict(batch=batch4, remat=False, ce_block=None),
     ]
     best, tried = None, []

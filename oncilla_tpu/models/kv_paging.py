@@ -318,6 +318,65 @@ def paged_decode_page_jit(
     return logits.transpose(1, 0, 2), tail_k, tail_v
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "layer_params_fn", "mlp_of"),
+    donate_argnums=(5, 6),
+)
+def paged_generate_page_jit(
+    params: dict,
+    token0: jax.Array,       # (B,) the token that seeds this page
+    meta: jax.Array,         # (2,) int32 [pos0, ctx_start]
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    tail_k: jax.Array,       # (L, B, KV, P, Hd) empty tail (donated)
+    tail_v: jax.Array,
+    cfg: LlamaConfig,
+    key: jax.Array,
+    temperature: float = 0.0,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """One page of *autoregressive* paged decode as ONE compiled program:
+    each scan tick consumes the previous tick's sample (greedy at
+    ``temperature`` 0, else softmax sampling) — the sampled flavor of
+    :func:`paged_decode_page_jit` and the per-page serving loop proper
+    (the paged counterpart of :func:`llama.generate`'s sampling scan).
+
+    Returns (sampled ids (B, P), new_tail_k, new_tail_v). The tail holds
+    K/V of every *consumed* token this page (token0 + the first P-1
+    samples); the final sample is output-only and seeds the next page.
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    pos0, ctx_start = meta[0], meta[1]
+    P = tail_k.shape[3]
+
+    def pick(logits_b, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits_b, axis=-1).astype(token0.dtype)
+        return jax.random.categorical(
+            k, logits_b / jnp.float32(temperature), axis=-1
+        ).astype(token0.dtype)
+
+    def body(carry, inp):
+        tok, tail_k, tail_v = carry
+        j, k_j = inp
+        logits, tail_k, tail_v = _paged_token(
+            params, tok, pos0 + j, j, ctx_start, k_ctx, v_ctx,
+            tail_k, tail_v, cfg, lp_fn, mlp_of,
+        )
+        nxt = pick(logits, k_j)
+        return (nxt, tail_k, tail_v), nxt
+
+    keys = jax.random.split(key, P)
+    (last, tail_k, tail_v), out = jax.lax.scan(
+        body, (token0, tail_k, tail_v), (jnp.arange(P), keys)
+    )
+    return out.transpose(1, 0), tail_k, tail_v
+
+
 class BucketedPagedDecoder:
     """Jitted decode session with OCM-paged KV history.
 
@@ -406,6 +465,35 @@ class BucketedPagedDecoder:
         self._tail_len = self.page_tokens
         self._ship_page()
         return logits
+
+    def generate_page(self, token: jax.Array, *, key: jax.Array | None = None,
+                      temperature: float = 0.0) -> jax.Array:
+        """Autoregressively sample one full page in a single compiled
+        dispatch (:func:`paged_generate_page_jit`), then ship it. ``token``
+        is the (B,) seed (the previous page's last sample, or the last
+        prompt token); returns the (B, page_tokens) sampled ids — the last
+        of which seeds the next ``generate_page`` call. Greedy at
+        ``temperature`` 0, else softmax sampling with ``key``. Requires an
+        empty tail (page-boundary-aligned, same as :meth:`step_page`)."""
+        if self._tail_len != 0:
+            raise ValueError(
+                f"generate_page needs an empty tail (tail_len="
+                f"{self._tail_len}); align calls to page boundaries"
+            )
+        if key is None:
+            key = jax.random.key(self.pos)
+        meta = jnp.asarray([self.pos, self._ctx_start], dtype=jnp.int32)
+        out, self._tail_k, self._tail_v = paged_generate_page_jit(
+            self.params, token, meta,
+            self._fetched[0], self._fetched[1],
+            self._tail_k, self._tail_v, self.cfg, key,
+            temperature=temperature,
+            **self._hooks,
+        )
+        self.pos += self.page_tokens
+        self._tail_len = self.page_tokens
+        self._ship_page()
+        return out
 
     def _ship_page(self) -> None:
         """Page boundary: ship the full tail into the pod and extend the

@@ -382,15 +382,21 @@ int ocmc_get(ocmc_ctx* ctx, const ocmc_handle* h, void* buf, uint64_t nbytes,
   }
 }
 
-void* ocmc_localbuf(ocmc_ctx* ctx, const ocmc_handle* h) {
-  if (!ctx || !h) return nullptr;
+static void* localbuf_impl(ocmc_ctx* ctx, const ocmc_handle* h,
+                           uint64_t window, uint64_t* out_size) {
   try {
     std::lock_guard<std::mutex> g(ctx->stage_mu);
     auto it = ctx->stagebufs.find(h->alloc_id);
-    if (it == ctx->stagebufs.end())
+    if (it == ctx->stagebufs.end()) {
       it = ctx->stagebufs
-               .emplace(h->alloc_id, std::vector<uint8_t>(h->nbytes, 0))
+               .emplace(h->alloc_id,
+                        std::vector<uint8_t>(window ? window : h->nbytes, 0))
                .first;
+    } else if (window && it->second.size() != window) {
+      ctx->set_error("staging window already created at a different size");
+      return nullptr;
+    }
+    if (out_size) *out_size = it->second.size();
     return it->second.data();
   } catch (const std::exception& e) {  // bad_alloc must not cross the C ABI
     ctx->set_error(std::string("localbuf allocation failed: ") + e.what());
@@ -398,14 +404,39 @@ void* ocmc_localbuf(ocmc_ctx* ctx, const ocmc_handle* h) {
   }
 }
 
+void* ocmc_localbuf(ocmc_ctx* ctx, const ocmc_handle* h) {
+  if (!ctx || !h) return nullptr;
+  return localbuf_impl(ctx, h, 0, nullptr);
+}
+
+uint64_t ocmc_localbuf_size(ocmc_ctx* ctx, const ocmc_handle* h) {
+  if (!ctx || !h) return 0;
+  std::lock_guard<std::mutex> g(ctx->stage_mu);
+  auto it = ctx->stagebufs.find(h->alloc_id);
+  return it == ctx->stagebufs.end() ? 0 : it->second.size();
+}
+
+void* ocmc_localbuf_sized(ocmc_ctx* ctx, const ocmc_handle* h,
+                          uint64_t nbytes) {
+  if (!ctx || !h) return nullptr;
+  if (nbytes == 0 || nbytes > h->nbytes) {
+    ctx->set_error("window size must be in (0, handle nbytes]");
+    return nullptr;
+  }
+  return localbuf_impl(ctx, h, nbytes, nullptr);
+}
+
 int ocmc_copy_onesided(ocmc_ctx* ctx, const ocmc_handle* h, int op_flag) {
   if (!ctx || !h) return -1;
-  void* buf = ocmc_localbuf(ctx, h);
+  uint64_t window = 0;
+  void* buf = localbuf_impl(ctx, h, 0, &window);
   if (!buf) return -1;
   // The staging vector is stable (never resized after creation), so using
-  // the pointer outside stage_mu is safe until ocmc_free/ocmc_tini.
-  return op_flag ? ocmc_put(ctx, h, buf, h->nbytes, 0)
-                 : ocmc_get(ctx, h, buf, h->nbytes, 0);
+  // the pointer outside stage_mu is safe until ocmc_free/ocmc_tini. An
+  // asymmetric window moves its own size (from remote offset 0; use
+  // ocmc_put/ocmc_get for explicit offsets).
+  return op_flag ? ocmc_put(ctx, h, buf, window, 0)
+                 : ocmc_get(ctx, h, buf, window, 0);
 }
 
 int ocmc_copy(ocmc_ctx* ctx, const ocmc_handle* dst, const ocmc_handle* src,

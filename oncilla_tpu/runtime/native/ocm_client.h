@@ -71,11 +71,26 @@ int ocmc_get(ocmc_ctx* ctx, const ocmc_handle* h, void* buf, uint64_t nbytes,
              uint64_t offset);
 
 /* ocm_localbuf analogue (lib.c:425-460): the app-side staging window onto
- * an allocation. Lazily allocated (h->nbytes bytes, zero-initialised) and
- * owned by the context; stable for the handle's lifetime, released by
+ * an allocation. Lazily allocated (h->nbytes bytes unless
+ * ocmc_localbuf_sized created a smaller window first — check
+ * ocmc_localbuf_size before writing h->nbytes into it), zero-initialised
+ * and owned by the context; stable for the handle's lifetime, released by
  * ocmc_free/ocmc_tini. Mutate it in place, then move it with
  * ocmc_copy_onesided. Returns NULL on failure. */
 void* ocmc_localbuf(ocmc_ctx* ctx, const ocmc_handle* h);
+
+/* Size of the handle's staging window: h->nbytes, or the smaller size a
+ * prior ocmc_localbuf_sized chose. 0 when no window exists yet. */
+uint64_t ocmc_localbuf_size(ocmc_ctx* ctx, const ocmc_handle* h);
+
+/* Asymmetric staging window (the reference's ocm_alloc_params
+ * .local_alloc_bytes idiom, test/ocm_test.c:35-47): create the handle's
+ * staging buffer at `nbytes` < h->nbytes. Must be called before the
+ * full-size window exists; a second call with a different size fails.
+ * Move window-sized pieces at explicit remote offsets with
+ * ocmc_put/ocmc_get; ocmc_copy_onesided moves the window from offset 0. */
+void* ocmc_localbuf_sized(ocmc_ctx* ctx, const ocmc_handle* h,
+                          uint64_t nbytes);
 
 /* ocm_copy_onesided analogue (lib.c:670): move the handle's OWN staging
  * buffer (ocmc_localbuf) over the fabric. op_flag = 1 writes the staging

@@ -60,6 +60,10 @@ def lib():
     L.ocmc_last_error.argtypes = [ctypes.c_void_p]
     L.ocmc_localbuf.restype = ctypes.c_void_p
     L.ocmc_localbuf.argtypes = [ctypes.c_void_p, ctypes.POINTER(OcmcHandle)]
+    L.ocmc_localbuf_sized.restype = ctypes.c_void_p
+    L.ocmc_localbuf_sized.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(OcmcHandle), ctypes.c_uint64,
+    ]
     L.ocmc_copy_onesided.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(OcmcHandle), ctypes.c_int,
     ]
@@ -382,5 +386,44 @@ def test_c_client_localbuf_copy_surface(lib, cluster, rng):
 
         for h in (h1, h2, small):
             assert lib.ocmc_free(ctx, ctypes.byref(h)) == 0
+    finally:
+        lib.ocmc_tini(ctx)
+
+
+def test_c_client_sized_window(lib, cluster, rng):
+    """Asymmetric staging window from C (ocmc_localbuf_sized): a 4 KiB
+    window slides over a 64 KiB remote region via put/get offsets; the
+    reference's local_alloc_bytes idiom (ocm_test.c:35-47)."""
+    ctx = lib.ocmc_init(cluster.encode(), 0, 0.0)
+    assert ctx, lib.ocmc_last_error(None)
+    try:
+        h = OcmcHandle()
+        assert lib.ocmc_alloc(ctx, 64 << 10, 3, ctypes.byref(h)) == 0
+        p = lib.ocmc_localbuf_sized(ctx, ctypes.byref(h), 4 << 10)
+        assert p
+        # Same pointer on repeat; resize rejected.
+        assert lib.ocmc_localbuf(ctx, ctypes.byref(h)) == p
+        assert not lib.ocmc_localbuf_sized(ctx, ctypes.byref(h), 8 << 10)
+        assert b"different size" in lib.ocmc_last_error(ctx)
+
+        stage = (ctypes.c_uint8 * (4 << 10)).from_address(p)
+        data = rng.integers(0, 256, 4 << 10, dtype=np.uint8)
+        stage[:] = data.tolist()
+        assert lib.ocmc_put(ctx, ctypes.byref(h), p, 4 << 10, 32 << 10) == 0
+        out = np.zeros(4 << 10, dtype=np.uint8)
+        assert lib.ocmc_get(
+            ctx, ctypes.byref(h), out.ctypes.data_as(ctypes.c_void_p),
+            4 << 10, 32 << 10,
+        ) == 0
+        np.testing.assert_array_equal(out, data)
+
+        # copy_onesided moves only the window (from remote offset 0).
+        assert lib.ocmc_copy_onesided(ctx, ctypes.byref(h), 1) == 0
+        assert lib.ocmc_get(
+            ctx, ctypes.byref(h), out.ctypes.data_as(ctypes.c_void_p),
+            4 << 10, 0,
+        ) == 0
+        np.testing.assert_array_equal(out, data)
+        assert lib.ocmc_free(ctx, ctypes.byref(h)) == 0
     finally:
         lib.ocmc_tini(ctx)

@@ -325,3 +325,36 @@ def test_moe_top1_switch_routing(rng):
     assert np.all(d.sum(axis=2).argmax(axis=1) == am)
     # decode/forward consistency for top_k=1 is covered by the
     # parametrized test_moe_decode_matches_forward.
+
+
+def test_moe_step_page_matches_per_token(rng):
+    """The page-fused decode works with the MoE family hooks (static
+    layer slicer + expert-FFN factory flow through the scan)."""
+    import oncilla_tpu as ocm_pkg
+    from oncilla_tpu.models.kv_paging import BucketedPagedDecoder
+
+    cfg = dataclasses.replace(
+        MoeConfig.tiny(), capacity_factor=64.0, max_seq=32
+    )
+    params = moe.init_moe_params(jax.random.key(10), cfg)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (1, 8)), jnp.int32)
+    ctx = ocm_pkg.ocm_init(ocm_pkg.OcmConfig(
+        host_arena_bytes=16 << 20, device_arena_bytes=1 << 20,
+    ))
+    try:
+        kw = dict(batch=1, page_tokens=4, kind=ocm_pkg.OcmKind.LOCAL_HOST,
+                  dtype="float32", **moe.paged_hooks(cfg))
+        ref = BucketedPagedDecoder(params, cfg, ctx, **kw)
+        want = [np.asarray(ref.step(tokens[:, i])[0]) for i in range(8)]
+        ref.close()
+        dec = BucketedPagedDecoder(params, cfg, ctx, **kw)
+        for p in range(2):
+            lg = dec.step_page(tokens[:, 4 * p: 4 * (p + 1)])
+            for j in range(4):
+                np.testing.assert_allclose(
+                    np.asarray(lg[0, j]), want[4 * p + j],
+                    atol=2e-3, rtol=2e-3, err_msg=f"pos {4 * p + j}",
+                )
+        dec.close()
+    finally:
+        ctx.tini()
