@@ -354,11 +354,7 @@ def paged_generate_page_jit(
     P = tail_k.shape[3]
 
     def pick(logits_b, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits_b, axis=-1).astype(token0.dtype)
-        return jax.random.categorical(
-            k, logits_b / jnp.float32(temperature), axis=-1
-        ).astype(token0.dtype)
+        return llama.sample_token(logits_b, k, temperature, token0.dtype)
 
     def body(carry, inp):
         tok, tail_k, tail_v = carry

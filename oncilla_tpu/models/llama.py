@@ -450,6 +450,19 @@ def decode_loop(params, tokens: jax.Array, kv_cache: tuple, cfg: LlamaConfig,
     return logits.transpose(1, 0, 2), kv_cache
 
 
+def sample_token(logits_b: jax.Array, key: jax.Array, temperature: float,
+                 dtype) -> jax.Array:
+    """Greedy at ``temperature`` 0, else softmax sampling — THE sampler,
+    shared by :func:`generate` and the paged serving loop
+    (``kv_paging.paged_generate_page_jit``) so the two cannot diverge.
+    ``temperature`` must be trace-static (the greedy branch is Python-level)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits_b, axis=-1).astype(dtype)
+    return jax.random.categorical(
+        key, logits_b / jnp.float32(temperature), axis=-1
+    ).astype(dtype)
+
+
 def generate(
     params,
     prompt: jax.Array,
@@ -484,11 +497,7 @@ def generate(
         key = jax.random.key(0)
 
     def pick(logits_b, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits_b, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits_b / jnp.float32(temperature), axis=-1
-        ).astype(prompt.dtype)
+        return sample_token(logits_b, k, temperature, prompt.dtype)
 
     first = pick(logits[:, -1], key)
 

@@ -137,9 +137,9 @@ def test_ici_copy_dispatch_is_async(cluster2x4, rng):
 @pytest.fixture
 def spmd_cluster():
     # 2 "hosts" x 4 chips; handles resolve onto the mesh-sharded arena.
-    # Small rows: the interpret machine's cross-device barrier starves on a
-    # single-core host with rows >= ~128 KiB (ops/pallas_ici.py caveat);
-    # handle translation and DMA semantics are size-independent.
+    # Small rows keep this fixture's many tests fast; MiB-scale extents
+    # through the windowed interpret path are covered by
+    # test_spmd_plane_mib_scale_pallas_copy.
     c = OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=64 << 10)
     with local_cluster(2, config=c, ndevices=4) as cl:
         plane = SpmdIciPlane(config=c, devices_per_rank=4)
@@ -338,3 +338,25 @@ def test_spmd_plane_concurrent_ops(spmd_cluster, rng):
             np.asarray(plane.get(h, 4 << 10)), datas[i]
         )
         ctx.free(h)
+
+
+def test_spmd_plane_mib_scale_pallas_copy(rng):
+    """Handle-level one-sided copy at 1 MiB over 4 MiB rows through the
+    remote-DMA route — the sizes that were CI-capped before the windowed
+    interpret path (ops/pallas_ici.py): handle translation, daemon
+    bookkeeping, and the DMA kernel all at realistic extents."""
+    c = OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=4 << 20)
+    with local_cluster(2, config=c, ndevices=4) as cl:
+        plane = SpmdIciPlane(config=c, devices_per_rank=4)
+        ctx0 = cl.context(0, ici_plane=plane)
+        ctx1 = cl.context(1, ici_plane=plane)
+        src = ctx1.alloc(1 << 20, OcmKind.REMOTE_DEVICE)  # on rank 0
+        dst = ctx0.alloc(1 << 20, OcmKind.REMOTE_DEVICE)  # on rank 1
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        plane.put(src, data)
+        plane.copy(dst, src, 1 << 20, use_pallas=True)
+        np.testing.assert_array_equal(
+            np.asarray(plane.get(dst, 1 << 20)), data
+        )
+        ctx0.free(dst)
+        ctx1.free(src)
