@@ -162,3 +162,59 @@ def test_window_chunk_boundary(mesh, rng):
     np.testing.assert_array_equal(
         got[8 * pi.BLOCK + nbytes:], base[8 * pi.BLOCK + nbytes:]
     )
+
+
+def test_fuzz_windowed_copies_against_numpy_model(mesh, rng):
+    """Property test: a chain of one-sided copies must equal a numpy
+    shadow model byte-for-byte. The chain FORCES the paths a fixed seed
+    might miss — a multi-window transfer (> INTERP_WINDOW_BLOCKS, so the
+    chunk loop's `+ done` offsets are on the hook), a same-device
+    disjoint copy (local-DMA fast path), and a loopback force_remote copy
+    (send/recv semaphore machinery) — then adds random cross-device
+    routes on top."""
+    row = 48 * pi.BLOCK
+    nd = mesh.devices.size
+    arena = sa.make_arena(mesh, row)
+    shadow = np.zeros((nd, row), np.uint8)
+    for d in range(nd):
+        stamp = rng.integers(0, 256, row, dtype=np.uint8)
+        shadow[d] = stamp
+        arena = sa.host_put(arena, d, stamp, 0, mesh=mesh)
+
+    win = pi.INTERP_WINDOW_BLOCKS
+    cases = [
+        # (s_dev, d_dev, s_blk, d_blk, nblk, force_remote)
+        (1, 6, 2, 10, win + 5, False),   # multi-window chunking
+        (3, 3, 0, 30, 12, False),        # same-device local fast path
+        (5, 5, 20, 4, 9, True),          # loopback remote DMA
+    ]
+    draws = 0
+    while draws < 8:
+        s_dev, d_dev = int(rng.integers(nd)), int(rng.integers(nd))
+        nblk = int(rng.integers(1, 31))
+        s_blk = int(rng.integers(0, 48 - nblk + 1))
+        d_blk = int(rng.integers(0, 48 - nblk + 1))
+        if s_dev == d_dev and not (
+            s_blk + nblk <= d_blk or d_blk + nblk <= s_blk
+        ):
+            continue  # re-draw: same-device extents must be disjoint
+        cases.append((s_dev, d_dev, s_blk, d_blk, nblk, False))
+        draws += 1
+
+    assert any(c[4] > win for c in cases)          # multi-window present
+    assert any(c[0] == c[1] and not c[5] for c in cases)
+    assert any(c[5] for c in cases)                # loopback present
+    for s_dev, d_dev, s_blk, d_blk, nblk, force in cases:
+        n = nblk * pi.BLOCK
+        arena = pi.pallas_ici_copy(
+            arena, s_dev, d_dev, s_blk * pi.BLOCK, d_blk * pi.BLOCK, n,
+            mesh=mesh, force_remote=force,
+        )
+        shadow[d_dev, d_blk * pi.BLOCK: d_blk * pi.BLOCK + n] = (
+            shadow[s_dev, s_blk * pi.BLOCK: s_blk * pi.BLOCK + n]
+        )
+    for d in range(nd):
+        np.testing.assert_array_equal(
+            np.asarray(sa.host_get(arena, d, row, 0, mesh=mesh)), shadow[d],
+            err_msg=f"device {d}",
+        )
