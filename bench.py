@@ -53,14 +53,18 @@ ITERS = 2000        # copies per timed program (amortizes the
 BLOCK = 4096
 
 
-def bench_alloc_p50(ctx, n=2000) -> float:
-    ts = []
+def bench_alloc_p50(ctx, n=2000) -> tuple[float, float]:
+    """p50 alloc AND free latency (µs) — the reference's test 2 times the
+    register/teardown pair (/root/reference/test/ib_client.c:48-75)."""
+    ta, tf = [], []
     for _ in range(n):
         t0 = time.perf_counter()
         h = ctx.alloc(1 << 20, OcmKind.LOCAL_DEVICE)
-        ts.append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
         ctx.free(h)
-    return sorted(ts)[n // 2] * 1e6
+        tf.append(time.perf_counter() - t1)
+        ta.append(t1 - t0)
+    return sorted(ta)[n // 2] * 1e6, sorted(tf)[n // 2] * 1e6
 
 
 @partial(jax.jit, donate_argnums=0, static_argnums=(1, 2))
@@ -419,10 +423,10 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
     ctx = _init_with_retry(cfg)
     mark("init")
     try:
-        p50_us = bench_alloc_p50(ctx)
+        p50_us, free_p50_us = bench_alloc_p50(ctx)
     except Exception as e:  # noqa: BLE001 — never lose the headline
         errors["alloc_p50"] = f"{type(e).__name__}: {e}"
-        p50_us = 0.0
+        p50_us = free_p50_us = 0.0
     mark("alloc_p50")
 
     # The copy loops donate the buffer, so they run through arena.update(),
@@ -586,6 +590,7 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
             "pallas_streams": best_streams,
             "pallas_remote_gbps": round(remote_gbps, 2),
             "alloc_p50_us": round(p50_us, 2),
+            "free_p50_us": round(free_p50_us, 2),
         }
     )
 

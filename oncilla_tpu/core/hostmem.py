@@ -29,6 +29,11 @@ class HostArena:
         return self.allocator.alloc(nbytes)
 
     def free(self, extent: Extent) -> None:
+        # Scrub on free: the next tenant of these bytes must read zeros,
+        # as the reference's calloc'd server buffers guarantee
+        # (/root/reference/src/alloc.c:171) — freed data never leaks
+        # across allocations.
+        self._buf[extent.offset: extent.offset + extent.nbytes] = 0
         self.allocator.free(extent)
 
     def write(self, extent: Extent, data: np.ndarray, offset: int = 0) -> None:

@@ -136,6 +136,12 @@ class IciDataPlane:
                 moved, at = inflight.pop(0)
                 a_dst.write(dst.extent, moved, dst_offset + at)
 
+    def scrub(self, handle: OcmAlloc) -> None:
+        """Zero a freshly issued handle's extent (scrub-at-alloc; the
+        daemon books device extents without touching the bytes, so the
+        plane clears them before use — calloc parity, alloc.c:171)."""
+        self._arena(handle).fill_zero(handle.extent)
+
     # -- typed helpers ----------------------------------------------------
 
     def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0) -> jax.Array:
@@ -280,6 +286,22 @@ class SpmdIciPlane:
         callable must return a new global arena of identical shape/sharding."""
         with self._mu:
             self.arena = fn(self.arena)
+
+    def scrub(self, handle: OcmAlloc) -> None:
+        """Zero the handle's extent. Called by the control-plane client on
+        a freshly ISSUED device handle (scrub-at-alloc): the daemon only
+        books device extents — the bytes live here — and alloc time is
+        the one choke point covering every recycle path (client free,
+        lease reaping, DISCONNECT reclamation) without letting a stale
+        handle zero a live tenant (calloc parity, alloc.c:171)."""
+        g = self._gdev(handle)
+        with self.tracer.span("spmd_ici_scrub", nbytes=handle.extent.nbytes):
+            self.update(
+                lambda a: self._sa.fill_zero(
+                    a, g, handle.extent.offset, handle.extent.nbytes,
+                    mesh=self.mesh,
+                )
+            )
 
     # -- typed helpers ----------------------------------------------------
 

@@ -64,6 +64,29 @@ def _host_get(arena, dev: int, offset, nbytes: int, mesh):
     return jax.lax.dynamic_slice(arena, (dev, offset), (1, nbytes))[0]
 
 
+def fill_zero(arena: jax.Array, dev: int, offset, nbytes: int, *, mesh: Mesh) -> jax.Array:
+    """Zero ``nbytes`` of device ``dev``'s row at ``offset`` with a
+    device-generated fill (no host transfer) — the scrub primitive behind
+    allocations reading as zeros (the calloc guarantee of
+    /root/reference/src/alloc.c:171). Chunked into power-of-two fills so
+    arbitrary extent sizes compile a bounded program set (the same trade
+    as ``core.hbm._pow2_chunks``)."""
+    from oncilla_tpu.core.hbm import _pow2_chunks
+
+    offset = int(offset)
+    for c in _pow2_chunks(int(nbytes), 256 << 20):
+        arena = _fill_zero(arena, jnp.int32(offset), dev, c, mesh)
+        offset += c
+    return arena
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(2, 3, 4))
+def _fill_zero(arena, offset, dev: int, nbytes: int, mesh):
+    return jax.lax.dynamic_update_slice(
+        arena, jnp.zeros((1, nbytes), jnp.uint8), (dev, offset)
+    )
+
+
 def ici_copy(
     arena: jax.Array,
     src_dev: int,

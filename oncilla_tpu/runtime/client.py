@@ -194,6 +194,20 @@ class ControlPlaneClient:
         )
         h.owner_addr = (f["owner_host"], f["owner_port"])  # for the DCN path
         self._note_owner(h.rank, +1)
+        # Scrub-at-alloc for the device arm (calloc parity, alloc.c:171):
+        # the daemon only BOOKS device extents — the bytes live in the
+        # app-side ICI plane's arena — so the plane zeroes a freshly
+        # issued extent before the handle is returned. Alloc-time is the
+        # one choke point that covers every path an offset can be
+        # recycled through (client free, lease-reaper free, DISCONNECT
+        # reclamation), and unlike a free-time scrub it never lets a
+        # stale handle destructively zero a live tenant's bytes. Host
+        # arms are scrubbed at free time by the owner daemon itself
+        # (all of its free paths funnel through one arena release).
+        if placed_kind == OcmKind.REMOTE_DEVICE and self.ici_plane is not None:
+            scrub = getattr(self.ici_plane, "scrub", None)
+            if scrub is not None:
+                scrub(h)
         return h
 
     def free(self, handle: OcmAlloc) -> None:

@@ -736,6 +736,9 @@ class Daemon {
   void do_free_local(uint64_t alloc_id) {
     RegEntry e = registry_.remove(alloc_id);
     if (kind_is_host(e.kind)) {
+      // Scrub on free (reference parity: server buffers are calloc'd,
+      // alloc.c:171): the next tenant of this extent reads zeros.
+      std::memset(host_store_.data() + e.extent.offset, 0, e.extent.nbytes);
       host_arena_.release(e.extent.offset);
     } else {
       device_books_[e.device_index]->release(e.extent.offset);

@@ -127,3 +127,30 @@ def test_dma_routing_in_arena(monkeypatch, rng):
     # same bytes.
     got = np.asarray(a.read(ext, 100, offset=17))
     np.testing.assert_array_equal(got, data[17:117])
+
+
+def test_blocked_scrub_on_free(big_arena, rng):
+    """Scrub-on-free at GB scale incl. past the int32 cliff and with
+    unaligned head/tail: a freed extent's reused bytes read as zeros."""
+    a = big_arena
+    first = a.alloc(2 * GIB)
+    ext = a.alloc(2 << 20)
+    assert ext.offset + ext.nbytes > 2**31
+    a.write(ext, rng.integers(1, 256, 2 << 20, dtype=np.uint8))
+    a.free(ext)
+    ext2 = a.alloc(2 << 20)
+    assert ext2.offset == ext.offset  # first-fit reuses the hole
+    assert not np.asarray(a.read(ext2, 2 << 20)).any()
+    a.free(ext2)
+
+    # Unaligned partial fill (head/tail path) leaves neighbors intact.
+    ext3 = a.alloc(64 << 10)
+    pat = rng.integers(1, 256, 64 << 10, dtype=np.uint8)
+    a.write(ext3, pat)
+    a.fill_zero(ext3, nbytes=5000, offset=1000)
+    got = np.asarray(a.read(ext3, 64 << 10))
+    assert not got[1000:6000].any()
+    np.testing.assert_array_equal(got[:1000], pat[:1000])
+    np.testing.assert_array_equal(got[6000:], pat[6000:])
+    a.free(ext3)
+    a.free(first)
