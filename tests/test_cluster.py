@@ -514,10 +514,14 @@ def test_fuzz_full_stack_ops_against_model(rng):
     against a byte-exact shadow model, then leak-free teardown — the
     randomized version of ocm_test.c tests 1-3 the reference could only
     run by hand on lab hardware."""
-    with local_cluster(2, config=small_cfg()) as c:
-        ctx = c.context(0)
+    from oncilla_tpu.ops.ici import SpmdIciPlane
+
+    cfg = small_cfg()
+    with local_cluster(2, config=cfg, ndevices=2) as c:
+        plane = SpmdIciPlane(config=cfg, devices_per_rank=2)
+        ctx = c.context(0, ici_plane=plane)
         kinds = [OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE,
-                 OcmKind.REMOTE_HOST]
+                 OcmKind.REMOTE_HOST, OcmKind.REMOTE_DEVICE]
         live: list = []      # [(handle, shadow bytearray)]
         for _ in range(120):
             op = rng.choice(["alloc", "free", "put", "get", "copy"])
