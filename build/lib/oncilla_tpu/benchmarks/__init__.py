@@ -1,0 +1,27 @@
+"""Benchmark harnesses (the analogue of the reference's test/ bandwidth
+programs, /root/reference/test/ocm_test.c:323-425 and ib_client.c:78-141):
+
+- :mod:`oncilla_tpu.benchmarks.sweep` — size-doubling one-sided read/write
+  bandwidth sweep over any handle kind, plus the all-links SPMD ring sweep.
+- :mod:`oncilla_tpu.benchmarks.gups` — GUPS random-access benchmark over the
+  arena fabric (BASELINE.md config 4; no reference analogue).
+- :mod:`oncilla_tpu.benchmarks.mfu` — single-chip MFU on the flagship model
+  (exact per-matmul FLOP accounting; forward and train step).
+- :mod:`oncilla_tpu.benchmarks.kv_decode` — OCM-paged KV decode tokens/s.
+"""
+
+from oncilla_tpu.benchmarks.gups import gups_mesh, gups_single
+from oncilla_tpu.benchmarks.mfu import forward_flops, mfu_forward, mfu_train, train_flops
+from oncilla_tpu.benchmarks.sweep import SweepPoint, size_sweep, spmd_ring_sweep
+
+__all__ = [
+    "SweepPoint",
+    "forward_flops",
+    "gups_mesh",
+    "gups_single",
+    "mfu_forward",
+    "mfu_train",
+    "size_sweep",
+    "spmd_ring_sweep",
+    "train_flops",
+]

@@ -1,0 +1,14 @@
+"""Shared benchmark plumbing."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def fence(x) -> None:
+    """Force completion of the program producing ``x``: block_until_ready
+    alone does not reliably block on the tunneled dev platform; a small
+    readback of the producing op does."""
+    if x is not None and not isinstance(x, np.ndarray):
+        np.asarray(jax.device_get(x.reshape(-1)[:8]))

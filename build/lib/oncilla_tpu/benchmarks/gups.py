@@ -1,0 +1,326 @@
+"""GUPS — giga-updates-per-second random access over the arena fabric.
+
+BASELINE.md config 4 (no reference analogue): measure how fast randomly
+addressed words can be updated, (a) within one chip's HBM arena and (b)
+across the mesh, where every update targets a random word on a random chip
+and rides ICI. TPU-idiomatic formulation: updates are batched scatter-adds
+inside one jitted ``fori_loop`` (no per-update dispatch), and the cross-chip
+flavor routes each batch with ``lax.all_to_all`` under ``shard_map`` — each
+source device draws ``batch // D`` random target words *per destination
+device*, so destinations are uniform and shapes stay static.
+
+Updates are ``+1`` on a uint32 table, so correctness is checkable:
+``table.sum() == total_updates`` (duplicate indices accumulate).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from oncilla_tpu.benchmarks._util import fence as _fence
+from oncilla_tpu.parallel.mesh import NODE_AXIS, arena_sharding, node_mesh
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4, 5))
+def _gups_single_run(table, steps: int, batch: int, words: int, seed: int,
+                     method: str):
+    def body(i, t):
+        key = jax.random.fold_in(jax.random.key(seed), i)
+        idx = jax.random.randint(key, (batch,), 0, words, dtype=jnp.int32)
+        if method == "bincount":
+            # Histogram formulation: XLA lowers bincount via sort/segment
+            # machinery, which can beat the serialized scatter on TPU for
+            # dense batches; same semantics (+1 per drawn index).
+            return t + jnp.bincount(idx, length=words).astype(jnp.uint32)
+        return t.at[idx].add(jnp.uint32(1))
+
+    return jax.lax.fori_loop(0, steps, body, table)
+
+
+def gups_single(
+    words: int = 1 << 20,
+    batch: int = 1 << 14,
+    steps: int = 64,
+    seed: int = 0,
+    device=None,
+    method: str = "scatter",
+) -> dict:
+    """Single-chip GUPS on a ``words``-word uint32 HBM table. ``method``
+    picks the update lowering ("scatter" or "bincount"); both are exact."""
+    def fresh():
+        t = jnp.zeros((words,), dtype=jnp.uint32)
+        return jax.device_put(t, device) if device is not None else t
+
+    # Warm up with the SAME static args (steps is a static argnum — a
+    # different value would recompile inside the timed region).
+    _fence(_gups_single_run(fresh(), steps, batch, words, seed, method))
+    table = fresh()
+    _fence(table)
+    t0 = time.perf_counter()
+    table = _gups_single_run(table, steps, batch, words, seed, method)
+    _fence(table)
+    dt = time.perf_counter() - t0
+    updates = steps * batch
+    total = int(np.asarray(table).astype(np.uint64).sum())
+    return {
+        "mode": f"single:{method}",
+        "gups": updates / dt / 1e9,
+        "updates": updates,
+        "seconds": dt,
+        "table_sum": total,  # == updates (duplicates accumulate)
+    }
+
+
+def gups_single_best(
+    words: int = 1 << 20,
+    batch: int = 1 << 14,
+    steps: int = 64,
+    seed: int = 0,
+) -> dict:
+    """Measure both lowerings, verify conservation on each, keep the best
+    (the engine sweet spot differs by backend/generation)."""
+    best = None
+    for method in ("scatter", "bincount"):
+        r = gups_single(words=words, batch=batch, steps=steps, seed=seed,
+                        method=method)
+        if r["table_sum"] != r["updates"]:
+            continue  # wrong results are not publishable
+        if best is None or r["gups"] > best["gups"]:
+            best = r
+    if best is None:
+        raise RuntimeError("no GUPS method produced conserved updates")
+    return best
+
+
+# -- handle/arena flavor: the oncilla number ------------------------------
+#
+# BASELINE config 4 says "random remote-access over ICI via ocm handles";
+# the flavors above measure XLA scatter on a standalone table (VERDICT r3
+# weak #5). Here the table IS an OcmAlloc extent inside an SpmdIciPlane
+# arena row — the same (rank, device, offset) handle-addressed HBM the
+# one-sided fabric serves — and every update batch scatter-adds into that
+# extent region of the arena in place (donated), inside one jitted
+# shard_map program. Conservation is verified by reading the table back
+# *through the handle* (plane.get_as), proving the updates landed in
+# handle-addressable memory.
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _gups_handle_run(arena, steps: int, batch: int, words: int, seed: int,
+                     off: int, gdev: int, method: str, mesh):
+    def shard_fn(shard):  # shard: (1, row_bytes) — this device's arena row
+        me = jax.lax.axis_index(NODE_AXIS)
+        row = shard[0]
+
+        def body(i, row):
+            key = jax.random.fold_in(jax.random.key(seed), i)
+            idx = jax.random.randint(key, (batch,), 0, words, dtype=jnp.int32)
+            raw = jax.lax.dynamic_slice(row, (off,), (4 * words,))
+            tbl = jax.lax.bitcast_convert_type(
+                raw.reshape(words, 4), jnp.uint32
+            )
+            if method == "bincount":
+                tbl = tbl + jnp.bincount(idx, length=words).astype(jnp.uint32)
+            else:
+                tbl = tbl.at[idx].add(jnp.uint32(1))
+            back = jax.lax.bitcast_convert_type(tbl, jnp.uint8).reshape(-1)
+            return jax.lax.dynamic_update_slice(row, back, (off,))
+
+        updated = jax.lax.fori_loop(0, steps, body, row)
+        # Only the handle's device mutates its row: on a multi-device plane
+        # every other row (and any allocation living there) is untouched,
+        # and `updates = steps * batch` counts exactly what landed.
+        return jnp.where(me == gdev, updated, row)[None]
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=P(NODE_AXIS, None),
+        out_specs=P(NODE_AXIS, None),
+    )(arena)
+
+
+def gups_handles(
+    words: int = 1 << 20,
+    batch: int = 1 << 14,
+    steps: int = 32,
+    seed: int = 0,
+    method: str = "scatter",
+    plane=None,
+) -> dict:
+    """GUPS over an ocm handle: alloc a ``words``-word uint32 table as a
+    REMOTE_DEVICE extent in the one-sided plane's arena and run the update
+    loop against the extent bytes in place (only the handle's device row
+    is mutated), verifying through the handle. The helper claims bytes
+    [4096, 4096 + 4*words) of device 0's row, so pass a dedicated bench
+    ``plane`` (or none — a fresh loopback plane is made), not one holding
+    live allocations."""
+    from oncilla_tpu.core.arena import Extent
+    from oncilla_tpu.core.handle import OcmAlloc
+    from oncilla_tpu.core.kinds import Fabric, OcmKind
+    from oncilla_tpu.ops.ici import SpmdIciPlane
+    from oncilla_tpu.utils.config import OcmConfig
+
+    nbytes = 4 * words
+    if plane is None:
+        from oncilla_tpu.parallel.mesh import node_mesh
+
+        mesh = node_mesh(jax.devices()[:1])
+        plane = SpmdIciPlane(
+            config=OcmConfig(device_arena_bytes=nbytes + (1 << 20)),
+            mesh=mesh, devices_per_rank=1,
+        )
+    mesh = plane.mesh
+    off = 4096  # a non-zero extent offset: prove offset addressing, not row 0
+    handle = OcmAlloc(
+        alloc_id=2, kind=OcmKind.REMOTE_DEVICE, fabric=Fabric.ICI,
+        nbytes=nbytes, rank=0, device_index=0,
+        extent=Extent(offset=off, nbytes=nbytes), origin_rank=0,
+    )
+    plane.put(handle, np.zeros(nbytes, np.uint8))
+    from oncilla_tpu.ops.ici import resolve_global_device
+
+    gdev = resolve_global_device(
+        handle, plane.devices_per_rank, int(mesh.devices.size)
+    )
+
+    def run(arena):
+        return _gups_handle_run(arena, steps, batch, words, seed, off,
+                                gdev, method, mesh)
+
+    plane.update(run)               # warm-up compiles the timed executable
+    plane.put(handle, np.zeros(nbytes, np.uint8))   # reset via the handle
+    _fence(plane.arena[0, :8])
+    t0 = time.perf_counter()
+    plane.update(run)
+    _fence(plane.arena[0, :8])
+    dt = time.perf_counter() - t0
+    updates = steps * batch
+    # Conservation, read back THROUGH the handle.
+    tbl = np.asarray(plane.get_as(handle, (words,), np.uint32))
+    total = int(tbl.astype(np.uint64).sum())
+    return {
+        "mode": f"handle:{method}",
+        "gups": updates / dt / 1e9,
+        "updates": updates,
+        "seconds": dt,
+        "table_sum": total,  # == updates (duplicates accumulate)
+    }
+
+
+def gups_handle_best(
+    words: int = 1 << 20,
+    batch: int = 1 << 14,
+    steps: int = 32,
+    seed: int = 0,
+) -> dict:
+    """Both lowerings over the same handle-backed table; conservation
+    gates publishability, best wins."""
+    from oncilla_tpu.ops.ici import SpmdIciPlane
+    from oncilla_tpu.parallel.mesh import node_mesh
+    from oncilla_tpu.utils.config import OcmConfig
+
+    mesh = node_mesh(jax.devices()[:1])
+    plane = SpmdIciPlane(
+        config=OcmConfig(device_arena_bytes=4 * words + (1 << 20)),
+        mesh=mesh, devices_per_rank=1,
+    )
+    best = None
+    for method in ("scatter", "bincount"):
+        r = gups_handles(words=words, batch=batch, steps=steps, seed=seed,
+                         method=method, plane=plane)
+        if r["table_sum"] != r["updates"]:
+            continue  # wrong results are not publishable
+        if best is None or r["gups"] > best["gups"]:
+            best = r
+    if best is None:
+        raise RuntimeError("no handle-GUPS method produced conserved updates")
+    return best
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(1, 2, 3, 4, 5))
+def _gups_mesh_run(table, steps: int, per_dest: int, words: int, seed: int, mesh):
+    def shard_fn(shard):  # shard: (1, words) — this device's table row
+        me = jax.lax.axis_index(NODE_AXIS)
+        d = jax.lax.axis_size(NODE_AXIS)
+
+        def body(i, row):
+            key = jax.random.fold_in(jax.random.key(seed), me * 1_000_003 + i)
+            # Row j of idx targets device j; all_to_all delivers to it.
+            idx = jax.random.randint(
+                key, (d, per_dest), 0, words, dtype=jnp.int32
+            )
+            recv = jax.lax.all_to_all(idx, NODE_AXIS, 0, 0)
+            return row.at[recv.reshape(-1)].add(jnp.uint32(1))
+
+        return jax.lax.fori_loop(0, steps, body, shard[0])[None]
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=P(NODE_AXIS, None),
+        out_specs=P(NODE_AXIS, None),
+    )(table)
+
+
+def gups_mesh(
+    mesh=None,
+    words_per_dev: int = 1 << 18,
+    batch: int = 1 << 12,
+    steps: int = 32,
+    seed: int = 0,
+) -> dict:
+    """Cross-chip GUPS: each device issues ``batch`` random updates per step,
+    each targeting a uniformly random word on a uniformly random device; the
+    index batches ride ICI via all_to_all. The table is laid out exactly like
+    the SPMD arena (one row per chip's HBM, ``arena_sharding``)."""
+    mesh = mesh if mesh is not None else node_mesh()
+    d = mesh.devices.size
+    per_dest = max(1, batch // d)
+    def fresh():
+        return jax.device_put(
+            jnp.zeros((d, words_per_dev), dtype=jnp.uint32), arena_sharding(mesh)
+        )
+
+    _fence(_gups_mesh_run(fresh(), steps, per_dest, words_per_dev, seed, mesh))
+    table = fresh()
+    _fence(table)
+    t0 = time.perf_counter()
+    table = _gups_mesh_run(table, steps, per_dest, words_per_dev, seed, mesh)
+    _fence(table)
+    dt = time.perf_counter() - t0
+    updates = steps * d * d * per_dest  # per step: d sources x d dests x per_dest
+    total = int(np.asarray(table).astype(np.uint64).sum())
+    return {
+        "mode": f"mesh:{d}dev",
+        "gups": updates / dt / 1e9,
+        "updates": updates,
+        "seconds": dt,
+        "table_sum": total,  # == updates (duplicates accumulate)
+    }
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=["single", "mesh"], default="single")
+    ap.add_argument("--words", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=1 << 14)
+    ap.add_argument("--steps", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.mode == "mesh":
+        out = gups_mesh(
+            words_per_dev=args.words, batch=args.batch, steps=args.steps
+        )
+    else:
+        out = gups_single(words=args.words, batch=args.batch, steps=args.steps)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

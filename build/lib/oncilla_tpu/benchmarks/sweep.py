@@ -1,0 +1,201 @@
+"""Size-doubling one-sided bandwidth sweep.
+
+The measurement *shape* of the reference's integration benchmark
+(/root/reference/test/ocm_test.c:323-402): allocate one region, then for each
+size 64 B, 128 B, ... max — a separate WRITE pass and a separate READ pass of
+N iterations each, reporting per-size GB/s. Two flavors:
+
+- :func:`size_sweep` drives the public ``put``/``get`` path on any handle
+  kind (local host/device, or remote kinds through a cluster control plane) —
+  the controller-orchestrated view, including protocol overhead.
+- :func:`spmd_ring_sweep` times the in-mesh fabric itself: every device
+  ships its chunk to its ring neighbor simultaneously (all ICI links active),
+  iterated inside one jitted program so dispatch cost is amortized — the
+  shape used for the GB/s-per-chip-vs-line-rate target (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from oncilla_tpu.benchmarks._util import fence as _force
+from oncilla_tpu.core.kinds import OcmKind
+
+
+@dataclass
+class SweepPoint:
+    nbytes: int
+    iters: int
+    write_gbps: float
+    read_gbps: float
+
+
+@dataclass
+class SweepResult:
+    label: str
+    points: list[SweepPoint] = field(default_factory=list)
+    # Sizes dropped because the sweep's wall-clock budget ran out —
+    # recorded, never silent (a truncated sweep must not read as a
+    # complete one).
+    dropped: list[int] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {
+            "label": self.label,
+            "points": [vars(p) for p in self.points],
+            "dropped": list(self.dropped),
+        }
+
+
+def _doubling_sizes(min_bytes: int, max_bytes: int) -> list[int]:
+    sizes, n = [], min_bytes
+    while n <= max_bytes:
+        sizes.append(n)
+        n *= 2
+    return sizes
+
+
+def size_sweep(
+    ctx,
+    kind: OcmKind = OcmKind.LOCAL_HOST,
+    min_bytes: int = 64,
+    max_bytes: int = 1 << 20,
+    iters: int = 8,
+    device_index: int = 0,
+    budget_s: float | None = None,
+) -> SweepResult:
+    """Alloc one ``max_bytes`` region of ``kind``; per size, a write pass then
+    a read pass of ``iters`` one-sided ops each (ocm_test.c:362-402 shape).
+    With ``budget_s``, sizes whose turn comes after the budget is spent are
+    skipped and listed in ``result.dropped`` (per-size compiles plus
+    GB-scale writes over a slow host link can cost minutes).
+
+    Leg semantics for LOCAL_DEVICE: the write leg stages host bytes into
+    the arena extent (host→device link on the path, tunnel-bound on a dev
+    chip), while the read leg lands in the app-side buffer — which for a
+    TPU-native consumer is a device-resident ``jax.Array``, so it measures
+    the on-device extent read, NOT a device→host transfer. The legs are
+    deliberately asymmetric because the app's buffers live on opposite
+    sides of the link; expect write ≪ read on a tunneled dev setup.
+    """
+    h = ctx.alloc(max_bytes, kind, device_index=device_index) \
+        if kind == OcmKind.LOCAL_DEVICE else ctx.alloc(max_bytes, kind)
+    res = SweepResult(label=f"size_sweep:{kind.name}")
+    rng = np.random.default_rng(0xB0)
+    t_start = time.perf_counter()
+    try:
+        for nbytes in _doubling_sizes(min_bytes, max_bytes):
+            if (budget_s is not None
+                    and time.perf_counter() - t_start > budget_s):
+                res.dropped.append(nbytes)
+                continue
+            data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+            ctx.put(h, data)  # warm caches / compile this size
+            _force(ctx.get(h, 8))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ctx.put(h, data)
+            _force(ctx.get(h, 8))  # fence the last lazy write
+            wt = time.perf_counter() - t0
+
+            out = ctx.get(h, nbytes)
+            _force(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = ctx.get(h, nbytes)
+            _force(out)
+            rt = time.perf_counter() - t0
+
+            res.points.append(
+                SweepPoint(
+                    nbytes=nbytes,
+                    iters=iters,
+                    write_gbps=nbytes * iters / wt / 1e9,
+                    read_gbps=nbytes * iters / rt / 1e9,
+                )
+            )
+    finally:
+        ctx.free(h)
+    return res
+
+
+def spmd_ring_sweep(
+    mesh=None,
+    min_bytes: int = 1 << 10,
+    max_bytes: int = 1 << 24,
+    iters: int = 16,
+    arena_bytes: int | None = None,
+) -> SweepResult:
+    """All-links sweep on the SPMD arena fabric: per size, ``iters`` ring
+    shifts (every chip sends+receives ``nbytes`` simultaneously) timed
+    end-to-end; reports per-chip GB/s (bytes sent per chip / time)."""
+    from oncilla_tpu.parallel import spmd_arena as sa
+    from oncilla_tpu.parallel.mesh import node_mesh
+
+    mesh = mesh if mesh is not None else node_mesh()
+    if arena_bytes is None:
+        arena_bytes = max_bytes
+    if arena_bytes < max_bytes:
+        raise ValueError(
+            f"arena_bytes ({arena_bytes}) must hold the largest chunk "
+            f"(max_bytes={max_bytes})"
+        )
+    arena = sa.make_arena(mesh, arena_bytes)
+    res = SweepResult(label=f"spmd_ring_sweep:{mesh.devices.size}dev")
+    for nbytes in _doubling_sizes(min_bytes, max_bytes):
+        arena = sa.ring_shift(arena, 0, nbytes, mesh=mesh)  # compile
+        _force(arena[0, :8])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            arena = sa.ring_shift(arena, 0, nbytes, mesh=mesh)
+        _force(arena[0, :8])  # fences the whole chain (data dependency)
+        dt = time.perf_counter() - t0
+        gbps = nbytes * iters / dt / 1e9
+        # One ring shift moves nbytes out of (and into) every chip; per-chip
+        # GB/s is the per-size figure BASELINE.md asks to compare to line rate.
+        res.points.append(
+            SweepPoint(nbytes=nbytes, iters=iters, write_gbps=gbps, read_gbps=gbps)
+        )
+    return res
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    import oncilla_tpu as ocm
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=["local", "ring"], default="local")
+    ap.add_argument("--kind", default="LOCAL_DEVICE")
+    ap.add_argument("--min-bytes", type=int, default=64)
+    ap.add_argument("--max-bytes", type=int, default=1 << 24)
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args()
+
+    if args.mode == "ring":
+        res = spmd_ring_sweep(
+            min_bytes=args.min_bytes, max_bytes=args.max_bytes, iters=args.iters
+        )
+    else:
+        cfg = ocm.OcmConfig(
+            host_arena_bytes=2 * args.max_bytes,
+            device_arena_bytes=2 * args.max_bytes,
+        )
+        ctx = ocm.ocm_init(cfg)
+        res = size_sweep(
+            ctx,
+            OcmKind[args.kind],
+            min_bytes=args.min_bytes,
+            max_bytes=args.max_bytes,
+            iters=args.iters,
+        )
+        ocm.ocm_tini(ctx)
+    print(json.dumps(res.as_dict()))
+
+
+if __name__ == "__main__":
+    main()

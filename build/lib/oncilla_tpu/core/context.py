@@ -399,7 +399,6 @@ def _coerce_bytes(data):
 
 
 def _nbytes_of(data) -> int:
-    data = _coerce_bytes(data)
     if isinstance(data, np.ndarray):
         return data.nbytes
     a = jnp.asarray(data)

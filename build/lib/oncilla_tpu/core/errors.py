@@ -1,0 +1,47 @@
+"""Error types for oncilla-tpu.
+
+The reference signals errors with -1 returns and ``BUG()``/``ABORT()`` crash
+macros (/root/reference/inc/debug.h:32-48). Here errors are typed exceptions.
+"""
+
+from __future__ import annotations
+
+
+class OcmError(Exception):
+    """Base class for all oncilla-tpu errors."""
+
+
+class OcmOutOfMemory(OcmError):
+    """Arena cannot satisfy the requested allocation."""
+
+
+class OcmBoundsError(OcmError):
+    """A put/get would run outside the allocation, analogue of the bounds
+    checks in post_send (/root/reference/src/rdma.c:55-59)."""
+
+
+class OcmInvalidHandle(OcmError):
+    """Handle is freed, unknown, or of the wrong kind for the operation."""
+
+
+class OcmProtocolError(OcmError):
+    """Malformed or unexpected control-plane message (transport-level: the
+    connection can no longer be trusted)."""
+
+
+class OcmRemoteError(OcmProtocolError):
+    """A peer replied with a well-formed ERROR message. The connection
+    remains in sync and reusable; ``code`` is the wire ErrCode value."""
+
+    def __init__(self, code: int, detail: str):
+        super().__init__(detail)
+        self.code = code
+        self.detail = detail
+
+
+class OcmConnectError(OcmError):
+    """Could not reach the local daemon or a peer daemon."""
+
+
+class OcmPlacementError(OcmError):
+    """The placement policy could not site the allocation."""

@@ -1,0 +1,67 @@
+"""The opaque allocation handle.
+
+Analogue of the reference's ``struct lib_alloc`` (/root/reference/src/lib.c:
+36-78): a tagged union over the host / GPU / RDMA / RMA arms carrying whatever
+the data plane needs to reach the memory. Here one dataclass carries the kind
+tag plus the pod-wide address ``(rank, device_index, offset, nbytes)`` — the
+TPU analogue of EXTOLL's connectionless (node, vpid, NLA) triple
+(/root/reference/inc/io/extoll.h:31-44), which SURVEY.md §7 identifies as the
+better model for ICI than IB's connection handshake.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from oncilla_tpu.core.arena import Extent
+from oncilla_tpu.core.kinds import Fabric, OcmKind
+
+
+@dataclass
+class OcmAlloc:
+    """Opaque handle to an oncilla allocation.
+
+    Fields:
+      alloc_id:     pod-unique monotonically increasing id, analogue of
+                    ``rem_alloc_id`` (/root/reference/src/mem.c:45,345-348).
+      kind:         which arm the memory lives on.
+      fabric:       which data plane reaches it (LOCAL / ICI / DCN).
+      nbytes:       user-requested size (``ocm_remote_sz`` analogue).
+      rank:         owning node's rank in the cluster (0-based).
+      device_index: owning chip's index on that node (device arms only);
+                    together with rank it determines the logical mesh position.
+      extent:       (offset, nbytes-as-reserved) inside the owning arena.
+      origin_rank:  rank of the node that requested the allocation.
+    """
+
+    alloc_id: int
+    kind: OcmKind
+    fabric: Fabric
+    nbytes: int
+    rank: int
+    device_index: int
+    extent: Extent
+    origin_rank: int
+    freed: bool = field(default=False, compare=False)
+    # (host, port) of the owner daemon, filled for DCN-reachable arms —
+    # the connectionless address the ALLOC_RESULT reply carries.
+    owner_addr: tuple[str, int] | None = field(default=None, compare=False)
+    # App-side staging-window size for remote arms, when smaller than the
+    # remote region — the reference's ``ocm_alloc_params.local_alloc_bytes``
+    # (/root/reference/test/ocm_test.c:35-47): a small local window onto a
+    # large remote allocation. None = window matches ``nbytes``.
+    local_nbytes: int | None = field(default=None, compare=False)
+
+    @property
+    def is_remote(self) -> bool:
+        return self.kind.is_remote
+
+    @property
+    def remote_sz(self) -> int:
+        """Size of the remote region (``ocm_remote_sz``,
+        /root/reference/inc/oncillamem.h:84)."""
+        return self.nbytes if self.is_remote else 0
+
+    def address(self) -> tuple[int, int, int, int]:
+        """The pod-wide one-sided address (rank, device, offset, nbytes)."""
+        return (self.rank, self.device_index, self.extent.offset, self.nbytes)

@@ -1,0 +1,56 @@
+"""Host-DRAM arena: numpy-backed storage for the LOCAL_HOST / REMOTE_HOST arms.
+
+Analogue of the reference's host arm, where the app-owned buffer comes from
+``malloc`` (/root/reference/src/lib.c:222-233) and the daemon-side remote
+buffer from ``calloc`` + NIC registration (/root/reference/src/alloc.c:171).
+Here one pre-allocated byte buffer per host plays the role of the registered
+region; suballocations are zero-copy memoryview slices of it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
+
+
+class HostArena:
+    """A byte arena in host DRAM with offset-addressed read/write."""
+
+    def __init__(self, capacity: int, alignment: int = 512):
+        self.allocator = ArenaAllocator(capacity, alignment)
+        self._buf = np.zeros(capacity, dtype=np.uint8)
+
+    @property
+    def capacity(self) -> int:
+        return self.allocator.capacity
+
+    def alloc(self, nbytes: int) -> Extent:
+        return self.allocator.alloc(nbytes)
+
+    def free(self, extent: Extent) -> None:
+        # Scrub on free: the next tenant of these bytes must read zeros,
+        # as the reference's calloc'd server buffers guarantee
+        # (/root/reference/src/alloc.c:171) — freed data never leaks
+        # across allocations.
+        self._buf[extent.offset: extent.offset + extent.nbytes] = 0
+        self.allocator.free(extent)
+
+    def write(self, extent: Extent, data: np.ndarray, offset: int = 0) -> None:
+        """One-sided put into the arena (bounds-checked like post_send,
+        /root/reference/src/rdma.c:55-59)."""
+        raw = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+        check_bounds(extent, offset, raw.nbytes)
+        start = extent.offset + offset
+        self._buf[start : start + raw.nbytes] = raw
+
+    def read(self, extent: Extent, nbytes: int, offset: int = 0) -> np.ndarray:
+        """One-sided get; returns a copy of the bytes."""
+        check_bounds(extent, offset, nbytes)
+        start = extent.offset + offset
+        return self._buf[start : start + nbytes].copy()
+
+    def view(self, extent: Extent) -> np.ndarray:
+        """Zero-copy window over the live extent (``ocm_localbuf`` analogue,
+        /root/reference/src/lib.c:425)."""
+        return self._buf[extent.offset : extent.offset + extent.nbytes]

@@ -1,0 +1,51 @@
+"""Allocation-kind taxonomy.
+
+TPU-native analogue of the reference's ``enum ocm_kind``
+(/root/reference/inc/oncillamem.h:26-35), which distinguishes local host
+memory, local GPU memory, and remote memory behind an IB or EXTOLL NIC.
+
+On TPU the four arms are:
+
+- ``LOCAL_HOST``    — TPU-VM host DRAM on this process's host.
+- ``LOCAL_DEVICE``  — HBM on a chip attached to this host (the GPU arm's
+  analogue; reference ``OCM_LOCAL_GPU``).
+- ``REMOTE_DEVICE`` — HBM on a chip elsewhere in the pod, reached over ICI
+  (reference ``OCM_REMOTE_RDMA``'s analogue — one-sided put/get).
+- ``REMOTE_HOST``   — host DRAM on another TPU-VM host, reached over DCN
+  (reference ``OCM_REMOTE_RMA``'s analogue — the second fabric).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class OcmKind(enum.Enum):
+    LOCAL_HOST = "local_host"
+    LOCAL_DEVICE = "local_device"
+    REMOTE_DEVICE = "remote_device"
+    REMOTE_HOST = "remote_host"
+
+    @property
+    def is_remote(self) -> bool:
+        """True for remote arms.
+
+        The reference's ``ocm_is_remote`` (lib.c:461) has an operator-precedence
+        bug that returns false for remote allocations; SURVEY.md §"Known
+        reference bugs" instructs not to replicate it.
+        """
+        return self in (OcmKind.REMOTE_DEVICE, OcmKind.REMOTE_HOST)
+
+    @property
+    def is_device(self) -> bool:
+        return self in (OcmKind.LOCAL_DEVICE, OcmKind.REMOTE_DEVICE)
+
+
+class Fabric(enum.Enum):
+    """Data-plane selector, analogue of ``enum alloc_ation_type``
+    (/root/reference/inc/alloc.h:32-42). Both fabrics can be live in one
+    build, as IB+EXTOLL could in the reference (SConstruct:122)."""
+
+    LOCAL = "local"  # no fabric: same-process memory
+    ICI = "ici"      # inter-chip interconnect (Pallas remote DMA / ppermute)
+    DCN = "dcn"      # data-center network between TPU-VM hosts (daemon TCP)

@@ -1,0 +1,621 @@
+"""KV-cache paging through OCM handles: long-context decode whose KV pages
+live anywhere in the pod — local HBM, a *remote* chip's HBM (ICI fabric), or
+remote host DRAM (DCN fabric) — BASELINE.md config 5.
+
+The decode working set stays small: a local tail window of the KV cache plus
+a list of opaque OCM handles for completed pages. Attention over the full
+context fetches pages back through the data plane. This is exactly the
+reference's usage pattern (allocate remote, fill with ocm put, read back
+with ocm get — test/ocm_test.c test 2) with a transformer as the
+application.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.hbm import from_bytes, to_bytes
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.models.llama import LlamaConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER
+
+
+@dataclass
+class PagedKVCache:
+    """KV pages for one decode session.
+
+    ``backend`` is anything with alloc/free/put/get — an :class:`Ocm`
+    context (local arms) or a :class:`ControlPlaneClient` (remote arms).
+    Page layout: both K and V of one page are packed into a single
+    allocation: (2, L, B, KV, page_tokens, Hd) bitcast to bytes.
+    """
+
+    backend: object
+    cfg: LlamaConfig
+    batch: int
+    page_tokens: int = 128
+    kind: OcmKind = OcmKind.REMOTE_DEVICE
+    dtype: str = "float32"
+    pages: list[OcmAlloc] = field(default_factory=list)
+
+    @property
+    def page_shape(self) -> tuple:
+        c = self.cfg
+        return (2, c.n_layers, self.batch, c.n_kv_heads, self.page_tokens,
+                c.head_dim)
+
+    @property
+    def page_bytes(self) -> int:
+        return int(np.prod(self.page_shape)) * jnp.dtype(self.dtype).itemsize
+
+    @property
+    def tokens_paged(self) -> int:
+        return len(self.pages) * self.page_tokens
+
+    def store_page(self, k_page: jax.Array, v_page: jax.Array) -> OcmAlloc:
+        """Ship one completed page into the pod (one-sided put). k/v:
+        (L, B, KV, page_tokens, Hd)."""
+        packed = jnp.stack([k_page, v_page]).astype(jnp.dtype(self.dtype))
+        assert packed.shape == self.page_shape, (packed.shape, self.page_shape)
+        with GLOBAL_TRACER.span("kv_store_page", nbytes=self.page_bytes):
+            h = self.backend.alloc(self.page_bytes, self.kind)
+            self.backend.put(h, to_bytes(packed), 0)
+        self.pages.append(h)
+        return h
+
+    def fetch_pages(self) -> tuple[jax.Array, jax.Array] | None:
+        """Gather every page back (one-sided gets) and concatenate along the
+        token axis: (L, B, KV, tokens_paged, Hd) x2."""
+        if not self.pages:
+            return None
+        ks, vs = [], []
+        with GLOBAL_TRACER.span(
+            "kv_fetch_pages", nbytes=self.page_bytes * len(self.pages)
+        ):
+            for h in self.pages:
+                raw = self.backend.get(h, self.page_bytes, 0)
+                # jnp.asarray: device-resident gets stay on device (a
+                # numpy round-trip here cost a sync + two transfers per
+                # page on the tunneled chip); host-arm gets upload once.
+                packed = from_bytes(
+                    jnp.asarray(raw), self.page_shape, self.dtype
+                )
+                ks.append(packed[0])
+                vs.append(packed[1])
+        return jnp.concatenate(ks, axis=3), jnp.concatenate(vs, axis=3)
+
+    def drop_oldest(self) -> None:
+        """Free the oldest page (sliding-window eviction).
+
+        The caller MUST track the global position of the first retained
+        page and feed it to the decode step (``ctx_start`` in
+        :func:`paged_decode_step_jit`, as :class:`BucketedPagedDecoder`
+        does) — after an eviction, retained pages no longer start at
+        absolute position 0, and a decoder that assumes they do
+        (:class:`PagedDecoder` / :func:`paged_decode_step`) would
+        attribute wrong positions to every key."""
+        self.backend.free(self.pages.pop(0))
+
+    def free(self) -> None:
+        for h in self.pages:
+            self.backend.free(h)
+        self.pages.clear()
+
+
+def paged_decode_step(
+    params: dict,
+    token: jax.Array,
+    pos: int,
+    k_ctx: jax.Array | None,
+    v_ctx: jax.Array | None,
+    cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """Decode one token attending over the full valid context.
+
+    k_ctx/v_ctx: (L, B, KV, T, Hd) — paged pages + local tail concatenated,
+    containing exactly the T = ``pos`` valid entries (no masking needed);
+    None when pos == 0. Returns (logits, (new_k, new_v)) where new_k/new_v
+    are this token's (L, B, KV, 1, Hd) cache entries.
+
+    Reuses :func:`llama.block` — one transformer-block implementation for
+    training, cached decode, and paged decode. ``layer_params_fn``/
+    ``mlp_of`` are the family hooks (see ``llama.decode_step``): the MoE
+    family passes its slicer + expert-FFN factory and pages its KV the
+    same way.
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
+    positions = jnp.asarray([pos])
+    new_k, new_v = [], []
+
+    for i in range(cfg.n_layers):
+        def attend(q, kn, vn, i=i):
+            new_k.append(kn)
+            new_v.append(vn)
+            if k_ctx is not None:
+                k_all = jnp.concatenate(
+                    [k_ctx[i].astype(q.dtype), kn.astype(q.dtype)], axis=2
+                )
+                v_all = jnp.concatenate(
+                    [v_ctx[i].astype(q.dtype), vn.astype(q.dtype)], axis=2
+                )
+            else:
+                k_all, v_all = kn.astype(q.dtype), vn.astype(q.dtype)
+            mask = None
+            if cfg.window is not None:
+                # Keys are laid out by absolute position 0..pos.
+                mask = (jnp.arange(k_all.shape[2]) > pos - cfg.window)[None, :]
+            return llama.grouped_attention(q, k_all, v_all, mask)
+
+        lp = lp_fn(params, i)
+        x = llama.block(cfg, x, lp, positions, attend,
+                        mlp=mlp_of(lp) if mlp_of else None)
+
+    logits = llama.final_logits(params, x, cfg)
+    return logits[:, 0], (jnp.stack(new_k), jnp.stack(new_v))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "layer_params_fn", "mlp_of"),
+    donate_argnums=(5, 6),
+)
+def paged_decode_step_jit(
+    params: dict,
+    token: jax.Array,      # (B,) current token ids
+    meta: jax.Array,       # (3,) int32 [pos, tail_len, ctx_start]
+    k_ctx: jax.Array,      # (L, B, KV, C, Hd) paged context; C may be 0
+    v_ctx: jax.Array,
+    tail_k: jax.Array,     # (L, B, KV, P, Hd) local tail buffer (donated)
+    tail_v: jax.Array,
+    cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """Shape-bucketed jitted paged decode.
+
+    Unlike :func:`paged_decode_step` (whose context length grows by one
+    every token, forcing an XLA recompile per step), the tail lives in a
+    fixed (L, B, KV, P, Hd) buffer masked by ``tail_len``, so the traced
+    shapes change only when the paged context ``C`` grows by a page:
+    O(tokens / page_tokens) compilations instead of O(tokens). This is the
+    static-shape formulation TPU/XLA wants and what makes paged decode
+    usable as a real-chip benchmark (BASELINE.md config 5).
+
+    Per-step host traffic is ONE packed (3,) int32 transfer: ``meta``
+    carries [pos, tail_len, ctx_start] (ctx_start = global position of
+    ``k_ctx[..., 0, :]`` after evictions). Three separate scalar uploads
+    cost ~a dispatch each on a tunneled chip — the bulk of r3's paged
+    per-token deficit vs the plain loop. The tail buffers are donated:
+    XLA updates them in place instead of allocating fresh ones per step.
+
+    Returns (logits, new_tail_k, new_tail_v); the caller owns tail_len
+    bookkeeping and page shipping. ``layer_params_fn``/``mlp_of`` are the
+    family hooks (static under jit) — see :func:`paged_decode_step`.
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    return _paged_token(
+        params, token, meta[0], meta[1], meta[2], k_ctx, v_ctx,
+        tail_k, tail_v, cfg, lp_fn, mlp_of,
+    )
+
+
+def _paged_token(params, token, pos, tail_len, ctx_start, k_ctx, v_ctx,
+                 tail_k, tail_v, cfg, lp_fn, mlp_of):
+    """One paged-decode token: the traced body shared by the per-token jit
+    (:func:`paged_decode_step_jit`) and the page-fused scan
+    (:func:`paged_decode_page_jit`). All of pos/tail_len/ctx_start are
+    traced scalars."""
+    from oncilla_tpu.models import llama
+
+    x = params["embed"][token][:, None, :].astype(jnp.dtype(cfg.dtype))
+    positions = pos[None]
+    P = tail_k.shape[3]
+    C = k_ctx.shape[3]
+    # Keys = [paged context (all valid) | tail slots (valid through this
+    # step's insertion at index tail_len)].
+    valid = jnp.concatenate(
+        [jnp.ones((C,), bool), jnp.arange(P) <= tail_len]
+    )[None, :]
+    if cfg.window is not None:
+        # Global key positions: paged context starts at ctx_start (pages
+        # before it may have been evicted), tail slot j holds position
+        # pos - tail_len + j; band-limit to the query's last `window`.
+        gk = jnp.concatenate(
+            [ctx_start + jnp.arange(C), (pos - tail_len) + jnp.arange(P)]
+        )
+        valid &= (gk > pos - cfg.window)[None, :]
+
+    for i in range(cfg.n_layers):
+        state = {}
+
+        def attend(q, kn, vn, i=i, state=state):
+            tk = jax.lax.dynamic_update_slice(
+                tail_k[i], kn.astype(tail_k.dtype), (0, 0, tail_len, 0)
+            )
+            tv = jax.lax.dynamic_update_slice(
+                tail_v[i], vn.astype(tail_v.dtype), (0, 0, tail_len, 0)
+            )
+            state["tk"], state["tv"] = tk, tv
+            k_all = jnp.concatenate(
+                [k_ctx[i].astype(q.dtype), tk.astype(q.dtype)], axis=2
+            )
+            v_all = jnp.concatenate(
+                [v_ctx[i].astype(q.dtype), tv.astype(q.dtype)], axis=2
+            )
+            return llama.grouped_attention(q, k_all, v_all, valid)
+
+        lp = lp_fn(params, i)
+        x = llama.block(cfg, x, lp, positions, attend,
+                        mlp=mlp_of(lp) if mlp_of else None)
+        tail_k = tail_k.at[i].set(state["tk"])
+        tail_v = tail_v.at[i].set(state["tv"])
+
+    logits = llama.final_logits(params, x, cfg)
+    return logits[:, 0], tail_k, tail_v
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "layer_params_fn", "mlp_of"),
+    donate_argnums=(5, 6),
+)
+def paged_decode_page_jit(
+    params: dict,
+    tokens_page: jax.Array,  # (B, P) one full page of token ids
+    meta: jax.Array,         # (2,) int32 [pos0, ctx_start]
+    k_ctx: jax.Array,        # (L, B, KV, C, Hd) paged context; C may be 0
+    v_ctx: jax.Array,
+    tail_k: jax.Array,       # (L, B, KV, P, Hd) tail buffer (donated)
+    tail_v: jax.Array,
+    cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """One full page of paged decode as ONE compiled program: a
+    ``lax.scan`` over the page's P tokens with the tail buffers threaded
+    (and donated) through the carry — the per-page-dispatch formulation a
+    TPU serving loop wants (the per-token loop pays one host dispatch per
+    token; this pays one per page, the same trade as
+    :func:`llama.decode_loop` at page granularity, with the paged OCM
+    context still on the attention path).
+
+    Starts from an empty tail (tail_len 0); token j of the page decodes
+    at absolute position pos0 + j with tail_len j. Returns
+    (logits (B, P, vocab), new_tail_k, new_tail_v) — the caller ships the
+    now-full tail as a page.
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    pos0, ctx_start = meta[0], meta[1]
+    P = tail_k.shape[3]
+
+    def body(carry, inp):
+        tail_k, tail_v = carry
+        tok, j = inp
+        logits, tail_k, tail_v = _paged_token(
+            params, tok, pos0 + j, j, ctx_start, k_ctx, v_ctx,
+            tail_k, tail_v, cfg, lp_fn, mlp_of,
+        )
+        return (tail_k, tail_v), logits
+
+    (tail_k, tail_v), logits = jax.lax.scan(
+        body, (tail_k, tail_v), (tokens_page.T, jnp.arange(P))
+    )
+    return logits.transpose(1, 0, 2), tail_k, tail_v
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "layer_params_fn", "mlp_of"),
+    donate_argnums=(5, 6),
+)
+def paged_generate_page_jit(
+    params: dict,
+    token0: jax.Array,       # (B,) the token that seeds this page
+    meta: jax.Array,         # (2,) int32 [pos0, ctx_start]
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    tail_k: jax.Array,       # (L, B, KV, P, Hd) empty tail (donated)
+    tail_v: jax.Array,
+    cfg: LlamaConfig,
+    key: jax.Array,
+    temperature: float = 0.0,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """One page of *autoregressive* paged decode as ONE compiled program:
+    each scan tick consumes the previous tick's sample (greedy at
+    ``temperature`` 0, else softmax sampling) — the sampled flavor of
+    :func:`paged_decode_page_jit` and the per-page serving loop proper
+    (the paged counterpart of :func:`llama.generate`'s sampling scan).
+
+    Returns (sampled ids (B, P), new_tail_k, new_tail_v). The tail holds
+    K/V of every *consumed* token this page (token0 + the first P-1
+    samples); the final sample is output-only and seeds the next page.
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    pos0, ctx_start = meta[0], meta[1]
+    P = tail_k.shape[3]
+
+    def pick(logits_b, k):
+        return llama.sample_token(logits_b, k, temperature, token0.dtype)
+
+    def body(carry, inp):
+        tok, tail_k, tail_v = carry
+        j, k_j = inp
+        logits, tail_k, tail_v = _paged_token(
+            params, tok, pos0 + j, j, ctx_start, k_ctx, v_ctx,
+            tail_k, tail_v, cfg, lp_fn, mlp_of,
+        )
+        nxt = pick(logits, k_j)
+        return (nxt, tail_k, tail_v), nxt
+
+    keys = jax.random.split(key, P)
+    (last, tail_k, tail_v), out = jax.lax.scan(
+        body, (token0, tail_k, tail_v), (jnp.arange(P), keys)
+    )
+    return out.transpose(1, 0), tail_k, tail_v
+
+
+class BucketedPagedDecoder:
+    """Jitted decode session with OCM-paged KV history.
+
+    Same contract as :class:`PagedDecoder`, but decode steps run through
+    :func:`paged_decode_step_jit` with a fixed-size masked tail, so a long
+    decode compiles once per *page* rather than once per *token*.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: LlamaConfig,
+        backend,
+        batch: int = 1,
+        page_tokens: int = 16,
+        kind: OcmKind = OcmKind.REMOTE_DEVICE,
+        dtype: str = "float32",
+        refetch: bool = False,
+        layer_params_fn=None,
+        mlp_of=None,
+    ):
+        """``refetch=True`` re-reads the *whole* paged context through the
+        OCM data plane (one-sided gets) at every page boundary instead of
+        extending a locally retained copy — O(pages^2) read traffic, the
+        mode that actually exercises the get path (and what a resumed
+        session with no local copy would do every page)."""
+        self.params = params
+        self.cfg = cfg
+        self.cache = PagedKVCache(backend, cfg, batch, page_tokens, kind, dtype)
+        self.page_tokens = page_tokens
+        self.refetch = refetch
+        self._hooks = dict(layer_params_fn=layer_params_fn, mlp_of=mlp_of)
+        self.pos = 0
+        self._ctx_start = 0  # global position of the first retained page
+        shape = (cfg.n_layers, batch, cfg.n_kv_heads, page_tokens, cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        self._tail_k = jnp.zeros(shape, dt)
+        self._tail_v = jnp.zeros(shape, dt)
+        self._tail_len = 0
+        # Paged context starts empty (C = 0); grows a page at a time.
+        empty = shape[:3] + (0,) + shape[4:]
+        self._fetched = (jnp.zeros(empty, dt), jnp.zeros(empty, dt))
+
+    def step(self, token: jax.Array) -> jax.Array:
+        meta = jnp.asarray(
+            [self.pos, self._tail_len, self._ctx_start], dtype=jnp.int32
+        )
+        logits, self._tail_k, self._tail_v = paged_decode_step_jit(
+            self.params, token, meta,
+            self._fetched[0], self._fetched[1],
+            self._tail_k, self._tail_v, self.cfg,
+            **self._hooks,
+        )
+        self.pos += 1
+        self._tail_len += 1
+        if self._tail_len == self.page_tokens:
+            self._ship_page()
+        return logits
+
+    def step_page(self, tokens_page: jax.Array) -> jax.Array:
+        """Decode one FULL page of teacher-forced tokens in a single
+        compiled dispatch (:func:`paged_decode_page_jit`), then ship the
+        page — the per-page-dispatch serving loop. Requires an empty tail
+        (step/step_page calls must align to page boundaries) and
+        ``tokens_page.shape[-1] == page_tokens``. Returns per-token logits
+        (B, P, vocab)."""
+        if self._tail_len != 0:
+            raise ValueError(
+                f"step_page needs an empty tail (tail_len="
+                f"{self._tail_len}); align step()/step_page() calls to "
+                "page boundaries"
+            )
+        if tokens_page.shape[-1] != self.page_tokens:
+            raise ValueError(
+                f"step_page wants exactly page_tokens="
+                f"{self.page_tokens} ids, got {tokens_page.shape[-1]}"
+            )
+        meta = jnp.asarray([self.pos, self._ctx_start], dtype=jnp.int32)
+        logits, self._tail_k, self._tail_v = paged_decode_page_jit(
+            self.params, tokens_page, meta,
+            self._fetched[0], self._fetched[1],
+            self._tail_k, self._tail_v, self.cfg,
+            **self._hooks,
+        )
+        self.pos += self.page_tokens
+        self._tail_len = self.page_tokens
+        self._ship_page()
+        return logits
+
+    def generate_page(self, token: jax.Array, *, key: jax.Array | None = None,
+                      temperature: float = 0.0) -> jax.Array:
+        """Autoregressively sample one full page in a single compiled
+        dispatch (:func:`paged_generate_page_jit`), then ship it. ``token``
+        is the (B,) seed (the previous page's last sample, or the last
+        prompt token); returns the (B, page_tokens) sampled ids — the last
+        of which seeds the next ``generate_page`` call. Greedy at
+        ``temperature`` 0, else softmax sampling with ``key``. Requires an
+        empty tail (page-boundary-aligned, same as :meth:`step_page`)."""
+        if self._tail_len != 0:
+            raise ValueError(
+                f"generate_page needs an empty tail (tail_len="
+                f"{self._tail_len}); align calls to page boundaries"
+            )
+        if key is None:
+            key = jax.random.key(self.pos)
+        meta = jnp.asarray([self.pos, self._ctx_start], dtype=jnp.int32)
+        out, self._tail_k, self._tail_v = paged_generate_page_jit(
+            self.params, token, meta,
+            self._fetched[0], self._fetched[1],
+            self._tail_k, self._tail_v, self.cfg, key,
+            temperature=temperature,
+            **self._hooks,
+        )
+        self.pos += self.page_tokens
+        self._tail_len = self.page_tokens
+        self._ship_page()
+        return out
+
+    def _ship_page(self) -> None:
+        """Page boundary: ship the full tail into the pod and extend the
+        local concat (same O(pages) traffic policy as PagedDecoder.step);
+        with ``refetch`` re-read the whole paged context instead."""
+        k_page = self._tail_k.astype(jnp.dtype(self.cache.dtype))
+        v_page = self._tail_v.astype(jnp.dtype(self.cache.dtype))
+        self.cache.store_page(k_page, v_page)
+        dt = jnp.dtype(self.cfg.dtype)
+        # Sliding-window eviction: a page whose every key is outside
+        # the window of all future queries (>= self.pos) is freed from
+        # OCM and dropped from the local concat, keeping the working
+        # set O(window) instead of O(pos) — the rolling-buffer
+        # semantics of the Mistral scheme, on paged storage.
+        if self.cfg.window is not None:
+            while (self.cache.pages and self._ctx_start
+                   + self.page_tokens <= self.pos - self.cfg.window):
+                self.cache.drop_oldest()
+                self._ctx_start += self.page_tokens
+                if not self.refetch:
+                    self._fetched = (
+                        self._fetched[0][:, :, :, self.page_tokens:],
+                        self._fetched[1][:, :, :, self.page_tokens:],
+                    )
+        if self.refetch:
+            fk, fv = self.cache.fetch_pages()
+            self._fetched = (fk.astype(dt), fv.astype(dt))
+        else:
+            self._fetched = (
+                jnp.concatenate(
+                    [self._fetched[0], k_page.astype(dt)], axis=3
+                ),
+                jnp.concatenate(
+                    [self._fetched[1], v_page.astype(dt)], axis=3
+                ),
+            )
+        # Stale tail contents are masked out by tail_len; no need to
+        # zero the buffers.
+        self._tail_len = 0
+
+    def close(self) -> None:
+        self.cache.free()
+
+
+class PagedDecoder:
+    """A decode session whose KV history pages out through OCM.
+
+    The local working set is one page of tail KV; every ``page_tokens``
+    steps the tail ships into the pod (remote chip HBM / remote host DRAM
+    per ``kind``) and decode continues against fetched pages + fresh tail —
+    the Llama-KV-cache-in-remote-pod-HBM loop of BASELINE.md config 5.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: LlamaConfig,
+        backend,
+        batch: int = 1,
+        page_tokens: int = 16,
+        kind: OcmKind = OcmKind.REMOTE_DEVICE,
+        dtype: str = "float32",
+        layer_params_fn=None,
+        mlp_of=None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.cache = PagedKVCache(
+            backend, cfg, batch, page_tokens, kind, dtype
+        )
+        self.page_tokens = page_tokens
+        self._hooks = dict(layer_params_fn=layer_params_fn, mlp_of=mlp_of)
+        self.pos = 0
+        self._tail_k: list = []  # per-step (L, B, KV, 1, Hd)
+        self._tail_v: list = []
+        self._fetched = None  # concatenated paged context (k, v)
+
+    def _context(self):
+        parts_k, parts_v = [], []
+        if self.cache.pages:
+            if self._fetched is None:
+                # Cold start (e.g. resuming a session): one bulk fetch.
+                self._fetched = self.cache.fetch_pages()
+            parts_k.append(self._fetched[0])
+            parts_v.append(self._fetched[1])
+        if self._tail_k:
+            parts_k.append(jnp.concatenate(self._tail_k, axis=3))
+            parts_v.append(jnp.concatenate(self._tail_v, axis=3))
+        if not parts_k:
+            return None, None
+        return (
+            jnp.concatenate(parts_k, axis=3),
+            jnp.concatenate(parts_v, axis=3),
+        )
+
+    def step(self, token: jax.Array) -> jax.Array:
+        k_ctx, v_ctx = self._context()
+        logits, (nk, nv) = paged_decode_step(
+            self.params, token, self.pos, k_ctx, v_ctx, self.cfg,
+            **self._hooks,
+        )
+        self._tail_k.append(nk)
+        self._tail_v.append(nv)
+        self.pos += 1
+        if len(self._tail_k) == self.page_tokens:
+            # Ship the full tail into the pod; extend the local fetched
+            # concat with the page we already hold instead of refetching
+            # every page (keeps remote traffic O(pages), not O(pages^2)).
+            k_page = jnp.concatenate(self._tail_k, axis=3).astype(
+                jnp.dtype(self.cache.dtype)
+            )
+            v_page = jnp.concatenate(self._tail_v, axis=3).astype(
+                jnp.dtype(self.cache.dtype)
+            )
+            self.cache.store_page(k_page, v_page)
+            if self._fetched is None and len(self.cache.pages) > 1:
+                self._fetched = self.cache.fetch_pages()
+            elif self._fetched is None:
+                self._fetched = (k_page, v_page)
+            else:
+                self._fetched = (
+                    jnp.concatenate([self._fetched[0], k_page], axis=3),
+                    jnp.concatenate([self._fetched[1], v_page], axis=3),
+                )
+            self._tail_k, self._tail_v = [], []
+        return logits
+
+    def close(self) -> None:
+        self.cache.free()

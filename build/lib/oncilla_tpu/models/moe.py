@@ -1,0 +1,333 @@
+"""Mixture-of-Experts model family: a Mixtral-style sparse-FFN transformer
+with expert parallelism over an ``ep`` mesh axis.
+
+TPU-first design notes:
+- Routing uses the dense-dispatch formulation (one-hot dispatch/combine
+  einsums, the GShard/Switch pattern): the dispatch is a matmul that rides
+  the MXU, shapes are static (capacity-based), and when the (E, C, D)
+  expert-batch tensor carries a ``P(ep, ...)`` sharding GSPMD lowers the
+  dispatch/combine einsums to ICI all-to-alls — no hand-written routing
+  collectives.
+- Expert weights are stacked on a leading ``E`` axis (after the layer
+  axis), so sharding the experts is one PartitionSpec; the per-expert FFN
+  is a single E-batched einsum, not a Python loop.
+- Capacity is static (shape-stable under jit): ``C = ceil(k*T/E * cf)``;
+  overflowing tokens are dropped by the dispatch mask and their combine
+  weight is zero (they pass through the residual unchanged).
+- The attention half of every block is byte-identical to the dense family
+  (:func:`oncilla_tpu.models.llama.block` with an ``mlp`` callback), so
+  ring attention over ``sp`` composes with expert parallelism.
+
+The reference is not an ML framework (SURVEY.md §0): like
+:mod:`oncilla_tpu.models.llama`, this is demo/benchmark cargo proving the
+runtime and the parallelism surface (dp/tp/sp/ep here, pp in
+:mod:`oncilla_tpu.parallel.pipeline`) on a real workload.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from oncilla_tpu.models.llama import (
+    LlamaConfig,
+    block,
+    final_logits,
+    init_from_spec,
+    param_spec,
+)
+
+
+@dataclass(frozen=True)
+class MoeConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+
+    @staticmethod
+    def tiny() -> "MoeConfig":
+        """CI-size config for the virtual CPU mesh."""
+        return MoeConfig(
+            vocab=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_hidden=128, max_seq=128, dtype="float32",
+            n_experts=4, top_k=2,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoeConfig":
+        """Mixtral-8x7B geometry (the public MoE flagship shape)."""
+        return MoeConfig(
+            vocab=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_hidden=14336, max_seq=8192, rope_theta=1e6,
+            n_experts=8, top_k=2,
+        )
+
+
+def moe_param_spec(cfg: MoeConfig) -> dict:
+    """Dense spec with the FFN leaves replaced by E-stacked expert weights
+    plus a per-layer router."""
+    spec = dict(param_spec(cfg))
+    L, D, E, F = cfg.n_layers, cfg.dim, cfg.n_experts, cfg.ffn_hidden
+    s_in = 1.0 / math.sqrt(D)
+    s_out = 1.0 / math.sqrt(2 * L * D)
+    for k in ("w_gate", "w_up", "w_down"):
+        del spec[k]
+    spec["w_router"] = ((L, D, E), s_in)
+    spec["w_gate_e"] = ((L, E, D, F), s_in)
+    spec["w_up_e"] = ((L, E, D, F), s_in)
+    spec["w_down_e"] = ((L, E, F, D), s_out)
+    return spec
+
+
+def init_moe_params(key: jax.Array, cfg: MoeConfig) -> dict:
+    return init_from_spec(key, moe_param_spec(cfg), cfg.dtype)
+
+
+def capacity(cfg: MoeConfig, tokens: int) -> int:
+    """Static per-expert slot count: ceil(k*T/E * capacity_factor)."""
+    return max(
+        1,
+        int(math.ceil(cfg.top_k * tokens / cfg.n_experts
+                      * cfg.capacity_factor)),
+    )
+
+
+def route(router_logits: jax.Array, top_k: int, cap: int):
+    """Top-k capacity-based routing (fp32 throughout).
+
+    router_logits: (T, E). Returns ``(dispatch, combine, aux)`` where
+    dispatch is the 0/1 (T, E, C) assignment, combine is dispatch scaled by
+    the renormalized top-k gate weights, and aux is the GShard
+    load-balancing loss E·Σₑ fₑ·pₑ (fₑ = fraction of tokens whose first
+    choice is e, pₑ = mean router probability of e; minimized at 1 when
+    both are uniform).
+
+    Slot priority is choice-major: every token's 1st choice is placed
+    before any token's 2nd choice, so under overflow a token loses its
+    secondary expert before any token loses its primary.
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)            # (T, k)
+    gate_vals = gate_vals / jnp.clip(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+    oh = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # (T, k, E)
+
+    # Position of each (token, choice) in its expert's queue, counted in
+    # choice-major order.
+    oh_priority = oh.transpose(1, 0, 2).reshape(top_k * T, E)
+    pos = jnp.cumsum(oh_priority, axis=0) - oh_priority
+    pos = pos.reshape(top_k, T, E).transpose(1, 0, 2)            # (T, k, E)
+
+    pos_in_expert = jnp.sum(pos * oh, axis=-1)                   # (T, k)
+    keep = jnp.any((pos < cap) & (oh > 0), axis=-1)              # (T, k)
+    slot = (
+        jax.nn.one_hot(pos_in_expert.astype(jnp.int32), cap, dtype=jnp.float32)
+        * keep[..., None]
+    )                                                            # (T, k, C)
+
+    dispatch = jnp.einsum("tke,tkc->tec", oh, slot)
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_vals, oh, slot)
+
+    first_choice_frac = jnp.mean(oh[:, 0, :], axis=0)            # (E,)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(first_choice_frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    h: jax.Array,
+    lp: dict,
+    cfg: MoeConfig,
+    *,
+    mesh=None,
+    ep_axis: str | None = None,
+):
+    """The sparse FFN: route → dispatch → E-batched SwiGLU → combine.
+
+    h: (B, S, D) post-rmsnorm residual branch. lp holds this layer's
+    ``w_router``/``w_gate_e``/``w_up_e``/``w_down_e``. With ``mesh`` +
+    ``ep_axis``, the (E, C, ·) expert batch is sharding-constrained over
+    the expert axis so GSPMD inserts the dispatch/combine all-to-alls over
+    ICI. Returns ``(y, aux)``.
+    """
+    B, S, D = h.shape
+    T = B * S
+    x = h.reshape(T, D)
+    cap = capacity(cfg, T)
+
+    router_logits = jnp.einsum(
+        "td,de->te", x.astype(jnp.float32), lp["w_router"].astype(jnp.float32)
+    )
+    dispatch, combine, aux = route(router_logits, cfg.top_k, cap)
+
+    def constrain(v, spec):
+        if mesh is None or ep_axis is None:
+            return v
+        return jax.lax.with_sharding_constraint(
+            v, jax.sharding.NamedSharding(mesh, spec)
+        )
+
+    from jax.sharding import PartitionSpec as P
+
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(h.dtype), x)
+    xe = constrain(xe, P(ep_axis, None, None))
+    g = jnp.einsum("ecd,edf->ecf", xe, lp["w_gate_e"])
+    u = jnp.einsum("ecd,edf->ecf", xe, lp["w_up_e"])
+    ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, lp["w_down_e"])
+    ye = constrain(ye, P(ep_axis, None, None))
+    y = jnp.einsum("tec,ecd->td", combine.astype(h.dtype), ye)
+    return y.reshape(B, S, D), aux
+
+
+# Per-layer (stacked) leaves of the MoE family — the single source of
+# truth for layer slicing, pp sharding specs, and pipeline block dicts
+# (the dense family's counterpart is llama.LAYER_KEYS).
+MOE_LAYER_KEYS = (
+    "wq", "wk", "wv", "wo", "ln_attn", "ln_mlp",
+    "w_router", "w_gate_e", "w_up_e", "w_down_e",
+)
+
+
+def moe_layer_params(params: dict, i: int) -> dict:
+    return {k: params[k][i] for k in MOE_LAYER_KEYS}
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    *,
+    mesh=None,
+    seq_axis: str | None = None,
+    ep_axis: str | None = None,
+    remat: bool = False,
+):
+    """Logits + summed router aux loss for a (B, S) token batch. Attention
+    is the dense family's (optionally ring over ``seq_axis``); every FFN is
+    the expert layer. ``remat`` checkpoints each block (recompute in the
+    backward pass), same trade as the dense family's."""
+    from oncilla_tpu.models.llama import make_attend
+
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    positions = jnp.arange(S)
+    attend = make_attend(S, mesh, seq_axis, window=cfg.window)
+
+    def one_block(x, lp):
+        box = {}
+
+        def mlp(hn, lp=lp, box=box):
+            y, aux = moe_ffn(hn, lp, cfg, mesh=mesh, ep_axis=ep_axis)
+            box["aux"] = aux
+            return y
+
+        out = block(cfg, x, lp, positions, attend, mlp=mlp)
+        return out, box["aux"]
+
+    if remat:
+        one_block = jax.checkpoint(one_block)
+
+    aux_total = jnp.float32(0.0)
+    for i in range(cfg.n_layers):
+        x, aux = one_block(x, moe_layer_params(params, i))
+        aux_total = aux_total + aux
+    return final_logits(params, x, cfg), aux_total
+
+
+def loss_fn(params, tokens, cfg: MoeConfig, **kw) -> jax.Array:
+    """Next-token cross entropy + weighted router load-balancing loss."""
+    logits, aux = forward(params, tokens, cfg, **kw)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + cfg.router_aux_weight * aux
+
+
+# -- decode (same KV-cache machinery as the dense family) ------------------
+
+
+@functools.lru_cache(maxsize=64)
+def mlp_of(cfg: MoeConfig, mesh=None, ep_axis: str | None = None):
+    """``mlp_of(lp) -> mlp`` family hook for the dense decode/paging
+    machinery (``llama.decode_step``, ``kv_paging.paged_decode_step*``).
+    With ``mesh`` + ``ep_axis`` the expert batch is sharding-constrained
+    so decode dispatch/combine also ride the ep all-to-all.
+
+    Memoized on (cfg, mesh, ep_axis): the paged jit step declares the
+    hook STATIC (identity-hashed), so equal configs must share one
+    callable or every decoder instance would retrace and recompile all
+    its shape buckets.
+
+    Retention: the lru_cache keeps strong references to up to 64
+    (cfg, Mesh) keys for process lifetime — a Mesh pinned here (and its
+    devices) outlives the session that created it. Deliberate: jax's own
+    jit caches retain the same objects anyway, the bound is small, and a
+    weak-keyed cache would break the identity contract above whenever
+    the caller drops its Mesh between decode sessions."""
+
+    def of(lp):
+        def mlp(hn):
+            return moe_ffn(hn, lp, cfg, mesh=mesh, ep_axis=ep_axis)[0]
+
+        return mlp
+
+    return of
+
+
+def paged_hooks(cfg: MoeConfig, mesh=None, ep_axis: str | None = None) -> dict:
+    """kwargs for the paged decoders
+    (:class:`oncilla_tpu.models.kv_paging.BucketedPagedDecoder` /
+    ``PagedDecoder``) so MoE KV history pages through OCM like the dense
+    family's: ``BucketedPagedDecoder(params, cfg, ctx,
+    **moe.paged_hooks(cfg))``."""
+    return dict(
+        layer_params_fn=moe_layer_params,
+        mlp_of=mlp_of(cfg, mesh, ep_axis),
+    )
+
+
+def decode_step(params, token, pos, kv_cache, cfg: MoeConfig,
+                *, mesh=None, ep_axis: str | None = None):
+    """Single-token MoE decode: the dense family's cache machinery
+    (:func:`oncilla_tpu.models.llama.decode_step`) with the expert FFN
+    plugged in per layer. The (L, B, KV, max_seq, Hd) cache layout is the
+    dense one, and the paged decoders accept the same hooks
+    (:func:`paged_hooks`), so OCM KV paging applies to this family too.
+    ``mesh``/``ep_axis`` opt decode into expert-parallel dispatch.
+
+    Routing note: at decode T = B tokens route per step, so per-expert
+    capacity rarely binds — a token that would have been capacity-dropped
+    during teacher-forced prefill (where all B·S tokens compete) keeps
+    its expert here. Decode logits therefore match the teacher-forced
+    forward exactly only when capacity is ample (no drops); under drops
+    the two are legitimately different computations."""
+    from oncilla_tpu.models import llama
+
+    return llama.decode_step(
+        params, token, pos, kv_cache, cfg,
+        layer_params_fn=moe_layer_params,
+        mlp_of=mlp_of(cfg, mesh, ep_axis),
+    )
+
+
+def generate(params, prompt, kv_cache, cfg: MoeConfig, steps: int,
+             *, mesh=None, ep_axis: str | None = None, **kw):
+    """MoE autoregressive continuation — the dense family's compiled
+    prefill+sample program with the MoE decode step. ``mesh``/``ep_axis``
+    opt the decode FFNs into expert-parallel dispatch."""
+    from functools import partial
+
+    from oncilla_tpu.models import llama
+
+    return llama.generate(
+        params, prompt, kv_cache, cfg, steps,
+        step_fn=partial(decode_step, mesh=mesh, ep_axis=ep_axis), **kw
+    )

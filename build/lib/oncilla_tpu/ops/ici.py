@@ -1,0 +1,319 @@
+"""ICI data plane, app side: REMOTE_DEVICE put/get/copy over chip interconnect.
+
+The reference's device data plane is one-sided RDMA into a remote daemon's
+registered buffer (/root/reference/src/rdma.c:241-263). On TPU the analogue
+splits in two:
+
+- **This module** — the single-controller orchestration path: the app holds
+  one :class:`DeviceArena` per chip (the "registered" HBM regions) and moves
+  bytes with ``jax.device_put``, which XLA routes over ICI for chip-to-chip
+  transfers. It implements the data half of the client's RemoteBackend for
+  ``REMOTE_DEVICE`` handles.
+- :mod:`oncilla_tpu.parallel.spmd_arena` — the in-mesh SPMD fabric used
+  *inside* jitted training steps (shard_map + ppermute / Pallas remote DMA),
+  where collectives are compiler-scheduled.
+
+Addressing is connectionless, EXTOLL-style (node, vpid, NLA ≙ rank,
+device_index, offset — SURVEY.md §7 mapping table).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.errors import OcmError, OcmInvalidHandle
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.hbm import DeviceArena
+from oncilla_tpu.parallel.mesh import global_index
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER
+
+
+def resolve_global_device(handle: OcmAlloc, devices_per_rank: int, ndevices: int) -> int:
+    """(rank, device_index) -> global device id with range validation —
+    shared by both device data planes."""
+    if not 0 <= handle.device_index < devices_per_rank:
+        raise OcmInvalidHandle(
+            f"device_index {handle.device_index} out of range for "
+            f"{devices_per_rank} devices per rank"
+        )
+    g = global_index(handle.rank, handle.device_index, devices_per_rank)
+    if not 0 <= g < ndevices:
+        raise OcmInvalidHandle(
+            f"handle addresses device {g} but only {ndevices} devices "
+            "are attached"
+        )
+    return g
+
+
+class IciDataPlane:
+    """Per-chip HBM arenas addressable pod-wide by (rank, device_index).
+
+    ``devices_per_rank`` maps a handle's (rank, device_index) to a global
+    device: ``global = rank * devices_per_rank + device_index``. The arena
+    capacities must match what the daemons' bookkeeping allocators assume
+    (``OcmConfig.device_arena_bytes``), since daemons hand out offsets into
+    these arenas without touching the bytes.
+    """
+
+    def __init__(
+        self,
+        config: OcmConfig | None = None,
+        devices=None,
+        devices_per_rank: int | None = None,
+    ):
+        self.config = config or OcmConfig()
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.devices_per_rank = devices_per_rank or len(self.devices)
+        self.arenas = [
+            DeviceArena(self.config.device_arena_bytes, d, self.config.alignment)
+            for d in self.devices
+        ]
+        self.tracer = GLOBAL_TRACER
+
+    def _arena(self, handle: OcmAlloc) -> DeviceArena:
+        g = resolve_global_device(handle, self.devices_per_rank, len(self.arenas))
+        return self.arenas[g]
+
+    # -- RemoteBackend data interface ------------------------------------
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        """One-sided write: host (or any device) -> owning chip's arena."""
+        arena = self._arena(handle)
+        with self.tracer.span("ici_put", nbytes=_nbytes(data)):
+            arena.write(handle.extent, data, offset)
+
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0) -> jax.Array:
+        """One-sided read from the owning chip's arena."""
+        arena = self._arena(handle)
+        with self.tracer.span("ici_get", nbytes=nbytes):
+            return arena.read(handle.extent, nbytes, offset)
+
+    def copy(
+        self,
+        dst: OcmAlloc,
+        src: OcmAlloc,
+        nbytes: int,
+        dst_offset: int = 0,
+        src_offset: int = 0,
+    ) -> None:
+        """Chip-to-chip extent copy. Same chip fuses on-device; different
+        chips ride ICI via chunked device-to-device transfers.
+
+        How this pipelines (and what the window is for): every operation in
+        the loop — source slice, D2D ``device_put``, destination update —
+        is an *async dispatch*; the host thread never waits on data, so
+        chunk i+1's read and ICI transfer execute on the source chip while
+        the destination chip is still applying chunk i (PJRT schedules
+        them on independent streams; the only true serialization is the
+        destination arena's in-place update chain, which is inherent to
+        in-place writes and exists on the hardware regardless of issue
+        order). ``inflight_ops`` therefore does NOT gate concurrency — it
+        bounds how many staged chunk buffers exist at once, the same role
+        the reference's 2-posted-commands limit plays for NIC queue depth
+        (extoll.c:44-51): without it a GB-sized copy would stage every
+        chunk in HBM simultaneously. tests/test_ici.py checks every chunk
+        goes through an async D2D dispatch and that no module-level sync
+        entry point (jax.block_until_ready / jax.device_get) is reached."""
+        a_src, a_dst = self._arena(src), self._arena(dst)
+        with self.tracer.span("ici_copy", nbytes=nbytes):
+            if a_src is a_dst:
+                a_src.move(src.extent, dst.extent, nbytes, src_offset, dst_offset)
+                return
+            chunk = self.config.chunk_bytes
+            inflight: list[tuple[jax.Array, int]] = []
+            pos = 0
+            while pos < nbytes or inflight:
+                while pos < nbytes and len(inflight) < max(1, self.config.inflight_ops):
+                    n = min(chunk, nbytes - pos)
+                    piece = a_src.read(src.extent, n, src_offset + pos)
+                    # Async D2D transfer (ICI on TPU pods).
+                    moved = jax.device_put(piece, a_dst.device)
+                    inflight.append((moved, pos))
+                    pos += n
+                moved, at = inflight.pop(0)
+                a_dst.write(dst.extent, moved, dst_offset + at)
+
+    def scrub(self, handle: OcmAlloc) -> None:
+        """Zero a freshly issued handle's extent (scrub-at-alloc; the
+        daemon books device extents without touching the bytes, so the
+        plane clears them before use — calloc parity, alloc.c:171)."""
+        self._arena(handle).fill_zero(handle.extent)
+
+    # -- typed helpers ----------------------------------------------------
+
+    def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0) -> jax.Array:
+        arena = self._arena(handle)
+        return arena.read_as(handle.extent, shape, dtype, offset)
+
+
+class SpmdIciPlane:
+    """The one-sided flavor of the device data plane: handles resolve onto a
+    single mesh-sharded global arena (one row per chip's HBM), and
+    handle-to-handle copies are true chip-to-chip one-sided ops —
+    ``spmd_arena.ici_copy`` dispatching to the Pallas remote-DMA kernel
+    (``ops/pallas_ici.py``) on TPU, exactly as ``ocm_copy_onesided`` on an
+    RDMA handle goes straight to ``ib_write``
+    (/root/reference/src/lib.c:670-700, rdma.c:241-263).
+
+    Where :class:`IciDataPlane` holds independent per-chip arenas and
+    orchestrates movement from the controller, this plane's storage IS the
+    SPMD fabric, so the same arena rows are addressable both through
+    connectionless handles (rank, device_index, offset) and from inside
+    jitted SPMD steps (KV paging, ring attention). Implements the same
+    RemoteBackend data interface; pass as ``ici_plane=`` to the client.
+    """
+
+    def __init__(
+        self,
+        config: OcmConfig | None = None,
+        mesh=None,
+        devices_per_rank: int | None = None,
+    ):
+        from oncilla_tpu.parallel import spmd_arena as sa
+        from oncilla_tpu.parallel.mesh import node_mesh
+
+        import threading
+
+        self._sa = sa
+        self.config = config or OcmConfig()
+        # Rows are addressed with flat int32 traced offsets inside the
+        # shard_map programs (spmd_arena), so the per-chip row must stay
+        # below the int32 cliff — unlike DeviceArena, which switches to
+        # blocked addressing above it.
+        if self.config.device_arena_bytes > 2**31 - 1:
+            raise OcmError(
+                "SpmdIciPlane rows are int32-addressed; device_arena_bytes "
+                f"must be < 2 GiB (got {self.config.device_arena_bytes}). "
+                "Use multiple device arenas or DeviceArena's blocked mode."
+            )
+        self.mesh = mesh if mesh is not None else node_mesh()
+        ndev = int(self.mesh.devices.size)
+        self.devices_per_rank = devices_per_rank or ndev
+        self.arena = sa.make_arena(self.mesh, self.config.device_arena_bytes)
+        self.tracer = GLOBAL_TRACER
+        self.stats = {"ici_copies": 0, "puts": 0, "gets": 0}
+        # Serializes the donated-arena rebind (same hazard DeviceArena._mu
+        # guards): two unlocked concurrent ops would both capture the same
+        # buffer, and the loser dispatches on a deleted (donated) array or
+        # silently drops the winner's write.
+        self._mu = threading.Lock()
+
+    def _gdev(self, handle: OcmAlloc) -> int:
+        g = resolve_global_device(
+            handle, self.devices_per_rank, int(self.mesh.devices.size)
+        )
+        # The extent must fit this plane's rows: dynamic_slice/update CLAMP
+        # out-of-range offsets, so a daemon-issued extent sized for a bigger
+        # arena would silently land on another allocation's bytes.
+        end = handle.extent.offset + handle.extent.nbytes
+        if end > self.config.device_arena_bytes:
+            from oncilla_tpu.core.errors import OcmBoundsError
+
+            raise OcmBoundsError(
+                f"extent [{handle.extent.offset}, {end}) exceeds the plane's "
+                f"{self.config.device_arena_bytes} B arena rows (plane and "
+                "daemon device_arena_bytes must match)"
+            )
+        return g
+
+    # -- RemoteBackend data interface ------------------------------------
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        from oncilla_tpu.core.arena import check_bounds
+
+        n = _nbytes(data)
+        check_bounds(handle.extent, offset, n)
+        g = self._gdev(handle)
+        with self.tracer.span("spmd_ici_put", nbytes=n), self._mu:
+            self.arena = self._sa.host_put(
+                self.arena, g, data, handle.extent.offset + offset,
+                mesh=self.mesh,
+            )
+            self.stats["puts"] += 1
+
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0) -> jax.Array:
+        from oncilla_tpu.core.arena import check_bounds
+
+        check_bounds(handle.extent, offset, nbytes)
+        g = self._gdev(handle)
+        with self.tracer.span("spmd_ici_get", nbytes=nbytes), self._mu:
+            # Dispatch under the lock: a concurrent donated put would delete
+            # the buffer this read is about to consume.
+            out = self._sa.host_get(
+                self.arena, g, nbytes, handle.extent.offset + offset,
+                mesh=self.mesh,
+            )
+            self.stats["gets"] += 1
+        return out
+
+    def copy(
+        self,
+        dst: OcmAlloc,
+        src: OcmAlloc,
+        nbytes: int,
+        dst_offset: int = 0,
+        src_offset: int = 0,
+        use_pallas: bool | None = None,
+    ) -> None:
+        """True one-sided chip-to-chip copy: the origin chip's DMA engine
+        writes into the target chip's arena row over ICI (no host hop, no
+        per-chunk controller round-trips)."""
+        from oncilla_tpu.core.arena import check_bounds
+
+        check_bounds(src.extent, src_offset, nbytes)
+        check_bounds(dst.extent, dst_offset, nbytes)
+        g_src, g_dst = self._gdev(src), self._gdev(dst)
+        with self.tracer.span("spmd_ici_copy", nbytes=nbytes), self._mu:
+            self.arena = self._sa.ici_copy(
+                self.arena,
+                g_src,
+                g_dst,
+                src.extent.offset + src_offset,
+                dst.extent.offset + dst_offset,
+                nbytes,
+                mesh=self.mesh,
+                use_pallas=use_pallas,
+            )
+            self.stats["ici_copies"] += 1
+
+    def update(self, fn) -> None:
+        """Atomically rebind ``self.arena = fn(self.arena)`` under the plane
+        lock — for in-mesh jitted programs that donate the arena (the
+        :meth:`oncilla_tpu.core.hbm.DeviceArena.update` analogue). The
+        callable must return a new global arena of identical shape/sharding."""
+        with self._mu:
+            self.arena = fn(self.arena)
+
+    def scrub(self, handle: OcmAlloc) -> None:
+        """Zero the handle's extent. Called by the control-plane client on
+        a freshly ISSUED device handle (scrub-at-alloc): the daemon only
+        books device extents — the bytes live here — and alloc time is
+        the one choke point covering every recycle path (client free,
+        lease reaping, DISCONNECT reclamation) without letting a stale
+        handle zero a live tenant (calloc parity, alloc.c:171)."""
+        g = self._gdev(handle)
+        with self.tracer.span("spmd_ici_scrub", nbytes=handle.extent.nbytes):
+            self.update(
+                lambda a: self._sa.fill_zero(
+                    a, g, handle.extent.offset, handle.extent.nbytes,
+                    mesh=self.mesh,
+                )
+            )
+
+    # -- typed helpers ----------------------------------------------------
+
+    def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0) -> jax.Array:
+        from oncilla_tpu.core.hbm import from_bytes
+
+        nbytes = int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+        return from_bytes(self.get(handle, nbytes, offset), shape, dtype)
+
+
+def _nbytes(data) -> int:
+    if isinstance(data, np.ndarray):
+        return data.nbytes
+    a = jnp.asarray(data)
+    return a.size * a.dtype.itemsize

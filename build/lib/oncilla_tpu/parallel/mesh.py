@@ -1,0 +1,35 @@
+"""Mesh helpers.
+
+The cluster's device topology as a JAX mesh. The control plane addresses
+chips as (rank, device_index); the SPMD fabric addresses them by position
+along the ``node`` mesh axis — ``global = rank * devices_per_rank + index``,
+the TPU analogue of EXTOLL's flat (node, vpid) space
+(/root/reference/inc/io/extoll.h:31-44).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "node"
+
+
+def node_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over all devices: the disaggregated-memory fabric."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def arena_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the (D, arena_bytes) global arena: one row per device."""
+    return NamedSharding(mesh, P(NODE_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def global_index(rank: int, device_index: int, devices_per_rank: int) -> int:
+    return rank * devices_per_rank + device_index

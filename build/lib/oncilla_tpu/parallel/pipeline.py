@@ -1,0 +1,145 @@
+"""Pipeline parallelism: a GPipe-schedule stage executor over a ``pp``
+mesh axis.
+
+TPU-first design notes:
+- Stages are the model's stacked layer axis sharded over ``pp`` (one
+  PartitionSpec, no per-stage parameter surgery): inside ``shard_map``
+  each device holds ``n_layers / pp_size`` layers and runs them with a
+  ``lax.scan`` over its local stack.
+- Microbatched activations move stage-to-stage with ``lax.ppermute`` —
+  the point-to-point ICI collective — inside a ``lax.scan`` over the
+  pipeline schedule, so the whole pipeline is one compiled program with
+  static control flow (no data-dependent Python).
+- The schedule is plain GPipe: ``M + n_stages - 1`` ticks; at tick ``t``
+  stage ``s`` works on microbatch ``t - s`` (bubble ticks compute on
+  don't-care values that never reach an output — cheaper than predicating
+  the stage body, which XLA would have to keep resident anyway).
+- Differentiable end-to-end: ``jax.grad`` transposes the ``ppermute``s
+  into the reverse-direction pipeline, giving the standard
+  full-forward/full-backward GPipe schedule; replicated-input transposes
+  insert the ``psum``s for cross-stage parameter grads.
+
+The reference has no ML parallelism (SURVEY.md §2 checklist) — this
+module, with :mod:`oncilla_tpu.models.moe` (ep) and
+:mod:`oncilla_tpu.parallel.ring_attention` (sp), completes the
+dp/tp/pp/sp/ep surface of the training stack.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_stages_shard(stage_fn, stage_params, x_local, *, axis_name: str,
+                          microbatches: int, with_aux: bool = False,
+                          batch_axis: str | None = None):
+    """Per-shard GPipe body (call inside shard_map over ``axis_name``).
+
+    stage_fn(stage_params, mb) -> mb applies THIS stage's layer stack to
+    one microbatch. stage_params: this stage's shard (leaves with leading
+    local-layer axis). x_local: (B_local, ...) activations entering stage
+    0. Returns the last stage's outputs, psum-replicated so every stage
+    holds them (shape = x_local's).
+
+    With ``with_aux``, stage_fn returns ``(mb, aux_scalar)`` and the
+    result is ``(outputs, aux_total)`` — aux summed over every REAL
+    (stage, microbatch) pair across the pp axis (bubble ticks compute on
+    don't-care values; their aux is masked out). This is how the MoE
+    family's router load-balancing loss crosses the pipeline.
+    """
+    n = jax.lax.psum(1, axis_name)
+    s = jax.lax.axis_index(axis_name)
+    M = microbatches
+    B = x_local.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    xs = x_local.reshape(M, B // M, *x_local.shape[1:])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        recv, outs, aux_total = carry
+        # Stage 0 feeds microbatch t (clipped during drain ticks); other
+        # stages consume what the previous stage sent last tick.
+        feed = jax.lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        )
+        inp = jnp.where(s == 0, feed, recv)
+        if with_aux:
+            y, aux = stage_fn(stage_params, inp)
+            # Stage s works on microbatch t-s; only 0 <= t-s < M is real.
+            real = jnp.logical_and(t - s >= 0, t - s < M)
+            aux_total = aux_total + jnp.where(
+                real, aux.astype(jnp.float32), 0.0
+            )
+        else:
+            y = stage_fn(stage_params, inp)
+        # The last stage finishes microbatch t-(n-1) at tick t.
+        oidx = t - (n - 1)
+        record = jnp.logical_and(s == n - 1, oidx >= 0)
+        outs = jnp.where(
+            record,
+            jax.lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(oidx, 0, M - 1), 0
+            ),
+            outs,
+        )
+        recv = jax.lax.ppermute(y, axis_name, perm)
+        return (recv, outs, aux_total), None
+
+    recv0 = jnp.zeros(xs.shape[1:], x_local.dtype)
+    outs0 = jnp.zeros_like(xs)
+    (_, outs, aux_total), _ = jax.lax.scan(
+        tick, (recv0, outs0, jnp.float32(0.0)), jnp.arange(M + n - 1)
+    )
+    # Replicate the last stage's outputs across the pp axis (everything
+    # downstream — final norm, head, loss — runs replicated over pp);
+    # aux sums every stage's real contributions.
+    outs = jax.lax.psum(jnp.where(s == n - 1, outs, 0), axis_name)
+    if with_aux:
+        aux_total = jax.lax.psum(aux_total, axis_name)
+        if batch_axis is not None:
+            # Replicated out_spec needs cross-dp invariance too: average
+            # the per-dp-shard aux (matching a batch-mean semantics).
+            aux_total = jax.lax.pmean(aux_total, batch_axis)
+        return outs.reshape(x_local.shape), aux_total
+    return outs.reshape(x_local.shape)
+
+
+def pipeline_apply(
+    stage_fn,
+    params,
+    x,
+    *,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    batch_axis: str | None = None,
+    microbatches: int,
+    with_aux: bool = False,
+):
+    """Run ``x`` through the pp-sharded layer stack under GPipe.
+
+    params: pytree whose leaves carry the FULL stacked layer axis leading
+    (length divisible by the pp size); shard_map splits it so each stage
+    sees its local chunk. x: (B, ...) activations; with ``batch_axis`` the
+    batch dim is additionally data-parallel over that axis. ``with_aux``:
+    see :func:`pipeline_stages_shard`.
+    """
+    fn = jax.shard_map(
+        partial(
+            pipeline_stages_shard, stage_fn,
+            axis_name=axis_name, microbatches=microbatches,
+            with_aux=with_aux, batch_axis=batch_axis,
+        ),
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(axis_name), params),
+            P(batch_axis),
+        ),
+        out_specs=(P(batch_axis), P()) if with_aux else P(batch_axis),
+        check_vma=False,
+    )
+    return fn(params, x)

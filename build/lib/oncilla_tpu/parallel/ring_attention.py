@@ -1,0 +1,156 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context sequence parallelism for the flagship model: each device holds a
+sequence chunk of Q/K/V; K/V chunks rotate around the mesh ring
+(CollectivePermute over ICI) while a flash-style online softmax accumulates
+the exact result — sequence length scales with the number of devices, and
+the K/V traffic rides the same ICI fabric as the OCM arenas.
+
+GQA-aware: K/V may carry fewer heads than Q (``n_kv_heads``); the ring
+rotates the *unexpanded* KV tensors (group-size-times less ICI traffic) and
+the per-block einsum works on grouped heads. Scores and accumulators are
+fp32 regardless of the activation dtype, matching the dense path.
+
+The reference has no ML parallelism (SURVEY.md §2 checklist); this module is
+part of the TPU framework's first-class long-context support, built on the
+same ring pattern as :func:`oncilla_tpu.parallel.spmd_arena.ring_shift`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _block_attend(q5, k, v, scale, mask):
+    """One (Q-chunk x K-chunk) block with grouped KV heads, fp32 math.
+
+    q5: (B, KV, G, Sq, D) — query heads grouped by KV head.
+    k/v: (B, KV, Sk, D), mask: (Sq, Sk) bool or None.
+    Returns (o, row_max, row_sum) for online-softmax merging, all fp32.
+    """
+    s = jnp.einsum(
+        "bkgqd,bksd->bkgqs", q5, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, None], s, _NEG)
+    m = jnp.max(s, axis=-1)                      # (B, KV, G, Sq)
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # A fully-masked row has m == _NEG and p == 1 everywhere; zero it.
+        p = jnp.where(mask[None, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(
+        "bkgqs,bksd->bkgqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return o, m, l
+
+
+def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = True,
+                         window: int | None = None):
+    """Per-shard ring attention body (call inside shard_map over
+    ``axis_name``). q: (B, H, S_local, D); k/v: (B, KV, S_local, D) with
+    KV dividing H. ``window`` band-limits each query to its last ``window``
+    global positions (sliding-window attention composed with the ring).
+    Returns (B, H, S_local, D) in q's dtype."""
+    if window is not None and not causal:
+        raise ValueError(
+            "window requires causal=True (the band is defined over past "
+            "positions; a non-causal window is ambiguous)"
+        )
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    B, H, s_local, D = q.shape
+    KV = k.shape[1]
+    G = H // KV
+    q5 = q.reshape(B, KV, G, s_local, D)
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(D))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        # Which global chunk do we currently hold? Chunks rotate forward, so
+        # after i steps device `me` holds chunk (me - i) mod n.
+        j = (me - i) % n
+
+        if causal or window is not None:
+            # Mask from GLOBAL positions: my queries are chunk `me`, the
+            # keys in hand are chunk `j` (covers block-level causality,
+            # the diagonal triangle, and the sliding-window band in one
+            # comparison; fully-masked blocks zero out in _block_attend).
+            # Accepted cost: ring steps whose block is entirely outside
+            # the window still run the block einsums before zeroing —
+            # with window ≪ S that wastes up to ~(1 - window/S) of
+            # attention FLOPs. A lax.cond skip of all-False blocks would
+            # reclaim them at the price of divergent per-device control
+            # flow inside the collective loop; at current scales the
+            # simple form wins.
+            qg = me * s_local + jnp.arange(s_local)[:, None]
+            kg = j * s_local + jnp.arange(s_local)[None, :]
+            mask = jnp.ones((s_local, s_local), dtype=bool)
+            if causal:
+                mask &= kg <= qg
+            if window is not None:
+                mask &= kg > qg - window
+        else:
+            mask = None
+
+        o_blk, m_blk, l_blk = _block_attend(q5, k_cur, v_cur, scale, mask)
+
+        # Online-softmax merge (flash-attention accumulation), fp32.
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l * alpha + l_blk * beta
+        o_new = o * alpha[..., None] + o_blk * beta[..., None]
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_nxt, v_nxt
+
+    # Derive carries from q5 so they inherit the varying manual axis
+    # (shard_map rejects unvarying-in / varying-out loop carries).
+    o0 = jnp.zeros_like(q5, dtype=jnp.float32)
+    m0 = jnp.full_like(q5[..., 0], _NEG, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q5[..., 0], dtype=jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, s_local, D).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+    window: int | None = None,
+) -> jax.Array:
+    """Exact attention with Q/K/V sequence-sharded over ``axis_name``.
+
+    q: (B, H, S, D); k/v: (B, KV, S, D), KV dividing H (GQA); S sharded over
+    the mesh axis. ``window`` composes sliding-window attention with the
+    ring. Usable standalone or inside a larger jitted step (shard_map
+    composes with jit)."""
+    fn = jax.shard_map(
+        partial(
+            ring_attention_shard, axis_name=axis_name, causal=causal,
+            window=window,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, axis_name, None),
+            P(None, None, axis_name, None),
+            P(None, None, axis_name, None),
+        ),
+        out_specs=P(None, None, axis_name, None),
+    )
+    return fn(q, k, v)

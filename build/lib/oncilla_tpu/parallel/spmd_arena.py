@@ -1,0 +1,190 @@
+"""SpmdArena: the in-mesh ICI fabric — one arena row per device, moved with
+collectives / remote DMA *inside* jitted SPMD programs.
+
+This is the TPU-idiomatic half of the device data plane (SURVEY.md §5.8):
+where :class:`oncilla_tpu.ops.ici.IciDataPlane` orchestrates transfers from
+the single controller, SpmdArena ops are traced into the training step
+itself, so XLA schedules the ICI traffic alongside compute (KV-cache paging,
+ring attention). All ops are functional: they take and return the global
+arena array, which callers thread through their jitted step (donate it for
+in-place updates).
+
+Two transport implementations:
+
+- ``ppermute`` (portable, runs on the CPU test mesh): static (src, dst)
+  route, compiled per route; the XLA CollectivePermute rides ICI on TPU.
+- Pallas ``make_async_remote_copy`` (TPU only): dynamic (src, dst) device
+  ids, true one-sided HBM->HBM remote DMA (:mod:`oncilla_tpu.ops.pallas_ici`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from oncilla_tpu.parallel.mesh import NODE_AXIS, arena_sharding, replicated
+
+
+def make_arena(mesh: Mesh, arena_bytes: int) -> jax.Array:
+    """The global (D, arena_bytes) uint8 arena, one row in each chip's HBM."""
+    d = mesh.devices.size
+    return jax.device_put(
+        jnp.zeros((d, arena_bytes), dtype=jnp.uint8), arena_sharding(mesh)
+    )
+
+
+def host_put(arena: jax.Array, dev: int, data, offset, *, mesh: Mesh) -> jax.Array:
+    """Write ``data`` (bitcast to bytes) into device ``dev``'s row at
+    ``offset``. ``dev`` is static (one executable per target device);
+    ``offset`` is dynamic."""
+    from oncilla_tpu.core.hbm import to_bytes
+
+    raw = to_bytes(jnp.asarray(data))
+    # Replicate onto the mesh: data committed to a single device (e.g. read
+    # out of a local DeviceArena by the copy matrix) cannot enter a jit
+    # whose other operand is sharded across all mesh devices.
+    raw = jax.device_put(raw, replicated(mesh))
+    return _host_put(arena, raw, dev, jnp.int32(offset), mesh)
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(2, 4))
+def _host_put(arena, raw, dev: int, offset, mesh):
+    return jax.lax.dynamic_update_slice(arena, raw[None, :], (dev, offset))
+
+
+def host_get(arena: jax.Array, dev: int, nbytes: int, offset, *, mesh: Mesh) -> jax.Array:
+    return _host_get(arena, dev, jnp.int32(offset), nbytes, mesh)
+
+
+@partial(jax.jit, static_argnums=(1, 3, 4))
+def _host_get(arena, dev: int, offset, nbytes: int, mesh):
+    return jax.lax.dynamic_slice(arena, (dev, offset), (1, nbytes))[0]
+
+
+def fill_zero(arena: jax.Array, dev: int, offset, nbytes: int, *, mesh: Mesh) -> jax.Array:
+    """Zero ``nbytes`` of device ``dev``'s row at ``offset`` with a
+    device-generated fill (no host transfer) — the scrub primitive behind
+    allocations reading as zeros (the calloc guarantee of
+    /root/reference/src/alloc.c:171). Chunked into power-of-two fills so
+    arbitrary extent sizes compile a bounded program set (the same trade
+    as ``core.hbm._pow2_chunks``)."""
+    from oncilla_tpu.core.hbm import _pow2_chunks
+
+    offset = int(offset)
+    for c in _pow2_chunks(int(nbytes), 256 << 20):
+        arena = _fill_zero(arena, jnp.int32(offset), dev, c, mesh)
+        offset += c
+    return arena
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(2, 3, 4))
+def _fill_zero(arena, offset, dev: int, nbytes: int, mesh):
+    return jax.lax.dynamic_update_slice(
+        arena, jnp.zeros((1, nbytes), jnp.uint8), (dev, offset)
+    )
+
+
+def ici_copy(
+    arena: jax.Array,
+    src_dev: int,
+    dst_dev: int,
+    src_off,
+    dst_off,
+    nbytes: int,
+    *,
+    mesh: Mesh,
+    use_pallas: bool | None = None,
+) -> jax.Array:
+    """One-sided arena-to-arena copy over ICI: device ``src_dev``'s row
+    [src_off, src_off+nbytes) -> device ``dst_dev``'s row at ``dst_off``.
+
+    Offsets are dynamic scalars; ``nbytes`` and the route are static. The
+    chunk travels src->dst only (CollectivePermute / remote DMA), never
+    through the host — the analogue of ib_write's direct NIC path
+    (/root/reference/src/rdma.c:254)."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    # Same-device overlapping ranges are unsafe for a raw DMA (the engine
+    # may read blocks it already overwrote); the ppermute path slices the
+    # chunk before updating, so it handles overlap correctly.
+    overlap = src_dev == dst_dev and not (
+        src_off + nbytes <= dst_off or dst_off + nbytes <= src_off
+    )
+    if use_pallas and not overlap:
+        from oncilla_tpu.ops.pallas_ici import pallas_ici_copy, pallas_supported
+
+        if pallas_supported(int(src_off), int(dst_off), nbytes):
+            return pallas_ici_copy(
+                arena, src_dev, dst_dev, src_off, dst_off, nbytes, mesh=mesh
+            )
+        # Unaligned transfers fall back to the CollectivePermute path.
+    return _ici_copy_ppermute(
+        arena, jnp.int32(src_off), jnp.int32(dst_off), src_dev, dst_dev,
+        nbytes, mesh,
+    )
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(3, 4, 5, 6))
+def _ici_copy_ppermute(arena, src_off, dst_off, src_dev, dst_dev, nbytes, mesh):
+    def shard_fn(arena_shard, s_off, d_off):
+        # arena_shard: (1, B) — this device's row.
+        me = jax.lax.axis_index(NODE_AXIS)
+        row = arena_shard[0]
+        chunk = jax.lax.dynamic_slice(row, (s_off,), (nbytes,))
+        moved = jax.lax.ppermute(chunk, NODE_AXIS, [(src_dev, dst_dev)])
+        updated = jax.lax.dynamic_update_slice(row, moved, (d_off,))
+        new_row = jnp.where(me == dst_dev, updated, row)
+        return new_row[None, :]
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(NODE_AXIS, None), P(), P()),
+        out_specs=P(NODE_AXIS, None),
+    )(arena, src_off, dst_off)
+
+
+def ring_shift(
+    arena: jax.Array, offset, nbytes: int, *, mesh: Mesh, reverse: bool = False
+) -> jax.Array:
+    """Every device sends arena[offset:offset+nbytes] to its ring neighbor
+    simultaneously (the collective flavor of the copy — used by ring
+    attention and as the all-links bandwidth benchmark)."""
+    return _ring_shift(arena, jnp.int32(offset), nbytes, bool(reverse), mesh)
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=(2, 3, 4))
+def _ring_shift(arena, offset, nbytes, reverse, mesh):
+    d = mesh.devices.size
+    if reverse:
+        perm = [(i, (i - 1) % d) for i in range(d)]
+    else:
+        perm = [(i, (i + 1) % d) for i in range(d)]
+
+    def shard_fn(arena_shard, off):
+        row = arena_shard[0]
+        chunk = jax.lax.dynamic_slice(row, (off,), (nbytes,))
+        moved = jax.lax.ppermute(chunk, NODE_AXIS, perm)
+        return jax.lax.dynamic_update_slice(row, moved, (off,))[None, :]
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(NODE_AXIS, None), P()),
+        out_specs=P(NODE_AXIS, None),
+    )(arena, offset)
+
+
+def read_typed(arena: jax.Array, dev: int, shape, dtype, offset, *, mesh: Mesh):
+    """Typed view of a device's row (for pulling model state out of the
+    arena inside a jitted step)."""
+    from oncilla_tpu.core.hbm import from_bytes
+
+    import numpy as np
+
+    nbytes = int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+    raw = host_get(arena, dev, nbytes, offset, mesh=mesh)
+    return from_bytes(raw, shape, dtype)

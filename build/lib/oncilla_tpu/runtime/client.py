@@ -1,0 +1,358 @@
+"""App-side control-plane client: the RemoteBackend the Ocm context uses.
+
+Analogue of the app half of libocm (/root/reference/src/lib.c): registers
+with the local daemon (CONNECT handshake, lib.c:98-132), drives alloc/free
+through it, and talks **directly** to the owner daemon for REMOTE_HOST data
+(the reference's one-sided data plane bypasses the local daemon per transfer,
+SURVEY.md §1). REMOTE_DEVICE data rides the ICI plane supplied by the SPMD
+app (:mod:`oncilla_tpu.ops.ici`).
+
+Large host transfers are chunked and pipelined with a bounded in-flight
+window — the scheme of ``extoll_rma2_transfer`` (8 MB chunks, 2 overlapped
+ops, /root/reference/src/extoll.c:47-173).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+import numpy as np
+
+from oncilla_tpu.core.arena import Extent
+from oncilla_tpu.core.errors import (
+    OcmConnectError,
+    OcmInvalidHandle,
+    OcmProtocolError,
+    OcmRemoteError,
+)
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.kinds import Fabric, OcmKind
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.runtime.protocol import (
+    WIRE_KIND,
+    WIRE_KIND_INV,
+    Message,
+    MsgType,
+    recv_msg,
+    request,
+    send_msg,
+)
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
+
+
+class ControlPlaneClient:
+    """Connects an app process to its local daemon (and, for data, directly
+    to owner daemons). Implements the RemoteBackend protocol of
+    :class:`oncilla_tpu.core.context.Ocm`."""
+
+    def __init__(
+        self,
+        entries: list[NodeEntry],
+        rank: int,
+        config: OcmConfig | None = None,
+        ici_plane=None,
+        heartbeat: bool = True,
+    ):
+        self.entries = entries
+        self.rank = rank
+        self.config = config or OcmConfig()
+        self.pid = os.getpid()
+        self.ici_plane = ici_plane
+        self.tracer = GLOBAL_TRACER
+        self._pool = PeerPool()
+        me = entries[rank]
+        try:
+            self._ctrl = socket.create_connection(
+                (me.connect_host, me.port), timeout=30.0
+            )
+        except OSError as e:
+            raise OcmConnectError(
+                f"local daemon unreachable at {me.connect_host}:{me.port}: {e}"
+            ) from e
+        self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._ctrl_lock = threading.Lock()
+        # Which ranks own this app's live remote allocations (rank -> count).
+        # Reported on HEARTBEAT/DISCONNECT so daemons relay/reclaim with
+        # O(owners) fan-out instead of broadcasting to every node; app-side
+        # because the handles live here and the set survives daemon restarts.
+        self._owner_ranks: dict[int, int] = {}
+        self._owner_lock = threading.Lock()
+        # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132).
+        r = self._request(Message(MsgType.CONNECT, {"pid": self.pid, "rank": rank}))
+        if r.type != MsgType.CONNECT_CONFIRM:
+            raise OcmConnectError(f"bad handshake reply {r.type.name}")
+        self.nnodes = r.fields["nnodes"]
+        self._hb_stop = threading.Event()
+        if heartbeat:
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                 name=f"ocm-hb-{rank}")
+            t.start()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _request(self, msg: Message) -> Message:
+        with self._ctrl_lock:
+            return request(self._ctrl, msg)
+
+    def _owners_field(self) -> str:
+        with self._owner_lock:
+            return ",".join(str(r) for r in sorted(self._owner_ranks))
+
+    def _note_owner(self, rank: int, delta: int) -> None:
+        if rank == self.rank:
+            return
+        with self._owner_lock:
+            n = self._owner_ranks.get(rank, 0) + delta
+            if n > 0:
+                self._owner_ranks[rank] = n
+            else:
+                self._owner_ranks.pop(rank, None)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.config.heartbeat_s):
+            try:
+                self._request(
+                    Message(
+                        MsgType.HEARTBEAT,
+                        {"rank": self.rank, "pid": self.pid,
+                         "owners": self._owners_field()},
+                    )
+                )
+            except (OSError, OcmProtocolError):
+                printd("client rank %d: heartbeat failed", self.rank)
+
+    def close(self, detach: bool = False) -> None:
+        """``detach=True`` skips the DISCONNECT notification: daemons keep
+        the app's allocations until the lease runs out (crash simulation /
+        intentional handoff within the lease window). The default notifies,
+        and the daemons reclaim this app's allocations immediately.
+
+        App identity is (pid, rank) — per OS process, as in the reference,
+        where one app process owns one mailbox (pmsg.c). Multiple clients
+        in one process at the same rank share that identity: closing one
+        (without detach) reclaims the process's allocations at that rank.
+        """
+        self._hb_stop.set()
+        if not detach:
+            # Bounded lock (mirrors libocm.cc's try_lock teardown): a beat
+            # already inside _request holds _ctrl_lock mid send/recv, and an
+            # unlocked send here would interleave frames and corrupt the
+            # stream, losing the DISCONNECT. If the lock stays held (daemon
+            # wedged), skip the courtesy message — the lease reaper covers it.
+            if self._ctrl_lock.acquire(timeout=2.0):
+                try:
+                    send_msg(
+                        self._ctrl,
+                        Message(MsgType.DISCONNECT,
+                                {"pid": self.pid,
+                                 "owners": self._owners_field()}),
+                    )
+                except OSError:
+                    pass
+                finally:
+                    self._ctrl_lock.release()
+        self._pool.close()
+        try:
+            self._ctrl.close()
+        except OSError:
+            pass
+
+    # -- RemoteBackend: alloc / free ------------------------------------
+
+    def alloc(self, nbytes: int, kind: OcmKind) -> OcmAlloc:
+        r = self._request(
+            Message(
+                MsgType.REQ_ALLOC,
+                {
+                    "orig_rank": self.rank,
+                    "pid": self.pid,
+                    "kind": WIRE_KIND[kind.value],
+                    "nbytes": nbytes,
+                },
+            )
+        )
+        f = r.fields
+        placed_kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        fabric = (
+            Fabric.LOCAL
+            if not placed_kind.is_remote
+            else (Fabric.ICI if placed_kind == OcmKind.REMOTE_DEVICE else Fabric.DCN)
+        )
+        h = OcmAlloc(
+            alloc_id=f["alloc_id"],
+            kind=placed_kind,
+            fabric=fabric,
+            nbytes=nbytes,
+            rank=f["rank"],
+            device_index=f["device_index"],
+            extent=Extent(offset=f["offset"], nbytes=nbytes),
+            origin_rank=self.rank,
+        )
+        h.owner_addr = (f["owner_host"], f["owner_port"])  # for the DCN path
+        self._note_owner(h.rank, +1)
+        # Scrub-at-alloc for the device arm (calloc parity, alloc.c:171):
+        # the daemon only BOOKS device extents — the bytes live in the
+        # app-side ICI plane's arena — so the plane zeroes a freshly
+        # issued extent before the handle is returned. Alloc-time is the
+        # one choke point that covers every path an offset can be
+        # recycled through (client free, lease-reaper free, DISCONNECT
+        # reclamation), and unlike a free-time scrub it never lets a
+        # stale handle destructively zero a live tenant's bytes. Host
+        # arms are scrubbed at free time by the owner daemon itself
+        # (all of its free paths funnel through one arena release).
+        if placed_kind == OcmKind.REMOTE_DEVICE and self.ici_plane is not None:
+            scrub = getattr(self.ici_plane, "scrub", None)
+            if scrub is not None:
+                scrub(h)
+        return h
+
+    def free(self, handle: OcmAlloc) -> None:
+        self._request(
+            Message(
+                MsgType.REQ_FREE,
+                {"alloc_id": handle.alloc_id, "rank": handle.rank},
+            )
+        )
+        self._note_owner(handle.rank, -1)
+
+    # -- RemoteBackend: one-sided data ----------------------------------
+
+    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        if handle.kind == OcmKind.REMOTE_DEVICE:
+            self._ici(handle).put(handle, data, offset)
+            return
+        raw = np.ascontiguousarray(np.asarray(data)).view(np.uint8).reshape(-1)
+        self._dcn_put(handle, raw, offset)
+
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0):
+        if handle.kind == OcmKind.REMOTE_DEVICE:
+            return self._ici(handle).get(handle, nbytes, offset)
+        return self._dcn_get(handle, nbytes, offset)
+
+    def _ici(self, handle: OcmAlloc):
+        if self.ici_plane is None:
+            raise OcmInvalidHandle(
+                "REMOTE_DEVICE data needs an ICI plane; pass ici_plane= to "
+                "ControlPlaneClient (see oncilla_tpu.ops.ici)"
+            )
+        return self.ici_plane
+
+    # DCN path: chunked, pipelined DATA_PUT/GET straight to the owner
+    # daemon (extoll.c:47-173 scheme over TCP). On a peer ERROR reply the
+    # remaining in-flight replies are drained before raising, keeping the
+    # pooled connection in sync; transport errors evict it.
+    def _pipelined(self, handle: OcmAlloc, total: int, make_req, on_reply) -> None:
+        """DATA_PUT/DATA_GET are idempotent (same bytes, same offsets), so a
+        transport failure mid-transfer gets one full retry — through the
+        membership table's address for the owner rank, covering daemons that
+        restarted (snapshot restore) on a new port with a stale cached
+        owner_addr or a dead pooled connection."""
+        try:
+            self._pipelined_once(handle, total, make_req, on_reply,
+                                 self._owner_addr(handle))
+            return
+        except (OSError, OcmConnectError, OcmProtocolError) as err:
+            if isinstance(err, OcmRemoteError):
+                raise  # application error: the transfer itself was rejected
+            e = self.entries[handle.rank]
+            handle.owner_addr = (e.connect_host, e.port)
+            printd("retrying transfer via membership address %s:%d",
+                   e.connect_host, e.port)
+            self._pipelined_once(handle, total, make_req, on_reply,
+                                 (e.connect_host, e.port))
+
+    def _pipelined_once(
+        self, handle: OcmAlloc, total: int, make_req, on_reply, addr
+    ) -> None:
+        host, port = addr
+        s, lk = self._pool.connection(host, port)
+        chunk = self.config.chunk_bytes
+        window = max(1, self.config.inflight_ops)
+        with lk:
+            inflight: list[tuple[int, int]] = []  # (chunk_offset, nbytes)
+            pos = 0
+            failure: OcmRemoteError | None = None
+            try:
+                while pos < total or inflight:
+                    while pos < total and len(inflight) < window and failure is None:
+                        n = min(chunk, total - pos)
+                        send_msg(s, make_req(pos, n))
+                        inflight.append((pos, n))
+                        pos += n
+                    if not inflight:
+                        break
+                    r = recv_msg(s)
+                    start, n = inflight.pop(0)
+                    if r.type == MsgType.ERROR:
+                        # Remember the first failure; keep draining replies
+                        # for chunks already on the wire.
+                        if failure is None:
+                            failure = OcmRemoteError(
+                                r.fields["code"], r.fields["detail"]
+                            )
+                    elif failure is None:
+                        on_reply(r, start, n)
+            except (OSError, OcmProtocolError) as e:
+                if not isinstance(e, OcmRemoteError):
+                    self._pool.evict(host, port)
+                raise
+            if failure is not None:
+                raise failure
+
+    def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
+        def make_req(pos: int, n: int) -> Message:
+            return Message(
+                MsgType.DATA_PUT,
+                {
+                    "alloc_id": handle.alloc_id,
+                    "offset": offset + pos,
+                    "nbytes": n,
+                },
+                raw[pos : pos + n].tobytes(),
+            )
+
+        with self.tracer.span("dcn_put", nbytes=raw.nbytes):
+            self._pipelined(handle, raw.nbytes, make_req, lambda r, s0, n: None)
+
+    def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int) -> np.ndarray:
+        out = np.empty(nbytes, dtype=np.uint8)
+
+        def make_req(pos: int, n: int) -> Message:
+            return Message(
+                MsgType.DATA_GET,
+                {
+                    "alloc_id": handle.alloc_id,
+                    "offset": offset + pos,
+                    "nbytes": n,
+                },
+            )
+
+        def on_reply(r: Message, start: int, n: int) -> None:
+            out[start : start + n] = np.frombuffer(r.data, dtype=np.uint8)
+
+        with self.tracer.span("dcn_get", nbytes=nbytes):
+            self._pipelined(handle, nbytes, make_req, on_reply)
+        return out
+
+    def _owner_addr(self, handle: OcmAlloc) -> tuple[str, int]:
+        addr = getattr(handle, "owner_addr", None)
+        if addr is not None:
+            return addr
+        e = self.entries[handle.rank]
+        return (e.connect_host, e.port)
+
+    # -- introspection ---------------------------------------------------
+
+    def status(self, rank: int | None = None) -> dict:
+        if rank is None or rank == self.rank:
+            return self._request(Message(MsgType.STATUS, {})).fields
+        e = self.entries[rank]
+        s = socket.create_connection((e.connect_host, e.port), timeout=30.0)
+        try:
+            return request(s, Message(MsgType.STATUS, {})).fields
+        finally:
+            s.close()

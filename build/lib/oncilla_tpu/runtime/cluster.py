@@ -1,0 +1,70 @@
+"""In-process fake cluster: N daemons on ephemeral localhost ports.
+
+The reference cannot test its multi-node logic without two hosts with real
+IB/EXTOLL NICs (SURVEY.md §4 "gap to close"); this harness runs the entire
+control plane — placement, ids, leases, DCN data — inside one process (or
+with daemons as real subprocesses, see tests/test_daemon_cli.py), so the
+protocol is unit-testable on any machine.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from oncilla_tpu.core.context import Ocm
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.daemon import Daemon
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.utils.config import OcmConfig
+
+
+class LocalCluster:
+    """N in-process daemons + per-rank client/context factories."""
+
+    def __init__(
+        self,
+        nnodes: int,
+        config: OcmConfig | None = None,
+        policy: str = "capacity",
+        ndevices: int = 1,
+    ):
+        self.config = config or OcmConfig()
+        self.entries = [NodeEntry(r, "127.0.0.1", 0) for r in range(nnodes)]
+        self.daemons: list[Daemon] = []
+        # Start rank 0 first so ADD_NODE from the others lands (the
+        # reference's join-order constraint, README:31-40).
+        for r in range(nnodes):
+            d = Daemon(
+                r, self.entries, config=self.config, policy=policy,
+                ndevices=ndevices,
+            )
+            d.start()
+            self.daemons.append(d)
+        self.clients: list[ControlPlaneClient] = []
+
+    def client(self, rank: int, ici_plane=None, heartbeat: bool = True) -> ControlPlaneClient:
+        c = ControlPlaneClient(
+            self.entries, rank, config=self.config, ici_plane=ici_plane,
+            heartbeat=heartbeat,
+        )
+        self.clients.append(c)
+        return c
+
+    def context(self, rank: int, ici_plane=None, **kw) -> Ocm:
+        """An Ocm context whose remote arms ride this cluster."""
+        return Ocm(config=self.config, remote=self.client(rank, ici_plane=ici_plane, **kw))
+
+    def stop(self) -> None:
+        for c in self.clients:
+            c.close()
+        for d in self.daemons:
+            d.stop()
+
+
+@contextmanager
+def local_cluster(nnodes: int, **kw):
+    c = LocalCluster(nnodes, **kw)
+    try:
+        yield c
+    finally:
+        c.stop()

@@ -1,0 +1,774 @@
+"""The per-host daemon: control-plane state machine + DCN data plane.
+
+Python reference implementation of the daemon the reference builds as
+``bin/oncillamem`` (/root/reference/src/main.c + mem.c): thread-per-connection
+TCP server, rank-0 placement master, allocation registry, and — unlike the
+reference, whose daemon never touches data — the server side of the DCN
+data plane (REMOTE_HOST put/get into a daemon-owned host arena; the analogue
+of the daemon-registered NIC buffer, alloc.c:171-176).
+
+The C++ production daemon (runtime/native/) speaks the identical wire
+protocol; this implementation is the executable spec and the test harness
+(the in-process multi-daemon capability the reference lacked, SURVEY.md §4).
+
+Protocol-race fix: the reference replies to DO_ALLOC *before* the server
+listens for the data-plane connection ("XXX possible race condition",
+/root/reference/src/mem.c:350-354). Here the owner reserves the extent and
+registers the allocation before replying, and the data plane is
+connectionless, so no such window exists.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
+from oncilla_tpu.core.errors import (
+    OcmBoundsError,
+    OcmConnectError,
+    OcmError,
+    OcmInvalidHandle,
+    OcmOutOfMemory,
+    OcmPlacementError,
+    OcmProtocolError,
+)
+from oncilla_tpu.core.hostmem import HostArena
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.runtime.placement import (
+    POLICIES,
+    NodeResources,
+    Placement,
+)
+from oncilla_tpu.runtime.protocol import (
+    WIRE_KIND,
+    WIRE_KIND_INV,
+    ErrCode,
+    Message,
+    MsgType,
+    recv_msg,
+    request,
+    send_msg,
+)
+from oncilla_tpu.runtime.registry import AllocRegistry, RegEntry
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import printd
+
+
+class Daemon:
+    """One per host. ``rank == 0`` is the placement master."""
+
+    def __init__(
+        self,
+        rank: int,
+        entries: list[NodeEntry],
+        config: OcmConfig | None = None,
+        policy: str = "capacity",
+        ndevices: int = 1,
+        host: str | None = None,
+        snapshot_path: str | None = None,
+    ):
+        self.snapshot_path = snapshot_path
+        self.rank = rank
+        self.entries = entries
+        self.config = config or OcmConfig()
+        self.ndevices = ndevices
+        # The control/data plane is unauthenticated (like the reference's,
+        # sock.c binds INADDR_ANY) — so default to loopback; exposing it on
+        # other interfaces is an explicit opt-in via the host= argument
+        # (typically the nodefile hostname) or OCM_BIND_HOST=0.0.0.0.
+        if host is None:
+            host = os.environ.get("OCM_BIND_HOST", "127.0.0.1")
+        self.host = host
+        self.port = entries[rank].port
+        # Daemon-owned storage for the REMOTE_HOST arm (DCN fabric).
+        self.host_arena = HostArena(
+            self.config.host_arena_bytes, self.config.alignment
+        )
+        # Bookkeeping-only allocators for this host's device arenas: the HBM
+        # bytes live in the SPMD app processes (the ICI fabric); the daemon
+        # hands out extents inside them.
+        self.device_books = [
+            ArenaAllocator(self.config.device_arena_bytes, self.config.alignment)
+            for _ in range(ndevices)
+        ]
+        self.registry = AllocRegistry(rank, self.config.lease_s)
+        self.policy = POLICIES[policy]()
+        self.peers = PeerPool()
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._running = threading.Event()
+        self._started_ok = False
+        self._conns: set[socket.socket] = set()
+        self._conns_mu = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Loopback by default (see __init__); multi-host deployments pass the
+        # nodefile hostname or opt into the wildcard explicitly. Peers dial
+        # the nodefile's addr column, which need not match what the local
+        # resolver maps our own hostname to.
+        self._listener.bind((self.host, self.port))
+        if self.port == 0:  # ephemeral port (tests)
+            self.port = self._listener.getsockname()[1]
+            self.entries[self.rank] = NodeEntry(
+                self.rank, self.host, self.port, self.entries[self.rank].addr
+            )
+        self._listener.listen(64)
+        self._running.set()
+        # Join the cluster (ADD_NODE resets rank-0 accounting for this node)
+        # and restore the snapshot (NOTE_ALLOC resyncs it) BEFORE serving:
+        # the listen backlog queues early connections, so no request can
+        # claim an extent the snapshot needs (the C++ daemon orders the same
+        # way, native/daemon.cc restore-before-accept).
+        if self.rank == 0:
+            self.policy.add_node(self._own_resources())
+        else:
+            self._notify_rank0()
+        self._maybe_restore()
+        t = threading.Thread(target=self._accept_loop, daemon=True, name=f"d{self.rank}-accept")
+        t.start()
+        self._threads.append(t)
+        r = threading.Thread(target=self._reaper_loop, daemon=True, name=f"d{self.rank}-reaper")
+        r.start()
+        self._threads.append(r)
+        self._started_ok = True
+        printd("daemon rank=%d listening on %s:%d", self.rank, self.host, self.port)
+
+    def stop(self) -> None:
+        # Quiesce first: stop accepting, kick every serve thread off its
+        # socket, and only then snapshot — otherwise in-flight requests can
+        # tear the snapshot (half-written puts, allocations granted after
+        # the registry walk).
+        self._running.clear()
+        if self._listener is not None:
+            # shutdown() wakes the thread blocked in accept(); a bare close()
+            # leaves the kernel file description (and the LISTEN socket)
+            # alive until that accept returns, blocking port rebinds.
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_mu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._conns_mu:
+                if not self._conns:
+                    break
+            time.sleep(0.01)
+        # Snapshot only if this daemon actually served (a failed start must
+        # not clobber a good on-disk snapshot with an empty registry).
+        if self.snapshot_path and self._started_ok:
+            try:
+                self.save_snapshot()
+            except OSError:
+                printd("daemon %d: snapshot write failed", self.rank)
+        self.peers.close()
+
+    # -- checkpoint / resume (SURVEY.md §5.4 upgrade) --------------------
+
+    def save_snapshot(self, path: str | None = None) -> None:
+        """Persist the registry and the REMOTE_HOST arm's live bytes."""
+        from oncilla_tpu.runtime import snapshot as snap
+
+        reg_entries = self.registry.snapshot()
+
+        def lazy_entries():
+            # Arena bytes are read per entry inside the write loop, so peak
+            # memory overhead is one entry, not the whole live arena.
+            for e in reg_entries:
+                data = b""
+                if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                    data = self.host_arena.read(e.extent, e.nbytes, 0).tobytes()
+                yield snap.SnapEntry(
+                    alloc_id=e.alloc_id,
+                    kind=WIRE_KIND[e.kind.value],
+                    device_index=e.device_index,
+                    offset=e.extent.offset,
+                    nbytes=e.nbytes,
+                    origin_rank=e.origin_rank,
+                    origin_pid=e.origin_pid,
+                    data=data,
+                )
+
+        snap.write_file_iter(
+            path or self.snapshot_path,
+            self.rank, self.registry.counter, len(reg_entries), lazy_entries(),
+        )
+
+    def _maybe_restore(self) -> None:
+        import os
+
+        from oncilla_tpu.runtime import snapshot as snap
+
+        if not self.snapshot_path or not os.path.exists(self.snapshot_path):
+            return
+        sp = snap.read_file(self.snapshot_path)
+        if sp.rank != self.rank:
+            raise OcmError(
+                f"snapshot is for rank {sp.rank}, daemon is rank {self.rank}"
+            )
+        self.registry.restore_counter(sp.id_counter)
+        import numpy as np
+
+        for e in sp.entries:
+            kind = OcmKind(WIRE_KIND_INV[e.kind])
+            if kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                ext = self.host_arena.allocator.reserve(e.offset, e.nbytes)
+                if e.data:
+                    self.host_arena.write(
+                        ext, np.frombuffer(e.data, dtype=np.uint8), 0
+                    )
+            else:
+                if not 0 <= e.device_index < len(self.device_books):
+                    raise OcmProtocolError(
+                        "snapshot device_index out of range for this "
+                        f"daemon's ndevices ({e.device_index} >= "
+                        f"{len(self.device_books)})"
+                    )
+                self.device_books[e.device_index].reserve(e.offset, e.nbytes)
+            self.registry.insert(
+                RegEntry(
+                    alloc_id=e.alloc_id,
+                    kind=kind,
+                    rank=self.rank,
+                    device_index=e.device_index,
+                    extent=Extent(e.offset, e.nbytes),
+                    nbytes=e.nbytes,
+                    origin_rank=e.origin_rank,
+                    origin_pid=e.origin_pid,
+                    lease_expiry=self.registry.new_lease_deadline(),
+                )
+            )
+            # Resync the master's placement accounting.
+            note = Message(
+                MsgType.NOTE_ALLOC,
+                {
+                    "kind": e.kind,
+                    "rank": self.rank,
+                    "device_index": e.device_index,
+                    "nbytes": e.nbytes,
+                },
+            )
+            if self.rank == 0:
+                self._on_note_alloc(note)
+            else:
+                try:
+                    r0 = self.entries[0]
+                    self.peers.request(r0.connect_host, r0.port, note)
+                except (OSError, OcmConnectError):
+                    printd("daemon %d: NOTE_ALLOC to rank0 failed", self.rank)
+        printd(
+            "daemon %d restored %d allocations from snapshot",
+            self.rank, len(sp.entries),
+        )
+
+    def _on_note_alloc(self, msg: Message) -> Message:
+        if self.rank == 0:
+            f = msg.fields
+            self.policy.note_alloc(
+                Placement(
+                    rank=f["rank"],
+                    device_index=f["device_index"],
+                    kind=OcmKind(WIRE_KIND_INV[f["kind"]]),
+                ),
+                f["nbytes"],
+            )
+        return Message(MsgType.FREE_OK, {"alloc_id": 0})
+
+    def _own_resources(self) -> NodeResources:
+        return NodeResources(
+            rank=self.rank,
+            ndevices=self.ndevices,
+            device_arena_bytes=self.config.device_arena_bytes,
+            host_arena_bytes=self.config.host_arena_bytes,
+        )
+
+    def _notify_rank0(self, retries: int = 20) -> None:
+        """ADD_NODE to the master (notify_rank0 analogue, main.c:144-160;
+        the reference SIGINTs itself if the master is absent, mem.c:466-474 —
+        here we retry with backoff)."""
+        msg = Message(
+            MsgType.ADD_NODE,
+            {
+                "rank": self.rank,
+                # Announce a peer-reachable address: the bind host may be the
+                # wildcard. Short-form entries fall back to the host column.
+                "host": self.entries[self.rank].connect_host,
+                "port": self.port,
+                "ndevices": self.ndevices,
+                "device_arena_bytes": self.config.device_arena_bytes,
+                "host_arena_bytes": self.config.host_arena_bytes,
+            },
+        )
+        r0 = self.entries[0]
+        for i in range(retries):
+            try:
+                self.peers.request(r0.connect_host, r0.port, msg)
+                return
+            except (OSError, OcmConnectError):
+                time.sleep(min(0.05 * 2**i, 2.0))
+        raise OcmError(f"rank 0 daemon unreachable at {r0.connect_host}:{r0.port}")
+
+    # -- server loops ----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_mu:
+                self._conns.add(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """Per-connection handler (inbound_thread analogue, mem.c:319-393)."""
+        try:
+            while self._running.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except OcmProtocolError as e:
+                    # Clean EOF between frames is normal disconnect; any
+                    # other decode failure (truncated frame, bad magic,
+                    # malformed payload) is hostile/broken input worth a
+                    # diagnostic before dropping the connection.
+                    if str(e) != "peer closed":
+                        printd("daemon %d: dropping conn on malformed "
+                               "input: %s", self.rank, e)
+                    return
+                try:
+                    reply = self._dispatch(msg)
+                except OcmOutOfMemory as e:
+                    reply = _err(ErrCode.OOM, str(e))
+                except OcmBoundsError as e:
+                    reply = _err(ErrCode.BOUNDS, str(e))
+                except OcmInvalidHandle as e:
+                    reply = _err(ErrCode.BAD_ALLOC_ID, str(e))
+                except OcmPlacementError as e:
+                    reply = _err(ErrCode.PLACEMENT, str(e))
+                except OcmError as e:
+                    reply = _err(ErrCode.UNKNOWN, str(e))
+                except Exception as e:  # noqa: BLE001 — always answer with a
+                    # typed ERROR frame rather than killing the connection.
+                    reply = _err(ErrCode.UNKNOWN, f"{type(e).__name__}: {e}")
+                send_msg(conn, reply)
+        except OSError:
+            pass
+        finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reaper_loop(self) -> None:
+        """Reclaim expired leases — the capability the reference left as a
+        TODO (main.c:6-7): no heartbeat => allocations freed."""
+        while self._running.is_set():
+            time.sleep(self.config.heartbeat_s)
+            for e in self.registry.expired():
+                printd(
+                    "daemon %d reaping expired alloc %d (origin pid %d)",
+                    self.rank, e.alloc_id, e.origin_pid,
+                )
+                try:
+                    self._do_free_local(e.alloc_id)
+                except OcmInvalidHandle:
+                    pass
+
+    # -- dispatch --------------------------------------------------------
+
+    def _dispatch(self, msg: Message) -> Message:
+        h = _HANDLERS.get(msg.type)
+        if h is None:
+            return _err(ErrCode.BAD_MSG, f"unhandled message {msg.type.name}")
+        return h(self, msg)
+
+    # CONNECT: app attach (process_msg MSG_CONNECT analogue, main.c:58-103).
+    def _on_connect(self, msg: Message) -> Message:
+        printd("daemon %d: app pid %d connected", self.rank, msg.fields["pid"])
+        return Message(
+            MsgType.CONNECT_CONFIRM,
+            {
+                "rank": self.rank,
+                "nnodes": self.policy.nnodes if self.rank == 0
+                else len(self.entries),
+            },
+        )
+
+    def _on_disconnect(self, msg: Message) -> Message:
+        """Immediate reclamation on app disconnect instead of waiting out the
+        lease (the reference daemon tracks connected apps and frees on
+        disconnect, main.c:46-47,58-103). The app reports which owner ranks
+        hold its remote allocations ("owners", tracked app-side where the
+        handles live), so the fan-out is O(owners); a crashed app never sends
+        DISCONNECT and falls back to the lease reaper."""
+        pid = msg.fields["pid"]
+        self._reclaim_app_local(pid, self.rank)
+        for r in _parse_owners(msg.fields.get("owners", "")):
+            if r == self.rank or not 0 <= r < len(self.entries):
+                continue
+            e = self.entries[r]
+            try:
+                self.peers.request(
+                    e.connect_host, e.port,
+                    Message(MsgType.RECLAIM_APP,
+                            {"pid": pid, "rank": self.rank}),
+                )
+            except (OSError, OcmError):
+                printd("daemon %d: RECLAIM_APP to %d failed (lease reaper "
+                       "is the backstop)", self.rank, r)
+        return Message(MsgType.CONNECT_CONFIRM, {"rank": self.rank, "nnodes": 0})
+
+    def _on_reclaim_app(self, msg: Message) -> Message:
+        n = self._reclaim_app_local(msg.fields["pid"], msg.fields["rank"])
+        return Message(MsgType.RECLAIM_APP_OK, {"count": n})
+
+    def _reclaim_app_local(self, origin_pid: int, origin_rank: int) -> int:
+        n = 0
+        for e in self.registry.for_app(origin_pid, origin_rank):
+            printd("daemon %d reclaiming alloc %d of disconnected app %d",
+                   self.rank, e.alloc_id, origin_pid)
+            try:
+                self._do_free_local(e.alloc_id)
+                n += 1
+            except OcmInvalidHandle:  # raced with an explicit free
+                pass
+        return n
+
+    # ADD_NODE: only the master records membership (alloc_add_node,
+    # alloc.c:60-74).
+    def _on_add_node(self, msg: Message) -> Message:
+        if self.rank != 0:
+            return _err(ErrCode.NOT_MASTER, "ADD_NODE sent to non-master")
+        f = msg.fields
+        self.policy.add_node(
+            NodeResources(
+                rank=f["rank"],
+                ndevices=f["ndevices"],
+                device_arena_bytes=f["device_arena_bytes"],
+                host_arena_bytes=f["host_arena_bytes"],
+            )
+        )
+        # Record the peer's address for forwarding. A nodefile-provided
+        # connect address wins over the announced hostname (the announcement
+        # carries the daemon's bind host, which may not be routable).
+        if 0 <= f["rank"] < len(self.entries):
+            prev = self.entries[f["rank"]]
+            self.entries[f["rank"]] = NodeEntry(
+                f["rank"], f["host"], f["port"], prev.addr
+            )
+        return Message(MsgType.ADD_NODE_OK, {"nnodes": self.policy.nnodes})
+
+    # REQ_ALLOC: non-masters proxy the request to rank 0 (the placement leg,
+    # mem.c:128); rank 0 places (alloc_find analogue) then drives the
+    # DO_ALLOC leg to the owner and returns the complete handle
+    # (msg_send_req_alloc analogue, mem.c:234-260).
+    def _on_req_alloc(self, msg: Message) -> Message:
+        f = msg.fields
+        if self.rank != 0:
+            r0 = self.entries[0]
+            return self.peers.request(r0.connect_host, r0.port, msg)
+        kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        nbytes = f["nbytes"]
+        placed = self.policy.place(f["orig_rank"], kind, nbytes)
+        owner = self.entries[placed.rank]
+        if placed.rank == self.rank:
+            alloc_id, offset = self._do_alloc_local(
+                placed.kind, placed.device_index, nbytes, f["orig_rank"],
+                f["pid"],
+            )
+        else:
+            r = self.peers.request(
+                owner.connect_host,
+                owner.port,
+                Message(
+                    MsgType.DO_ALLOC,
+                    {
+                        "orig_rank": f["orig_rank"],
+                        "pid": f["pid"],
+                        "kind": WIRE_KIND[placed.kind.value],
+                        "device_index": placed.device_index,
+                        "nbytes": nbytes,
+                    },
+                ),
+            )
+            alloc_id, offset = r.fields["alloc_id"], r.fields["offset"]
+        self.policy.note_alloc(placed, nbytes)
+        return Message(
+            MsgType.ALLOC_RESULT,
+            {
+                "alloc_id": alloc_id,
+                "rank": placed.rank,
+                "device_index": placed.device_index,
+                "kind": WIRE_KIND[placed.kind.value],
+                "offset": offset,
+                "nbytes": nbytes,
+                "owner_host": owner.connect_host,
+                "owner_port": owner.port,
+            },
+        )
+
+    # DO_ALLOC on the owner: reserve BEFORE replying (race fix).
+    def _on_do_alloc(self, msg: Message) -> Message:
+        f = msg.fields
+        kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        alloc_id, offset = self._do_alloc_local(
+            kind, f["device_index"], f["nbytes"], f["orig_rank"], f["pid"]
+        )
+        return Message(MsgType.DO_ALLOC_OK, {"alloc_id": alloc_id, "offset": offset})
+
+    def _do_alloc_local(
+        self, kind: OcmKind, device_index: int, nbytes: int, orig_rank: int,
+        origin_pid: int = 0,
+    ) -> tuple[int, int]:
+        """alloc_ate analogue (alloc.c:151-222): reserve the extent in the
+        owner's arena and register the allocation."""
+        if kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            extent = self.host_arena.alloc(nbytes)
+            device_index = 0
+        else:
+            if not 0 <= device_index < self.ndevices:
+                raise OcmInvalidHandle(f"bad device_index {device_index}")
+            extent = self.device_books[device_index].alloc(nbytes)
+        alloc_id = self.registry.next_id()
+        self.registry.insert(
+            RegEntry(
+                alloc_id=alloc_id,
+                kind=kind,
+                rank=self.rank,
+                device_index=device_index,
+                extent=extent,
+                nbytes=nbytes,
+                origin_rank=orig_rank,
+                origin_pid=origin_pid,
+                lease_expiry=self.registry.new_lease_deadline(),
+            )
+        )
+        return alloc_id, extent.offset
+
+    # REQ_FREE from an app: forward to the owner (msg_send_req_free
+    # analogue, mem.c:265-295) and fix the rank-0 accounting the reference
+    # stubbed (mem.c:221-229).
+    def _on_req_free(self, msg: Message) -> Message:
+        f = msg.fields
+        owner_rank = f["rank"]
+        if not 0 <= owner_rank < len(self.entries):
+            raise OcmInvalidHandle(f"bad owner rank {owner_rank}")
+        if owner_rank == self.rank:
+            self._do_free_local(f["alloc_id"])
+        else:
+            owner = self.entries[owner_rank]
+            self.peers.request(
+                owner.connect_host, owner.port,
+                Message(MsgType.DO_FREE, {"alloc_id": f["alloc_id"]}),
+            )
+        return Message(MsgType.FREE_OK, {"alloc_id": f["alloc_id"]})
+
+    def _on_do_free(self, msg: Message) -> Message:
+        self._do_free_local(msg.fields["alloc_id"])
+        return Message(MsgType.FREE_OK, {"alloc_id": msg.fields["alloc_id"]})
+
+    def _do_free_local(self, alloc_id: int) -> None:
+        """dealloc_ate analogue (alloc.c:231-282)."""
+        e = self.registry.remove(alloc_id)
+        if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            self.host_arena.free(e.extent)
+        else:
+            self.device_books[e.device_index].free(e.extent)
+        self._note_free_rank0(e)
+
+    def _note_free_rank0(self, e: RegEntry) -> None:
+        note = Message(
+            MsgType.NOTE_FREE,
+            {
+                "kind": WIRE_KIND[e.kind.value],
+                "rank": e.rank,
+                "device_index": e.device_index,
+                "nbytes": e.nbytes,
+            },
+        )
+        if self.rank == 0:
+            self._on_note_free(note)
+        else:
+            r0 = self.entries[0]
+            try:
+                self.peers.request(r0.connect_host, r0.port, note)
+            except (OSError, OcmConnectError):
+                printd("daemon %d: NOTE_FREE to rank0 failed", self.rank)
+
+    def _on_note_free(self, msg: Message) -> Message:
+        if self.rank == 0:
+            f = msg.fields
+            self.policy.note_free(
+                Placement(
+                    rank=f["rank"],
+                    device_index=f["device_index"],
+                    kind=OcmKind(WIRE_KIND_INV[f["kind"]]),
+                ),
+                f["nbytes"],
+            )
+        return Message(MsgType.FREE_OK, {"alloc_id": 0})
+
+    # -- DCN data plane: one-sided put/get into the daemon's host arena ---
+
+    def _on_data_put(self, msg: Message) -> Message:
+        f = msg.fields
+        e = self.registry.lookup(f["alloc_id"])
+        if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            raise OcmInvalidHandle("DATA_PUT on a device-arm allocation")
+        if len(msg.data) != f["nbytes"]:
+            raise OcmProtocolError("DATA_PUT length mismatch")
+        check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
+        import numpy as np
+
+        self.host_arena.write(
+            e.extent, np.frombuffer(msg.data, dtype=np.uint8), f["offset"]
+        )
+        return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
+
+    def _on_data_get(self, msg: Message) -> Message:
+        f = msg.fields
+        e = self.registry.lookup(f["alloc_id"])
+        if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            raise OcmInvalidHandle("DATA_GET on a device-arm allocation")
+        check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
+        data = self.host_arena.read(e.extent, f["nbytes"], f["offset"])
+        return Message(
+            MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]}, data.tobytes()
+        )
+
+    # -- liveness --------------------------------------------------------
+
+    def _on_heartbeat(self, msg: Message) -> Message:
+        """Renew leases locally; a heartbeat arriving from a *local* app
+        (origin rank == ours) is relayed to every peer daemon, since owners
+        hold the leases. Relayed copies have origin rank != receiver rank,
+        so they are not re-relayed (no forwarding loop)."""
+        f = msg.fields
+        self.registry.renew_leases(f["pid"], f["rank"])
+        if f["rank"] == self.rank:
+            # Relay only to the ranks the app says own its allocations —
+            # O(owners) per beat, not an O(nnodes) broadcast per app.
+            for r in _parse_owners(f.get("owners", "")):
+                if r == self.rank or not 0 <= r < len(self.entries):
+                    continue
+                e = self.entries[r]
+                try:
+                    self.peers.request(e.connect_host, e.port, msg)
+                except (OSError, OcmConnectError):
+                    printd("daemon %d: heartbeat relay to %d failed",
+                           self.rank, e.rank)
+        return Message(MsgType.HEARTBEAT_OK, {"lease_s": self.registry.lease_s})
+
+    def _on_status(self, msg: Message) -> Message:
+        return Message(
+            MsgType.STATUS_OK,
+            {
+                "rank": self.rank,
+                "nnodes": self.policy.nnodes if self.rank == 0 else len(self.entries),
+                "live_allocs": self.registry.live_count(),
+                "host_bytes_live": self.host_arena.allocator.bytes_live,
+                "device_bytes_live": sum(
+                    b.bytes_live for b in self.device_books
+                ),
+            },
+        )
+
+
+def _err(code: ErrCode, detail: str) -> Message:
+    return Message(MsgType.ERROR, {"code": int(code), "detail": detail})
+
+
+def _parse_owners(s: str) -> list[int]:
+    """Comma-separated rank list from the wire ("1,3" -> [1, 3])."""
+    out = []
+    for part in s.split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                continue
+    return out
+
+
+def main(argv=None) -> int:
+    """``python -m oncilla_tpu.runtime.daemon <nodefile> [--rank N]`` — the
+    per-node daemon process (``bin/oncillamem nodefile`` analogue,
+    /root/reference/src/main.c:187-221, minus the busy-spin: we block on a
+    signal-interruptible event)."""
+    import argparse
+    import signal
+
+    from oncilla_tpu.runtime.membership import detect_rank, parse_nodefile
+    from oncilla_tpu.utils.platform import honor_cpu_env
+
+    honor_cpu_env()  # JAX_PLATFORMS=cpu must stick (see utils/platform.py)
+
+    ap = argparse.ArgumentParser(description="oncilla-tpu daemon")
+    ap.add_argument("nodefile")
+    ap.add_argument("--rank", type=int, default=None)
+    ap.add_argument("--policy", default="capacity", choices=sorted(POLICIES))
+    ap.add_argument("--ndevices", type=int, default=1)
+    ap.add_argument("--snapshot", default=None,
+                    help="snapshot file: restored on start, written on stop")
+    args = ap.parse_args(argv)
+
+    entries = parse_nodefile(args.nodefile)
+    rank = args.rank if args.rank is not None else detect_rank(entries)
+    d = Daemon(rank, entries, policy=args.policy, ndevices=args.ndevices,
+               host=entries[rank].host, snapshot_path=args.snapshot)
+    d.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    print(f"oncilla daemon rank={rank} listening on "
+          f"{entries[rank].host}:{d.port}", flush=True)
+    stop.wait()
+    d.stop()
+    return 0
+
+
+_HANDLERS = {
+    MsgType.CONNECT: Daemon._on_connect,
+    MsgType.DISCONNECT: Daemon._on_disconnect,
+    MsgType.ADD_NODE: Daemon._on_add_node,
+    MsgType.REQ_ALLOC: Daemon._on_req_alloc,
+    MsgType.RECLAIM_APP: Daemon._on_reclaim_app,
+    MsgType.DO_ALLOC: Daemon._on_do_alloc,
+    MsgType.REQ_FREE: Daemon._on_req_free,
+    MsgType.DO_FREE: Daemon._on_do_free,
+    MsgType.NOTE_FREE: Daemon._on_note_free,
+    MsgType.NOTE_ALLOC: Daemon._on_note_alloc,
+    MsgType.DATA_PUT: Daemon._on_data_put,
+    MsgType.DATA_GET: Daemon._on_data_get,
+    MsgType.HEARTBEAT: Daemon._on_heartbeat,
+    MsgType.STATUS: Daemon._on_status,
+}
+
+if __name__ == "__main__":
+    raise SystemExit(main())

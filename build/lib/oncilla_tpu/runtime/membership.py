@@ -1,0 +1,130 @@
+"""Cluster membership.
+
+The reference's membership is a positional text nodefile
+``#rank hostname ethernet_ip ocm_port rdmacm_port`` parsed into a global
+table, with self-rank found by matching gethostname()
+(/root/reference/src/nodefile.c:30-37,92-103). Here the same file format is
+supported (minus the per-fabric port column — the data plane is
+connectionless), and on a real TPU pod membership can instead come from the
+JAX runtime (``jax.process_index``/``process_count``).
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+
+from oncilla_tpu.core.errors import OcmError
+
+
+@dataclass(frozen=True)
+class NodeEntry:
+    """One row of the cluster table (``struct node_entry`` analogue,
+    /root/reference/inc/nodefile.h:19-27).
+
+    ``host`` is the DNS name used for self-rank detection; ``addr`` (the
+    reference's ethernet_ip column) is the address peers connect to, and
+    defaults to ``host`` for short-form nodefiles.
+    """
+
+    rank: int
+    host: str
+    port: int
+    addr: str | None = None
+
+    @property
+    def connect_host(self) -> str:
+        return self.addr or self.host
+
+
+def parse_nodefile(path: str) -> list[NodeEntry]:
+    """Parse nodefile lines; '#' starts a comment. Three layouts:
+
+    - ``rank host port`` (short form)
+    - ``rank host ip port``
+    - ``rank host ip ocm_port rdmacm_port`` — the reference's format
+      (/root/reference/src/nodefile.c:30-37); the trailing per-fabric port is
+      ignored because the TPU data plane is connectionless.
+    """
+    entries: list[NodeEntry] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            try:
+                if len(parts) == 3:
+                    entry = NodeEntry(
+                        rank=int(parts[0]), host=parts[1], port=int(parts[2])
+                    )
+                elif len(parts) in (4, 5):
+                    entry = NodeEntry(
+                        rank=int(parts[0]),
+                        host=parts[1],
+                        port=int(parts[3]),
+                        addr=parts[2],
+                    )
+                else:
+                    raise ValueError("wrong field count")
+            except ValueError:
+                raise OcmError(
+                    f"{path}:{lineno}: expected 'rank host port', "
+                    "'rank host ip port' or "
+                    "'rank host ip ocm_port rdmacm_port'"
+                ) from None
+            entries.append(entry)
+    entries.sort(key=lambda e: e.rank)
+    if [e.rank for e in entries] != list(range(len(entries))):
+        raise OcmError(f"{path}: ranks must be contiguous from 0")
+    return entries
+
+
+def detect_rank(entries: list[NodeEntry]) -> int:
+    """Self-rank by hostname match (nodefile.c:92-103 behavior), falling
+    back to ``jax.process_index()`` when the nodefile hosts don't resolve
+    to this machine but the pod shape matches (multi-host TPU pods, where
+    nodefile hosts may be pod DNS names the VM's gethostname won't match)."""
+    hostname = socket.gethostname()
+    for e in entries:
+        if e.host in (hostname, hostname.split(".")[0], "localhost", "127.0.0.1"):
+            return e.rank
+    try:
+        import jax
+
+        if jax.process_count() == len(entries):
+            return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no initialized distributed runtime
+        pass
+    raise OcmError(f"hostname {hostname!r} not present in nodefile")
+
+
+def jax_membership(
+    base_port: int, hosts: list[str] | None = None
+) -> tuple[list[NodeEntry], int]:
+    """Membership from the JAX distributed runtime: one daemon per host,
+    rank = jax.process_index(). JAX does not expose peer hostnames, so on a
+    real multi-host pod pass ``hosts`` explicitly or set ``OCM_HOSTS`` to a
+    comma-separated list ordered by process index (the nodefile equivalent).
+    Single-process falls back to localhost."""
+    import os
+
+    import jax
+
+    n = jax.process_count()
+    if hosts is None:
+        env = os.environ.get("OCM_HOSTS")
+        hosts = [h.strip() for h in env.split(",")] if env else None
+    if hosts is None:
+        if n > 1:
+            raise OcmError(
+                "multi-host membership needs hostnames: pass hosts= or set "
+                "OCM_HOSTS=host0,host1,... ordered by jax.process_index"
+            )
+        hosts = ["localhost"]
+    if len(hosts) != n:
+        raise OcmError(f"got {len(hosts)} hosts for {n} JAX processes")
+    entries = [
+        NodeEntry(rank=i, host=hosts[i], port=base_port + i) for i in range(n)
+    ]
+    return entries, jax.process_index()

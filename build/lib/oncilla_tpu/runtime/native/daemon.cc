@@ -1,0 +1,1113 @@
+// oncillamemd — the native per-host daemon for oncilla-tpu.
+//
+// Production C++ twin of the Python reference implementation in
+// oncilla_tpu/runtime/daemon.py, speaking the identical wire protocol
+// (protocol.hh). The analogue of the reference's bin/oncillamem
+// (/root/reference/src/main.c + mem.c + alloc.c): thread-per-connection TCP
+// server, rank-0 placement master (capacity-aware or neighbor round-robin),
+// allocation registry with heartbeat-renewed leases (the liveness upgrade the
+// reference left as a TODO, main.c:6-7), and the DCN data plane serving
+// one-sided put/get into a daemon-owned host arena.
+//
+// Build: cmake -S . -B build && cmake --build build   (or: make)
+// Run:   oncillamemd --nodefile FILE --rank N [flags]
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdlib>
+#include <condition_variable>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <thread>
+#include <vector>
+
+#include "arena.hh"
+#include "membership.hh"
+#include "net.hh"
+#include "protocol.hh"
+
+namespace ocm {
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Cached peer connections, no re-send on failure (pool.py semantics: control
+// messages are not idempotent). Conns are shared_ptr-held: eviction/shutdown
+// only ::shutdown()s the fd (waking any blocked recv) and drops the map
+// reference; the fd is ::close()d by ~Conn when the last in-flight request
+// lets go — so no thread ever uses a closed-and-reused fd number.
+class PeerPool {
+ public:
+  Message request(const std::string& host, int port, const Message& m) {
+    std::shared_ptr<Conn> c = get(host, port);
+    try {
+      std::lock_guard<std::mutex> g(c->mu);
+      send_msg(c->fd, m);
+      return recv_msg(c->fd);
+    } catch (const ProtocolError&) {
+      evict(host, port);
+      throw;
+    }
+  }
+
+  // Terminal: refuses new dials afterwards, so a worker racing shutdown
+  // cannot re-dial a hung peer and block stop()'s join forever.
+  void close_all() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    for (auto& kv : conns_) ::shutdown(kv.second->fd, SHUT_RDWR);
+    conns_.clear();
+  }
+
+ private:
+  struct Conn {
+    int fd = -1;  // -1 until dial succeeds: ~Conn must never close(0)
+    std::mutex mu;
+    ~Conn() {
+      if (fd >= 0) ::close(fd);
+    }
+  };
+
+  std::shared_ptr<Conn> get(const std::string& host, int port) {
+    auto key = host + ":" + std::to_string(port);
+    std::lock_guard<std::mutex> g(mu_);
+    if (closed_) throw ProtocolError("peer pool is shut down");
+    auto it = conns_.find(key);
+    if (it != conns_.end()) return it->second;
+    auto c = std::make_shared<Conn>();
+    c->fd = dial(host, port);
+    conns_[key] = c;
+    return c;
+  }
+
+  void evict(const std::string& host, int port) {
+    auto key = host + ":" + std::to_string(port);
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = conns_.find(key);
+    if (it != conns_.end()) {
+      ::shutdown(it->second->fd, SHUT_RDWR);
+      conns_.erase(it);
+    }
+  }
+
+  std::mutex mu_;
+  bool closed_ = false;
+  std::map<std::string, std::shared_ptr<Conn>> conns_;
+};
+
+// ---------------------------------------------------------------------------
+// Membership, registry, placement.
+// ---------------------------------------------------------------------------
+
+struct RegEntry {
+  uint64_t alloc_id;
+  Kind kind;
+  uint32_t device_index;
+  Extent extent;
+  uint64_t nbytes;
+  int64_t origin_rank;
+  int64_t origin_pid;
+  double lease_expiry;
+};
+
+// Owner-side registry (registry.py twin): ids = (rank << 32) | (counter << 1).
+class Registry {
+ public:
+  Registry(int64_t rank, double lease_s) : rank_(rank), lease_s_(lease_s) {}
+
+  uint64_t next_id() {
+    std::lock_guard<std::mutex> g(mu_);
+    ++counter_;
+    return (uint64_t(rank_) << 32) | (counter_ << 1);
+  }
+
+  void insert(RegEntry e) {
+    std::lock_guard<std::mutex> g(mu_);
+    entries_[e.alloc_id] = std::move(e);
+  }
+
+  RegEntry lookup(uint64_t id) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = entries_.find(id);
+    if (it == entries_.end())
+      throw BadHandleError("unknown alloc_id " + std::to_string(id));
+    return it->second;
+  }
+
+  RegEntry remove(uint64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = entries_.find(id);
+    if (it == entries_.end())
+      throw BadHandleError("unknown alloc_id " + std::to_string(id));
+    RegEntry e = it->second;
+    entries_.erase(it);
+    return e;
+  }
+
+  void renew(int64_t pid, int64_t rank) {
+    double deadline = now_s() + lease_s_;
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : entries_)
+      if (kv.second.origin_pid == pid && kv.second.origin_rank == rank)
+        kv.second.lease_expiry = deadline;
+  }
+
+  std::vector<uint64_t> expired() const {
+    double t = now_s();
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<uint64_t> out;
+    for (auto& kv : entries_)
+      if (kv.second.lease_expiry < t) out.push_back(kv.first);
+    return out;
+  }
+
+  // Every allocation an app originated (disconnect-time reclamation — the
+  // reference's unresolved TODO, main.c:6-7,58-103).
+  std::vector<uint64_t> ids_for_app(int64_t pid, int64_t rank) const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<uint64_t> out;
+    for (auto& kv : entries_)
+      if (kv.second.origin_pid == pid && kv.second.origin_rank == rank)
+        out.push_back(kv.first);
+    return out;
+  }
+
+  double new_deadline() const { return now_s() + lease_s_; }
+  double lease_s() const { return lease_s_; }
+
+  uint64_t live_count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return entries_.size();
+  }
+
+  uint64_t counter() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return counter_;
+  }
+
+  void restore_counter(uint64_t v) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (v > counter_) counter_ = v;
+  }
+
+  std::vector<RegEntry> all() const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<RegEntry> out;
+    for (auto& kv : entries_) out.push_back(kv.second);
+    return out;
+  }
+
+ private:
+  int64_t rank_;
+  double lease_s_;
+  mutable std::mutex mu_;
+  uint64_t counter_ = 0;
+  std::map<uint64_t, RegEntry> entries_;
+};
+
+struct NodeResources {
+  int64_t rank;
+  uint32_t ndevices;
+  uint64_t device_arena_bytes;
+  uint64_t host_arena_bytes;
+  std::vector<uint64_t> device_used;
+  uint64_t host_used = 0;
+};
+
+struct PlacementResult {
+  int64_t rank;
+  uint32_t device_index;
+  Kind kind;
+};
+
+struct PlacementError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Placement (placement.py twin): "capacity" = most-free-fit avoiding the
+// origin; "neighbor" = (orig+1) % n reference parity (alloc.c:107).
+class Placement {
+ public:
+  Placement(bool capacity_aware) : capacity_aware_(capacity_aware) {}
+
+  void add_node(NodeResources r) {
+    std::lock_guard<std::mutex> g(mu_);
+    r.device_used.assign(r.ndevices, 0);
+    nodes_[r.rank] = std::move(r);
+  }
+
+  int64_t nnodes() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return int64_t(nodes_.size());
+  }
+
+  void note(Kind kind, int64_t rank, uint32_t dev, uint64_t nbytes, bool alloc) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = nodes_.find(rank);
+    if (it == nodes_.end()) return;
+    NodeResources& n = it->second;
+    if (kind_is_host(kind)) {
+      n.host_used = alloc ? n.host_used + nbytes
+                          : (n.host_used > nbytes ? n.host_used - nbytes : 0);
+    } else if (dev < n.device_used.size()) {
+      uint64_t& u = n.device_used[dev];
+      u = alloc ? u + nbytes : (u > nbytes ? u - nbytes : 0);
+    }
+  }
+
+  PlacementResult place(int64_t orig_rank, Kind kind, uint64_t nbytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (nodes_.empty()) throw PlacementError("no nodes registered");
+    bool remote = kind == Kind::REMOTE_DEVICE || kind == Kind::REMOTE_HOST;
+    if (nodes_.size() == 1 && remote) {
+      // Single-node demotion (alloc.c:82-83).
+      Kind demoted = kind == Kind::REMOTE_DEVICE ? Kind::LOCAL_DEVICE
+                                                 : Kind::LOCAL_HOST;
+      return {orig_rank, 0, demoted};
+    }
+    if (!capacity_aware_) {
+      int64_t rank = (orig_rank + 1) % int64_t(nodes_.size());
+      const NodeResources& n = nodes_.at(rank);
+      if (kind == Kind::REMOTE_HOST) return {rank, 0, kind};
+      rr_++;
+      uint32_t dev = n.ndevices ? uint32_t(rr_ % n.ndevices) : 0;
+      return {rank, dev, kind};
+    }
+    // Capacity-aware: most free bytes that fit, off-origin preferred.
+    bool found = false;
+    int64_t best_score = 0;
+    PlacementResult best{0, 0, kind};
+    for (auto& kv : nodes_) {
+      const NodeResources& n = kv.second;
+      int64_t pref = (kv.first != orig_rank) ? 0 : -(int64_t(1) << 62);
+      if (kind == Kind::REMOTE_HOST) {
+        int64_t freeb = int64_t(n.host_arena_bytes) - int64_t(n.host_used);
+        if (freeb >= int64_t(nbytes)) {
+          int64_t score = freeb + pref;
+          if (!found || score > best_score) {
+            found = true;
+            best_score = score;
+            best = {kv.first, 0, kind};
+          }
+        }
+      } else {
+        for (uint32_t d = 0; d < n.ndevices; ++d) {
+          int64_t freeb =
+              int64_t(n.device_arena_bytes) - int64_t(n.device_used[d]);
+          if (freeb >= int64_t(nbytes)) {
+            int64_t score = freeb + pref;
+            if (!found || score > best_score) {
+              found = true;
+              best_score = score;
+              best = {kv.first, d, kind};
+            }
+          }
+        }
+      }
+    }
+    if (!found)
+      throw PlacementError("no node can fit " + std::to_string(nbytes) + " B");
+    return best;
+  }
+
+ private:
+  bool capacity_aware_;
+  mutable std::mutex mu_;
+  uint64_t rr_ = 0;
+  std::map<int64_t, NodeResources> nodes_;
+};
+
+// ---------------------------------------------------------------------------
+// The daemon.
+// ---------------------------------------------------------------------------
+
+struct Config {
+  std::string nodefile;
+  std::string snapshot_path;
+  // Empty = bind the daemon's own nodefile hostname (routable to peers but
+  // not the wildcard; the plane is unauthenticated, so INADDR_ANY is an
+  // explicit opt-in via --bind-host 0.0.0.0 / OCM_BIND_HOST). Mirrors the
+  // Python CLI (daemon.py main() passes host=entries[rank].host).
+  std::string bind_host;
+  int64_t rank = -1;
+  bool capacity_policy = true;
+  uint32_t ndevices = 1;
+  uint64_t host_arena_bytes = 256ull << 20;
+  uint64_t device_arena_bytes = 128ull << 20;
+  uint64_t alignment = 4096;
+  double lease_s = 30.0;
+  double heartbeat_s = 5.0;
+};
+
+class Daemon {
+ public:
+  Daemon(const Config& cfg, std::vector<NodeEntry> entries)
+      : cfg_(cfg),
+        entries_(std::move(entries)),
+        host_arena_(cfg.host_arena_bytes, cfg.alignment),
+        host_store_(cfg.host_arena_bytes, 0),
+        registry_(cfg.rank, cfg.lease_s),
+        placement_(cfg.capacity_policy) {
+    for (uint32_t i = 0; i < cfg.ndevices; ++i)
+      device_books_.emplace_back(std::make_unique<ArenaAllocator>(
+          cfg.device_arena_bytes, cfg.alignment));
+  }
+
+  void run() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr = {};
+    addr.sin_family = AF_INET;
+    if (cfg_.bind_host.empty())
+      cfg_.bind_host = entries_[cfg_.rank].host;
+    if (cfg_.bind_host == "0.0.0.0") {
+      addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    } else if (inet_pton(AF_INET, cfg_.bind_host.c_str(), &addr.sin_addr) != 1) {
+      // Not a dotted quad (e.g. a nodefile hostname): resolve it.
+      addrinfo hints = {};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (getaddrinfo(cfg_.bind_host.c_str(), nullptr, &hints, &res) != 0 ||
+          res == nullptr)
+        throw std::runtime_error("cannot resolve bind host " + cfg_.bind_host);
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    addr.sin_port = htons(uint16_t(entries_[cfg_.rank].port));
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("bind failed on port " +
+                               std::to_string(entries_[cfg_.rank].port));
+    ::listen(listen_fd_, 64);
+    running_ = true;
+
+    if (cfg_.rank == 0) {
+      placement_.add_node(own_resources());
+    } else {
+      notify_rank0();
+    }
+    maybe_restore();
+    // Joined in stop(), never detached: a detached worker can wake after
+    // run() returns and the Daemon is destroyed (use-after-free caught by
+    // the TSan test). Started only after the fallible setup above — a throw
+    // while a joinable thread is live would hit std::terminate in ~thread.
+    reaper_thread_ = std::thread([this] { reaper_loop(); });
+    started_ok_ = true;
+    std::printf("oncillamemd rank=%lld listening on %s:%d\n",
+                (long long)cfg_.rank, entries_[cfg_.rank].host.c_str(),
+                entries_[cfg_.rank].port);
+    std::fflush(stdout);
+
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        conns_.insert(fd);
+      }
+      std::lock_guard<std::mutex> g(reap_mu_);
+      serve_threads_.emplace_back([this, fd] { serve(fd); });
+    }
+    stop();  // signal handler only requested; do the real teardown here
+  }
+
+  // Async-signal-safe: called from the SIGINT/SIGTERM handler. Only an
+  // atomic store + shutdown(2); the real teardown (mutexes, file I/O)
+  // happens on the main thread once accept() returns.
+  void request_stop() {
+    running_.store(false);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  }
+
+  void stop() {
+    running_ = false;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    // Quiesce serve threads before snapshotting (they check running_ before
+    // each request; kick them off their blocking recvs).
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
+    }
+    // Unblock any worker waiting on a peer reply BEFORE joining — a hung
+    // peer must not turn SIGTERM into an infinite hang (close_all also
+    // refuses new dials from here on).
+    peers_.close_all();
+    // Serve threads exit promptly once their sockets are shut down; join
+    // them (and the reaper) so no worker can touch a destroyed Daemon.
+    // Only the accept loop spawns serve threads and it has exited by now.
+    // Joins run outside reap_mu_: an exiting serve thread takes that lock
+    // for its final finished_ push.
+    std::vector<std::thread> leftover;
+    {
+      std::lock_guard<std::mutex> g(reap_mu_);
+      leftover.swap(serve_threads_);
+      finished_.clear();
+    }
+    for (std::thread& t : leftover)
+      if (t.joinable()) t.join();
+    if (reaper_thread_.joinable()) reaper_thread_.join();
+    if (started_ok_) save_snapshot();
+  }
+
+ private:
+  NodeResources own_resources() const {
+    return {cfg_.rank, cfg_.ndevices, cfg_.device_arena_bytes,
+            cfg_.host_arena_bytes, {}, 0};
+  }
+
+  void notify_rank0() {
+    Message m{MsgType::ADD_NODE,
+              {{"rank", Value::I(cfg_.rank)},
+               {"host", Value::S(entries_[cfg_.rank].host)},
+               {"port", Value::U(uint64_t(entries_[cfg_.rank].port))},
+               {"ndevices", Value::U(cfg_.ndevices)},
+               {"device_arena_bytes", Value::U(cfg_.device_arena_bytes)},
+               {"host_arena_bytes", Value::U(cfg_.host_arena_bytes)}},
+              {}};
+    for (int attempt = 0; attempt < 40; ++attempt) {
+      try {
+        peers_.request(entries_[0].caddr(), entries_[0].port, m);
+        return;
+      } catch (const ProtocolError&) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(250));
+      }
+    }
+    throw std::runtime_error("rank 0 daemon unreachable");
+  }
+
+  void reaper_loop() {
+    // Lease reclamation (the reference's unresolved TODO, main.c:6-7).
+    // Sleep in short slices so stop()'s join returns promptly.
+    double slept = 0.0;
+    while (running_) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      reap_finished();
+      slept += 0.05;
+      if (slept < cfg_.heartbeat_s) continue;
+      slept = 0.0;
+      for (uint64_t id : registry_.expired()) {
+        try {
+          do_free_local(id);
+        } catch (const BadHandleError&) {
+        }
+      }
+    }
+  }
+
+  void serve(int fd) {
+    // inbound_thread analogue (mem.c:319-393): loop until peer closes.
+    while (running_) {
+      Message msg;
+      try {
+        msg = recv_msg(fd);
+      } catch (const ProtocolError& e) {
+        // Clean close at a frame boundary is normal; anything else —
+        // malformed wire input, truncation, a reset from a crashed peer —
+        // is worth a diagnostic saying which (daemon.py twin).
+        if (std::string(e.what()) != "peer closed" && getenv("OCM_VERBOSE"))
+          std::fprintf(stderr, "oncillamemd: dropping conn: %s\n", e.what());
+        break;
+      }
+      Message reply;
+      try {
+        reply = dispatch(msg);
+      } catch (const OomError& e) {
+        reply = err(ErrCode::OOM, e.what());
+      } catch (const BoundsError& e) {
+        reply = err(ErrCode::BOUNDS, e.what());
+      } catch (const BadHandleError& e) {
+        reply = err(ErrCode::BAD_ALLOC_ID, e.what());
+      } catch (const PlacementError& e) {
+        reply = err(ErrCode::PLACEMENT, e.what());
+      } catch (const std::exception& e) {
+        reply = err(ErrCode::UNKNOWN, e.what());
+      }
+      try {
+        send_msg(fd, reply);
+      } catch (const ProtocolError&) {
+        break;
+      }
+    }
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      conns_.erase(fd);
+    }
+    ::close(fd);
+    // Last member access: report this thread as joinable-now so the accept
+    // loop can reclaim it (a joinable pthread's stack is not freed until
+    // joined; detaching instead would re-open the shutdown use-after-free).
+    std::lock_guard<std::mutex> g(reap_mu_);
+    finished_.push_back(std::this_thread::get_id());
+  }
+
+  // Join serve threads that have finished (their stacks are not reclaimed
+  // until joined). Runs from the reaper loop so idle daemons reclaim too,
+  // not just ones with a steady stream of new connections. Joins happen
+  // outside reap_mu_ — the exiting thread's own final push needs that lock.
+  void reap_finished() {
+    std::vector<std::thread> done;
+    {
+      std::lock_guard<std::mutex> g(reap_mu_);
+      for (std::thread::id id : finished_)
+        for (auto it = serve_threads_.begin(); it != serve_threads_.end(); ++it)
+          if (it->get_id() == id) {
+            done.push_back(std::move(*it));
+            serve_threads_.erase(it);
+            break;
+          }
+      finished_.clear();
+    }
+    for (std::thread& t : done) t.join();
+  }
+
+  static Message err(ErrCode c, const std::string& detail) {
+    return {MsgType::ERR,
+            {{"code", Value::U(uint64_t(c))}, {"detail", Value::S(detail)}},
+            {}};
+  }
+
+  Message dispatch(const Message& m) {
+    switch (m.type) {
+      case MsgType::DISCONNECT:
+        on_disconnect(m);
+        [[fallthrough]];
+      case MsgType::CONNECT:
+        return {MsgType::CONNECT_CONFIRM,
+                {{"rank", Value::I(cfg_.rank)},
+                 {"nnodes", Value::I(cfg_.rank == 0
+                                         ? placement_.nnodes()
+                                         : int64_t(entries_.size()))}},
+                {}};
+      case MsgType::RECLAIM_APP:
+        return {MsgType::RECLAIM_APP_OK,
+                {{"count",
+                  Value::U(reclaim_app_local(m.i("pid"), m.i("rank")))}},
+                {}};
+      case MsgType::ADD_NODE: return on_add_node(m);
+      case MsgType::REQ_ALLOC: return on_req_alloc(m);
+      case MsgType::DO_ALLOC: return on_do_alloc(m);
+      case MsgType::REQ_FREE: return on_req_free(m);
+      case MsgType::DO_FREE:
+        do_free_local(m.u("alloc_id"));
+        return {MsgType::FREE_OK, {{"alloc_id", Value::U(m.u("alloc_id"))}}, {}};
+      case MsgType::NOTE_FREE: return on_note_free(m);
+      case MsgType::NOTE_ALLOC: return on_note_alloc(m);
+      case MsgType::DATA_PUT: return on_data_put(m);
+      case MsgType::DATA_GET: return on_data_get(m);
+      case MsgType::HEARTBEAT: return on_heartbeat(m);
+      case MsgType::STATUS: return on_status();
+      default:
+        return err(ErrCode::BAD_MSG, "unhandled message type");
+    }
+  }
+
+  Message on_add_node(const Message& m) {
+    if (cfg_.rank != 0) return err(ErrCode::NOT_MASTER, "ADD_NODE to non-master");
+    NodeResources r{m.i("rank"), uint32_t(m.u("ndevices")),
+                    m.u("device_arena_bytes"), m.u("host_arena_bytes"), {}, 0};
+    placement_.add_node(std::move(r));
+    int64_t rank = m.i("rank");
+    if (rank >= 0 && size_t(rank) < entries_.size()) {
+      std::lock_guard<std::mutex> g(entries_mu_);
+      entries_[rank] = {rank, m.s("host"), int(m.u("port")),
+                        entries_[rank].addr};
+    }
+    return {MsgType::ADD_NODE_OK, {{"nnodes", Value::I(placement_.nnodes())}}, {}};
+  }
+
+  Message on_req_alloc(const Message& m) {
+    if (cfg_.rank != 0) {
+      // Proxy the whole request to the master (the placement leg,
+      // mem.c:128).
+      NodeEntry r0 = entry(0);
+      return peers_.request(r0.caddr(), r0.port, m);
+    }
+    Kind kind = Kind(uint8_t(m.u("kind")));
+    uint64_t nbytes = m.u("nbytes");
+    PlacementResult placed = placement_.place(m.i("orig_rank"), kind, nbytes);
+    NodeEntry owner = entry(placed.rank);
+    uint64_t alloc_id, offset;
+    if (placed.rank == cfg_.rank) {
+      do_alloc_local(placed.kind, placed.device_index, nbytes,
+                     m.i("orig_rank"), m.i("pid"), &alloc_id, &offset);
+    } else {
+      Message r = peers_.request(
+          owner.caddr(), owner.port,
+          {MsgType::DO_ALLOC,
+           {{"orig_rank", Value::I(m.i("orig_rank"))},
+            {"pid", Value::I(m.i("pid"))},
+            {"kind", Value::U(uint64_t(placed.kind))},
+            {"device_index", Value::U(placed.device_index)},
+            {"nbytes", Value::U(nbytes)}},
+           {}});
+      if (r.type == MsgType::ERR) return r;
+      alloc_id = r.u("alloc_id");
+      offset = r.u("offset");
+    }
+    placement_.note(placed.kind, placed.rank, placed.device_index, nbytes,
+                    /*alloc=*/true);
+    return {MsgType::ALLOC_RESULT,
+            {{"alloc_id", Value::U(alloc_id)},
+             {"rank", Value::I(placed.rank)},
+             {"device_index", Value::U(placed.device_index)},
+             {"kind", Value::U(uint64_t(placed.kind))},
+             {"offset", Value::U(offset)},
+             {"nbytes", Value::U(nbytes)},
+             {"owner_host", Value::S(owner.caddr())},
+             {"owner_port", Value::U(uint64_t(owner.port))}},
+            {}};
+  }
+
+  Message on_do_alloc(const Message& m) {
+    uint64_t alloc_id, offset;
+    do_alloc_local(Kind(uint8_t(m.u("kind"))), uint32_t(m.u("device_index")),
+                   m.u("nbytes"), m.i("orig_rank"), m.i("pid"), &alloc_id,
+                   &offset);
+    return {MsgType::DO_ALLOC_OK,
+            {{"alloc_id", Value::U(alloc_id)}, {"offset", Value::U(offset)}},
+            {}};
+  }
+
+  // alloc_ate analogue (alloc.c:151-222): reserve BEFORE replying (fixes the
+  // reference's reply-before-listen race, mem.c:350-354).
+  void do_alloc_local(Kind kind, uint32_t device_index, uint64_t nbytes,
+                      int64_t orig_rank, int64_t pid, uint64_t* alloc_id,
+                      uint64_t* offset) {
+    Extent ext;
+    if (kind_is_host(kind)) {
+      ext = host_arena_.alloc(nbytes);
+      device_index = 0;
+    } else {
+      if (device_index >= device_books_.size())
+        throw BadHandleError("bad device_index");
+      ext = device_books_[device_index]->alloc(nbytes);
+    }
+    *alloc_id = registry_.next_id();
+    *offset = ext.offset;
+    registry_.insert({*alloc_id, kind, device_index, ext, nbytes, orig_rank,
+                      pid, registry_.new_deadline()});
+  }
+
+  Message on_req_free(const Message& m) {
+    int64_t owner_rank = m.i("rank");
+    if (owner_rank < 0 || size_t(owner_rank) >= entries_.size())
+      throw BadHandleError("bad owner rank " + std::to_string(owner_rank));
+    if (owner_rank == cfg_.rank) {
+      do_free_local(m.u("alloc_id"));
+    } else {
+      NodeEntry owner = entry(owner_rank);
+      Message r = peers_.request(
+          owner.caddr(), owner.port,
+          {MsgType::DO_FREE, {{"alloc_id", Value::U(m.u("alloc_id"))}}, {}});
+      if (r.type == MsgType::ERR) return r;
+    }
+    return {MsgType::FREE_OK, {{"alloc_id", Value::U(m.u("alloc_id"))}}, {}};
+  }
+
+  // dealloc_ate analogue (alloc.c:231-282), plus the rank-0 accounting the
+  // reference stubbed (mem.c:221-229).
+  void do_free_local(uint64_t alloc_id) {
+    RegEntry e = registry_.remove(alloc_id);
+    if (kind_is_host(e.kind)) {
+      // Scrub on free (reference parity: server buffers are calloc'd,
+      // alloc.c:171): the next tenant of this extent reads zeros.
+      std::memset(host_store_.data() + e.extent.offset, 0, e.extent.nbytes);
+      host_arena_.release(e.extent.offset);
+    } else {
+      device_books_[e.device_index]->release(e.extent.offset);
+    }
+    Message note{MsgType::NOTE_FREE,
+                 {{"kind", Value::U(uint64_t(e.kind))},
+                  {"rank", Value::I(cfg_.rank)},
+                  {"device_index", Value::U(e.device_index)},
+                  {"nbytes", Value::U(e.nbytes)}},
+                 {}};
+    if (cfg_.rank == 0) {
+      on_note_free(note);
+    } else {
+      try {
+        NodeEntry r0 = entry(0);
+        peers_.request(r0.caddr(), r0.port, note);
+      } catch (const ProtocolError&) {
+      }
+    }
+  }
+
+  Message on_note_free(const Message& m) {
+    if (cfg_.rank == 0)
+      placement_.note(Kind(uint8_t(m.u("kind"))), m.i("rank"),
+                      uint32_t(m.u("device_index")), m.u("nbytes"),
+                      /*alloc=*/false);
+    return {MsgType::FREE_OK, {{"alloc_id", Value::U(0)}}, {}};
+  }
+
+  Message on_note_alloc(const Message& m) {
+    if (cfg_.rank == 0)
+      placement_.note(Kind(uint8_t(m.u("kind"))), m.i("rank"),
+                      uint32_t(m.u("device_index")), m.u("nbytes"),
+                      /*alloc=*/true);
+    return {MsgType::FREE_OK, {{"alloc_id", Value::U(0)}}, {}};
+  }
+
+  // -- checkpoint / resume (snapshot.py's binary format, interchangeable
+  // with the Python daemon's snapshots) ----------------------------------
+
+  void save_snapshot() {
+    if (cfg_.snapshot_path.empty()) return;
+    std::string tmp = cfg_.snapshot_path + ".tmp";
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) {
+      std::fprintf(stderr, "oncillamemd: snapshot open failed: %s\n",
+                   std::strerror(errno));
+      return;
+    }
+    auto write_all = [&](const uint8_t* p, size_t n) {
+      size_t done = 0;
+      while (done < n) {
+        ssize_t w = ::write(fd, p + done, n - done);
+        if (w <= 0) return false;
+        done += size_t(w);
+      }
+      return true;
+    };
+    // Live arena bytes are written straight from host_store_, entry by
+    // entry, so peak memory overhead is one metadata record — not a full
+    // copy of every live byte (which could double resident memory on a
+    // mostly-full arena at shutdown).
+    std::vector<uint8_t> rec;
+    auto put_le = [&](uint64_t v, int n) {
+      for (int i = 0; i < n; ++i) rec.push_back((v >> (8 * i)) & 0xff);
+    };
+    bool ok = true;
+    rec.insert(rec.end(), {'O', 'C', 'M', 'S'});
+    rec.push_back(1);  // snapshot version
+    put_le(uint64_t(cfg_.rank), 8);
+    put_le(registry_.counter(), 8);
+    auto entries = registry_.all();
+    put_le(entries.size(), 4);
+    ok = write_all(rec.data(), rec.size());
+    for (const RegEntry& e : entries) {
+      if (!ok) break;
+      rec.clear();
+      put_le(e.alloc_id, 8);
+      rec.push_back(uint8_t(e.kind));
+      put_le(e.device_index, 4);
+      put_le(e.extent.offset, 8);
+      put_le(e.nbytes, 8);
+      put_le(uint64_t(e.origin_rank), 8);
+      put_le(uint64_t(e.origin_pid), 8);
+      put_le(kind_is_host(e.kind) ? e.nbytes : 0, 8);
+      ok = write_all(rec.data(), rec.size());
+      if (ok && kind_is_host(e.kind))
+        ok = write_all(host_store_.data() + e.extent.offset, e.nbytes);
+    }
+    if (!ok) {
+      std::fprintf(stderr, "oncillamemd: snapshot write failed: %s\n",
+                   std::strerror(errno));
+      ::close(fd);
+      ::unlink(tmp.c_str());  // never rename a bad snapshot into place
+      return;
+    }
+    if (::fsync(fd) != 0 || ::close(fd) != 0 ||
+        ::rename(tmp.c_str(), cfg_.snapshot_path.c_str()) != 0) {
+      std::fprintf(stderr, "oncillamemd: snapshot finalize failed: %s\n",
+                   std::strerror(errno));
+      ::unlink(tmp.c_str());
+    }
+  }
+
+  void maybe_restore() {
+    if (cfg_.snapshot_path.empty()) return;
+    std::ifstream f(cfg_.snapshot_path, std::ios::binary);
+    if (!f) return;
+    std::vector<uint8_t> raw((std::istreambuf_iterator<char>(f)),
+                             std::istreambuf_iterator<char>());
+    size_t off = 0;
+    auto get_le = [&](int n) -> uint64_t {
+      if (off + n > raw.size()) throw ProtocolError("truncated snapshot");
+      uint64_t v = 0;
+      for (int i = 0; i < n; ++i) v |= uint64_t(raw[off + i]) << (8 * i);
+      off += n;
+      return v;
+    };
+    if (raw.size() < 5 || std::memcmp(raw.data(), "OCMS", 4) != 0)
+      throw ProtocolError("bad snapshot magic");
+    off = 4;
+    if (get_le(1) != 1) throw ProtocolError("unsupported snapshot version");
+    int64_t srank = int64_t(get_le(8));
+    if (srank != cfg_.rank)
+      throw std::runtime_error("snapshot rank mismatch");
+    registry_.restore_counter(get_le(8));
+    uint64_t n = get_le(4);
+    for (uint64_t i = 0; i < n; ++i) {
+      RegEntry e;
+      e.alloc_id = get_le(8);
+      e.kind = Kind(uint8_t(get_le(1)));
+      e.device_index = uint32_t(get_le(4));
+      uint64_t offset = get_le(8);
+      e.nbytes = get_le(8);
+      e.origin_rank = int64_t(get_le(8));
+      e.origin_pid = int64_t(get_le(8));
+      uint64_t dlen = get_le(8);
+      if (kind_is_host(e.kind)) {
+        e.extent = host_arena_.reserve(offset, e.nbytes);
+        if (dlen) {
+          if (off + dlen > raw.size())
+            throw ProtocolError("truncated snapshot data");
+          if (dlen > e.nbytes || offset + dlen > host_store_.size())
+            throw ProtocolError("snapshot data exceeds its extent");
+          std::memcpy(host_store_.data() + offset, raw.data() + off, dlen);
+        }
+      } else {
+        if (e.device_index >= device_books_.size())
+          throw ProtocolError("snapshot device_index out of range for this "
+                              "daemon's --ndevices");
+        e.extent = device_books_[e.device_index]->reserve(offset, e.nbytes);
+      }
+      off += dlen;
+      e.lease_expiry = registry_.new_deadline();
+      registry_.insert(e);
+      // Resync the master's accounting.
+      Message note{MsgType::NOTE_ALLOC,
+                   {{"kind", Value::U(uint64_t(e.kind))},
+                    {"rank", Value::I(cfg_.rank)},
+                    {"device_index", Value::U(e.device_index)},
+                    {"nbytes", Value::U(e.nbytes)}},
+                   {}};
+      if (cfg_.rank == 0) {
+        on_note_alloc(note);
+      } else {
+        try {
+          NodeEntry r0 = entry(0);
+          peers_.request(r0.caddr(), r0.port, note);
+        } catch (const ProtocolError&) {
+        }
+      }
+    }
+    std::printf("oncillamemd rank=%lld restored %llu allocations\n",
+                (long long)cfg_.rank, (unsigned long long)n);
+  }
+
+  // DCN data plane: one-sided put/get into the daemon-owned host arena (the
+  // registered-buffer analogue, alloc.c:171-176).
+  Message on_data_put(const Message& m) {
+    RegEntry e = registry_.lookup(m.u("alloc_id"));
+    if (!kind_is_host(e.kind))
+      throw BadHandleError("DATA_PUT on a device-arm allocation");
+    uint64_t off = m.u("offset"), n = m.u("nbytes");
+    if (m.data.size() != n) throw ProtocolError("DATA_PUT length mismatch");
+    if (off + n > e.nbytes)
+      throw BoundsError("access [" + std::to_string(off) + ", " +
+                        std::to_string(off + n) + ") outside extent of " +
+                        std::to_string(e.nbytes) + " B");
+    std::memcpy(host_store_.data() + e.extent.offset + off, m.data.data(), n);
+    return {MsgType::DATA_PUT_OK, {{"nbytes", Value::U(n)}}, {}};
+  }
+
+  Message on_data_get(const Message& m) {
+    RegEntry e = registry_.lookup(m.u("alloc_id"));
+    if (!kind_is_host(e.kind))
+      throw BadHandleError("DATA_GET on a device-arm allocation");
+    uint64_t off = m.u("offset"), n = m.u("nbytes");
+    if (off + n > e.nbytes)
+      throw BoundsError("access [" + std::to_string(off) + ", " +
+                        std::to_string(off + n) + ") outside extent of " +
+                        std::to_string(e.nbytes) + " B");
+    Message r{MsgType::DATA_GET_OK, {{"nbytes", Value::U(n)}}, {}};
+    r.data.assign(host_store_.begin() + e.extent.offset + off,
+                  host_store_.begin() + e.extent.offset + off + n);
+    return r;
+  }
+
+  Message on_heartbeat(const Message& m) {
+    registry_.renew(m.i("pid"), m.i("rank"));
+    // Relay local-app heartbeats only to the ranks the app reports as
+    // owners of its allocations — O(owners) per beat, not an O(nnodes)
+    // broadcast. Relayed copies have origin rank != receiver rank, so no
+    // forwarding loop.
+    if (m.i("rank") == cfg_.rank) {
+      for (int64_t r : parse_owners(m.s("owners"))) {
+        if (r == cfg_.rank || r < 0 || size_t(r) >= entries_.size()) continue;
+        try {
+          NodeEntry e = entry(r);
+          peers_.request(e.caddr(), e.port, m);
+        } catch (const ProtocolError&) {
+        }
+      }
+    }
+    return {MsgType::HEARTBEAT_OK,
+            {{"lease_s", Value::D(registry_.lease_s())}},
+            {}};
+  }
+
+  // Immediate reclamation on app disconnect (main.c:46-47,58-103): free
+  // local allocations now, and fan RECLAIM_APP out to the owner ranks the
+  // app reported. A crashed app never disconnects — the lease reaper is the
+  // backstop.
+  void on_disconnect(const Message& m) {
+    int64_t pid = m.i("pid");
+    reclaim_app_local(pid, cfg_.rank);
+    for (int64_t r : parse_owners(m.s("owners"))) {
+      if (r == cfg_.rank || r < 0 || size_t(r) >= entries_.size()) continue;
+      try {
+        NodeEntry e = entry(r);
+        peers_.request(e.caddr(), e.port,
+                       {MsgType::RECLAIM_APP,
+                        {{"pid", Value::I(pid)}, {"rank", Value::I(cfg_.rank)}},
+                        {}});
+      } catch (const ProtocolError&) {
+      }
+    }
+  }
+
+  uint64_t reclaim_app_local(int64_t pid, int64_t origin_rank) {
+    uint64_t n = 0;
+    for (uint64_t id : registry_.ids_for_app(pid, origin_rank)) {
+      try {
+        do_free_local(id);
+        ++n;
+      } catch (const BadHandleError&) {  // raced with an explicit free
+      }
+    }
+    return n;
+  }
+
+  static std::vector<int64_t> parse_owners(const std::string& s) {
+    std::vector<int64_t> out;
+    size_t pos = 0;
+    while (pos <= s.size()) {
+      size_t comma = s.find(',', pos);
+      std::string part = s.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      if (!part.empty()) {
+        try {
+          out.push_back(std::stoll(part));
+        } catch (const std::exception&) {
+        }
+      }
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+    return out;
+  }
+
+  Message on_status() {
+    uint64_t dev_live = 0;
+    for (auto& b : device_books_) dev_live += b->bytes_live();
+    return {MsgType::STATUS_OK,
+            {{"rank", Value::I(cfg_.rank)},
+             {"nnodes", Value::I(cfg_.rank == 0 ? placement_.nnodes()
+                                                : int64_t(entries_.size()))},
+             {"live_allocs", Value::U(registry_.live_count())},
+             {"host_bytes_live", Value::U(host_arena_.bytes_live())},
+             {"device_bytes_live", Value::U(dev_live)}},
+            {}};
+  }
+
+  NodeEntry entry(int64_t rank) {
+    std::lock_guard<std::mutex> g(entries_mu_);
+    return entries_.at(size_t(rank));
+  }
+
+  Config cfg_;
+  std::vector<NodeEntry> entries_;
+  std::mutex entries_mu_;
+  ArenaAllocator host_arena_;
+  std::vector<uint8_t> host_store_;  // the DCN arm's actual bytes
+  std::vector<std::unique_ptr<ArenaAllocator>> device_books_;
+  Registry registry_;
+  Placement placement_;
+  PeerPool peers_;
+  std::atomic<bool> running_{false};
+  std::thread reaper_thread_;
+  std::vector<std::thread> serve_threads_;
+  std::mutex reap_mu_;
+  std::vector<std::thread::id> finished_;
+  bool started_ok_ = false;
+  std::mutex conns_mu_;
+  std::set<int> conns_;
+  int listen_fd_ = -1;
+};
+
+Daemon* g_daemon = nullptr;
+
+void on_signal(int) {
+  if (g_daemon) g_daemon->request_stop();
+}
+
+}  // namespace
+}  // namespace ocm
+
+int main(int argc, char** argv) {
+  ocm::Config cfg;
+  if (const char* bh = getenv("OCM_BIND_HOST")) cfg.bind_host = bh;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) throw std::runtime_error("missing value for " + a);
+      return argv[++i];
+    };
+    if (a == "--nodefile") cfg.nodefile = next();
+    else if (a == "--rank") cfg.rank = std::stoll(next());
+    else if (a == "--policy") cfg.capacity_policy = next() == "capacity";
+    else if (a == "--ndevices") cfg.ndevices = uint32_t(std::stoul(next()));
+    else if (a == "--host-arena-bytes") cfg.host_arena_bytes = std::stoull(next());
+    else if (a == "--device-arena-bytes") cfg.device_arena_bytes = std::stoull(next());
+    else if (a == "--alignment") cfg.alignment = std::stoull(next());
+    else if (a == "--lease-s") cfg.lease_s = std::stod(next());
+    else if (a == "--heartbeat-s") cfg.heartbeat_s = std::stod(next());
+    else if (a == "--snapshot") cfg.snapshot_path = next();
+    else if (a == "--bind-host") cfg.bind_host = next();
+    else {
+      std::fprintf(stderr, "unknown flag %s\n", a.c_str());
+      return 2;
+    }
+  }
+  if (cfg.nodefile.empty() || cfg.rank < 0) {
+    std::fprintf(stderr,
+                 "usage: oncillamemd --nodefile FILE --rank N [--policy "
+                 "capacity|neighbor] [--ndevices N] [--host-arena-bytes N] "
+                 "[--device-arena-bytes N] [--alignment N] [--lease-s S] "
+                 "[--heartbeat-s S]\n");
+    return 2;
+  }
+  try {
+    auto entries = ocm::parse_nodefile(cfg.nodefile);
+    ocm::Daemon d(cfg, entries);
+    ocm::g_daemon = &d;
+    signal(SIGINT, ocm::on_signal);
+    signal(SIGTERM, ocm::on_signal);
+    d.run();
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "oncillamemd: fatal: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

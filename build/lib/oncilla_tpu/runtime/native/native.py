@@ -1,0 +1,114 @@
+"""Build/launch helpers for the native daemon (oncillamemd).
+
+The Python daemon (runtime/daemon.py) is the executable spec; oncillamemd is
+the production twin. Both speak the identical wire protocol, so
+ControlPlaneClient works unchanged against either.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).resolve().parent
+BUILD_DIR = NATIVE_DIR / "build"
+BINARY = BUILD_DIR / "oncillamemd"
+
+
+def _stale(target: Path) -> bool:
+    srcs = [
+        *NATIVE_DIR.glob("*.cc"),
+        *NATIVE_DIR.glob("*.c"),
+        *NATIVE_DIR.glob("*.hh"),
+        *NATIVE_DIR.glob("*.h"),
+        NATIVE_DIR / "CMakeLists.txt",
+    ]
+    return target.stat().st_mtime < max(p.stat().st_mtime for p in srcs)
+
+
+def build(force: bool = False, tsan: bool = False) -> Path:
+    """Build oncillamemd with CMake (+ Ninja when available); cached, but
+    rebuilt whenever any native source is newer than the binary (a stale
+    cached binary would silently test old daemon code)."""
+    target = BUILD_DIR / ("oncillamemd_tsan" if tsan else "oncillamemd")
+    if target.exists() and not force and not _stale(target):
+        return target
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
+    if tsan:
+        cfg.append("-DOCM_TSAN=ON")
+    subprocess.run(cfg, check=True, capture_output=True)
+    subprocess.run(
+        ["cmake", "--build", str(BUILD_DIR)], check=True, capture_output=True
+    )
+    return target
+
+
+def spawn(
+    nodefile: str,
+    rank: int,
+    *,
+    policy: str = "capacity",
+    ndevices: int = 1,
+    host_arena_bytes: int | None = None,
+    device_arena_bytes: int | None = None,
+    lease_s: float | None = None,
+    heartbeat_s: float | None = None,
+    tsan: bool = False,
+    snapshot: str | None = None,
+    env: dict | None = None,
+    log_path: str | None = None,
+) -> subprocess.Popen:
+    """Launch one native daemon process (``bin/oncillamem nodefile``
+    analogue)."""
+    binary = build(tsan=tsan)
+    cmd = [
+        str(binary),
+        "--nodefile", nodefile,
+        "--rank", str(rank),
+        "--policy", policy,
+        "--ndevices", str(ndevices),
+    ]
+    if host_arena_bytes is not None:
+        cmd += ["--host-arena-bytes", str(host_arena_bytes)]
+    if device_arena_bytes is not None:
+        cmd += ["--device-arena-bytes", str(device_arena_bytes)]
+    if lease_s is not None:
+        cmd += ["--lease-s", str(lease_s)]
+    if heartbeat_s is not None:
+        cmd += ["--heartbeat-s", str(heartbeat_s)]
+    if snapshot is not None:
+        cmd += ["--snapshot", snapshot]
+    # Spool output to a file when asked: an undrained PIPE caps at ~64KB and
+    # a chatty child (e.g. TSan reports) would block writing to it.
+    out = open(log_path, "wb") if log_path is not None else subprocess.PIPE
+    try:
+        return subprocess.Popen(
+            cmd,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, **(env or {})},
+        )
+    finally:
+        if log_path is not None:
+            out.close()  # child keeps its own descriptor
+
+
+def build_lib(force: bool = False) -> Path:
+    """Build and return libocm_tpu.so — the C-linkable client library
+    (the app-linked libocm.so analogue, /root/reference/SConstruct:176)."""
+    target = BUILD_DIR / "libocm_tpu.so"
+    if target.exists() and not force and not _stale(target):
+        return target
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", str(BUILD_DIR), "--target", "ocm_tpu", "ocm_c_demo"],
+        check=True, capture_output=True,
+    )
+    return target

@@ -1,0 +1,88 @@
+// Socket plumbing shared by the daemon and the C client library
+// (conn_put/conn_get analogue, /root/reference/src/sock.c): length-exact
+// framed send/recv of protocol.hh messages over blocking TCP, plus dial().
+
+#pragma once
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "protocol.hh"
+
+namespace ocm {
+
+inline void send_all(int fd, const uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) throw ProtocolError("send failed");
+    p += w;
+    n -= size_t(w);
+  }
+}
+
+// Read exactly n bytes. eof_ok permits a clean EOF *before the first
+// byte* (returns false); EOF mid-read always throws (protocol.py
+// _recv_exact semantics). Socket errors (r < 0) are reported with errno —
+// a reset from a crashed peer is not "malformed input".
+inline bool recv_all(int fd, uint8_t* p, size_t n, bool eof_ok = false) {
+  size_t want = n;
+  while (want) {
+    ssize_t r = ::recv(fd, p, want, 0);
+    if (r < 0)
+      throw ProtocolError(std::string("recv failed: ") + strerror(errno));
+    if (r == 0) {
+      if (eof_ok && want == n) return false;
+      throw ProtocolError(want == n ? "peer closed" : "peer closed mid-message");
+    }
+    p += r;
+    want -= size_t(r);
+  }
+  return true;
+}
+
+inline void send_msg(int fd, const Message& m) {
+  auto buf = pack(m);
+  send_all(fd, buf.data(), buf.size());
+}
+
+inline Message recv_msg(int fd) {
+  uint8_t header[kHeaderSize];
+  if (!recv_all(fd, header, kHeaderSize, /*eof_ok=*/true))
+    throw ProtocolError("peer closed");
+  uint64_t plen = 0;
+  for (int i = 0; i < 4; ++i) plen |= uint64_t(header[8 + i]) << (8 * i);
+  if (plen > kMaxPayload) throw ProtocolError("advertised payload too large");
+  std::vector<uint8_t> payload(plen);
+  if (plen) recv_all(fd, payload.data(), plen);
+  return unpack(header, payload.data(), plen);
+}
+
+inline int dial(const std::string& host, int port) {
+  struct addrinfo hints = {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res))
+    throw ProtocolError("resolve failed for " + host);
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd >= 0) ::close(fd);
+    throw ProtocolError("connect failed to " + host + ":" +
+                        std::to_string(port));
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+}  // namespace ocm

@@ -1,0 +1,116 @@
+// Wire protocol for the oncilla-tpu control plane, C++ side.
+//
+// Byte-for-byte identical to oncilla_tpu/runtime/protocol.py (the executable
+// spec): frame = "OCM1" | version u8 | type u8 | flags u16 | payload_len u32,
+// all little-endian, strings u16-length-prefixed UTF-8, raw data trailing.
+// The reference shipped raw C structs over TCP with no versioning
+// (/root/reference/src/mem.c:63-88); this replaces that scheme.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ocm {
+
+constexpr char kMagic[4] = {'O', 'C', 'M', '1'};
+// v2: owners field on DISCONNECT/HEARTBEAT, RECLAIM_APP (protocol.py).
+constexpr uint8_t kVersion = 2;
+constexpr size_t kHeaderSize = 12;
+constexpr uint64_t kMaxPayload = 64ull << 20;
+
+enum class MsgType : uint8_t {
+  CONNECT = 1,
+  CONNECT_CONFIRM = 2,
+  DISCONNECT = 3,
+  ADD_NODE = 10,
+  ADD_NODE_OK = 11,
+  REQ_ALLOC = 12,
+  ALLOC_PLACED = 13,
+  DO_ALLOC = 14,
+  DO_ALLOC_OK = 15,
+  REQ_FREE = 16,
+  DO_FREE = 17,
+  FREE_OK = 18,
+  ALLOC_RESULT = 19,
+  NOTE_FREE = 20,
+  NOTE_ALLOC = 21,
+  RECLAIM_APP = 22,
+  RECLAIM_APP_OK = 23,
+  DATA_PUT = 30,
+  DATA_PUT_OK = 31,
+  DATA_GET = 32,
+  DATA_GET_OK = 33,
+  HEARTBEAT = 40,
+  HEARTBEAT_OK = 41,
+  STATUS = 42,
+  STATUS_OK = 43,
+  ERR = 99,
+};
+
+enum class ErrCode : uint32_t {
+  UNKNOWN = 0,
+  OOM = 1,
+  BAD_ALLOC_ID = 2,
+  BOUNDS = 3,
+  BAD_MSG = 4,
+  PLACEMENT = 5,
+  NOT_MASTER = 6,
+};
+
+// Wire kind tags (protocol.py WIRE_KIND).
+enum class Kind : uint8_t {
+  LOCAL_HOST = 0,
+  LOCAL_DEVICE = 1,
+  REMOTE_DEVICE = 2,
+  REMOTE_HOST = 3,
+};
+
+inline bool kind_is_host(Kind k) {
+  return k == Kind::LOCAL_HOST || k == Kind::REMOTE_HOST;
+}
+
+struct ProtocolError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// A field value: integers (stored as u64 two's complement), doubles, strings.
+struct Value {
+  enum class Tag { I64, U64, F64, STR } tag = Tag::U64;
+  int64_t i64 = 0;
+  uint64_t u64 = 0;
+  double f64 = 0.0;
+  std::string str;
+
+  static Value I(int64_t v) { Value x; x.tag = Tag::I64; x.i64 = v; return x; }
+  static Value U(uint64_t v) { Value x; x.tag = Tag::U64; x.u64 = v; return x; }
+  static Value D(double v) { Value x; x.tag = Tag::F64; x.f64 = v; return x; }
+  static Value S(std::string v) {
+    Value x; x.tag = Tag::STR; x.str = std::move(v); return x;
+  }
+};
+
+struct Message {
+  MsgType type;
+  std::map<std::string, Value> fields;
+  std::vector<uint8_t> data;
+
+  int64_t i(const std::string& k) const { return fields.at(k).i64; }
+  uint64_t u(const std::string& k) const { return fields.at(k).u64; }
+  const std::string& s(const std::string& k) const { return fields.at(k).str; }
+};
+
+// Schema: field name + struct char ('q' i64, 'Q' u64, 'I' u32, 'B' u8,
+// 'd' f64, 's' string) in wire order — mirrors protocol.py _SCHEMAS.
+struct Field { const char* name; char fmt; };
+
+const std::vector<Field>& schema(MsgType t);
+
+std::vector<uint8_t> pack(const Message& m);
+Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen);
+
+}  // namespace ocm

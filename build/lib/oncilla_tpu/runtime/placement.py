@@ -1,0 +1,165 @@
+"""Placement policy: which node/device hosts a new allocation.
+
+The reference's ``alloc_find`` (/root/reference/src/alloc.c:77-140) is the
+rank-0 placement policy: force local host memory when single-node
+(alloc.c:82-83), else fixed neighbor round-robin ``(orig_rank+1) % nnodes``
+(alloc.c:107,120 — marked /* XXX */), with capacity validation commented out
+(alloc.c:87-92). Here placement is pluggable; the neighbor policy reproduces
+reference behavior, and the capacity-aware policy is the upgrade SURVEY.md §7
+("Hard parts") calls for: per-chip HBM accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from oncilla_tpu.core.errors import OcmPlacementError
+from oncilla_tpu.core.kinds import OcmKind
+
+
+@dataclass
+class NodeResources:
+    """What rank 0 knows about one node, reported at ADD_NODE time
+    (alloc_add_node analogue, alloc.c:60-74) and updated on alloc/free."""
+
+    rank: int
+    ndevices: int
+    device_arena_bytes: int
+    host_arena_bytes: int
+    device_used: list[int] = field(default_factory=list)
+    host_used: int = 0
+
+    def __post_init__(self):
+        if not self.device_used:
+            self.device_used = [0] * self.ndevices
+
+
+@dataclass(frozen=True)
+class Placement:
+    rank: int
+    device_index: int
+    kind: OcmKind
+
+
+class PlacementPolicy:
+    """Tracks cluster resources and sites allocations. Thread-safe."""
+
+    def __init__(self):
+        self._nodes: dict[int, NodeResources] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # -- membership ------------------------------------------------------
+
+    def add_node(self, res: NodeResources) -> None:
+        with self._lock:
+            self._nodes[res.rank] = res
+
+    @property
+    def nnodes(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    # -- accounting ------------------------------------------------------
+
+    def note_alloc(self, p: Placement, nbytes: int) -> None:
+        with self._lock:
+            node = self._nodes[p.rank]
+            if p.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                node.host_used += nbytes
+            else:
+                node.device_used[p.device_index] += nbytes
+
+    def note_free(self, p: Placement, nbytes: int) -> None:
+        with self._lock:
+            node = self._nodes[p.rank]
+            if p.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                node.host_used = max(0, node.host_used - nbytes)
+            else:
+                node.device_used[p.device_index] = max(
+                    0, node.device_used[p.device_index] - nbytes
+                )
+
+    # -- policy ----------------------------------------------------------
+
+    def place(self, orig_rank: int, kind: OcmKind, nbytes: int) -> Placement:
+        raise NotImplementedError
+
+
+class NeighborRoundRobin(PlacementPolicy):
+    """Reference-parity policy: remote allocations go to
+    ``(orig_rank + 1) % nnodes`` (alloc.c:107,120), single node demotes to
+    local (alloc.c:82-83). Device chosen round-robin within the node."""
+
+    def place(self, orig_rank: int, kind: OcmKind, nbytes: int) -> Placement:
+        with self._lock:
+            n = len(self._nodes)
+            if n == 0:
+                raise OcmPlacementError("no nodes registered")
+            if n == 1 and kind.is_remote:
+                # Single-node demotion, alloc.c:82-83.
+                kind = (
+                    OcmKind.LOCAL_DEVICE
+                    if kind == OcmKind.REMOTE_DEVICE
+                    else OcmKind.LOCAL_HOST
+                )
+                return Placement(rank=orig_rank, device_index=0, kind=kind)
+            rank = (orig_rank + 1) % n
+            node = self._nodes[rank]
+            if kind == OcmKind.REMOTE_HOST:
+                return Placement(rank=rank, device_index=0, kind=kind)
+            self._rr += 1
+            return Placement(
+                rank=rank,
+                device_index=self._rr % max(1, node.ndevices),
+                kind=kind,
+            )
+
+
+class CapacityAware(PlacementPolicy):
+    """Pick the (node, device) with the most free bytes that can actually fit
+    the request — the accounting the reference commented out
+    (alloc.c:87-92) made real. Never places on the origin rank when another
+    node fits (disaggregation intent)."""
+
+    def place(self, orig_rank: int, kind: OcmKind, nbytes: int) -> Placement:
+        with self._lock:
+            if not self._nodes:
+                raise OcmPlacementError("no nodes registered")
+            n = len(self._nodes)
+            if n == 1 and kind.is_remote:
+                kind = (
+                    OcmKind.LOCAL_DEVICE
+                    if kind == OcmKind.REMOTE_DEVICE
+                    else OcmKind.LOCAL_HOST
+                )
+                return Placement(rank=orig_rank, device_index=0, kind=kind)
+
+            candidates: list[tuple[int, Placement]] = []
+            for rank, node in self._nodes.items():
+                prefer_remote = 0 if rank != orig_rank else -(1 << 62)
+                if kind == OcmKind.REMOTE_HOST:
+                    free = node.host_arena_bytes - node.host_used
+                    if free >= nbytes:
+                        candidates.append(
+                            (free + prefer_remote, Placement(rank, 0, kind))
+                        )
+                else:
+                    for di in range(node.ndevices):
+                        free = node.device_arena_bytes - node.device_used[di]
+                        if free >= nbytes:
+                            candidates.append(
+                                (free + prefer_remote, Placement(rank, di, kind))
+                            )
+            if not candidates:
+                raise OcmPlacementError(
+                    f"no node can fit {nbytes} B of {kind.value}"
+                )
+            return max(candidates, key=lambda c: c[0])[1]
+
+
+POLICIES = {
+    "neighbor": NeighborRoundRobin,
+    "capacity": CapacityAware,
+}

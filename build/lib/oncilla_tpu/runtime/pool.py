@@ -1,0 +1,88 @@
+"""Shared cached-connection pool for daemon⇄daemon and app⇄owner traffic.
+
+One implementation serves both sides (the client previously duplicated this
+logic without reconnect handling). Semantics are deliberately conservative:
+
+- A peer's well-formed ERROR reply (:class:`OcmRemoteError`) leaves the
+  connection cached — it is still in sync.
+- A transport failure (OSError, malformed frame) **evicts** the connection
+  and raises; the pool never re-sends a request, because control messages
+  are not idempotent (a re-sent DO_ALLOC would leak an extent, a re-sent
+  DO_FREE would report a spurious unknown-id error). Callers with
+  idempotent messages (ADD_NODE, HEARTBEAT) retry themselves.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from oncilla_tpu.core.errors import (
+    OcmConnectError,
+    OcmProtocolError,
+    OcmRemoteError,
+)
+from oncilla_tpu.runtime.protocol import Message, request
+
+
+class PeerPool:
+    """Cached connections keyed by (host, port), one lock per connection."""
+
+    def __init__(self, timeout: float = 30.0):
+        self._timeout = timeout
+        self._conns: dict[tuple[str, int], tuple[socket.socket, threading.Lock]] = {}
+        self._lock = threading.Lock()
+
+    def connection(self, host: str, port: int) -> tuple[socket.socket, threading.Lock]:
+        """The cached (socket, lock) pair, connecting if needed. Callers
+        doing multi-frame pipelining hold the lock for the whole exchange
+        and call :meth:`evict` on any transport error."""
+        key = (host, port)
+        with self._lock:
+            entry = self._conns.get(key)
+        if entry is not None:
+            return entry
+        try:
+            s = socket.create_connection(key, timeout=self._timeout)
+        except OSError as e:
+            raise OcmConnectError(f"peer {host}:{port} unreachable: {e}") from e
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        entry = (s, threading.Lock())
+        with self._lock:
+            # Lost a race with another thread? Keep the first, close ours.
+            existing = self._conns.get(key)
+            if existing is not None:
+                s.close()
+                return existing
+            self._conns[key] = entry
+        return entry
+
+    def evict(self, host: str, port: int) -> None:
+        with self._lock:
+            entry = self._conns.pop((host, port), None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def request(self, host: str, port: int, msg: Message) -> Message:
+        """One request/reply. No resend on failure (see module docstring)."""
+        s, lk = self.connection(host, port)
+        try:
+            with lk:
+                return request(s, msg)
+        except OcmRemoteError:
+            raise  # connection still in sync
+        except (OSError, OcmProtocolError) as e:
+            self.evict(host, port)
+            raise OcmConnectError(f"peer {host}:{port} failed: {e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            for s, _ in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()

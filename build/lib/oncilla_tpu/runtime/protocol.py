@@ -1,0 +1,298 @@
+"""Control-plane wire protocol.
+
+The reference ships raw fixed-size C structs over TCP with no versioning or
+endianness handling (``send_recv_msg``, /root/reference/src/mem.c:63-88), a
+homogeneous-architecture assumption SURVEY.md flags as a bug to replace. This
+module defines a versioned, explicitly little-endian framed protocol spoken
+identically by the Python client/daemon and the C++ daemon
+(oncilla_tpu/runtime/native/daemon.cc).
+
+Frame:  magic "OCM1" (4 B) | version u8 | type u8 | flags u16 | payload_len u32
+Payload: type-specific packed fields, strings length-prefixed (u16 + utf-8),
+raw data carried after the fixed fields (DATA_PUT / DATA_GET_OK).
+
+Message set mirrors /root/reference/inc/msg.h:24-45 (CONNECT, ADD_NODE,
+REQ_ALLOC, DO_ALLOC, REQ_FREE, DO_FREE, RELEASE_APP) plus the capability
+upgrades: DATA_PUT/DATA_GET (the DCN data plane), HEARTBEAT (leases — the
+reference's unresolved liveness TODO, main.c:6-7), and STATUS for
+observability.
+"""
+
+from __future__ import annotations
+
+import enum
+import socket
+import struct
+from dataclasses import dataclass, field
+
+from oncilla_tpu.core.errors import OcmProtocolError, OcmRemoteError
+
+MAGIC = b"OCM1"
+VERSION = 2  # v2: owners field on DISCONNECT/HEARTBEAT, RECLAIM_APP
+HEADER = struct.Struct("<4sBBHI")  # magic, version, type, flags, payload_len
+MAX_PAYLOAD = 64 << 20  # sanity cap; large transfers are chunked above this
+
+
+class MsgType(enum.IntEnum):
+    # app <-> local daemon (reference: pmsg mailbox messages)
+    CONNECT = 1
+    CONNECT_CONFIRM = 2
+    DISCONNECT = 3
+    # daemon <-> daemon control (reference: mem.c TCP messages)
+    ADD_NODE = 10
+    ADD_NODE_OK = 11
+    REQ_ALLOC = 12          # origin -> rank 0: place this allocation
+    ALLOC_PLACED = 13       # rank 0 -> origin: (rank, device, kind)
+    DO_ALLOC = 14           # origin -> owner: reserve the extent
+    DO_ALLOC_OK = 15        # owner -> origin: (alloc_id, offset)
+    REQ_FREE = 16
+    DO_FREE = 17
+    FREE_OK = 18
+    ALLOC_RESULT = 19       # local daemon -> app: the complete handle
+    NOTE_FREE = 20          # owner -> rank 0: update placement accounting
+    NOTE_ALLOC = 21         # restored owner -> rank 0: resync accounting
+    RECLAIM_APP = 22        # origin daemon -> owner: free a dead app's allocs
+    RECLAIM_APP_OK = 23
+    # DCN data plane (reference: the per-fabric one-sided put/get)
+    DATA_PUT = 30
+    DATA_PUT_OK = 31
+    DATA_GET = 32
+    DATA_GET_OK = 33
+    # liveness + observability (capability upgrades)
+    HEARTBEAT = 40
+    HEARTBEAT_OK = 41
+    STATUS = 42
+    STATUS_OK = 43
+    # failure
+    ERROR = 99
+
+
+# Kind tags on the wire (stable small ints, not Python enum identities).
+WIRE_KIND = {
+    "local_host": 0,
+    "local_device": 1,
+    "remote_device": 2,
+    "remote_host": 3,
+}
+WIRE_KIND_INV = {v: k for k, v in WIRE_KIND.items()}
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) > 0xFFFF:
+        raise OcmProtocolError("string field too long")
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(buf: bytes, off: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    if off + n > len(buf):  # a silent short slice would hide truncation
+        raise OcmProtocolError("truncated string field")
+    return buf[off : off + n].decode("utf-8"), off + n
+
+
+@dataclass
+class Message:
+    type: MsgType
+    fields: dict = field(default_factory=dict)
+    data: bytes = b""
+
+    def __repr__(self) -> str:  # data elided for log hygiene
+        return f"Message({self.type.name}, {self.fields}, data={len(self.data)}B)"
+
+
+# Payload schemas: (field_name, struct_char or "s" for string) in order.
+# "q" = i64, "Q" = u64, "I" = u32, "B" = u8, "d" = f64, "s" = string.
+_SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
+    MsgType.CONNECT: [("pid", "q"), ("rank", "q")],
+    MsgType.CONNECT_CONFIRM: [("rank", "q"), ("nnodes", "q")],
+    # "owners" on DISCONNECT/HEARTBEAT is the comma-separated set of ranks
+    # holding this app's remote allocations, tracked app-side (the app is
+    # the source of truth for its own handles, and the set survives daemon
+    # restarts). Bounds reclamation/relay fan-out to O(owners), not
+    # O(nnodes).
+    MsgType.DISCONNECT: [("pid", "q"), ("owners", "s")],
+    MsgType.ADD_NODE: [
+        ("rank", "q"),
+        ("host", "s"),
+        ("port", "I"),
+        ("ndevices", "I"),
+        ("device_arena_bytes", "Q"),
+        ("host_arena_bytes", "Q"),
+    ],
+    MsgType.ADD_NODE_OK: [("nnodes", "q")],
+    MsgType.REQ_ALLOC: [
+        ("orig_rank", "q"),
+        ("pid", "q"),
+        ("kind", "B"),
+        ("nbytes", "Q"),
+    ],
+    MsgType.ALLOC_PLACED: [
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("kind", "B"),
+    ],
+    MsgType.DO_ALLOC: [
+        ("orig_rank", "q"),
+        ("pid", "q"),
+        ("kind", "B"),
+        ("device_index", "I"),
+        ("nbytes", "Q"),
+    ],
+    MsgType.DO_ALLOC_OK: [("alloc_id", "Q"), ("offset", "Q")],
+    MsgType.REQ_FREE: [("alloc_id", "Q"), ("rank", "q")],
+    MsgType.ALLOC_RESULT: [
+        ("alloc_id", "Q"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("kind", "B"),
+        ("offset", "Q"),
+        ("nbytes", "Q"),
+        ("owner_host", "s"),
+        ("owner_port", "I"),
+    ],
+    MsgType.NOTE_FREE: [
+        ("kind", "B"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("nbytes", "Q"),
+    ],
+    MsgType.NOTE_ALLOC: [
+        ("kind", "B"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("nbytes", "Q"),
+    ],
+    MsgType.DO_FREE: [("alloc_id", "Q")],
+    MsgType.FREE_OK: [("alloc_id", "Q")],
+    MsgType.RECLAIM_APP: [("pid", "q"), ("rank", "q")],
+    MsgType.RECLAIM_APP_OK: [("count", "Q")],
+    MsgType.DATA_PUT: [("alloc_id", "Q"), ("offset", "Q"), ("nbytes", "Q")],
+    MsgType.DATA_PUT_OK: [("nbytes", "Q")],
+    MsgType.DATA_GET: [("alloc_id", "Q"), ("offset", "Q"), ("nbytes", "Q")],
+    MsgType.DATA_GET_OK: [("nbytes", "Q")],
+    MsgType.HEARTBEAT: [("rank", "q"), ("pid", "q"), ("owners", "s")],
+    MsgType.HEARTBEAT_OK: [("lease_s", "d")],
+    MsgType.STATUS: [],
+    MsgType.STATUS_OK: [
+        ("rank", "q"),
+        ("nnodes", "q"),
+        ("live_allocs", "Q"),
+        ("host_bytes_live", "Q"),
+        ("device_bytes_live", "Q"),
+    ],
+    MsgType.ERROR: [("code", "I"), ("detail", "s")],
+}
+
+
+class ErrCode(enum.IntEnum):
+    UNKNOWN = 0
+    OOM = 1
+    BAD_ALLOC_ID = 2
+    BOUNDS = 3
+    BAD_MSG = 4
+    PLACEMENT = 5
+    NOT_MASTER = 6
+
+
+def pack(msg: Message) -> bytes:
+    schema = _SCHEMAS.get(msg.type)
+    if schema is None:
+        raise OcmProtocolError(f"no schema for {msg.type}")
+    out = bytearray()
+    for name, fmt in schema:
+        v = msg.fields[name]
+        if fmt == "s":
+            out += _pack_str(v)
+        else:
+            out += struct.pack("<" + fmt, v)
+    out += msg.data
+    payload = bytes(out)
+    if len(payload) > MAX_PAYLOAD:
+        raise OcmProtocolError(f"payload {len(payload)} exceeds cap")
+    return HEADER.pack(MAGIC, VERSION, int(msg.type), 0, len(payload)) + payload
+
+
+def unpack(header: bytes, payload: bytes) -> Message:
+    try:
+        magic, version, mtype, _flags, plen = HEADER.unpack(header)
+    except struct.error as e:
+        raise OcmProtocolError(f"short header: {e}") from e
+    if magic != MAGIC:
+        raise OcmProtocolError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise OcmProtocolError(f"unsupported protocol version {version}")
+    if plen != len(payload):
+        raise OcmProtocolError("length mismatch")
+    try:
+        mtype = MsgType(mtype)
+    except ValueError as e:
+        raise OcmProtocolError(f"unknown message type {mtype}") from e
+    schema = _SCHEMAS[mtype]
+    fields = {}
+    off = 0
+    # The payload is untrusted wire input: truncated fields and invalid
+    # UTF-8 must surface as protocol errors, not struct/unicode internals.
+    try:
+        for name, fmt in schema:
+            if fmt == "s":
+                fields[name], off = _unpack_str(payload, off)
+            else:
+                st = struct.Struct("<" + fmt)
+                (fields[name],) = st.unpack_from(payload, off)
+                off += st.size
+    except (struct.error, UnicodeDecodeError) as e:
+        raise OcmProtocolError(
+            f"malformed {mtype.name} payload: {e}"
+        ) from e
+    return Message(mtype, fields, payload[off:])
+
+
+# -- blocking socket transport (conn_put/conn_get analogue, sock.c:215-253) --
+
+
+def send_msg(sock: socket.socket, msg: Message) -> None:
+    sock.sendall(pack(msg))
+
+
+def _recv_exact(sock: socket.socket, n: int, eof_ok: bool = False) -> bytes:
+    """Read exactly n bytes. ``eof_ok`` permits a clean EOF *before the
+    first byte* (returning b"") — EOF mid-message always raises."""
+    chunks = []
+    want = n
+    while want:
+        b = sock.recv(min(want, 1 << 20))
+        if not b:
+            if eof_ok and want == n:
+                return b""
+            raise OcmProtocolError("peer closed mid-message")
+        chunks.append(b)
+        want -= len(b)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Message:
+    header = _recv_exact(sock, HEADER.size, eof_ok=True)
+    if not header:
+        # Clean disconnect at a frame boundary — ordinary, not an anomaly.
+        raise OcmProtocolError("peer closed")
+    _, _, _, _, plen = HEADER.unpack(header)
+    if plen > MAX_PAYLOAD:
+        raise OcmProtocolError(f"advertised payload {plen} exceeds cap")
+    payload = _recv_exact(sock, plen) if plen else b""
+    return unpack(header, payload)
+
+
+def request(sock: socket.socket, msg: Message) -> Message:
+    """Send and await the reply (``send_recv_msg`` analogue, mem.c:63-88).
+    An ERROR reply raises :class:`OcmRemoteError` — the connection stays in
+    sync and reusable, unlike transport-level OcmProtocolError."""
+    send_msg(sock, msg)
+    reply = recv_msg(sock)
+    if reply.type == MsgType.ERROR:
+        raise OcmRemoteError(
+            reply.fields["code"],
+            f"{ErrCode(reply.fields['code']).name}: {reply.fields['detail']}",
+        )
+    return reply

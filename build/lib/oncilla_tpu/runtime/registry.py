@@ -1,0 +1,122 @@
+"""Allocation registry with monotonic ids and leases.
+
+Analogue of the reference's ``rem_alloc_id`` counter + per-node allocation
+lists (/root/reference/src/mem.c:45,345-348; alloc.c:41-43,242-255), with two
+fixes SURVEY.md mandates: the rank-0 bookkeeping actually removes entries on
+free (the reference's ``root_allocs`` list grows forever, alloc.c:134-137,
+and its free path is a stub, mem.c:221-229), and entries carry leases so a
+dead app's allocations are reclaimed (the unresolved TODO, main.c:6-7).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from oncilla_tpu.core.arena import Extent
+from oncilla_tpu.core.errors import OcmInvalidHandle
+from oncilla_tpu.core.kinds import OcmKind
+
+
+@dataclass
+class RegEntry:
+    alloc_id: int
+    kind: OcmKind
+    rank: int            # owner rank
+    device_index: int
+    extent: Extent
+    nbytes: int          # user-requested size
+    origin_rank: int
+    origin_pid: int
+    lease_expiry: float  # absolute monotonic deadline; renewed by heartbeat
+
+
+class AllocRegistry:
+    """Owner-side registry of live allocations. Ids are even and globally
+    unique per daemon: ``id = rank * 2^32 + counter*2`` (apps use odd local
+    ids, so the spaces never collide)."""
+
+    def __init__(self, rank: int, lease_s: float = 30.0):
+        self._rank = rank
+        self._lease_s = lease_s
+        self._counter = 0
+        self._entries: dict[int, RegEntry] = {}
+        self._lock = threading.Lock()
+
+    def next_id(self) -> int:
+        with self._lock:
+            self._counter += 1
+            return (self._rank << 32) | (self._counter << 1)
+
+    @property
+    def counter(self) -> int:
+        with self._lock:
+            return self._counter
+
+    def restore_counter(self, value: int) -> None:
+        with self._lock:
+            self._counter = max(self._counter, value)
+
+    def insert(self, entry: RegEntry) -> None:
+        with self._lock:
+            self._entries[entry.alloc_id] = entry
+
+    def lookup(self, alloc_id: int) -> RegEntry:
+        with self._lock:
+            e = self._entries.get(alloc_id)
+        if e is None:
+            raise OcmInvalidHandle(f"unknown alloc_id {alloc_id}")
+        return e
+
+    def remove(self, alloc_id: int) -> RegEntry:
+        with self._lock:
+            e = self._entries.pop(alloc_id, None)
+        if e is None:
+            raise OcmInvalidHandle(f"unknown alloc_id {alloc_id}")
+        return e
+
+    def renew_leases(self, origin_pid: int, origin_rank: int) -> None:
+        deadline = time.monotonic() + self._lease_s
+        with self._lock:
+            for e in self._entries.values():
+                if e.origin_pid == origin_pid and e.origin_rank == origin_rank:
+                    e.lease_expiry = deadline
+
+    def for_app(self, origin_pid: int, origin_rank: int) -> list[RegEntry]:
+        """Every allocation originated by an app — feeds the disconnect-time
+        reclamation the reference left as a TODO
+        (/root/reference/src/main.c:6-7,58-103)."""
+        with self._lock:
+            return [
+                e for e in self._entries.values()
+                if e.origin_pid == origin_pid and e.origin_rank == origin_rank
+            ]
+
+    def expired(self, now: float | None = None) -> list[RegEntry]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [e for e in self._entries.values() if e.lease_expiry < now]
+
+    def new_lease_deadline(self) -> float:
+        return time.monotonic() + self._lease_s
+
+    @property
+    def lease_s(self) -> float:
+        return self._lease_s
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def live_bytes(self, kind_filter=None) -> int:
+        with self._lock:
+            return sum(
+                e.extent.nbytes
+                for e in self._entries.values()
+                if kind_filter is None or e.kind == kind_filter
+            )
+
+    def snapshot(self) -> list[RegEntry]:
+        with self._lock:
+            return list(self._entries.values())

@@ -1,0 +1,62 @@
+"""Configuration.
+
+The reference's config surface is (a) the positional nodefile text format
+``#rank hostname eth_ip ocm_port rdmacm_port`` (/root/reference/src/
+nodefile.c:30-37), (b) env var ``OCM_VERBOSE`` (/root/reference/inc/
+debug.h:22), and (c) compile-time fabric flags (SConstruct:96-122). Here the
+same knobs are a dataclass with env-var overrides, and fabric selection is
+runtime (both fabrics always built, as SConstruct:122 allowed).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+@dataclass
+class OcmConfig:
+    # Arena capacities. The reference sizes buffers per-allocation at
+    # registration time; we pre-reserve arenas (HBM must be carved out of the
+    # chip up front to be remotely addressable).
+    host_arena_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_HOST_ARENA_BYTES", 256 << 20)
+    )
+    device_arena_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_DEVICE_ARENA_BYTES", 128 << 20)
+    )
+    # 4096 = the Pallas data-plane block (one (32,128) uint8 tile): extents
+    # aligned to it let the remote-DMA kernels address HBM by whole blocks
+    # (Mosaic cannot prove arbitrary dynamic byte offsets tile-aligned).
+    alignment: int = 4096
+
+    # Control plane. The reference's daemon listens on the nodefile's
+    # ocm_port; per-allocation IB ports came from a counter at 67980
+    # (/root/reference/src/mem.c:38) — here the data plane is connectionless
+    # so only the daemon port exists.
+    daemon_port: int = field(
+        default_factory=lambda: _env_int("OCM_DAEMON_PORT", 17980)
+    )
+    nodefile: str | None = field(
+        default_factory=lambda: os.environ.get("OCM_NODEFILE")
+    )
+    rank: int | None = None  # None = autodetect (nodefile hostname match
+    # in the reference, nodefile.c:92-103; jax.process_index() on TPU pods)
+
+    # Data-plane tuning. The reference pipelines 8 MB chunks with 2 in-flight
+    # ops (/root/reference/src/extoll.c:47-51); same defaults here for the
+    # chunked ICI/DCN paths.
+    chunk_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_CHUNK_BYTES", 8 << 20)
+    )
+    inflight_ops: int = field(default_factory=lambda: _env_int("OCM_INFLIGHT", 2))
+
+    # Liveness (capability upgrade over the reference's unresolved TODO,
+    # /root/reference/src/main.c:6-7).
+    lease_s: float = 30.0
+    heartbeat_s: float = 5.0

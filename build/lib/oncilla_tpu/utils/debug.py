@@ -1,0 +1,140 @@
+"""Env-gated structured logging and op timing.
+
+The reference's entire observability system is ``printd`` — print only when
+``OCM_VERBOSE`` is set, prefixed with pid/tid/file/func/line
+(/root/reference/inc/debug.h:22,50-65). This keeps the same env-var contract
+but adds what SURVEY.md §5.1 calls for: per-op latency/bandwidth counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+_logger = logging.getLogger("oncilla_tpu")
+if os.environ.get("OCM_VERBOSE"):
+    logging.basicConfig(
+        level=logging.DEBUG,
+        format="%(asctime)s %(process)d/%(threadName)s %(name)s "
+        "%(filename)s:%(lineno)d %(message)s",
+    )
+    _logger.setLevel(logging.DEBUG)
+
+
+def printd(msg: str, *args) -> None:
+    """Debug print, active only under ``OCM_VERBOSE`` (debug.h:22 contract)."""
+    _logger.debug(msg, *args)
+
+
+@dataclass
+class OpStats:
+    count: int = 0
+    total_s: float = 0.0
+    total_bytes: int = 0
+    samples_s: list[float] = field(default_factory=list)
+
+    @property
+    def p50_s(self) -> float:
+        if not self.samples_s:
+            return 0.0
+        s = sorted(self.samples_s)
+        return s[len(s) // 2]
+
+    @property
+    def gbps(self) -> float:
+        return self.total_bytes / self.total_s / 1e9 if self.total_s else 0.0
+
+
+class Tracer:
+    """Per-op timing registry. ``tracer.span("put", nbytes=...)`` wraps an op;
+    ``tracer.stats("put")`` reports count / p50 latency / GB/s."""
+
+    def __init__(self, max_samples: int = 4096):
+        self._stats: dict[str, OpStats] = defaultdict(OpStats)
+        self._lock = threading.Lock()
+        self._max_samples = max_samples
+
+    @contextmanager
+    def span(self, op: str, nbytes: int = 0):
+        cls = _annotation_cls()
+        annotation = cls(f"ocm:{op}") if cls is not None else None
+        t0 = time.perf_counter()
+        try:
+            if annotation is None:
+                yield
+            else:
+                with annotation:
+                    yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                st = self._stats[op]
+                st.count += 1
+                st.total_s += dt
+                st.total_bytes += nbytes
+                if len(st.samples_s) < self._max_samples:
+                    st.samples_s.append(dt)
+            printd("op=%s nbytes=%d dt_us=%.1f", op, nbytes, dt * 1e6)
+
+    def stats(self, op: str) -> OpStats:
+        with self._lock:
+            return self._stats[op]
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                k: {
+                    "count": v.count,
+                    "p50_us": v.p50_s * 1e6,
+                    "gbps": v.gbps,
+                    "total_bytes": v.total_bytes,
+                }
+                for k, v in self._stats.items()
+            }
+
+
+_ANNOTATION_CLS: object = False  # False = unresolved, None = unavailable
+
+
+def _annotation_cls():
+    """``jax.profiler.TraceAnnotation`` resolved once, so ocm op spans show
+    up on the TensorBoard trace timeline; None when the profiler is
+    unavailable (e.g. stripped minimal builds). Resolving per-span would put
+    an import lookup inside every timed hot-path op."""
+    global _ANNOTATION_CLS
+    if _ANNOTATION_CLS is False:
+        try:
+            import jax.profiler
+
+            _ANNOTATION_CLS = jax.profiler.TraceAnnotation
+        except Exception:  # noqa: BLE001
+            _ANNOTATION_CLS = None
+    return _ANNOTATION_CLS
+
+
+@contextmanager
+def capture_trace(log_dir: str):
+    """Capture a ``jax.profiler`` program trace around a block of ocm work::
+
+        with capture_trace("/tmp/ocm-trace"):
+            ctx.put(h, data)
+            ctx.get(h)
+
+    View with TensorBoard's profile plugin. Op spans recorded through
+    ``Tracer.span`` appear as ``ocm:<op>`` annotations on the timeline.
+    """
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+GLOBAL_TRACER = Tracer()

@@ -1,0 +1,93 @@
+"""Platform plumbing for hostile/partial environments.
+
+One concern today: dev images route the TPU through a tunnel plugin that
+force-registers itself in every python process; when the tunnel is
+wedged, jax initializes the plugin during backend discovery and hangs
+``jax.devices()`` on EVERY platform — CPU-only code included. Paths that
+never need the chip (test suites, multichip dryruns on virtual devices)
+drop the plugin's backend factory before any device init.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Force the CPU platform with ``n_devices`` virtual devices, robust
+    to this image's quirks: a sitecustomize that pre-registers (and may
+    pre-initialize) the TPU tunnel backend, and a wedged tunnel that
+    would hang device discovery. Call as early as possible; safe to call
+    after jax import.
+
+    Used by the multichip dryrun gate and the examples; tests/conftest.py
+    uses the env-var variant because it runs before jax is imported.
+    """
+    import os
+
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    drop_tunnel_plugin()
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError:
+        # A backend is already initialized; drop it and re-apply — the
+        # next jax.devices() re-initializes under the new config.
+        import jax._src.xla_bridge as xb
+
+        xb._clear_backends()
+        jax.clear_caches()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:  # older jax: XLA_FLAGS only works pre-init
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    devs = jax.devices()
+    if devs[0].platform == "cpu" and len(devs) >= n_devices:
+        return
+    import jax._src.xla_bridge as xb
+
+    xb._clear_backends()
+    jax.clear_caches()
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) >= n_devices, (
+        f"could not provision {n_devices} virtual CPU devices; have {devs}"
+    )
+
+
+def honor_cpu_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS=cpu`` request stick. This image's
+    sitecustomize force-sets jax_platforms to "axon,cpu" in every process,
+    so the env var alone is silently overridden — and with a wedged
+    tunnel, ANY device discovery then hangs. Entry points that users run
+    with JAX_PLATFORMS=cpu (the daemon CLI, examples) call this before
+    first device use; a no-op unless the env var says exactly "cpu"."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    drop_tunnel_plugin()
+
+
+def drop_tunnel_plugin(name: str = "axon") -> None:
+    """Remove a PJRT plugin's backend factory so a wedged tunnel cannot
+    hang device discovery. Only the tunnel-dialing plugin may be dropped
+    — removing builtin platforms (e.g. 'tpu') breaks MLIR platform
+    registration downstream. Call BEFORE the first ``jax.devices()``.
+
+    Best effort by design: the registry is private jax API, and a layout
+    change must degrade to the old (hang-prone) behavior, not an error.
+    """
+    try:
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop(name, None)
+    except Exception:  # noqa: BLE001 — registry layout changed
+        pass

@@ -163,3 +163,21 @@ def test_ocm_copy_out_in_named_api():
             ctx.free(h)
     finally:
         ctx.tini()
+
+
+def test_put_accepts_raw_bytes(ctx, rng):
+    """The put path takes bytes-likes (the C surface is void*-based; a
+    Python caller reasonably hands in bytes) on every local kind, and a
+    bytes-like ``local`` sizes the one-sided read."""
+    for kind in (OcmKind.LOCAL_HOST, OcmKind.LOCAL_DEVICE):
+        h = ctx.alloc(4096, kind)
+        payload = bytes(rng.integers(0, 256, 4096, dtype=np.uint8))
+        ctx.put(h, payload)
+        np.testing.assert_array_equal(
+            np.asarray(ctx.get(h)), np.frombuffer(payload, np.uint8)
+        )
+        ctx.put(h, bytearray(16), offset=100)
+        assert not np.asarray(ctx.get(h, nbytes=16, offset=100)).any()
+        out = ocm.ocm_copy_onesided(ctx, h, local=b"\0" * 16, op="read")
+        assert np.asarray(out).shape == (16,)
+        ctx.free(h)
