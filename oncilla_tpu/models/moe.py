@@ -207,13 +207,32 @@ def forward(
     mesh=None,
     seq_axis: str | None = None,
     ep_axis: str | None = None,
-    remat: bool = False,
+    remat=False,
 ):
     """Logits + summed router aux loss for a (B, S) token batch. Attention
     is the dense family's (optionally ring over ``seq_axis``); every FFN is
     the expert layer. ``remat`` checkpoints each block (recompute in the
-    backward pass), same trade as the dense family's."""
-    from oncilla_tpu.models.llama import make_attend
+    backward pass, "dots" for the dots-saveable policy), same trade as the
+    dense family's."""
+    x, aux_total = forward_hidden(
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, ep_axis=ep_axis,
+        remat=remat,
+    )
+    return final_logits(params, x, cfg), aux_total
+
+
+def forward_hidden(
+    params: dict,
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    *,
+    mesh=None,
+    seq_axis: str | None = None,
+    ep_axis: str | None = None,
+    remat=False,
+):
+    """Final hidden states (pre-``ln_out``) + summed router aux."""
+    from oncilla_tpu.models.llama import _remat_wrap, make_attend
 
     B, S = tokens.shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
@@ -231,18 +250,28 @@ def forward(
         out = block(cfg, x, lp, positions, attend, mlp=mlp)
         return out, box["aux"]
 
-    if remat:
-        one_block = jax.checkpoint(one_block)
+    one_block = _remat_wrap(one_block, remat)
 
     aux_total = jnp.float32(0.0)
     for i in range(cfg.n_layers):
         x, aux = one_block(x, moe_layer_params(params, i))
         aux_total = aux_total + aux
-    return final_logits(params, x, cfg), aux_total
+    return x, aux_total
 
 
-def loss_fn(params, tokens, cfg: MoeConfig, **kw) -> jax.Array:
-    """Next-token cross entropy + weighted router load-balancing loss."""
+def loss_fn(params, tokens, cfg: MoeConfig, *, ce_block: int | None = None,
+            **kw) -> jax.Array:
+    """Next-token cross entropy + weighted router load-balancing loss.
+    ``ce_block`` switches to the blocked vocab-head CE (shared with the
+    dense family — same ln_out/lm_head leaves)."""
+    if ce_block is not None:
+        from oncilla_tpu.models.llama import blocked_cross_entropy
+
+        x, aux = forward_hidden(params, tokens, cfg, **kw)
+        ce = blocked_cross_entropy(x=x, params=params,
+                                   targets=tokens[:, 1:], cfg=cfg,
+                                   block=ce_block)
+        return ce + cfg.router_aux_weight * aux
     logits, aux = forward(params, tokens, cfg, **kw)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]

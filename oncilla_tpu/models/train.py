@@ -302,16 +302,19 @@ def make_moe_train_state(key, cfg, mesh: Mesh, lr: float = 3e-4,
     )
 
 
-def make_moe_train_step(cfg, mesh: Mesh, tx, remat: bool = False,
-                        offload_opt: bool = False, opt_state=None):
+def make_moe_train_step(cfg, mesh: Mesh, tx, remat=False,
+                        offload_opt: bool = False, opt_state=None,
+                        ce_block: int | None = None):
     """Jitted MoE training step over the (dp, ep, tp) mesh: GSPMD lowers
     the dispatch/combine einsums to all-to-alls over the ep axis. Supports
-    the same ``remat``/``offload_opt`` memory trades as the dense step."""
+    the same ``remat``/``ce_block``/``offload_opt`` memory trades as the
+    dense step."""
     from oncilla_tpu.models import moe
 
     return _jit_step(
         lambda p, tokens: moe.loss_fn(
-            p, tokens, cfg, mesh=mesh, ep_axis=EP, remat=remat
+            p, tokens, cfg, mesh=mesh, ep_axis=EP, remat=remat,
+            ce_block=ce_block,
         ),
         moe_param_specs(cfg), mesh, P(DP, None), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,

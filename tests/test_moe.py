@@ -358,3 +358,32 @@ def test_moe_step_page_matches_per_token(rng):
         dec.close()
     finally:
         ctx.tini()
+
+
+def test_moe_blocked_ce_matches_plain(rng):
+    """ce_block on the MoE family: same loss (CE + router aux) as the
+    plain path, including under the ep mesh."""
+    cfg = MoeConfig.tiny()
+    params = moe.init_moe_params(jax.random.key(3), cfg)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (2, 24)), jnp.int32)
+    plain = float(moe.loss_fn(params, tokens, cfg))
+    blocked = float(moe.loss_fn(params, tokens, cfg, ce_block=8))
+    np.testing.assert_allclose(blocked, plain, rtol=2e-6)
+
+    mesh = train.make_moe_mesh(8)
+    p, o, tx = train.make_moe_train_state(jax.random.key(4), cfg, mesh,
+                                          lr=1e-2)
+    toks = jax.device_put(
+        train.sample_batch(np.random.default_rng(1), cfg, 4, 16),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(train.DP, None)),
+    )
+    losses = {}
+    for ce in (None, 8):
+        pp, oo = jax.tree.map(jnp.copy, (p, o))
+        step = train.make_moe_train_step(cfg, mesh, tx, ce_block=ce)
+        ls = []
+        for _ in range(2):
+            pp, oo, loss = step(pp, oo, toks)
+            ls.append(float(loss))
+        losses[ce] = ls
+    np.testing.assert_allclose(losses[8], losses[None], rtol=1e-5)
