@@ -423,7 +423,8 @@ def make_pp_stage_fn(cfg, moe_aux: bool = False):
 
 
 def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
-                  moe_aux: bool = False, remat: bool = False):
+                  moe_aux: bool = False, remat: bool = False,
+                  ce_block: int | None = None):
     """Shared GPipe loss: embed -> pipelined layer stack -> head -> CE
     (+ the scale-matched router aux for the MoE family). ``remat``
     checkpoints each stage application (recompute-in-backward per
@@ -445,11 +446,21 @@ def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
             microbatches=microbatches, with_aux=moe_aux,
         )
         x, aux = res if moe_aux else (res, None)
-        logits = final_logits(params, x, cfg)
-        targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        ce = -jnp.mean(ll)
+        if ce_block is not None:
+            from oncilla_tpu.models.llama import blocked_cross_entropy
+
+            ce = blocked_cross_entropy(
+                x=x, params=params, targets=tokens[:, 1:], cfg=cfg,
+                block=ce_block,
+            )
+        else:
+            logits = final_logits(params, x, cfg)
+            targets = tokens[:, 1:]
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            ll = jnp.take_along_axis(
+                logp, targets[..., None], axis=-1
+            )[..., 0]
+            ce = -jnp.mean(ll)
         if moe_aux:
             # aux sums one O(1) load-balance term per (layer, microbatch);
             # divide by microbatches so the regularizer scale matches the
@@ -469,7 +480,7 @@ def _make_pp_loss(cfg, mesh: Mesh, microbatches: int, layer_keys,
 
 def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
                        remat: bool = False, offload_opt: bool = False,
-                       opt_state=None):
+                       opt_state=None, ce_block: int | None = None):
     """Jitted GPipe training step over the (dp, pp) mesh: the stacked layer
     axis is sharded over pp; activations move stage-to-stage via ppermute
     (:mod:`oncilla_tpu.parallel.pipeline`); embed/head run replicated.
@@ -478,7 +489,8 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
     from oncilla_tpu.models.llama import LAYER_KEYS
 
     return _jit_step(
-        _make_pp_loss(cfg, mesh, microbatches, LAYER_KEYS, remat=remat),
+        _make_pp_loss(cfg, mesh, microbatches, LAYER_KEYS, remat=remat,
+                      ce_block=ce_block),
         pp_param_specs(cfg), mesh, P(DP, None), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
     )
@@ -486,7 +498,7 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, tx, microbatches: int = 2,
 
 def make_moe_pp_train_step(cfg, mesh: Mesh, tx, microbatches: int = 2,
                            remat: bool = False, offload_opt: bool = False,
-                           opt_state=None):
+                           opt_state=None, ce_block: int | None = None):
     """GPipe training step for the MoE family over the (dp, pp) mesh: the
     expert layers ride the pipeline like dense blocks, and the router
     load-balancing aux loss crosses it through the executor's aux channel
@@ -495,7 +507,7 @@ def make_moe_pp_train_step(cfg, mesh: Mesh, tx, microbatches: int = 2,
 
     return _jit_step(
         _make_pp_loss(cfg, mesh, microbatches, MOE_LAYER_KEYS, moe_aux=True,
-                      remat=remat),
+                      remat=remat, ce_block=ce_block),
         moe_pp_param_specs(cfg), mesh, P(DP, None), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
     )

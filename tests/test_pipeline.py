@@ -278,3 +278,38 @@ def test_pp_remat_matches_plain(rng, family):
             ls.append(float(loss))
         losses[remat] = ls
     np.testing.assert_allclose(losses[True], losses[False], rtol=rtol)
+
+
+@pytest.mark.parametrize("family", ["dense", "moe"])
+def test_pp_blocked_ce_matches_plain(rng, family):
+    """ce_block on the GPipe loss: same trajectory as the plain pp step
+    for both families."""
+    from oncilla_tpu.models.moe import MoeConfig
+
+    if family == "dense":
+        cfg = _cfg4()
+        make_state, make_step = (
+            train.make_pp_train_state, train.make_pp_train_step,
+        )
+    else:
+        cfg = dataclasses.replace(
+            MoeConfig.tiny(), n_layers=4, capacity_factor=64.0
+        )
+        make_state, make_step = (
+            train.make_moe_pp_train_state, train.make_moe_pp_train_step,
+        )
+    mesh = train.make_pp_mesh(8, n_layers=cfg.n_layers)
+    tokens = jax.device_put(
+        train.sample_batch(rng, cfg, 4, 16),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    losses = {}
+    for ce in (None, 8):
+        params, opt, tx = make_state(jax.random.key(5), cfg, mesh, lr=1e-2)
+        step = make_step(cfg, mesh, tx, microbatches=2, ce_block=ce)
+        ls = []
+        for _ in range(2):
+            params, opt, loss = step(params, opt, tokens)
+            ls.append(float(loss))
+        losses[ce] = ls
+    np.testing.assert_allclose(losses[8], losses[None], rtol=1e-5)
