@@ -269,39 +269,42 @@ class ControlPlaneClient:
         self, handle: OcmAlloc, total: int, make_req, on_reply, addr
     ) -> None:
         host, port = addr
-        s, lk = self._pool.connection(host, port)
+        entry = self._pool.lease(host, port)  # exclusive for the pipeline
+        s = entry.sock
         chunk = self.config.chunk_bytes
         window = max(1, self.config.inflight_ops)
-        with lk:
-            inflight: list[tuple[int, int]] = []  # (chunk_offset, nbytes)
-            pos = 0
-            failure: OcmRemoteError | None = None
-            try:
-                while pos < total or inflight:
-                    while pos < total and len(inflight) < window and failure is None:
-                        n = min(chunk, total - pos)
-                        send_msg(s, make_req(pos, n))
-                        inflight.append((pos, n))
-                        pos += n
-                    if not inflight:
-                        break
-                    r = recv_msg(s)
-                    start, n = inflight.pop(0)
-                    if r.type == MsgType.ERROR:
-                        # Remember the first failure; keep draining replies
-                        # for chunks already on the wire.
-                        if failure is None:
-                            failure = OcmRemoteError(
-                                r.fields["code"], r.fields["detail"]
-                            )
-                    elif failure is None:
-                        on_reply(r, start, n)
-            except (OSError, OcmProtocolError) as e:
-                if not isinstance(e, OcmRemoteError):
-                    self._pool.evict(host, port)
-                raise
-            if failure is not None:
-                raise failure
+        inflight: list[tuple[int, int]] = []  # (chunk_offset, nbytes)
+        pos = 0
+        failure: OcmRemoteError | None = None
+        try:
+            while pos < total or inflight:
+                while pos < total and len(inflight) < window and failure is None:
+                    n = min(chunk, total - pos)
+                    send_msg(s, make_req(pos, n))
+                    inflight.append((pos, n))
+                    pos += n
+                if not inflight:
+                    break
+                r = recv_msg(s)
+                start, n = inflight.pop(0)
+                if r.type == MsgType.ERROR:
+                    # Remember the first failure; keep draining replies
+                    # for chunks already on the wire.
+                    if failure is None:
+                        failure = OcmRemoteError(
+                            r.fields["code"], r.fields["detail"]
+                        )
+                elif failure is None:
+                    on_reply(r, start, n)
+        except (OSError, OcmProtocolError) as e:
+            if not isinstance(e, OcmRemoteError):
+                self._pool.discard(host, port, entry)
+            else:
+                self._pool.release(host, port, entry)
+            raise
+        self._pool.release(host, port, entry)
+        if failure is not None:
+            raise failure
 
     def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
         def make_req(pos: int, n: int) -> Message:

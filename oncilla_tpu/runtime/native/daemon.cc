@@ -57,16 +57,23 @@ double now_s() {
 // only ::shutdown()s the fd (waking any blocked recv) and drops the map
 // reference; the fd is ::close()d by ~Conn when the last in-flight request
 // lets go — so no thread ever uses a closed-and-reused fd number.
+//
+// MULTIPLE connections per peer (mirrors pool.py): one-conn-per-peer with
+// its mutex held across the round-trip lets the waits-for graph cycle
+// across >= 3 daemons (REQ_ALLOC forward + DO_ALLOC/DO_FREE legs +
+// NOTE_FREE accounting) and deadlocks the cluster until socket timeouts.
+// The message call graph is acyclic, so leasing an idle-or-fresh
+// connection per request removes every mutex edge.
 class PeerPool {
  public:
   Message request(const std::string& host, int port, const Message& m) {
-    std::shared_ptr<Conn> c = get(host, port);
+    std::shared_ptr<Conn> c = lease(host, port);
+    std::unique_lock<std::mutex> g(c->mu, std::adopt_lock);
     try {
-      std::lock_guard<std::mutex> g(c->mu);
       send_msg(c->fd, m);
       return recv_msg(c->fd);
     } catch (const ProtocolError&) {
-      evict(host, port);
+      discard(host, port, c);
       throw;
     }
   }
@@ -76,7 +83,8 @@ class PeerPool {
   void close_all() {
     std::lock_guard<std::mutex> g(mu_);
     closed_ = true;
-    for (auto& kv : conns_) ::shutdown(kv.second->fd, SHUT_RDWR);
+    for (auto& kv : conns_)
+      for (auto& c : kv.second) ::shutdown(c->fd, SHUT_RDWR);
     conns_.clear();
   }
 
@@ -89,31 +97,47 @@ class PeerPool {
     }
   };
 
-  std::shared_ptr<Conn> get(const std::string& host, int port) {
+  // Returns with c->mu HELD (caller adopts).
+  std::shared_ptr<Conn> lease(const std::string& host, int port) {
     auto key = host + ":" + std::to_string(port);
-    std::lock_guard<std::mutex> g(mu_);
-    if (closed_) throw ProtocolError("peer pool is shut down");
-    auto it = conns_.find(key);
-    if (it != conns_.end()) return it->second;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (closed_) throw ProtocolError("peer pool is shut down");
+      for (auto& c : conns_[key])
+        if (c->mu.try_lock()) return c;
+    }
     auto c = std::make_shared<Conn>();
     c->fd = dial(host, port);
-    conns_[key] = c;
+    c->mu.lock();
+    std::lock_guard<std::mutex> g(mu_);
+    if (closed_) {
+      ::shutdown(c->fd, SHUT_RDWR);
+      c->mu.unlock();
+      throw ProtocolError("peer pool is shut down");
+    }
+    conns_[key].push_back(c);
     return c;
   }
 
-  void evict(const std::string& host, int port) {
+  void discard(const std::string& host, int port,
+               const std::shared_ptr<Conn>& c) {
     auto key = host + ":" + std::to_string(port);
     std::lock_guard<std::mutex> g(mu_);
     auto it = conns_.find(key);
-    if (it != conns_.end()) {
-      ::shutdown(it->second->fd, SHUT_RDWR);
-      conns_.erase(it);
+    if (it == conns_.end()) return;
+    auto& vec = it->second;
+    for (auto vit = vec.begin(); vit != vec.end(); ++vit) {
+      if (*vit == c) {
+        ::shutdown(c->fd, SHUT_RDWR);
+        vec.erase(vit);
+        break;
+      }
     }
   }
 
   std::mutex mu_;
   bool closed_ = false;
-  std::map<std::string, std::shared_ptr<Conn>> conns_;
+  std::map<std::string, std::vector<std::shared_ptr<Conn>>> conns_;
 };
 
 // ---------------------------------------------------------------------------

@@ -5,11 +5,24 @@ logic without reconnect handling). Semantics are deliberately conservative:
 
 - A peer's well-formed ERROR reply (:class:`OcmRemoteError`) leaves the
   connection cached — it is still in sync.
-- A transport failure (OSError, malformed frame) **evicts** the connection
-  and raises; the pool never re-sends a request, because control messages
-  are not idempotent (a re-sent DO_ALLOC would leak an extent, a re-sent
-  DO_FREE would report a spurious unknown-id error). Callers with
+- A transport failure (OSError, malformed frame) **discards** the
+  connection and raises; the pool never re-sends a request, because control
+  messages are not idempotent (a re-sent DO_ALLOC would leak an extent, a
+  re-sent DO_FREE would report a spurious unknown-id error). Callers with
   idempotent messages (ADD_NODE, HEARTBEAT) retry themselves.
+
+Concurrency design — MULTIPLE connections per peer. One-connection-per-peer
+with a mutex held across the request/reply round-trip deadlocks the
+cluster: the waits-for graph couples "holds conn A→B's mutex awaiting B's
+reply" with "B's handler needs conn B→C" edges, and with ≥3 daemons
+exchanging REQ_ALLOC forwards, DO_ALLOC/DO_FREE legs, and NOTE_FREE
+accounting simultaneously those edges form cycles (observed: the
+multi-client stress test stalling ~30 s until every socket timed out).
+The message call graph itself is acyclic, so leasing an idle-or-new
+connection per request removes every mutex edge and with it the deadlock;
+``per_peer`` only bounds descriptor growth (reaching it blocks on an
+existing connection — with the cap far above any realistic outbound
+concurrency, that fallback never participates in a cycle in practice).
 """
 
 from __future__ import annotations
@@ -25,64 +38,115 @@ from oncilla_tpu.core.errors import (
 from oncilla_tpu.runtime.protocol import Message, request
 
 
+class PoolEntry:
+    """One pooled connection; ``lock`` is held by whoever leased it."""
+
+    __slots__ = ("sock", "lock", "dead")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.dead = False
+
+
 class PeerPool:
-    """Cached connections keyed by (host, port), one lock per connection."""
+    """Connections keyed by (host, port), several per peer, leased
+    exclusively per exchange."""
 
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, per_peer: int = 16):
         self._timeout = timeout
-        self._conns: dict[tuple[str, int], tuple[socket.socket, threading.Lock]] = {}
+        self._per_peer = per_peer
+        self._conns: dict[tuple[str, int], list[PoolEntry]] = {}
         self._lock = threading.Lock()
+        self._closed = False
 
-    def connection(self, host: str, port: int) -> tuple[socket.socket, threading.Lock]:
-        """The cached (socket, lock) pair, connecting if needed. Callers
-        doing multi-frame pipelining hold the lock for the whole exchange
-        and call :meth:`evict` on any transport error."""
+    def lease(self, host: str, port: int) -> PoolEntry:
+        """An exclusively held connection (``entry.lock`` acquired):
+        an idle cached one, else a fresh dial — callers doing multi-frame
+        pipelining keep the lease for the whole exchange, then
+        :meth:`release` (still in sync) or :meth:`discard` (broken)."""
         key = (host, port)
-        with self._lock:
-            entry = self._conns.get(key)
-        if entry is not None:
-            return entry
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise OcmConnectError("peer pool is shut down")
+                entries = self._conns.setdefault(key, [])
+                waiter = None
+                for e in entries:
+                    if e.lock.acquire(blocking=False):
+                        return e
+                if entries and len(entries) >= self._per_peer:
+                    waiter = entries[0]
+            if waiter is None:
+                break
+            # At the cap: block on an existing connection, re-checking
+            # liveness (it may be discarded while we wait).
+            waiter.lock.acquire()
+            if not waiter.dead:
+                return waiter
+            waiter.lock.release()
         try:
             s = socket.create_connection(key, timeout=self._timeout)
         except OSError as e:
             raise OcmConnectError(f"peer {host}:{port} unreachable: {e}") from e
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        entry = (s, threading.Lock())
+        entry = PoolEntry(s)
+        entry.lock.acquire()
         with self._lock:
-            # Lost a race with another thread? Keep the first, close ours.
-            existing = self._conns.get(key)
-            if existing is not None:
+            if self._closed:
                 s.close()
-                return existing
-            self._conns[key] = entry
+                raise OcmConnectError("peer pool is shut down")
+            self._conns.setdefault(key, []).append(entry)
         return entry
 
-    def evict(self, host: str, port: int) -> None:
+    def release(self, host: str, port: int, entry: PoolEntry) -> None:
+        """Return a healthy leased connection to the pool."""
+        entry.lock.release()
+
+    def discard(self, host: str, port: int, entry: PoolEntry) -> None:
+        """Drop a broken leased connection (closes it, ends the lease)."""
+        entry.dead = True
         with self._lock:
-            entry = self._conns.pop((host, port), None)
-        if entry is not None:
-            try:
-                entry[0].close()
-            except OSError:
-                pass
+            lst = self._conns.get((host, port), [])
+            if entry in lst:
+                lst.remove(entry)
+        try:
+            entry.sock.close()
+        except OSError:
+            pass
+        entry.lock.release()
 
     def request(self, host: str, port: int, msg: Message) -> Message:
         """One request/reply. No resend on failure (see module docstring)."""
-        s, lk = self.connection(host, port)
+        entry = self.lease(host, port)
         try:
-            with lk:
-                return request(s, msg)
+            reply = request(entry.sock, msg)
         except OcmRemoteError:
+            self.release(host, port, entry)
             raise  # connection still in sync
         except (OSError, OcmProtocolError) as e:
-            self.evict(host, port)
+            self.discard(host, port, entry)
             raise OcmConnectError(f"peer {host}:{port} failed: {e}") from e
+        self.release(host, port, entry)
+        return reply
+
+    def reset(self) -> None:
+        """Drop every cached connection but keep the pool usable (e.g. to
+        free a peer's port before it rebinds); in-flight leases see their
+        socket close and discard on their own error path."""
+        with self._lock:
+            for lst in self._conns.values():
+                for e in lst:
+                    e.dead = True
+                    try:
+                        e.sock.close()
+                    except OSError:
+                        pass
+            self._conns.clear()
 
     def close(self) -> None:
+        """Terminal: drops every connection AND refuses new dials, so a
+        worker racing shutdown cannot re-dial a hung peer."""
         with self._lock:
-            for s, _ in self._conns.values():
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._conns.clear()
+            self._closed = True
+        self.reset()

@@ -148,3 +148,36 @@ def test_alloc_storm_capacity_accounting():
         for client, h in held:
             client.free(h)
         _assert_quiescent(cl)
+
+
+def test_pool_leases_are_exclusive_and_concurrent():
+    """The deadlock-breaking property: concurrent leases to one peer get
+    DISTINCT connections (no mutex held across a round-trip can couple two
+    requests), discarded connections never come back, and released ones
+    are reused."""
+    from oncilla_tpu.runtime.pool import PeerPool
+    from oncilla_tpu.runtime.protocol import Message, MsgType
+
+    with local_cluster(1, config=cfg()) as cl:
+        d = cl.daemons[0]
+        pool = PeerPool(timeout=10.0)
+        host, port = "127.0.0.1", d.port
+
+        e1 = pool.lease(host, port)
+        e2 = pool.lease(host, port)       # e1 still held -> fresh dial
+        assert e1 is not e2 and e1.sock is not e2.sock
+        pool.release(host, port, e1)
+        e3 = pool.lease(host, port)       # idle e1 is reused
+        assert e3 is e1
+        pool.release(host, port, e2)
+        pool.release(host, port, e3)
+
+        # A request still works and a discarded conn is gone for good.
+        r = pool.request(host, port, Message(MsgType.STATUS, {}))
+        assert r.fields["rank"] == 0
+        ebad = pool.lease(host, port)
+        pool.discard(host, port, ebad)
+        assert ebad.dead
+        r = pool.request(host, port, Message(MsgType.STATUS, {}))
+        assert r.fields["rank"] == 0      # pool recovered with a live conn
+        pool.close()

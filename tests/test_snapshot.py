@@ -56,8 +56,9 @@ def test_daemon_restart_restores_allocations(tmp_path, rng):
         client.close(detach=True)
         cl.clients.remove(client)
         # Daemon 0's peer pool also holds connections into d1's port (from
-        # the DO_ALLOC/heartbeat legs); drop them so the port frees up.
-        cl.daemons[0].peers.close()
+        # the DO_ALLOC/heartbeat legs); drop them so the port frees up
+        # (reset keeps the pool usable for the post-restart traffic).
+        cl.daemons[0].peers.reset()
         d1.stop()
         import time as _t
         _t.sleep(0.3)  # let d1's serve threads notice the closed peers
