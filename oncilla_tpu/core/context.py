@@ -178,7 +178,11 @@ class Ocm:
                 raise OcmInvalidHandle(f"double free of alloc {handle.alloc_id}")
             del self._allocs[handle.alloc_id]
             self._stagebufs.pop(handle.alloc_id, None)
-        if handle.kind == OcmKind.LOCAL_HOST:
+        if handle.daemon_owned:
+            # Includes single-node DEMOTED handles (kind LOCAL_*): the
+            # daemon registered the extent, so it must release it.
+            self._remote_or_raise(handle.kind).free(handle)
+        elif handle.kind == OcmKind.LOCAL_HOST:
             self.host_arena.free(handle.extent)
         elif handle.kind == OcmKind.LOCAL_DEVICE:
             self.device_arenas[handle.device_index].free(handle.extent)
@@ -199,7 +203,9 @@ class Ocm:
         data = _coerce_bytes(data)
         raw_n = _nbytes_of(data)
         with self.tracer.span("put", nbytes=raw_n):
-            if handle.kind == OcmKind.LOCAL_HOST:
+            if handle.daemon_owned:
+                self._remote_or_raise(handle.kind).put(handle, data, offset)
+            elif handle.kind == OcmKind.LOCAL_HOST:
                 self.host_arena.write(handle.extent, _to_numpy(data), offset)
             elif handle.kind == OcmKind.LOCAL_DEVICE:
                 self.device_arenas[handle.device_index].write(
@@ -215,6 +221,10 @@ class Ocm:
         if nbytes is None:
             nbytes = handle.nbytes - offset
         with self.tracer.span("get", nbytes=nbytes):
+            if handle.daemon_owned:
+                return self._remote_or_raise(handle.kind).get(
+                    handle, nbytes, offset
+                )
             if handle.kind == OcmKind.LOCAL_HOST:
                 return self.host_arena.read(handle.extent, nbytes, offset)
             if handle.kind == OcmKind.LOCAL_DEVICE:
@@ -248,7 +258,7 @@ class Ocm:
         over the region via push/pull offsets."""
         self._check_live(handle)
         if nbytes is not None:
-            if not handle.is_remote:
+            if not (handle.is_remote or handle.daemon_owned):
                 raise OcmInvalidHandle(
                     "a sized staging window applies to remote kinds only"
                 )
@@ -264,12 +274,14 @@ class Ocm:
                         f"{existing.nbytes} B; cannot resize to {nbytes}"
                     )
                 handle.local_nbytes = nbytes
-        if handle.kind == OcmKind.LOCAL_HOST:
+        if handle.kind == OcmKind.LOCAL_HOST and not handle.daemon_owned:
             return self.host_arena.view(handle.extent)
-        if handle.kind == OcmKind.LOCAL_DEVICE:
+        if handle.kind == OcmKind.LOCAL_DEVICE and not handle.daemon_owned:
             return self.device_arenas[handle.device_index].read(
                 handle.extent, handle.nbytes
             )
+        # Remote kinds AND daemon-owned demoted ones: the bytes live behind
+        # the control plane, so the app-side arm is a staging buffer.
         with self._lock:
             # Re-check liveness under the lock: a free() racing in between
             # _check_live and here would otherwise let us cache a buffer for
@@ -293,7 +305,7 @@ class Ocm:
         the region (local_offset = offset, the original symmetric
         semantics); a smaller window defaults to local_offset 0 — its
         whole content moves to/from the remote ``offset``."""
-        if not handle.is_remote:
+        if not (handle.is_remote or handle.daemon_owned):
             raise OcmInvalidHandle("push/pull is for remote-kind handles")
         window = handle.local_nbytes or handle.nbytes
         if local_offset is None:
@@ -346,6 +358,7 @@ class Ocm:
                 src.kind == OcmKind.LOCAL_DEVICE
                 and dst.kind == OcmKind.LOCAL_DEVICE
                 and src.device_index == dst.device_index
+                and not (src.daemon_owned or dst.daemon_owned)
             ):
                 # Fused on-chip move: one jitted slice+update, no host hop.
                 self.device_arenas[src.device_index].move(
@@ -368,6 +381,14 @@ class Ocm:
             self.put(dst, data, dst_offset)
 
     # -- introspection (oncillamem.h parity) ----------------------------
+
+    def status(self, rank: int | None = None) -> dict:
+        """Live daemon status (rank, nnodes, live_allocs, bytes live) —
+        the STATUS endpoint. On the rank-0 master ``nnodes`` is the JOINED
+        count; poll it before depending on remote placement (a
+        still-joining cluster demotes remote requests, alloc.c:82-83)."""
+        backend = self._remote_or_raise("status")
+        return backend.status(rank)
 
     @staticmethod
     def is_remote(handle: OcmAlloc) -> bool:
@@ -485,13 +506,13 @@ def ocm_copy_onesided(
     staging buffer (``ctx.localbuf``) — the reference's semantics, where
     one-sided ops always use the handle's malloc'd local arm."""
     if op == "write":
-        if local is None and handle.is_remote:
+        if local is None and (handle.is_remote or handle.daemon_owned):
             ctx.push(handle, offset=offset)
         else:
             ctx.put(handle, local, offset)
         return None
     if op == "read":
-        if local is None and handle.is_remote:
+        if local is None and (handle.is_remote or handle.daemon_owned):
             ctx.pull(handle, offset=offset)
             # Same shape as the plain-get path: element 0 is the byte at
             # ``offset`` (a view into the staging buffer). With an

@@ -51,6 +51,14 @@ class OcmAlloc:
     # (/root/reference/test/ocm_test.c:35-47): a small local window onto a
     # large remote allocation. None = window matches ``nbytes``.
     local_nbytes: int | None = field(default=None, compare=False)
+    # True when a daemon placed (and registered) this allocation — including
+    # a single-node DEMOTED one (alloc.c:82-83 parity: the reported kind
+    # becomes LOCAL_*, is_remote turns False). The daemon owns the bytes
+    # either way, so the app context must route every data op and the free
+    # through the control plane, never through its own arenas: a demoted
+    # offset is an address in the DAEMON's arena, and treating it as an
+    # app-arena offset reads/writes unrelated memory and fails the free.
+    daemon_owned: bool = field(default=False, compare=False)
 
     @property
     def is_remote(self) -> bool:

@@ -1,0 +1,130 @@
+"""Single-node demotion end to end (alloc.c:82-83 parity).
+
+The reference demotes remote allocation requests to the local arm when the
+cluster has one node. Here the daemon still places and REGISTERS the extent
+(in its own arena / device book), and the handle reports the demoted kind
+(LOCAL_*, is_remote False) while ``daemon_owned`` keeps every data op and
+the free routed through the control plane. Round 4 shipped the kind parity
+but routed demoted handles through the APP's arenas — put/get silently
+touched unrelated app memory and free raised OcmInvalidHandle, leaking the
+daemon extent (found while verifying the round-5 pool rewrite). These are
+the regression tests.
+"""
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def cfg(**kw):
+    d = dict(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=8 << 20,
+        chunk_bytes=64 << 10,
+        heartbeat_s=0.2,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+def test_demoted_host_roundtrip_and_free(rng):
+    with local_cluster(1, config=cfg()) as c:
+        ctx = c.context(0)
+        d = c.daemons[0]
+        h = ctx.alloc(256 << 10, OcmKind.REMOTE_HOST)
+        # Kind parity with alloc.c:82-83 ...
+        assert h.kind == OcmKind.LOCAL_HOST
+        assert not h.is_remote and ctx.remote_sz(h) == 0
+        # ... but the DAEMON owns the bytes.
+        assert h.daemon_owned
+        assert d.host_arena.allocator.bytes_live >= 256 << 10
+
+        data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+        ctx.put(h, data)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+        # The bytes landed in the daemon's arena, not the app's.
+        np.testing.assert_array_equal(
+            np.asarray(d.host_arena.read(h.extent, 4096, 0)), data[:4096]
+        )
+
+        ctx.free(h)
+        assert d.registry.live_count() == 0
+        assert d.host_arena.allocator.bytes_live == 0
+
+
+def test_demoted_handle_does_not_alias_app_arena(rng):
+    """A demoted offset is a DAEMON-arena address; the app arena extent at
+    the same offset must be untouched by demoted-handle traffic."""
+    with local_cluster(1, config=cfg()) as c:
+        ctx = c.context(0)
+        mine = ctx.alloc(64 << 10, OcmKind.LOCAL_HOST)     # app offset 0
+        theirs = ctx.alloc(64 << 10, OcmKind.REMOTE_HOST)  # daemon offset 0
+        assert mine.extent.offset == theirs.extent.offset == 0
+
+        local_bytes = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        demoted_bytes = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        ctx.put(mine, local_bytes)
+        ctx.put(theirs, demoted_bytes)
+        np.testing.assert_array_equal(np.asarray(ctx.get(mine)), local_bytes)
+        np.testing.assert_array_equal(np.asarray(ctx.get(theirs)), demoted_bytes)
+
+        ctx.free(theirs)  # daemon-side free; app arena untouched
+        np.testing.assert_array_equal(np.asarray(ctx.get(mine)), local_bytes)
+        ctx.free(mine)
+        with pytest.raises(ocm.OcmInvalidHandle):
+            ctx.free(theirs)
+
+
+def test_demoted_staging_push_pull(rng):
+    """The app-side arm of a demoted handle is a staging buffer (the bytes
+    are behind the control plane), so localbuf/push/pull work like a
+    remote handle's."""
+    with local_cluster(1, config=cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        buf = ctx.localbuf(h)
+        assert buf.nbytes == 64 << 10
+        piece = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        buf[:] = piece
+        ctx.push(h)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), piece)
+        buf[:] = 0
+        ctx.pull(h)
+        np.testing.assert_array_equal(buf, piece)
+        ctx.free(h)
+
+
+def test_demoted_device_roundtrip_via_plane(rng):
+    from oncilla_tpu.ops.ici import SpmdIciPlane
+
+    config = cfg()
+    with local_cluster(1, config=config, ndevices=2) as c:
+        plane = SpmdIciPlane(config=config, devices_per_rank=2)
+        ctx = c.context(0, ici_plane=plane)
+        d = c.daemons[0]
+        h = ctx.alloc(128 << 10, OcmKind.REMOTE_DEVICE)
+        assert h.kind == OcmKind.LOCAL_DEVICE and h.daemon_owned
+        assert sum(b.bytes_live for b in d.device_books) >= 128 << 10
+
+        data = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+        ctx.put(h, data)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+        ctx.free(h)
+        assert sum(b.bytes_live for b in d.device_books) == 0
+        assert d.registry.live_count() == 0
+
+
+def test_demoted_device_without_plane_raises_typed():
+    with local_cluster(1, config=cfg()) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(4096, OcmKind.REMOTE_DEVICE)
+        assert h.kind == OcmKind.LOCAL_DEVICE and h.daemon_owned
+        # With no plane registered anywhere the daemon refuses the relayed
+        # op with a typed error naming the fix (no hang, no desync).
+        with pytest.raises(ocm.OcmError, match="registered plane"):
+            ctx.put(h, np.zeros(4096, np.uint8))
+        ctx.free(h)
