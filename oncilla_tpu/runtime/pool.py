@@ -58,6 +58,7 @@ class PeerPool:
         self._per_peer = per_peer
         self._conns: dict[tuple[str, int], list[PoolEntry]] = {}
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._closed = False
 
     def lease(self, host: str, port: int) -> PoolEntry:
@@ -66,25 +67,23 @@ class PeerPool:
         pipelining keep the lease for the whole exchange, then
         :meth:`release` (still in sync) or :meth:`discard` (broken)."""
         key = (host, port)
-        while True:
-            with self._lock:
+        with self._cond:
+            while True:
                 if self._closed:
                     raise OcmConnectError("peer pool is shut down")
                 entries = self._conns.setdefault(key, [])
-                waiter = None
                 for e in entries:
-                    if e.lock.acquire(blocking=False):
+                    if not e.dead and e.lock.acquire(blocking=False):
+                        if e.dead:  # discarded between scan and acquire
+                            e.lock.release()
+                            continue
                         return e
-                if entries and len(entries) >= self._per_peer:
-                    waiter = entries[0]
-            if waiter is None:
-                break
-            # At the cap: block on an existing connection, re-checking
-            # liveness (it may be discarded while we wait).
-            waiter.lock.acquire()
-            if not waiter.dead:
-                return waiter
-            waiter.lock.release()
+                if len(entries) < self._per_peer:
+                    break  # room to dial a fresh connection
+                # At the cap: wait until ANY lease to this peer ends
+                # (release or discard notifies); the timeout is a
+                # belt-and-braces rescan, not the wakeup mechanism.
+                self._cond.wait(timeout=1.0)
         try:
             s = socket.create_connection(key, timeout=self._timeout)
         except OSError as e:
@@ -102,11 +101,14 @@ class PeerPool:
     def release(self, host: str, port: int, entry: PoolEntry) -> None:
         """Return a healthy leased connection to the pool."""
         entry.lock.release()
+        with self._cond:
+            self._cond.notify_all()
 
     def discard(self, host: str, port: int, entry: PoolEntry) -> None:
-        """Drop a broken leased connection (closes it, ends the lease)."""
+        """Drop a broken leased connection (closes it, ends the lease);
+        waiters at the cap are woken because the peer's list shrank."""
         entry.dead = True
-        with self._lock:
+        with self._cond:
             lst = self._conns.get((host, port), [])
             if entry in lst:
                 lst.remove(entry)
@@ -115,6 +117,8 @@ class PeerPool:
         except OSError:
             pass
         entry.lock.release()
+        with self._cond:
+            self._cond.notify_all()
 
     def request(self, host: str, port: int, msg: Message) -> Message:
         """One request/reply. No resend on failure (see module docstring)."""
@@ -127,6 +131,13 @@ class PeerPool:
         except (OSError, OcmProtocolError) as e:
             self.discard(host, port, entry)
             raise OcmConnectError(f"peer {host}:{port} failed: {e}") from e
+        except BaseException:
+            # Anything else that interrupts the exchange (decode bugs,
+            # KeyboardInterrupt mid-recv) leaves the stream desynced; the
+            # lease must end either way, and never with a cached
+            # half-read connection.
+            self.discard(host, port, entry)
+            raise
         self.release(host, port, entry)
         return reply
 
@@ -134,7 +145,7 @@ class PeerPool:
         """Drop every cached connection but keep the pool usable (e.g. to
         free a peer's port before it rebinds); in-flight leases see their
         socket close and discard on their own error path."""
-        with self._lock:
+        with self._cond:
             for lst in self._conns.values():
                 for e in lst:
                     e.dead = True
@@ -143,6 +154,7 @@ class PeerPool:
                     except OSError:
                         pass
             self._conns.clear()
+            self._cond.notify_all()
 
     def close(self) -> None:
         """Terminal: drops every connection AND refuses new dials, so a

@@ -1,0 +1,208 @@
+"""Soak test for the leased multi-connection peer pool (runtime/pool.py and
+the C++ twin in native/daemon.cc).
+
+The pool rewrite exists because one-connection-per-peer with a mutex held
+across the round trip deadlocks >=3-daemon clusters (pool.py module
+docstring); `test_daemon_stress` covers seconds of that. This file runs a
+MINUTES-capable mixed workload — alloc/free/put/get/status, several client
+ranks, thread counts above the per-peer cap of 16 so the cap-wait
+condition-variable path actually runs — across 3 daemons, Python and native
+TSan flavors. Wall-clock is tunable: OCM_SOAK_S (default 20 s per flavor so
+CI stays affordable; set 120+ for a real soak).
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from _helpers import free_ports
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.context import Ocm
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.native import native
+from oncilla_tpu.utils.config import OcmConfig
+
+SOAK_S = float(os.environ.get("OCM_SOAK_S", "20"))
+TSAN_EXIT = 66
+
+
+def cfg(**kw):
+    d = dict(
+        host_arena_bytes=32 << 20,
+        device_arena_bytes=8 << 20,
+        chunk_bytes=64 << 10,
+        heartbeat_s=0.2,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+def _mixed_workload(make_client, nranks: int, nthreads: int,
+                    stop_at: float) -> list:
+    """Threads spread over client ranks; each loops mixed ops until the
+    deadline. Returns the error list (empty on success)."""
+    errors: list = []
+    ops_done = [0] * nthreads
+
+    def worker(tid: int) -> None:
+        rank = tid % nranks
+        try:
+            client = make_client(rank)
+            ctx = Ocm(config=cfg(), remote=client)
+            r = np.random.default_rng(tid)
+            live: list = []  # [(handle, data, put_done)]
+            while time.time() < stop_at:
+                roll = r.integers(0, 100)
+                if roll < 35 or not live:
+                    if len(live) < 4:
+                        nb = int(r.integers(1, 9)) * (32 << 10)
+                        live.append([ctx.alloc(nb, OcmKind.REMOTE_HOST),
+                                     r.integers(0, 256, nb, dtype=np.uint8),
+                                     False])
+                elif roll < 55:
+                    ent = live[int(r.integers(len(live)))]
+                    ctx.put(ent[0], ent[1])
+                    ent[2] = True
+                elif roll < 75:
+                    h, data, put_done = live[int(r.integers(len(live)))]
+                    got = np.asarray(ctx.get(h, data.nbytes))
+                    # Fresh extents read as scrubbed zeros until this
+                    # thread's first whole-extent put lands.
+                    want = data if put_done else np.zeros_like(data)
+                    np.testing.assert_array_equal(got[: data.nbytes], want)
+                elif roll < 90:
+                    h, _, _ = live.pop(int(r.integers(len(live))))
+                    ctx.free(h)
+                else:
+                    client.status()
+                ops_done[tid] += 1
+            for h, _, _ in live:
+                ctx.free(h)
+            client.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"t{tid}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), name=f"soak-{t}")
+        for t in range(nthreads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=SOAK_S + 180)
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"soak workers hung (pool deadlock?): {hung}"
+    assert sum(ops_done) > nthreads, "soak did no work"
+    return errors
+
+
+def test_python_pool_soak():
+    """3 Python daemons, 18 threads (above the per-peer cap of 16 when all
+    route through one master) of mixed traffic for SOAK_S seconds."""
+    with local_cluster(3, config=cfg()) as cl:
+        errors = _mixed_workload(
+            lambda r: cl.client(r), nranks=3, nthreads=18,
+            stop_at=time.time() + SOAK_S,
+        )
+        assert not errors, errors[:5]
+        for d in cl.daemons:
+            assert d.registry.live_count() == 0, f"rank {d.rank} leaked"
+            assert d.host_arena.allocator.bytes_live == 0
+
+
+def test_native_pool_soak_tsan(tmp_path, rng):
+    """3 native daemons under ThreadSanitizer: the same mixed workload,
+    with REQ_ALLOC forwards + DO_ALLOC/DO_FREE legs + NOTE_FREE accounting
+    crossing all three PeerPools concurrently (the waits-for shapes that
+    deadlocked the one-conn design). Any TSan report fails the test."""
+    try:
+        native.build(tsan=True)
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"TSan build unavailable: {e}")
+
+    ports = free_ports(3)
+    nodefile = tmp_path / "nodefile"
+    nodefile.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    env = {"TSAN_OPTIONS": f"halt_on_error=0 exitcode={TSAN_EXIT}"}
+    logs = [str(tmp_path / f"daemon{r}.log") for r in range(3)]
+    procs = [
+        native.spawn(
+            str(nodefile), r, ndevices=1, tsan=True,
+            host_arena_bytes=32 << 20, device_arena_bytes=8 << 20,
+            heartbeat_s=0.2, lease_s=30.0, env=env, log_path=logs[r],
+        )
+        for r in range(3)
+    ]
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    try:
+        deadline = time.time() + 90  # TSan slows startup ~10x
+        for e in entries:
+            while time.time() < deadline:
+                try:
+                    socket.create_connection((e.host, e.port), 0.5).close()
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                pytest.fail("TSan daemon did not come up")
+        from oncilla_tpu.runtime.protocol import Message, MsgType, request
+
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(
+                    (entries[0].host, entries[0].port), 2.0
+                )
+                try:
+                    st = request(s, Message(MsgType.STATUS, {})).fields
+                    if st["nnodes"] >= 3:
+                        break
+                finally:
+                    s.close()
+            except (OSError, ocm.OcmProtocolError):
+                pass
+            time.sleep(0.1)
+        else:
+            pytest.fail("cluster never reached 3 nodes under TSan")
+
+        errors = _mixed_workload(
+            lambda r: ControlPlaneClient(entries, r, config=cfg()),
+            nranks=3, nthreads=18, stop_at=time.time() + SOAK_S,
+        )
+        assert not errors, errors[:5]
+
+        probe = ControlPlaneClient(entries, 0, config=cfg(), heartbeat=False)
+        qdeadline = time.time() + 60
+        while time.time() < qdeadline:
+            if all(
+                probe.status(rank=r)["live_allocs"] == 0 for r in range(3)
+            ):
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("native daemons not quiescent after soak")
+        probe.close()
+    finally:
+        for p in procs:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except Exception:  # noqa: BLE001
+            p.kill()
+            p.wait()
+    report = "\n".join(
+        open(lp, "rb").read().decode(errors="replace") for lp in logs
+    )
+    assert "WARNING: ThreadSanitizer" not in report, report[-4000:]
+    for p in procs:
+        assert p.returncode != TSAN_EXIT, report[-4000:]
