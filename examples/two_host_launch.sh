@@ -34,12 +34,15 @@ sleep 0.5
 # Rank 1: the Python daemon, same protocol.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.runtime.daemon "$NODEFILE" --rank 1 &
 D1=$!
-sleep 1.5
 
 # A pure-C application linked against libocm_tpu.so (the reference's
 # ocm_test.c journey: init -> alloc -> one-sided put/get -> free).
+# EXPECT_NNODES=2 makes the demo poll the master's membership until both
+# daemons joined, then REQUIRE the allocation to be remote — a fixed
+# sleep here raced the Python daemon's slow JAX import and silently
+# demoted the "remote" leg to the local arm.
 echo "== C app (ocm_c_demo) against the live cluster =="
-LD_LIBRARY_PATH="$NATIVE" "$NATIVE/ocm_c_demo" "$NODEFILE" 0
+LD_LIBRARY_PATH="$NATIVE" "$NATIVE/ocm_c_demo" "$NODEFILE" 0 1048576 2
 
 # The same cluster from Python: remote alloc + push/pull via nodefile
 # auto-attach.
@@ -50,8 +53,16 @@ import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
 
 ctx = ocm.ocm_init(ocm.OcmConfig(rank=0))
+import time
+for _ in range(300):  # joined membership, not nodefile size
+    if ctx.status()["nnodes"] >= 2:
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit("cluster never reached 2 nodes")
 h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
 print(f"allocated {h.nbytes} B on rank {h.rank} (remote={h.is_remote})")
+assert h.is_remote and h.rank == 1, "expected rank-1 remote placement"
 data = np.random.default_rng(0).integers(0, 256, 1 << 20, dtype=np.uint8)
 ctx.put(h, data)
 assert np.array_equal(np.asarray(ctx.get(h)), data)

@@ -23,6 +23,7 @@ import numpy as np
 from oncilla_tpu.core.arena import Extent
 from oncilla_tpu.core.errors import (
     OcmConnectError,
+    OcmError,
     OcmInvalidHandle,
     OcmProtocolError,
     OcmRemoteError,
@@ -44,10 +45,135 @@ from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
 
 
+class _PlaneServer:
+    """Serves a :class:`SpmdIciPlane` to the rest of the cluster: a tiny
+    loopback TCP endpoint speaking PLANE_PUT/PLANE_GET, registered with the
+    daemons via PLANE_SERVE. This is what lets a process WITHOUT a plane
+    (a pure-C app over libocm, a second Python process) do one-sided
+    device-kind ops: its DATA_PUT/DATA_GET reach the owner daemon, which
+    relays them here — closing the cross-process gap vs the reference,
+    where every fabric arm is served between processes
+    (/root/reference/src/alloc.c:151-222). The plane's own lock makes the
+    concurrent server threads safe against the controller's in-process use.
+    """
+
+    def __init__(self, plane, bind_host: str | None = None):
+        self.plane = plane
+        # Bind must match what gets ADVERTISED: a controller announcing a
+        # routable OCM_ADVERTISE_HOST while listening on loopback would
+        # register an endpoint no other host can reach.
+        host = bind_host or os.environ.get("OCM_BIND_HOST") or (
+            "0.0.0.0" if os.environ.get("OCM_ADVERTISE_HOST") else "127.0.0.1"
+        )
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, 0))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="ocm-plane-srv"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="ocm-plane-conn",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except (OSError, OcmProtocolError):
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # noqa: BLE001 — typed wire error
+                    from oncilla_tpu.core.errors import (
+                        OcmBoundsError,
+                        OcmInvalidHandle as _BadHandle,
+                    )
+                    from oncilla_tpu.runtime.protocol import ErrCode
+
+                    if isinstance(e, OcmBoundsError):
+                        code = ErrCode.BOUNDS
+                    elif isinstance(e, _BadHandle):
+                        code = ErrCode.BAD_ALLOC_ID
+                    else:
+                        code = ErrCode.UNKNOWN
+                    reply = Message(
+                        MsgType.ERROR,
+                        {"code": int(code),
+                         "detail": f"plane: {type(e).__name__}: {e}"},
+                    )
+                try:
+                    send_msg(conn, reply)
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: Message) -> Message:
+        f = msg.fields
+        if msg.type not in (
+            MsgType.PLANE_PUT, MsgType.PLANE_GET, MsgType.PLANE_SCRUB
+        ):
+            raise OcmProtocolError(f"plane server got {msg.type.name}")
+        handle = OcmAlloc(
+            alloc_id=f["alloc_id"],
+            kind=OcmKind.REMOTE_DEVICE,
+            fabric=Fabric.ICI,
+            nbytes=f["ext_nbytes"],
+            rank=f["rank"],
+            device_index=f["device_index"],
+            extent=Extent(offset=f["ext_offset"], nbytes=f["ext_nbytes"]),
+            origin_rank=f["rank"],
+        )
+        if msg.type == MsgType.PLANE_SCRUB:
+            # Owner-daemon free-time scrub of a recycled device extent.
+            self.plane.scrub(handle)
+            return Message(MsgType.DATA_PUT_OK, {"nbytes": f["ext_nbytes"]})
+        if msg.type == MsgType.PLANE_PUT:
+            if len(msg.data) != f["nbytes"]:
+                raise OcmProtocolError("PLANE_PUT length mismatch")
+            self.plane.put(
+                handle, np.frombuffer(msg.data, dtype=np.uint8), f["offset"]
+            )
+            return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
+        data = np.asarray(self.plane.get(handle, f["nbytes"], f["offset"]))
+        return Message(
+            MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]}, data.tobytes()
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
 class ControlPlaneClient:
     """Connects an app process to its local daemon (and, for data, directly
     to owner daemons). Implements the RemoteBackend protocol of
-    :class:`oncilla_tpu.core.context.Ocm`."""
+    :class:`oncilla_tpu.core.context.Ocm`.
+
+    When constructed with an ``ici_plane``, the client also SERVES that
+    plane to the cluster (``serve_plane=False`` opts out): plane-less
+    processes' device-kind data ops are relayed here by the daemons (see
+    :class:`_PlaneServer`)."""
 
     def __init__(
         self,
@@ -56,6 +182,7 @@ class ControlPlaneClient:
         config: OcmConfig | None = None,
         ici_plane=None,
         heartbeat: bool = True,
+        serve_plane: bool = True,
     ):
         self.entries = entries
         self.rank = rank
@@ -86,6 +213,18 @@ class ControlPlaneClient:
         if r.type != MsgType.CONNECT_CONFIRM:
             raise OcmConnectError(f"bad handshake reply {r.type.name}")
         self.nnodes = r.fields["nnodes"]
+        self._plane_server: _PlaneServer | None = None
+        if ici_plane is not None and serve_plane:
+            self._plane_server = _PlaneServer(ici_plane)
+            r = self._request(Message(
+                MsgType.PLANE_SERVE,
+                {"host": os.environ.get("OCM_ADVERTISE_HOST", "127.0.0.1"),
+                 "port": self._plane_server.port, "relay": 0},
+            ))
+            if r.type != MsgType.PLANE_SERVE_OK:
+                raise OcmConnectError(
+                    f"plane registration failed: {r.type.name}"
+                )
         self._hb_stop = threading.Event()
         if heartbeat:
             t = threading.Thread(target=self._heartbeat_loop, daemon=True,
@@ -113,6 +252,7 @@ class ControlPlaneClient:
                 self._owner_ranks.pop(rank, None)
 
     def _heartbeat_loop(self) -> None:
+        beats = 0
         while not self._hb_stop.wait(self.config.heartbeat_s):
             try:
                 self._request(
@@ -122,6 +262,18 @@ class ControlPlaneClient:
                          "owners": self._owners_field()},
                     )
                 )
+                beats += 1
+                if self._plane_server is not None and beats % 15 == 0:
+                    # Periodic re-registration: self-heals daemons that
+                    # dropped a stale endpoint (controller crash on the
+                    # same port) or restarted from a snapshot. The daemon
+                    # treats an unchanged endpoint as a no-op.
+                    self._request(Message(
+                        MsgType.PLANE_SERVE,
+                        {"host": os.environ.get(
+                            "OCM_ADVERTISE_HOST", "127.0.0.1"),
+                         "port": self._plane_server.port, "relay": 0},
+                    ))
             except (OSError, OcmProtocolError):
                 printd("client rank %d: heartbeat failed", self.rank)
 
@@ -137,6 +289,15 @@ class ControlPlaneClient:
         (without detach) reclaims the process's allocations at that rank.
         """
         self._hb_stop.set()
+        if self._plane_server is not None and not detach:
+            # Deregister the plane endpoint before it goes dark so daemons
+            # stop relaying (and scrubbing) into a dead socket.
+            try:
+                self._request(Message(
+                    MsgType.PLANE_SERVE, {"host": "", "port": 0, "relay": 0}
+                ))
+            except (OSError, OcmError):
+                pass
         if not detach:
             # Bounded lock (mirrors libocm.cc's try_lock teardown): a beat
             # already inside _request holds _ctrl_lock mid send/recv, and an
@@ -156,6 +317,8 @@ class ControlPlaneClient:
                 finally:
                     self._ctrl_lock.release()
         self._pool.close()
+        if self._plane_server is not None:
+            self._plane_server.close()
         try:
             self._ctrl.close()
         except OSError:
@@ -193,21 +356,27 @@ class ControlPlaneClient:
             origin_rank=self.rank,
         )
         h.owner_addr = (f["owner_host"], f["owner_port"])  # for the DCN path
+        h.daemon_owned = True  # even when demoted: the daemon holds the bytes
         self._note_owner(h.rank, +1)
-        # Scrub-at-alloc for the device arm (calloc parity, alloc.c:171):
-        # the daemon only BOOKS device extents — the bytes live in the
-        # app-side ICI plane's arena — so the plane zeroes a freshly
-        # issued extent before the handle is returned. Alloc-time is the
-        # one choke point that covers every path an offset can be
-        # recycled through (client free, lease-reaper free, DISCONNECT
-        # reclamation), and unlike a free-time scrub it never lets a
-        # stale handle destructively zero a live tenant's bytes. Host
-        # arms are scrubbed at free time by the owner daemon itself
-        # (all of its free paths funnel through one arena release).
-        if placed_kind == OcmKind.REMOTE_DEVICE and self.ici_plane is not None:
-            scrub = getattr(self.ici_plane, "scrub", None)
-            if scrub is not None:
-                scrub(h)
+        # Device-arm scrub (calloc parity, alloc.c:171): the daemon only
+        # BOOKS device extents — the bytes live in the plane's arena. The
+        # authoritative scrub is the owner daemon's free-time PLANE_SCRUB
+        # (every recycle path — client free, lease reaping, DISCONNECT
+        # reclamation — funnels through its one free routine, mirroring
+        # how host arms are scrubbed). A plane-OWNING client additionally
+        # zeroes at alloc via its plane: belt and braces for setups where
+        # no endpoint is registered (serve_plane=False) and therefore the
+        # daemon's free-time scrub had nowhere to go.
+        if placed_kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE):
+            # LOCAL_DEVICE here means single-node demotion of a
+            # REMOTE_DEVICE request: still plane-resident bytes. A
+            # plane-less client needs no alloc-time scrub: the owner
+            # daemon scrubs device extents at FREE time through the plane
+            # (PLANE_SCRUB), so recycled offsets are already clean.
+            if self.ici_plane is not None:
+                scrub = getattr(self.ici_plane, "scrub", None)
+                if scrub is not None:
+                    scrub(h)
         return h
 
     def free(self, handle: OcmAlloc) -> None:
@@ -221,25 +390,30 @@ class ControlPlaneClient:
 
     # -- RemoteBackend: one-sided data ----------------------------------
 
+    # Device arms (REMOTE_DEVICE, and its single-node demotion to
+    # LOCAL_DEVICE) hold their bytes in the SPMD controller's ICI plane
+    # arena — the daemon only books the extents. A client that OWNS the
+    # plane uses it directly; a plane-less client (second process, C app)
+    # rides the DCN path to the owner daemon, which relays to the
+    # registered plane endpoint (PLANE_PUT/PLANE_GET). Host arms always
+    # ride the DCN path.
     def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
-        if handle.kind == OcmKind.REMOTE_DEVICE:
-            self._ici(handle).put(handle, data, offset)
+        if (
+            handle.kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE)
+            and self.ici_plane is not None
+        ):
+            self.ici_plane.put(handle, data, offset)
             return
         raw = np.ascontiguousarray(np.asarray(data)).view(np.uint8).reshape(-1)
         self._dcn_put(handle, raw, offset)
 
     def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0):
-        if handle.kind == OcmKind.REMOTE_DEVICE:
-            return self._ici(handle).get(handle, nbytes, offset)
+        if (
+            handle.kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE)
+            and self.ici_plane is not None
+        ):
+            return self.ici_plane.get(handle, nbytes, offset)
         return self._dcn_get(handle, nbytes, offset)
-
-    def _ici(self, handle: OcmAlloc):
-        if self.ici_plane is None:
-            raise OcmInvalidHandle(
-                "REMOTE_DEVICE data needs an ICI plane; pass ici_plane= to "
-                "ControlPlaneClient (see oncilla_tpu.ops.ici)"
-            )
-        return self.ici_plane
 
     # DCN path: chunked, pipelined DATA_PUT/GET straight to the owner
     # daemon (extoll.c:47-173 scheme over TCP). On a peer ERROR reply the
@@ -295,12 +469,23 @@ class ControlPlaneClient:
                             r.fields["code"], r.fields["detail"]
                         )
                 elif failure is None:
-                    on_reply(r, start, n)
-        except (OSError, OcmProtocolError) as e:
-            if not isinstance(e, OcmRemoteError):
-                self._pool.discard(host, port, entry)
-            else:
-                self._pool.release(host, port, entry)
+                    try:
+                        on_reply(r, start, n)
+                    except (OSError, OcmProtocolError):
+                        raise
+                    except Exception as exc:
+                        # A reply that parses as a frame but whose payload
+                        # doesn't decode (wrong length for np.frombuffer,
+                        # bad field types) means the stream is desynced:
+                        # a transport failure, not an application error.
+                        raise OcmProtocolError(
+                            f"malformed {r.type.name} reply payload: {exc}"
+                        ) from exc
+        except BaseException:
+            # Whatever escaped, the pipeline stopped mid-exchange with
+            # replies possibly still on the wire — the connection cannot
+            # be trusted and the lease must not leak.
+            self._pool.discard(host, port, entry)
             raise
         self._pool.release(host, port, entry)
         if failure is not None:

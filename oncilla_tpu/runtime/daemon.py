@@ -34,6 +34,7 @@ from oncilla_tpu.core.errors import (
     OcmOutOfMemory,
     OcmPlacementError,
     OcmProtocolError,
+    OcmRemoteError,
 )
 from oncilla_tpu.core.hostmem import HostArena
 from oncilla_tpu.core.kinds import OcmKind
@@ -99,6 +100,19 @@ class Daemon:
         self.registry = AllocRegistry(rank, self.config.lease_s)
         self.policy = POLICIES[policy]()
         self.peers = PeerPool()
+        # Device-plane endpoint (host, port) registered by the SPMD
+        # controller's client via PLANE_SERVE; device-kind data ops are
+        # relayed there (tuple rebind is atomic under the GIL). The daemon
+        # that takes a fresh registration pushes it to every peer; ranks
+        # still pending live in _plane_unsynced and are retried by the
+        # reaper loop.
+        self.plane_addr: tuple[str, int] | None = None
+        self._plane_unsynced: set[int] = set()
+        self._plane_sync_lock = threading.Lock()
+        # True once this daemon has relayed a device-kind write: from then
+        # on freed device extents MUST be scrubbed through the plane even
+        # if the local endpoint is momentarily unknown (master hop).
+        self._device_writes_relayed = False
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._running = threading.Event()
@@ -398,6 +412,8 @@ class Daemon:
                     self._do_free_local(e.alloc_id)
                 except OcmInvalidHandle:
                     pass
+            if self._plane_unsynced:
+                self._sync_plane_endpoint()
 
     # -- dispatch --------------------------------------------------------
 
@@ -598,6 +614,28 @@ class Daemon:
         if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             self.host_arena.free(e.extent)
         else:
+            # Scrub-at-free for device extents, BEFORE the offset returns
+            # to the book (no tenant can reuse a dirty extent): the device
+            # twin of the host arms' free-time scrub, done at O(1) wire
+            # cost by the plane controller. Skipped unless this daemon
+            # knows a plane endpoint or has relayed a device write (a
+            # purely bookkeeping workload would otherwise pay a wasted
+            # master round trip per free); plane-owning clients also
+            # scrub at alloc, covering the sync window.
+            if self.plane_addr is not None or self._device_writes_relayed:
+                try:
+                    self._forward_to_plane(Message(
+                        MsgType.PLANE_SCRUB,
+                        {
+                            "alloc_id": e.alloc_id,
+                            "rank": self.rank,
+                            "device_index": e.device_index,
+                            "ext_offset": e.extent.offset,
+                            "ext_nbytes": e.nbytes,
+                        },
+                    ))
+                except (OSError, OcmError):
+                    pass
             self.device_books[e.device_index].free(e.extent)
         self._note_free_rank0(e)
 
@@ -639,7 +677,7 @@ class Daemon:
         f = msg.fields
         e = self.registry.lookup(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
-            raise OcmInvalidHandle("DATA_PUT on a device-arm allocation")
+            return self._relay_device_op(msg, e)
         if len(msg.data) != f["nbytes"]:
             raise OcmProtocolError("DATA_PUT length mismatch")
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
@@ -654,12 +692,126 @@ class Daemon:
         f = msg.fields
         e = self.registry.lookup(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
-            raise OcmInvalidHandle("DATA_GET on a device-arm allocation")
+            return self._relay_device_op(msg, e)
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
         data = self.host_arena.read(e.extent, f["nbytes"], f["offset"])
         return Message(
             MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]}, data.tobytes()
         )
+
+    # -- cross-process device plane (PLANE_SERVE / PLANE_PUT / PLANE_GET) --
+    #
+    # Device bytes live in the SPMD controller's plane arena (the daemon
+    # only BOOKS extents), so a plane-less process's device data op is
+    # relayed to the controller's registered plane endpoint — the bridge
+    # that gives C apps / second processes the full kind taxonomy the
+    # reference serves cross-process (alloc.c:151-222).
+
+    def _on_plane_serve(self, msg: Message) -> Message:
+        f = msg.fields
+        new_addr = (f["host"], f["port"]) if f["port"] else None  # 0=clear
+        if new_addr == self.plane_addr:
+            # Periodic client re-registration of the same endpoint: no
+            # re-broadcast churn.
+            return Message(MsgType.PLANE_SERVE_OK, {"port": f["port"]})
+        self.plane_addr = new_addr
+        printd("daemon %d: device plane %s", self.rank,
+               f"registered at {f['host']}:{f['port']}" if new_addr
+               else "deregistered")
+        if not f.get("relay", 0):
+            # Fresh (de)registration from a local client: every other
+            # daemon must learn it too (owner daemons relay device ops
+            # there; the master is the fallback hop, so it matters MOST).
+            # Push to the master inline — one dial, and a cluster whose
+            # master is down is already broken — but defer the rest to
+            # the reaper loop: a synchronous broadcast here would stall
+            # the registering client ~30 s per unreachable peer.
+            with self._plane_sync_lock:
+                self._plane_unsynced = {
+                    r for r in range(len(self.entries)) if r != self.rank
+                }
+            if self.rank != 0:
+                self._sync_plane_endpoint(only_rank=0)
+        return Message(MsgType.PLANE_SERVE_OK, {"port": f["port"]})
+
+    def _sync_plane_endpoint(self, only_rank: int | None = None) -> None:
+        """Push the current endpoint state (set or cleared) to peers that
+        have not confirmed yet; called from the reaper loop (a one-shot
+        best-effort send would strand the cluster if a peer was briefly
+        unreachable — then 'no device plane registered' forever)."""
+        addr = self.plane_addr
+        host, port = addr if addr is not None else ("", 0)
+        with self._plane_sync_lock:
+            pending = sorted(self._plane_unsynced)
+        for r in pending:
+            if only_rank is not None and r != only_rank:
+                continue
+            e = self.entries[r]
+            try:
+                self.peers.request(
+                    e.connect_host, e.port,
+                    Message(MsgType.PLANE_SERVE,
+                            {"host": host, "port": port, "relay": 1}),
+                )
+                with self._plane_sync_lock:
+                    self._plane_unsynced.discard(r)
+            except (OSError, OcmError):
+                pass  # retried on the next reaper tick
+
+    def _relay_device_op(self, msg: Message, e) -> Message:
+        f = msg.fields
+        # Owner-side bounds check first: never ship an op the extent
+        # cannot satisfy.
+        check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
+        if msg.type == MsgType.DATA_PUT and len(msg.data) != f["nbytes"]:
+            raise OcmProtocolError("DATA_PUT length mismatch")
+        if msg.type == MsgType.DATA_PUT:
+            self._device_writes_relayed = True
+        relay = Message(
+            MsgType.PLANE_PUT if msg.type == MsgType.DATA_PUT
+            else MsgType.PLANE_GET,
+            {
+                "alloc_id": e.alloc_id,
+                "rank": self.rank,
+                "device_index": e.device_index,
+                "ext_offset": e.extent.offset,
+                "ext_nbytes": e.nbytes,
+                "offset": f["offset"],
+                "nbytes": f["nbytes"],
+            },
+            msg.data,
+        )
+        return self._forward_to_plane(relay)
+
+    def _forward_to_plane(self, relay: Message) -> Message:
+        addr = self.plane_addr
+        try:
+            if addr is not None:
+                try:
+                    return self.peers.request(addr[0], addr[1], relay)
+                except OcmConnectError:
+                    # Nothing listens there anymore (controller crashed
+                    # without deregistering). Drop the stale endpoint —
+                    # clients re-register live planes periodically — and
+                    # fall through to the master hop / typed error.
+                    self.plane_addr = None
+                    addr = None
+            if self.rank != 0:
+                r0 = self.entries[0]  # master hop: it learns endpoints first
+                return self.peers.request(r0.connect_host, r0.port, relay)
+        except OcmRemoteError as err:
+            return _err(ErrCode(err.code) if err.code in
+                        ErrCode._value2member_map_ else ErrCode.UNKNOWN,
+                        err.detail)
+        raise OcmInvalidHandle(
+            "device-kind data needs a registered plane: construct the "
+            "controller's ControlPlaneClient with ici_plane= (it serves "
+            "the plane automatically)"
+        )
+
+    def _on_plane_relay(self, msg: Message) -> Message:
+        """Master hop for owner daemons that don't know the endpoint."""
+        return self._forward_to_plane(msg)
 
     # -- liveness --------------------------------------------------------
 
@@ -766,6 +918,10 @@ _HANDLERS = {
     MsgType.NOTE_ALLOC: Daemon._on_note_alloc,
     MsgType.DATA_PUT: Daemon._on_data_put,
     MsgType.DATA_GET: Daemon._on_data_get,
+    MsgType.PLANE_SERVE: Daemon._on_plane_serve,
+    MsgType.PLANE_PUT: Daemon._on_plane_relay,
+    MsgType.PLANE_GET: Daemon._on_plane_relay,
+    MsgType.PLANE_SCRUB: Daemon._on_plane_relay,
     MsgType.HEARTBEAT: Daemon._on_heartbeat,
     MsgType.STATUS: Daemon._on_status,
 }

@@ -71,9 +71,17 @@ class PeerPool {
     std::unique_lock<std::mutex> g(c->mu, std::adopt_lock);
     try {
       send_msg(c->fd, m);
-      return recv_msg(c->fd);
-    } catch (const ProtocolError&) {
+      Message r = recv_msg(c->fd);
+      g.unlock();
+      cv_.notify_all();  // a cap-blocked lease() can have this conn now
+      return r;
+    } catch (...) {
+      // Any interrupted exchange leaves the stream desynced: evict the
+      // connection (never cache a half-read one) and wake cap waiters,
+      // since the peer's list just shrank below the bound.
       discard(host, port, c);
+      g.unlock();
+      cv_.notify_all();
       throw;
     }
   }
@@ -81,11 +89,14 @@ class PeerPool {
   // Terminal: refuses new dials afterwards, so a worker racing shutdown
   // cannot re-dial a hung peer and block stop()'s join forever.
   void close_all() {
-    std::lock_guard<std::mutex> g(mu_);
-    closed_ = true;
-    for (auto& kv : conns_)
-      for (auto& c : kv.second) ::shutdown(c->fd, SHUT_RDWR);
-    conns_.clear();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      closed_ = true;
+      for (auto& kv : conns_)
+        for (auto& c : kv.second) ::shutdown(c->fd, SHUT_RDWR);
+      conns_.clear();
+    }
+    cv_.notify_all();  // cap-blocked leases must see closed_ and throw
   }
 
  private:
@@ -97,14 +108,24 @@ class PeerPool {
     }
   };
 
-  // Returns with c->mu HELD (caller adopts).
+  // Returns with c->mu HELD (caller adopts). Bounded at kPerPeer
+  // connections per peer (pool.py's per_peer): at the cap, wait for any
+  // in-flight request to that peer to finish instead of dialing without
+  // bound under a concurrency spike.
   std::shared_ptr<Conn> lease(const std::string& host, int port) {
     auto key = host + ":" + std::to_string(port);
     {
-      std::lock_guard<std::mutex> g(mu_);
-      if (closed_) throw ProtocolError("peer pool is shut down");
-      for (auto& c : conns_[key])
-        if (c->mu.try_lock()) return c;
+      std::unique_lock<std::mutex> g(mu_);
+      while (true) {
+        if (closed_) throw ProtocolError("peer pool is shut down");
+        auto& vec = conns_[key];
+        for (auto& c : vec)
+          if (c->mu.try_lock()) return c;
+        if (vec.size() < kPerPeer) break;  // room: dial outside mu_
+        // The timed wait is only a missed-notify backstop; request()'s
+        // notify_all is the real wakeup.
+        cv_.wait_for(g, std::chrono::seconds(1));
+      }
     }
     auto c = std::make_shared<Conn>();
     c->fd = dial(host, port);
@@ -135,7 +156,9 @@ class PeerPool {
     }
   }
 
+  static constexpr size_t kPerPeer = 16;  // pool.py per_peer
   std::mutex mu_;
+  std::condition_variable cv_;
   bool closed_ = false;
   std::map<std::string, std::vector<std::shared_ptr<Conn>>> conns_;
 };
@@ -542,6 +565,12 @@ class Daemon {
         } catch (const BadHandleError&) {
         }
       }
+      bool pending;
+      {
+        std::lock_guard<std::mutex> g(plane_mu_);
+        pending = !plane_unsynced_.empty();
+      }
+      if (pending) sync_plane_endpoint();
     }
   }
 
@@ -645,6 +674,10 @@ class Daemon {
       case MsgType::NOTE_ALLOC: return on_note_alloc(m);
       case MsgType::DATA_PUT: return on_data_put(m);
       case MsgType::DATA_GET: return on_data_get(m);
+      case MsgType::PLANE_SERVE: return on_plane_serve(m);
+      case MsgType::PLANE_PUT: return forward_to_plane(m);
+      case MsgType::PLANE_GET: return forward_to_plane(m);
+      case MsgType::PLANE_SCRUB: return forward_to_plane(m);
       case MsgType::HEARTBEAT: return on_heartbeat(m);
       case MsgType::STATUS: return on_status();
       default:
@@ -765,6 +798,29 @@ class Daemon {
       std::memset(host_store_.data() + e.extent.offset, 0, e.extent.nbytes);
       host_arena_.release(e.extent.offset);
     } else {
+      // Device twin of the host scrub: ask the plane controller to zero
+      // the extent BEFORE the offset returns to the book (O(1) wire).
+      // Skipped unless this daemon knows a plane endpoint or has relayed
+      // a device write — a bookkeeping-only workload must not pay a
+      // master round trip per free (daemon.py twin).
+      bool known;
+      {
+        std::lock_guard<std::mutex> g(plane_mu_);
+        known = !plane_host_.empty();
+      }
+      if (known || device_writes_relayed_) {
+        try {
+          forward_to_plane(Message{
+              MsgType::PLANE_SCRUB,
+              {{"alloc_id", Value::U(e.alloc_id)},
+               {"rank", Value::I(cfg_.rank)},
+               {"device_index", Value::U(e.device_index)},
+               {"ext_offset", Value::U(e.extent.offset)},
+               {"ext_nbytes", Value::U(e.nbytes)}},
+              {}});
+        } catch (const std::exception&) {
+        }
+      }
       device_books_[e.device_index]->release(e.extent.offset);
     }
     Message note{MsgType::NOTE_FREE,
@@ -940,34 +996,147 @@ class Daemon {
   }
 
   // DCN data plane: one-sided put/get into the daemon-owned host arena (the
-  // registered-buffer analogue, alloc.c:171-176).
+  // registered-buffer analogue, alloc.c:171-176). Device-kind extents hold
+  // their bytes in the SPMD controller's plane arena, so those ops are
+  // relayed to the registered plane endpoint (runtime/daemon.py twin).
   Message on_data_put(const Message& m) {
     RegEntry e = registry_.lookup(m.u("alloc_id"));
-    if (!kind_is_host(e.kind))
-      throw BadHandleError("DATA_PUT on a device-arm allocation");
     uint64_t off = m.u("offset"), n = m.u("nbytes");
     if (m.data.size() != n) throw ProtocolError("DATA_PUT length mismatch");
     if (off + n > e.nbytes)
       throw BoundsError("access [" + std::to_string(off) + ", " +
                         std::to_string(off + n) + ") outside extent of " +
                         std::to_string(e.nbytes) + " B");
+    if (!kind_is_host(e.kind)) return relay_device_op(m, e);
     std::memcpy(host_store_.data() + e.extent.offset + off, m.data.data(), n);
     return {MsgType::DATA_PUT_OK, {{"nbytes", Value::U(n)}}, {}};
   }
 
   Message on_data_get(const Message& m) {
     RegEntry e = registry_.lookup(m.u("alloc_id"));
-    if (!kind_is_host(e.kind))
-      throw BadHandleError("DATA_GET on a device-arm allocation");
     uint64_t off = m.u("offset"), n = m.u("nbytes");
     if (off + n > e.nbytes)
       throw BoundsError("access [" + std::to_string(off) + ", " +
                         std::to_string(off + n) + ") outside extent of " +
                         std::to_string(e.nbytes) + " B");
+    if (!kind_is_host(e.kind)) return relay_device_op(m, e);
     Message r{MsgType::DATA_GET_OK, {{"nbytes", Value::U(n)}}, {}};
     r.data.assign(host_store_.begin() + e.extent.offset + off,
                   host_store_.begin() + e.extent.offset + off + n);
     return r;
+  }
+
+  // -- cross-process device plane (PLANE_SERVE / PLANE_PUT / PLANE_GET) --
+
+  Message on_plane_serve(const Message& m) {
+    std::string host = m.u("port") ? m.s("host") : "";  // port 0 = clear
+    int port = int(m.u("port"));
+    {
+      std::lock_guard<std::mutex> g(plane_mu_);
+      if (host == plane_host_ && port == plane_port_) {
+        // Periodic client re-registration of the same endpoint: no-op.
+        return {MsgType::PLANE_SERVE_OK, {{"port", Value::U(m.u("port"))}},
+                {}};
+      }
+      plane_host_ = host;
+      plane_port_ = port;
+    }
+    if (m.u("relay") == 0) {
+      // Fresh (de)registration from a local client: the master matters
+      // most (it is everyone's fallback hop), so push there inline — one
+      // dial. The rest of the peers are retried from the reaper loop; a
+      // synchronous broadcast here would stall the registering client
+      // for the connect timeout per unreachable peer.
+      size_t n;
+      {
+        std::lock_guard<std::mutex> ge(entries_mu_);
+        n = entries_.size();
+      }
+      {
+        std::lock_guard<std::mutex> g(plane_mu_);
+        plane_unsynced_.clear();
+        for (size_t r = 0; r < n; ++r)
+          if (int64_t(r) != cfg_.rank) plane_unsynced_.insert(int64_t(r));
+      }
+      if (cfg_.rank != 0) sync_plane_endpoint(/*only_rank=*/0);
+    }
+    return {MsgType::PLANE_SERVE_OK, {{"port", Value::U(m.u("port"))}}, {}};
+  }
+
+  // only_rank == -1: push to every pending peer (reaper); otherwise only
+  // to that rank.
+  void sync_plane_endpoint(int64_t only_rank = -1) {
+    std::string host;
+    int port = 0;
+    std::vector<int64_t> pending;
+    {
+      std::lock_guard<std::mutex> g(plane_mu_);
+      host = plane_host_;
+      port = plane_port_;
+      pending.assign(plane_unsynced_.begin(), plane_unsynced_.end());
+    }
+    for (int64_t r : pending) {
+      if (only_rank >= 0 && r != only_rank) continue;
+      try {
+        NodeEntry e = entry(r);
+        peers_.request(e.caddr(), e.port,
+                       Message{MsgType::PLANE_SERVE,
+                               {{"host", Value::S(host)},
+                                {"port", Value::U(uint64_t(port))},
+                                {"relay", Value::U(1)}},
+                               {}});
+        std::lock_guard<std::mutex> g(plane_mu_);
+        plane_unsynced_.erase(r);
+      } catch (const std::exception&) {
+        // retried on the next reaper tick
+      }
+    }
+  }
+
+  Message relay_device_op(const Message& m, const RegEntry& e) {
+    if (m.type == MsgType::DATA_PUT) device_writes_relayed_ = true;
+    Message relay{
+        m.type == MsgType::DATA_PUT ? MsgType::PLANE_PUT : MsgType::PLANE_GET,
+        {{"alloc_id", Value::U(e.alloc_id)},
+         {"rank", Value::I(cfg_.rank)},
+         {"device_index", Value::U(e.device_index)},
+         {"ext_offset", Value::U(e.extent.offset)},
+         {"ext_nbytes", Value::U(e.nbytes)},
+         {"offset", Value::U(m.u("offset"))},
+         {"nbytes", Value::U(m.u("nbytes"))}},
+        m.data};
+    return forward_to_plane(relay);
+  }
+
+  Message forward_to_plane(const Message& relay) {
+    std::string host;
+    int port = 0;
+    {
+      std::lock_guard<std::mutex> g(plane_mu_);
+      host = plane_host_;
+      port = plane_port_;
+    }
+    if (!host.empty()) {
+      try {
+        return peers_.request(host, port, relay);
+      } catch (const std::exception&) {
+        // Endpoint unreachable (controller gone without deregistering):
+        // drop it — live controllers re-register periodically — and fall
+        // through to the master hop / typed error.
+        std::lock_guard<std::mutex> g(plane_mu_);
+        if (plane_host_ == host && plane_port_ == port) {
+          plane_host_.clear();
+          plane_port_ = 0;
+        }
+      }
+    }
+    if (cfg_.rank != 0) {  // master hop: it learns endpoints first
+      NodeEntry r0 = entry(0);
+      return peers_.request(r0.caddr(), r0.port, relay);
+    }
+    throw BadHandleError(
+        "device-kind data needs a registered plane: construct the "
+        "controller's ControlPlaneClient with ici_plane=");
   }
 
   Message on_heartbeat(const Message& m) {
@@ -1063,6 +1232,14 @@ class Daemon {
   Config cfg_;
   std::vector<NodeEntry> entries_;
   std::mutex entries_mu_;
+  // Device-plane endpoint registered via PLANE_SERVE (empty host = none);
+  // plane_unsynced_ = peer ranks that have not confirmed the endpoint yet
+  // (pushed again from the reaper loop).
+  std::mutex plane_mu_;
+  std::string plane_host_;
+  int plane_port_ = 0;
+  std::set<int64_t> plane_unsynced_;
+  std::atomic<bool> device_writes_relayed_{false};
   ArenaAllocator host_arena_;
   std::vector<uint8_t> host_store_;  // the DCN arm's actual bytes
   std::vector<std::unique_ptr<ArenaAllocator>> device_books_;

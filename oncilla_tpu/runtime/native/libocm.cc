@@ -325,11 +325,9 @@ int ocmc_free(ocmc_ctx* ctx, const ocmc_handle* h) {
 int ocmc_put(ocmc_ctx* ctx, const ocmc_handle* h, const void* buf,
              uint64_t nbytes, uint64_t offset) {
   if (!ctx || !h || (!buf && nbytes)) return -1;
-  if (kind_is_device(h->kind)) {
-    ctx->set_error(
-        "device-kind data moves through the JAX/SPMD binding, not libocm");
-    return -1;
-  }
+  // Device kinds flow like host kinds: the owner daemon relays them to the
+  // SPMD controller's registered plane endpoint (PLANE_PUT/PLANE_GET), so
+  // a pure-C app gets the full kind taxonomy cross-process.
   const uint8_t* p = static_cast<const uint8_t*>(buf);
   try {
     ctx->transfer(
@@ -354,11 +352,6 @@ int ocmc_put(ocmc_ctx* ctx, const ocmc_handle* h, const void* buf,
 int ocmc_get(ocmc_ctx* ctx, const ocmc_handle* h, void* buf, uint64_t nbytes,
              uint64_t offset) {
   if (!ctx || !h || (!buf && nbytes)) return -1;
-  if (kind_is_device(h->kind)) {
-    ctx->set_error(
-        "device-kind data moves through the JAX/SPMD binding, not libocm");
-    return -1;
-  }
   uint8_t* p = static_cast<uint8_t*>(buf);
   try {
     ctx->transfer(
@@ -503,6 +496,18 @@ uint64_t ocmc_remote_sz(const ocmc_handle* h) {
 }
 
 int64_t ocmc_nnodes(const ocmc_ctx* ctx) { return ctx ? ctx->nnodes : 0; }
+
+int64_t ocmc_refresh_nnodes(ocmc_ctx* ctx) {
+  if (!ctx) return -1;
+  try {
+    Message r = ctx->ctrl_request(Message{MsgType::STATUS, {}, {}});
+    ctx->nnodes = r.i("nnodes");
+    return ctx->nnodes;
+  } catch (const std::exception& e) {
+    ctx->set_error(e.what());
+    return -1;
+  }
+}
 
 const char* ocmc_last_error(const ocmc_ctx* ctx) {
   // Snapshot into thread-local storage under the lock: the returned pointer

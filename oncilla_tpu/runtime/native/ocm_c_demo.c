@@ -5,28 +5,52 @@
  * buffers and through the handle's localbuf via ocmc_copy_onesided), and
  * test 3's host arm (handle-to-handle ocmc_copy).
  *
- * Usage: ocm_c_demo NODEFILE RANK [NBYTES]
+ * Usage: ocm_c_demo NODEFILE RANK [NBYTES [EXPECT_NNODES]]
+ * With EXPECT_NNODES > 1 the demo first polls the master's membership
+ * until that many daemons joined (a still-joining cluster demotes remote
+ * requests to the local arm, alloc.c:82-83), then REQUIRES the
+ * allocation to actually be remote — the reference's ocm_test asserts
+ * its remoteness expectations the same way (test/ocm_test.c:97-103).
  * Exit code 0 and "pass:" lines on success, -1/"FAIL:" otherwise. */
 
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #include "ocm_client.h"
 
 int main(int argc, char** argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s NODEFILE RANK [NBYTES]\n", argv[0]);
+    fprintf(stderr, "usage: %s NODEFILE RANK [NBYTES [EXPECT_NNODES]]\n",
+            argv[0]);
     return -1;
   }
   const char* nodefile = argv[1];
   long rank = strtol(argv[2], NULL, 10);
   unsigned long long n = argc > 3 ? strtoull(argv[3], NULL, 10) : (1u << 20);
+  long expect_nnodes = argc > 4 ? strtol(argv[4], NULL, 10) : 0;
 
   ocmc_ctx* ctx = ocmc_init(nodefile, rank, 2.0);
   if (!ctx) {
     fprintf(stderr, "FAIL: init: %s\n", ocmc_last_error(NULL));
     return -1;
+  }
+
+  if (expect_nnodes > 1) {
+    int64_t seen = ocmc_nnodes(ctx);
+    for (int i = 0; i < 300 && seen < expect_nnodes; ++i) { /* <= 30 s */
+      usleep(100 * 1000);
+      seen = ocmc_refresh_nnodes(ctx);
+    }
+    if (seen < expect_nnodes) {
+      fprintf(stderr, "FAIL: cluster never reached %ld nodes (saw %lld)\n",
+              expect_nnodes, (long long)seen);
+      ocmc_tini(ctx);
+      return -1;
+    }
+    printf("membership: %lld/%ld nodes joined\n", (long long)seen,
+           expect_nnodes);
   }
 
   ocmc_handle h;
@@ -39,6 +63,17 @@ int main(int argc, char** argv) {
   printf("alloc id=%llu owner_rank=%lld remote=%d sz=%llu\n",
          (unsigned long long)h.alloc_id, (long long)h.rank,
          ocmc_is_remote(&h), (unsigned long long)ocmc_remote_sz(&h));
+  if (ocmc_nnodes(ctx) >= 2) {
+    /* A multi-node cluster must serve REMOTE_HOST remotely; a demoted
+     * handle here means the join raced the app (ocm_test.c:97-103). */
+    if (!ocmc_is_remote(&h) || ocmc_remote_sz(&h) != n) {
+      fprintf(stderr, "FAIL: expected a remote allocation on a %lld-node "
+              "cluster, got remote=%d sz=%llu\n",
+              (long long)ocmc_nnodes(ctx), ocmc_is_remote(&h),
+              (unsigned long long)ocmc_remote_sz(&h));
+      goto done;
+    }
+  }
 
   src = malloc(n);
   dst = malloc(n);

@@ -119,6 +119,14 @@ uint64_t ocmc_remote_sz(const ocmc_handle* h);
 /* Number of cluster nodes the daemon reported at CONNECT. */
 int64_t ocmc_nnodes(const ocmc_ctx* ctx);
 
+/* Re-query the local daemon's CURRENT membership view (STATUS round
+ * trip; on the rank-0 master this is the joined count, not the nodefile
+ * size). Updates the value ocmc_nnodes returns. Returns the fresh count,
+ * or -1 on error. Poll this before depending on remote placement: a
+ * still-joining cluster demotes remote allocation requests to the local
+ * arm (alloc.c:82-83 parity). */
+int64_t ocmc_refresh_nnodes(ocmc_ctx* ctx);
+
 /* Description of the most recent failure on `ctx`; with ctx == NULL, the
  * most recent ocmc_init failure (process-wide). Valid until the next call
  * on the same context / thread. */

@@ -60,6 +60,30 @@ const std::map<MsgType, std::vector<Field>>& schemas() {
         {"live_allocs", 'Q'},
         {"host_bytes_live", 'Q'},
         {"device_bytes_live", 'Q'}}},
+      {MsgType::PLANE_SERVE, {{"host", 's'}, {"port", 'I'}, {"relay", 'B'}}},
+      {MsgType::PLANE_SERVE_OK, {{"port", 'I'}}},
+      {MsgType::PLANE_PUT,
+       {{"alloc_id", 'Q'},
+        {"rank", 'q'},
+        {"device_index", 'I'},
+        {"ext_offset", 'Q'},
+        {"ext_nbytes", 'Q'},
+        {"offset", 'Q'},
+        {"nbytes", 'Q'}}},
+      {MsgType::PLANE_GET,
+       {{"alloc_id", 'Q'},
+        {"rank", 'q'},
+        {"device_index", 'I'},
+        {"ext_offset", 'Q'},
+        {"ext_nbytes", 'Q'},
+        {"offset", 'Q'},
+        {"nbytes", 'Q'}}},
+      {MsgType::PLANE_SCRUB,
+       {{"alloc_id", 'Q'},
+        {"rank", 'q'},
+        {"device_index", 'I'},
+        {"ext_offset", 'Q'},
+        {"ext_nbytes", 'Q'}}},
       {MsgType::ERR, {{"code", 'I'}, {"detail", 's'}}},
   };
   return kSchemas;

@@ -49,6 +49,15 @@ enum class MsgType : uint8_t {
   HEARTBEAT_OK = 41,
   STATUS = 42,
   STATUS_OK = 43,
+  // Cross-process device plane: the SPMD controller registers its plane
+  // endpoint (PLANE_SERVE); daemons relay device-kind data ops to it as
+  // PLANE_PUT/PLANE_GET enriched with the registry extent (replies reuse
+  // DATA_PUT_OK / DATA_GET_OK).
+  PLANE_SERVE = 50,
+  PLANE_SERVE_OK = 51,
+  PLANE_PUT = 52,
+  PLANE_GET = 53,
+  PLANE_SCRUB = 54,
   ERR = 99,
 };
 

@@ -63,6 +63,18 @@ class MsgType(enum.IntEnum):
     HEARTBEAT_OK = 41
     STATUS = 42
     STATUS_OK = 43
+    # cross-process device plane: the SPMD controller's client registers
+    # its plane endpoint (PLANE_SERVE -> master), and daemons relay
+    # device-kind data ops to it as PLANE_PUT/PLANE_GET enriched with the
+    # registry extent (replies reuse DATA_PUT_OK/DATA_GET_OK). This is how
+    # a plane-less process (a C app over libocm, a second Python process)
+    # reaches device bytes — the reference serves every arm cross-process
+    # (alloc.c:151-222); here the daemon bridges to the controller.
+    PLANE_SERVE = 50
+    PLANE_SERVE_OK = 51
+    PLANE_PUT = 52
+    PLANE_GET = 53
+    PLANE_SCRUB = 54
     # failure
     ERROR = 99
 
@@ -181,6 +193,40 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
         ("live_allocs", "Q"),
         ("host_bytes_live", "Q"),
         ("device_bytes_live", "Q"),
+    ],
+    # "relay" = 0 from the registering client, 1 daemon-to-daemon (the
+    # forward-to-master and master-broadcast legs never re-forward).
+    MsgType.PLANE_SERVE: [("host", "s"), ("port", "I"), ("relay", "B")],
+    MsgType.PLANE_SERVE_OK: [("port", "I")],
+    # The daemon->plane relay legs carry the registry extent so the plane
+    # controller can address its arena without a registry of its own.
+    MsgType.PLANE_PUT: [
+        ("alloc_id", "Q"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("ext_offset", "Q"),
+        ("ext_nbytes", "Q"),
+        ("offset", "Q"),
+        ("nbytes", "Q"),
+    ],
+    MsgType.PLANE_GET: [
+        ("alloc_id", "Q"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("ext_offset", "Q"),
+        ("ext_nbytes", "Q"),
+        ("offset", "Q"),
+        ("nbytes", "Q"),
+    ],
+    # Owner-daemon -> plane: zero a recycled device extent at free time
+    # (O(1) wire; the device twin of "host arms are scrubbed at free time
+    # by the owner daemon"). Reply: DATA_PUT_OK.
+    MsgType.PLANE_SCRUB: [
+        ("alloc_id", "Q"),
+        ("rank", "q"),
+        ("device_index", "I"),
+        ("ext_offset", "Q"),
+        ("ext_nbytes", "Q"),
     ],
     MsgType.ERROR: [("code", "I"), ("detail", "s")],
 }

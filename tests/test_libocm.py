@@ -197,14 +197,17 @@ def test_c_client_errors(lib, cluster):
         # double free fails cleanly
         assert lib.ocmc_free(ctx, ctypes.byref(h)) == -1
 
-        # device-kind data is rejected at the client
+        # Device-kind data with NO plane registered anywhere: the owner
+        # daemon refuses the relayed op with a typed error naming the fix
+        # (when a controller serves a plane this same call succeeds —
+        # tests/test_plane_relay.py::test_libocm_c_abi_device_roundtrip).
         hd = OcmcHandle()
         assert lib.ocmc_alloc(ctx, 4096, 2, ctypes.byref(hd)) == 0  # REMOTE_DEVICE
         rc = lib.ocmc_put(
             ctx, ctypes.byref(hd),
             buf.ctypes.data_as(ctypes.c_void_p), 4096, 0,
         )
-        assert rc == -1 and b"JAX" in lib.ocmc_last_error(ctx)
+        assert rc == -1 and b"registered plane" in lib.ocmc_last_error(ctx)
         assert lib.ocmc_free(ctx, ctypes.byref(hd)) == 0
     finally:
         lib.ocmc_tini(ctx)

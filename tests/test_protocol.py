@@ -23,6 +23,7 @@ def test_roundtrip_all_schemas():
         "host_bytes_live": 11, "device_bytes_live": 22,
         "owner_host": "10.0.0.1", "owner_port": 18000,
         "owners": "1,3,5", "count": 2,
+        "relay": 1, "ext_offset": 4096, "ext_nbytes": 65536,
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})
