@@ -152,17 +152,17 @@ def gups_handles(
     method: str = "scatter",
     plane=None,
 ) -> dict:
-    """GUPS over an ocm handle: alloc a ``words``-word uint32 table as a
-    REMOTE_DEVICE extent in the one-sided plane's arena and run the update
-    loop against the extent bytes in place (only the handle's device row
-    is mutated), verifying through the handle. The helper claims bytes
-    [4096, 4096 + 4*words) of device 0's row, so pass a dedicated bench
-    ``plane`` (or none — a fresh loopback plane is made), not one holding
-    live allocations."""
-    from oncilla_tpu.core.arena import Extent
-    from oncilla_tpu.core.handle import OcmAlloc
-    from oncilla_tpu.core.kinds import Fabric, OcmKind
+    """GUPS over an ocm handle allocated END TO END through the control
+    plane: an in-process daemon cluster places the table as a device-kind
+    allocation (``ctx.alloc``), the plane serves the bytes, and the update
+    loop scatter-adds into the daemon-issued extent in place (only the
+    handle's device row is mutated). Reset and conservation read-back go
+    through ``ctx.put``/``ctx.get_as`` — the full public path. Pass a
+    dedicated bench ``plane`` (or none — a fresh loopback plane is made),
+    not one holding live allocations."""
+    from oncilla_tpu.core.kinds import OcmKind
     from oncilla_tpu.ops.ici import SpmdIciPlane
+    from oncilla_tpu.runtime.cluster import local_cluster
     from oncilla_tpu.utils.config import OcmConfig
 
     nbytes = 4 * words
@@ -175,34 +175,43 @@ def gups_handles(
             mesh=mesh, devices_per_rank=1,
         )
     mesh = plane.mesh
-    off = 4096  # a non-zero extent offset: prove offset addressing, not row 0
-    handle = OcmAlloc(
-        alloc_id=2, kind=OcmKind.REMOTE_DEVICE, fabric=Fabric.ICI,
-        nbytes=nbytes, rank=0, device_index=0,
-        extent=Extent(offset=off, nbytes=nbytes), origin_rank=0,
+    cfg = OcmConfig(
+        host_arena_bytes=1 << 20,
+        device_arena_bytes=plane.config.device_arena_bytes,
     )
-    plane.put(handle, np.zeros(nbytes, np.uint8))
-    from oncilla_tpu.ops.ici import resolve_global_device
+    with local_cluster(1, config=cfg) as cl:
+        ctx = cl.context(0, ici_plane=plane)
+        # A pad first so the table extent sits at a non-zero offset:
+        # proves offset addressing, not row 0. (On a 1-node cluster the
+        # REMOTE_DEVICE request demotes to LOCAL_DEVICE, alloc.c:82-83 —
+        # still daemon-registered, still plane-resident.)
+        pad = ctx.alloc(4096, OcmKind.REMOTE_DEVICE)
+        handle = ctx.alloc(nbytes, OcmKind.REMOTE_DEVICE)
+        off = handle.extent.offset
+        assert off != 0, "pad should push the table off offset 0"
+        from oncilla_tpu.ops.ici import resolve_global_device
 
-    gdev = resolve_global_device(
-        handle, plane.devices_per_rank, int(mesh.devices.size)
-    )
+        gdev = resolve_global_device(
+            handle, plane.devices_per_rank, int(mesh.devices.size)
+        )
 
-    def run(arena):
-        return _gups_handle_run(arena, steps, batch, words, seed, off,
-                                gdev, method, mesh)
+        def run(arena):
+            return _gups_handle_run(arena, steps, batch, words, seed, off,
+                                    gdev, method, mesh)
 
-    plane.update(run)               # warm-up compiles the timed executable
-    plane.put(handle, np.zeros(nbytes, np.uint8))   # reset via the handle
-    _fence(plane.arena[0, :8])
-    t0 = time.perf_counter()
-    plane.update(run)
-    _fence(plane.arena[0, :8])
-    dt = time.perf_counter() - t0
-    updates = steps * batch
-    # Conservation, read back THROUGH the handle.
-    tbl = np.asarray(plane.get_as(handle, (words,), np.uint32))
-    total = int(tbl.astype(np.uint64).sum())
+        plane.update(run)           # warm-up compiles the timed executable
+        ctx.put(handle, np.zeros(nbytes, np.uint8))  # reset via the handle
+        _fence(plane.arena[0, :8])
+        t0 = time.perf_counter()
+        plane.update(run)
+        _fence(plane.arena[0, :8])
+        dt = time.perf_counter() - t0
+        updates = steps * batch
+        # Conservation, read back THROUGH the handle via the public API.
+        tbl = np.asarray(ctx.get_as(handle, (words,), np.uint32))
+        total = int(tbl.astype(np.uint64).sum())
+        ctx.free(handle)
+        ctx.free(pad)
     return {
         "mode": f"handle:{method}",
         "gups": updates / dt / 1e9,

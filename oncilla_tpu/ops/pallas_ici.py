@@ -286,15 +286,28 @@ def pallas_ici_copy(
         "pallas path needs BLOCK-aligned offsets/size; use spmd_arena."
         "ici_copy which falls back to the ppermute path"
     )
+    # Same-device overlapping extents are unsafe on BOTH paths: the raw
+    # TPU DMA reads undefined bytes (pallas_local_copy's contract), and
+    # the windowed interpret path chunks the transfer, so an earlier
+    # window can overwrite source blocks a later window still needs.
+    # Enforce the contract whenever the device ids are concrete (they may
+    # be traced scalars, in which case the caller owns the invariant).
+    try:
+        same_dev = int(src_dev) == int(dst_dev)
+    except (TypeError, jax.errors.JAXTypeError):
+        same_dev = False
+    if same_dev:
+        lo, hi = int(src_off), int(dst_off)
+        assert hi + nbytes <= lo or lo + nbytes <= hi, (
+            "overlapping same-device extents are unsafe for "
+            "pallas_ici_copy; use DeviceArena.move"
+        )
     if interpret is None:
         interpret = _interpret_mode()
     if interpret:
         # Windowed path: the interpret machine cannot move refs ≥128 KiB
         # (module docstring), so slice ≤96 KiB windows around the extents
-        # and chunk — O(transfer) interpret cost on any arena size. Note a
-        # same-device copy with overlapping extents is handled correctly
-        # here (the windows are value copies), matching the TPU path's
-        # non-overlap contract rather than relaxing it.
+        # and chunk — O(transfer) interpret cost on any arena size.
         return _windowed_interpret_copy(
             arena, src_dev, dst_dev, int(src_off) // BLOCK,
             int(dst_off) // BLOCK, nbytes // BLOCK,
