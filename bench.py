@@ -20,14 +20,16 @@ numbers (BASELINE.md), so the target transplanted from the north star
 a copy touches each byte twice (read + write), so we credit 2·nbytes of
 HBM traffic per copy.
 
-Ceiling evidence: the ~0.88 vs_baseline is the DMA copy engine's
-plateau, not a tuning gap. Swept on-chip (fresh process per variant):
-1/2/4/8 persistent streams all land 442-584 GB/s of combined traffic
-(stream count immaterial — the engine saturates), a chunked/windowed
-descriptor scheme adds nothing, and a VMEM-round-trip grid memcpy is
-strictly worse (~366 GB/s: each byte makes two DMA hops). A copy's
-read-write turnaround keeps HBM below the read-only line rate the 819
-figure describes.
+Ceiling evidence (MEASURED IN ROUND 3 — the round-4 tunnel wedge allowed
+no re-measurement; every run re-derives it fresh in ``detail.ceiling``):
+the ~0.88 vs_baseline was the DMA copy engine's plateau, not a tuning
+gap. Swept on-chip then (fresh process per variant): 1/2/4/8 persistent
+streams all landed 442-584 GB/s of combined traffic (stream count
+immaterial — the engine saturates), a chunked/windowed descriptor scheme
+added nothing, and a VMEM-round-trip grid memcpy was strictly worse
+(~366 GB/s: each byte makes two DMA hops). A copy's read-write
+turnaround keeps HBM below the read-only line rate the 819 figure
+describes. Trust the current run's ``detail`` block over these numbers.
 """
 
 from __future__ import annotations
