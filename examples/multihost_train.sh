@@ -1,0 +1,30 @@
+#!/bin/sh
+# Self-contained multi-"host" walkthrough: 2 oncilla daemons + 2 JAX
+# processes forming ONE global SPMD mesh (jax.distributed over Gloo on
+# CPU here; identical driver code on a real multi-host TPU slice), the
+# shared train step running over it, and a train-state checkpoint written
+# into rank 1's arena and read back one-sided by every process.
+# Usage: multihost_train.sh [PORT0 PORT1 COORD_PORT] — override the
+# defaults to run concurrent instances (the test passes free ports).
+set -e
+cd "$(dirname "$0")/.."
+PORT0=${1:-7745}
+PORT1=${2:-7746}
+COORD=${3:-7799}
+NODEFILE=$(mktemp)
+trap 'kill $D0 $D1 $P1 2>/dev/null || true; rm -f "$NODEFILE"' EXIT
+cat > "$NODEFILE" <<EOF
+0 localhost 127.0.0.1 $PORT0
+1 localhost 127.0.0.1 $PORT1
+EOF
+
+JAX_PLATFORMS=cpu python -m oncilla_tpu.runtime.daemon "$NODEFILE" --rank 0 &
+D0=$!
+JAX_PLATFORMS=cpu python -m oncilla_tpu.runtime.daemon "$NODEFILE" --rank 1 &
+D1=$!
+
+python examples/multihost_train.py 1 2 $COORD "$NODEFILE" &
+P1=$!
+python examples/multihost_train.py 0 2 $COORD "$NODEFILE"
+wait $P1
+echo "== multihost walkthrough ok =="

@@ -1,0 +1,38 @@
+"""Multi-process SPMD: the examples/multihost_train.py walkthrough as a
+test — 2 OS processes form one global mesh via jax.distributed (Gloo,
+CPU), run the shared train step (losses identical and falling in both),
+and checkpoint the train state through per-process oncilla daemons into
+a REMOTE_HOST arena, restoring byte-exact everywhere. This is the
+process-level scaling story (SURVEY.md §5.8) executed for real, not
+simulated on a single-process virtual mesh."""
+
+import os
+import pathlib
+import signal
+import subprocess
+
+from _helpers import free_ports
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_two_process_mesh_train_and_ocm_checkpoint():
+    ports = free_ports(3)
+    # Own session so a timeout can kill the WHOLE tree (daemons + both
+    # JAX processes) — killing just `sh` would orphan daemons holding the
+    # ports and break every later run.
+    p = subprocess.Popen(
+        ["sh", "examples/multihost_train.sh", *map(str, ports)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, start_new_session=True,
+    )
+    try:
+        out, _ = p.communicate(timeout=280)
+    except subprocess.TimeoutExpired:
+        os.killpg(p.pid, signal.SIGKILL)
+        out, _ = p.communicate()
+        raise AssertionError(f"walkthrough timed out:\n{out[-3000:]}")
+    assert p.returncode == 0, out[-3000:]
+    assert "multihost walkthrough ok" in out, out[-3000:]
+    assert out.count("checkpoint of") == 2, out[-3000:]
+    assert "mesh={'dp': 2, 'tp': 2, 'sp': 2}" in out, out[-3000:]
