@@ -277,6 +277,66 @@ def test_libocm_c_abi_device_roundtrip(tmp_path, rng):
             d.stop()
 
 
+def test_relay_concurrency_stress():
+    """Concurrent plane-less device traffic: 10 threads race
+    alloc/put/get/free of REMOTE_DEVICE through the daemon relay while
+    the controller uses the same plane in-process — the brand-new relay
+    path under the same contention the host-path soak applies. Ends
+    quiescent with zero device bytes booked."""
+    import threading
+
+    config = cfg(device_arena_bytes=16 << 20)
+    with local_cluster(2, config=config) as cl:
+        plane = SpmdIciPlane(config=config, devices_per_rank=1)
+        controller = cl.client(0, ici_plane=plane)
+        ctx_a = Ocm(config=config, remote=controller)
+        errs: list = []
+
+        def planeless_worker(tid: int) -> None:
+            try:
+                ctx = Ocm(config=config, remote=cl.client(1))
+                r = np.random.default_rng(tid)
+                for _ in range(5):
+                    nb = int(r.integers(1, 5)) * (32 << 10)
+                    h = ctx.alloc(nb, OcmKind.REMOTE_DEVICE)
+                    data = r.integers(0, 256, nb, dtype=np.uint8)
+                    ctx.put(h, data)
+                    got = np.asarray(ctx.get(h))
+                    np.testing.assert_array_equal(got, data)
+                    ctx.free(h)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"t{tid}: {type(e).__name__}: {e}")
+
+        def controller_worker() -> None:
+            try:
+                r = np.random.default_rng(999)
+                for _ in range(5):
+                    h = ctx_a.alloc(64 << 10, OcmKind.REMOTE_DEVICE)
+                    data = r.integers(0, 256, 64 << 10, dtype=np.uint8)
+                    ctx_a.put(h, data)
+                    np.testing.assert_array_equal(np.asarray(ctx_a.get(h)), data)
+                    ctx_a.free(h)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"controller: {type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=planeless_worker, args=(t,))
+            for t in range(10)
+        ] + [threading.Thread(target=controller_worker)]
+        import time as _time
+
+        for t in threads:
+            t.start()
+        deadline = _time.monotonic() + 180  # shared: bounds the WHOLE wait
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
+        assert not any(t.is_alive() for t in threads), "relay stress hung"
+        assert not errs, errs[:5]
+        for d in cl.daemons:
+            assert all(b.bytes_live == 0 for b in d.device_books)
+            assert d.registry.live_count() == 0
+
+
 def test_two_os_processes_share_device_plane(tmp_path, rng):
     """The real thing: a SECOND OS PROCESS (fresh JAX runtime, CPU) drives
     REMOTE_DEVICE put/get against daemons whose plane lives in THIS
