@@ -337,6 +337,140 @@ def test_relay_concurrency_stress():
             assert d.registry.live_count() == 0
 
 
+def test_plane_deregister_on_close():
+    """A cleanly closing controller deregisters its endpoint: subsequent
+    device ops fail typed instead of dialing a dead socket."""
+    config = cfg()
+    with local_cluster(2, config=config) as cl:
+        plane = SpmdIciPlane(config=config, devices_per_rank=1)
+        controller = cl.client(0, ici_plane=plane)
+        ctx_b = Ocm(config=config, remote=cl.client(1))
+        h = ctx_b.alloc(32 << 10, OcmKind.REMOTE_DEVICE)
+        ctx_b.put(h, np.zeros(32 << 10, np.uint8))  # relay works
+        controller.close()
+        # The clear reaches non-local daemons via the reaper gossip
+        # (heartbeat_s tick): poll, don't assert instantly.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(d.plane_addr is None for d in cl.daemons):
+                break
+            time.sleep(0.05)
+        assert all(d.plane_addr is None for d in cl.daemons), (
+            [d.plane_addr for d in cl.daemons]
+        )
+        with pytest.raises(ocm.OcmError, match="registered plane"):
+            ctx_b.put(h, np.zeros(32 << 10, np.uint8))
+        ctx_b.free(h)
+
+
+def test_stale_endpoint_self_heals(rng):
+    """A controller that CRASHES (no deregistration) leaves a stale
+    endpoint; the first relay attempt clears it (connect refused) and a
+    new controller's registration restores service."""
+    config = cfg()
+    with local_cluster(2, config=config) as cl:
+        plane = SpmdIciPlane(config=config, devices_per_rank=1)
+        c1 = cl.client(0, ici_plane=plane)
+        ctx_b = Ocm(config=config, remote=cl.client(1))
+        h = ctx_b.alloc(32 << 10, OcmKind.REMOTE_DEVICE)
+        # Simulate a crash: the plane server socket dies, no deregister
+        # (detach skips the courtesy messages).
+        c1._plane_server.close()
+        c1.close(detach=True)
+        with pytest.raises(ocm.OcmError):
+            ctx_b.put(h, np.zeros(32 << 10, np.uint8))
+        # The daemon that DIALED the dead endpoint dropped it (only the
+        # dialing daemon clears by design — peers self-heal when a live
+        # controller re-registers, which the next leg exercises).
+        assert any(d.plane_addr is None for d in cl.daemons), (
+            [d.plane_addr for d in cl.daemons]
+        )
+        # A replacement controller restores the device plane.
+        plane2 = SpmdIciPlane(config=config, devices_per_rank=1)
+        cl.client(0, ici_plane=plane2)
+        data = rng.integers(0, 256, 32 << 10, dtype=np.uint8)
+        ctx_b.put(h, data)
+        np.testing.assert_array_equal(np.asarray(ctx_b.get(h)), data)
+        ctx_b.free(h)
+
+
+def test_native_master_hop(tmp_path, rng):
+    """The C++ daemon's master-hop leg, deterministically: rank 2 never
+    learns the endpoint (reaper throttled by a huge heartbeat_s), so a
+    pre-enriched PLANE_GET sent straight to it must be forwarded to the
+    master (which the registering daemon pushed inline) and relayed to
+    the plane."""
+    from oncilla_tpu.runtime.native import native
+    from oncilla_tpu.runtime.protocol import Message, MsgType, request
+
+    try:
+        native.build()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+    from _helpers import free_ports
+
+    ports = free_ports(3)
+    nodefile = tmp_path / "nodefile"
+    nodefile.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    config = cfg(heartbeat_s=60.0)  # reaper tick too slow to gossip
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    procs = [
+        native.spawn(
+            str(nodefile), r, ndevices=1,
+            host_arena_bytes=4 << 20, device_arena_bytes=4 << 20,
+            heartbeat_s=60.0, lease_s=120.0,
+        )
+        for r in range(3)
+    ]
+    try:
+        from _helpers import wait_port
+
+        for e in entries:
+            if not wait_port(e.port):
+                pytest.fail("native daemon did not come up")
+        plane = SpmdIciPlane(config=config, devices_per_rank=1)
+        # Register via rank 1 (non-master): stores locally + inline-pushes
+        # ONLY the master; rank 2 stays unsynced for ~heartbeat_s.
+        controller = ControlPlaneClient(
+            entries, 1, config=config, ici_plane=plane, heartbeat=False
+        )
+        stamp = rng.integers(0, 256, 4096, dtype=np.uint8)
+        from oncilla_tpu.core.arena import Extent
+        from oncilla_tpu.core.handle import OcmAlloc
+        from oncilla_tpu.core.kinds import Fabric
+
+        gh = OcmAlloc(
+            alloc_id=2, kind=OcmKind.REMOTE_DEVICE, fabric=Fabric.ICI,
+            nbytes=4096, rank=0, device_index=0,
+            extent=Extent(offset=0, nbytes=4096), origin_rank=0,
+        )
+        plane.put(gh, stamp)
+        s = socket.create_connection(
+            (entries[2].host, entries[2].port), 5.0
+        )
+        try:
+            r = request(s, Message(
+                MsgType.PLANE_GET,
+                {"alloc_id": 2, "rank": 0, "device_index": 0,
+                 "ext_offset": 0, "ext_nbytes": 4096,
+                 "offset": 0, "nbytes": 4096},
+            ))
+        finally:
+            s.close()
+        assert r.type == MsgType.DATA_GET_OK, r
+        np.testing.assert_array_equal(
+            np.frombuffer(r.data, np.uint8), stamp
+        )
+        controller.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
 def test_two_os_processes_share_device_plane(tmp_path, rng):
     """The real thing: a SECOND OS PROCESS (fresh JAX runtime, CPU) drives
     REMOTE_DEVICE put/get against daemons whose plane lives in THIS
