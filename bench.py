@@ -699,6 +699,33 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
             errors["kv_decode"] = f"{type(e).__name__}: {e}"
     mark("kv_decode")
 
+    # DCN data plane (BASELINE config 2): daemon-path one-sided put/get
+    # bandwidth through two REAL daemon processes on loopback — the one
+    # fabric metric that needs no chip (also measured on the wedge path).
+    if budgeted("dcn", 60):
+        out["detail"]["dcn"] = bench_dcn(errors)
+    mark("dcn")
+
+
+def bench_dcn(errors: dict) -> dict:
+    try:
+        from oncilla_tpu.benchmarks.dcn import dcn_loopback_bench
+
+        try:
+            r = dcn_loopback_bench(nbytes=256 << 20, iters=3, native=True)
+        except Exception:  # noqa: BLE001 — C++ twin unavailable: measure anyway
+            r = dcn_loopback_bench(nbytes=256 << 20, iters=3, native=False)
+        return {
+            "put_gbps": round(r["put_gbps"], 3),
+            "get_gbps": round(r["get_gbps"], 3),
+            "nbytes": r["nbytes"],
+            "native_daemons": r["native_daemons"],
+            "verified": r["verified"],
+        }
+    except Exception as e:  # noqa: BLE001
+        errors["dcn"] = f"{type(e).__name__}: {e}"
+        return {}
+
 
 def bench_gb_sweep(errors: dict, seconds: float = 205.0) -> dict:
     """BASELINE.md config-3 shape on the hardware available: a 1 KB -> 1 GB
@@ -840,6 +867,7 @@ def main() -> None:
                     errors["tunnel_probe"] = (
                         f"backend init failed twice: {probe.stderr[-300:]}"
                     )
+                    out["detail"]["dcn"] = bench_dcn(errors)  # chip-free
                     done.set()
                     emit()
                     return
@@ -848,6 +876,9 @@ def main() -> None:
                 "TPU tunnel wedged: device discovery hung >180s; no chip "
                 "benchmarks possible this run"
             )
+            # The DCN data plane needs no chip: bank it even when wedged,
+            # so a wedged round still records a measured fabric number.
+            out["detail"]["dcn"] = bench_dcn(errors)
             done.set()
             emit()
             return

@@ -1,0 +1,148 @@
+"""DCN data-plane bandwidth: the daemon-served one-sided put/get path.
+
+BASELINE config 2 — "2-host remote alloc + one-sided put/get (daemon
+path)" (≙ the reference's ocm_test test 2 / extoll_rma2_transfer timing,
+/root/reference/test/ocm_test.c:132-206, src/extoll.c:47-173). Two
+daemons on this host, a client attached to rank 0, a REMOTE_HOST
+allocation placed on rank 1, and timed whole-region put/get through the
+chunked pipelined engine (8 MiB x 2 in flight). On one host this rides
+loopback TCP, so the number is an upper bound on protocol+engine
+overhead rather than a fabric measurement — but unlike every chip
+metric it needs no TPU, so a wedged-tunnel bench still banks it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import tempfile
+import time
+
+import numpy as np
+
+from oncilla_tpu.core.context import Ocm
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.utils.config import OcmConfig
+
+
+@contextlib.contextmanager
+def _daemon_pair(cfg: OcmConfig, native: bool):
+    """Two REAL daemon processes on loopback (the C++ twin when built,
+    else python subprocesses) — in-process daemon threads would share the
+    client's GIL and understate the data plane by ~2x."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    nf = tempfile.NamedTemporaryFile("w", suffix=".nodes", delete=False)
+    nf.write("".join(
+        f"{r} localhost 127.0.0.1 {p}\n" for r, p in enumerate(ports)
+    ))
+    nf.close()
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    procs = []
+    try:
+        if native:
+            from oncilla_tpu.runtime.native import native as nat
+
+            nat.build()
+            for r in range(2):
+                procs.append(nat.spawn(
+                    nf.name, r, ndevices=1,
+                    host_arena_bytes=cfg.host_arena_bytes,
+                    device_arena_bytes=cfg.device_arena_bytes,
+                    heartbeat_s=5.0, lease_s=120.0,
+                ))
+        else:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            for r in range(2):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "oncilla_tpu.runtime.daemon",
+                     nf.name, "--rank", str(r),
+                     "--host-arena-bytes", str(cfg.host_arena_bytes),
+                     "--device-arena-bytes", str(cfg.device_arena_bytes)],
+                    env=env,
+                ))
+        deadline = time.time() + 60
+        for e in entries:
+            while time.time() < deadline:
+                try:
+                    socket.create_connection((e.host, e.port), 0.5).close()
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                raise RuntimeError("bench daemon did not come up")
+        yield entries
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        os.unlink(nf.name)
+
+
+def dcn_loopback_bench(
+    nbytes: int = 256 << 20,
+    iters: int = 3,
+    chunk_bytes: int = 8 << 20,
+    inflight: int = 2,
+    native: bool = True,
+) -> dict:
+    """Timed put/get of a ``nbytes`` REMOTE_HOST region through two live
+    daemon PROCESSES (loopback). Returns GB/s per direction (best of
+    ``iters``) plus the verified-roundtrip flag."""
+    cfg = OcmConfig(
+        host_arena_bytes=nbytes + (8 << 20),
+        device_arena_bytes=1 << 20,
+        chunk_bytes=chunk_bytes,
+        inflight_ops=inflight,
+        heartbeat_s=5.0,
+    )
+    with _daemon_pair(cfg, native=native) as entries:
+        client = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+        # Full membership before placement (a 1-node cluster demotes).
+        deadline = time.time() + 30
+        while time.time() < deadline and client.status()["nnodes"] < 2:
+            time.sleep(0.1)
+        # devices=[] — this bench is host-kind only, and the default
+        # jax.local_devices() probe would HANG on a wedged TPU tunnel
+        # (this stage runs on the bench's wedge path precisely because it
+        # needs no chip).
+        ctx = Ocm(config=cfg, remote=client, devices=[])
+        h = ctx.alloc(nbytes, OcmKind.REMOTE_HOST)
+        assert h.is_remote, "placement demoted; membership race?"
+        data = np.random.default_rng(0).integers(
+            0, 256, nbytes, dtype=np.uint8
+        )
+        put_s, get_s = [], []
+        got = None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.put(h, data)
+            put_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            got = np.asarray(ctx.get(h))
+            get_s.append(time.perf_counter() - t0)
+        ok = bool(np.array_equal(got, data))
+        ctx.free(h)
+        client.close()
+    return {
+        "put_gbps": nbytes / min(put_s) / 1e9,
+        "get_gbps": nbytes / min(get_s) / 1e9,
+        "nbytes": nbytes,
+        "iters": iters,
+        "native_daemons": native,
+        "verified": ok,
+    }

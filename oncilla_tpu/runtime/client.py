@@ -37,6 +37,7 @@ from oncilla_tpu.runtime.protocol import (
     WIRE_KIND_INV,
     Message,
     MsgType,
+    RecvScratch,
     recv_msg,
     request,
     send_msg,
@@ -450,6 +451,9 @@ class ControlPlaneClient:
         inflight: list[tuple[int, int]] = []  # (chunk_offset, nbytes)
         pos = 0
         failure: OcmRemoteError | None = None
+        # Reusable reply buffer: each DATA_GET_OK chunk is consumed by
+        # on_reply before the next recv, the RecvScratch contract.
+        scratch = RecvScratch()
         try:
             while pos < total or inflight:
                 while pos < total and len(inflight) < window and failure is None:
@@ -459,7 +463,7 @@ class ControlPlaneClient:
                     pos += n
                 if not inflight:
                     break
-                r = recv_msg(s)
+                r = recv_msg(s, scratch)
                 start, n = inflight.pop(0)
                 if r.type == MsgType.ERROR:
                     # Remember the first failure; keep draining replies
@@ -492,6 +496,9 @@ class ControlPlaneClient:
             raise failure
 
     def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
+        mv = memoryview(raw)  # chunks stay zero-copy views; send_msg
+        # scatter-gathers them onto the wire without concatenation
+
         def make_req(pos: int, n: int) -> Message:
             return Message(
                 MsgType.DATA_PUT,
@@ -500,7 +507,7 @@ class ControlPlaneClient:
                     "offset": offset + pos,
                     "nbytes": n,
                 },
-                raw[pos : pos + n].tobytes(),
+                mv[pos : pos + n],
             )
 
         with self.tracer.span("dcn_put", nbytes=raw.nbytes):

@@ -51,6 +51,7 @@ from oncilla_tpu.runtime.protocol import (
     ErrCode,
     Message,
     MsgType,
+    RecvScratch,
     recv_msg,
     request,
     send_msg,
@@ -350,6 +351,11 @@ class Daemon:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+                try:  # stream 8 MiB chunks without window stalls
+                    conn.setsockopt(socket.SOL_SOCKET, opt, 4 << 20)
+                except OSError:
+                    pass
             with self._conns_mu:
                 self._conns.add(conn)
             t = threading.Thread(
@@ -359,10 +365,14 @@ class Daemon:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         """Per-connection handler (inbound_thread analogue, mem.c:319-393)."""
+        # Reusable receive buffer: every inbound bulk payload (DATA_PUT
+        # chunks) is fully consumed by its handler before the next recv —
+        # the RecvScratch contract.
+        scratch = RecvScratch()
         try:
             while self._running.is_set():
                 try:
-                    msg = recv_msg(conn)
+                    msg = recv_msg(conn, scratch)
                 except OcmProtocolError as e:
                     # Clean EOF between frames is normal disconnect; any
                     # other decode failure (truncated frame, bad magic,
@@ -694,10 +704,15 @@ class Daemon:
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
-        data = self.host_arena.read(e.extent, f["nbytes"], f["offset"])
-        return Message(
-            MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]}, data.tobytes()
-        )
+        # One-copy reply payload: SNAPSHOT the extent bytes at handler
+        # time (a live view would keep streaming the arena for the whole
+        # TCP send — a reaper-expired lease could recycle the extent
+        # mid-send and leak the next tenant's bytes), but skip the old
+        # tobytes + frame-concat copies via send_msg's scatter-gather.
+        data = bytes(memoryview(self.host_arena.view(e.extent))[
+            f["offset"]:f["offset"] + f["nbytes"]
+        ])
+        return Message(MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]}, data)
 
     # -- cross-process device plane (PLANE_SERVE / PLANE_PUT / PLANE_GET) --
     #
@@ -888,12 +903,22 @@ def main(argv=None) -> int:
     ap.add_argument("--ndevices", type=int, default=1)
     ap.add_argument("--snapshot", default=None,
                     help="snapshot file: restored on start, written on stop")
+    ap.add_argument("--host-arena-bytes", type=int, default=None,
+                    help="served DRAM arena size (native daemon parity)")
+    ap.add_argument("--device-arena-bytes", type=int, default=None,
+                    help="booked per-device HBM size (native daemon parity)")
     args = ap.parse_args(argv)
 
     entries = parse_nodefile(args.nodefile)
     rank = args.rank if args.rank is not None else detect_rank(entries)
+    cfg_kw = {}
+    if args.host_arena_bytes is not None:
+        cfg_kw["host_arena_bytes"] = args.host_arena_bytes
+    if args.device_arena_bytes is not None:
+        cfg_kw["device_arena_bytes"] = args.device_arena_bytes
     d = Daemon(rank, entries, policy=args.policy, ndevices=args.ndevices,
-               host=entries[rank].host, snapshot_path=args.snapshot)
+               host=entries[rank].host, snapshot_path=args.snapshot,
+               config=OcmConfig(**cfg_kw) if cfg_kw else None)
     d.start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())

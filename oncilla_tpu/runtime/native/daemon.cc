@@ -471,6 +471,9 @@ class Daemon {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) break;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      int buf = 4 << 20;  // stream 8 MiB chunks without window stalls
+      setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+      setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
       {
         std::lock_guard<std::mutex> g(conns_mu_);
         conns_.insert(fd);

@@ -48,7 +48,37 @@ inline bool recv_all(int fd, uint8_t* p, size_t n, bool eof_ok = false) {
   return true;
 }
 
+// Scatter-gather sendall of [a, b] without concatenating them — the
+// bulk-data path (copying an 8 MiB payload into a contiguous frame costs
+// two extra memcpys per chunk).
+inline void send_vec(int fd, const uint8_t* a, size_t an, const uint8_t* b,
+                     size_t bn) {
+  while (an + bn) {
+    struct iovec iov[2];
+    int cnt = 0;
+    if (an) iov[cnt++] = {const_cast<uint8_t*>(a), an};
+    if (bn) iov[cnt++] = {const_cast<uint8_t*>(b), bn};
+    struct msghdr mh = {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = size_t(cnt);
+    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
+    if (w <= 0) throw ProtocolError("send failed");
+    size_t ww = size_t(w);
+    size_t from_a = ww < an ? ww : an;
+    a += from_a;
+    an -= from_a;
+    ww -= from_a;
+    b += ww;
+    bn -= ww;
+  }
+}
+
 inline void send_msg(int fd, const Message& m) {
+  if (m.data.size() >= (64u << 10)) {
+    auto prefix = pack_prefix(m);
+    send_vec(fd, prefix.data(), prefix.size(), m.data.data(), m.data.size());
+    return;
+  }
   auto buf = pack(m);
   send_all(fd, buf.data(), buf.size());
 }
@@ -82,6 +112,11 @@ inline int dial(const std::string& host, int port) {
   freeaddrinfo(res);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Large buffers so 8 MiB pipelined chunks stream without window
+  // stalls (kernel may clamp; best effort).
+  int buf = 4 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
   return fd;
 }
 

@@ -107,7 +107,9 @@ const std::vector<Field>& schema(MsgType t) {
   return it->second;
 }
 
-std::vector<uint8_t> pack(const Message& m) {
+namespace {
+
+std::vector<uint8_t> encode_fields(const Message& m) {
   std::vector<uint8_t> payload;
   for (const Field& f : schema(m.type)) {
     auto it = m.fields.find(f.name);
@@ -135,17 +137,29 @@ std::vector<uint8_t> pack(const Message& m) {
       default: throw ProtocolError("bad schema fmt");
     }
   }
-  payload.insert(payload.end(), m.data.begin(), m.data.end());
-  if (payload.size() > kMaxPayload) throw ProtocolError("payload exceeds cap");
+  return payload;
+}
 
+}  // namespace
+
+std::vector<uint8_t> pack_prefix(const Message& m) {
+  std::vector<uint8_t> fields = encode_fields(m);
+  size_t plen = fields.size() + m.data.size();
+  if (plen > kMaxPayload) throw ProtocolError("payload exceeds cap");
   std::vector<uint8_t> out;
-  out.reserve(kHeaderSize + payload.size());
+  out.reserve(kHeaderSize + fields.size());
   out.insert(out.end(), kMagic, kMagic + 4);
   out.push_back(kVersion);
   out.push_back(uint8_t(m.type));
   put_le(out, 0, 2);  // flags
-  put_le(out, payload.size(), 4);
-  out.insert(out.end(), payload.begin(), payload.end());
+  put_le(out, plen, 4);
+  out.insert(out.end(), fields.begin(), fields.end());
+  return out;
+}
+
+std::vector<uint8_t> pack(const Message& m) {
+  std::vector<uint8_t> out = pack_prefix(m);
+  out.insert(out.end(), m.data.begin(), m.data.end());
   return out;
 }
 

@@ -120,6 +120,10 @@ struct Field { const char* name; char fmt; };
 const std::vector<Field>& schema(MsgType t);
 
 std::vector<uint8_t> pack(const Message& m);
+// Header + encoded fields ONLY (the frame length still counts m.data):
+// the bulk-data fast path sends [prefix, m.data] as one scatter-gather
+// write instead of copying the payload into a contiguous frame.
+std::vector<uint8_t> pack_prefix(const Message& m);
 Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen);
 
 }  // namespace ocm

@@ -89,6 +89,14 @@ class PeerPool:
         except OSError as e:
             raise OcmConnectError(f"peer {host}:{port} unreachable: {e}") from e
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Large buffers so an 8 MiB pipelined chunk streams without the
+        # sender stalling on the default ~208 KiB window (the kernel may
+        # clamp; best effort).
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                s.setsockopt(socket.SOL_SOCKET, opt, 4 << 20)
+            except OSError:
+                pass
         entry = PoolEntry(s)
         entry.lock.acquire()
         with self._lock:

@@ -96,12 +96,12 @@ def _pack_str(s: str) -> bytes:
     return struct.pack("<H", len(b)) + b
 
 
-def _unpack_str(buf: bytes, off: int) -> tuple[str, int]:
+def _unpack_str(buf, off: int) -> tuple[str, int]:
     (n,) = struct.unpack_from("<H", buf, off)
     off += 2
     if off + n > len(buf):  # a silent short slice would hide truncation
         raise OcmProtocolError("truncated string field")
-    return buf[off : off + n].decode("utf-8"), off + n
+    return bytes(buf[off : off + n]).decode("utf-8"), off + n
 
 
 @dataclass
@@ -242,22 +242,29 @@ class ErrCode(enum.IntEnum):
     NOT_MASTER = 6
 
 
-def pack(msg: Message) -> bytes:
+def _pack_prefix(msg: Message) -> bytes:
+    """Header + encoded fields ONLY (the frame length still counts
+    msg.data) — shared by pack() and send_msg's scatter-gather fast path
+    so the wire encoding has exactly one implementation (protocol.cc's
+    pack_prefix twin)."""
     schema = _SCHEMAS.get(msg.type)
     if schema is None:
         raise OcmProtocolError(f"no schema for {msg.type}")
-    out = bytearray()
+    fields = bytearray()
     for name, fmt in schema:
         v = msg.fields[name]
         if fmt == "s":
-            out += _pack_str(v)
+            fields += _pack_str(v)
         else:
-            out += struct.pack("<" + fmt, v)
-    out += msg.data
-    payload = bytes(out)
-    if len(payload) > MAX_PAYLOAD:
-        raise OcmProtocolError(f"payload {len(payload)} exceeds cap")
-    return HEADER.pack(MAGIC, VERSION, int(msg.type), 0, len(payload)) + payload
+            fields += struct.pack("<" + fmt, v)
+    plen = len(fields) + len(msg.data)
+    if plen > MAX_PAYLOAD:
+        raise OcmProtocolError(f"payload {plen} exceeds cap")
+    return HEADER.pack(MAGIC, VERSION, int(msg.type), 0, plen) + fields
+
+
+def pack(msg: Message) -> bytes:
+    return _pack_prefix(msg) + bytes(msg.data)
 
 
 def unpack(header: bytes, payload: bytes) -> Message:
@@ -292,33 +299,86 @@ def unpack(header: bytes, payload: bytes) -> Message:
         raise OcmProtocolError(
             f"malformed {mtype.name} payload: {e}"
         ) from e
-    return Message(mtype, fields, payload[off:])
+    # Bulk payloads stay a zero-copy view into the receive buffer (an
+    # 8 MiB DATA_PUT chunk would otherwise be copied once more here);
+    # small ones become plain bytes, the friendliest type for callers.
+    n_data = len(payload) - off
+    data = (
+        memoryview(payload)[off:] if n_data >= (64 << 10)
+        else bytes(payload[off:])
+    )
+    return Message(mtype, fields, data)
 
 
 # -- blocking socket transport (conn_put/conn_get analogue, sock.c:215-253) --
 
 
+def _sendall_vec(sock: socket.socket, parts: list) -> None:
+    """sendall over a list of buffers WITHOUT concatenating them — the
+    bulk-data fast path (a DATA_PUT frame is header+fields plus an 8 MiB
+    payload; building one contiguous frame copies the payload twice)."""
+    views = [memoryview(p) for p in parts if len(p)]
+    while views:
+        sent = sock.sendmsg(views)
+        while views and sent >= len(views[0]):
+            sent -= len(views[0])
+            views.pop(0)
+        if views and sent:
+            views[0] = views[0][sent:]
+
+
 def send_msg(sock: socket.socket, msg: Message) -> None:
-    sock.sendall(pack(msg))
+    prefix = _pack_prefix(msg)
+    if len(msg.data) >= (64 << 10):
+        _sendall_vec(sock, [prefix, msg.data])
+    else:
+        sock.sendall(prefix + bytes(msg.data) if msg.data else prefix)
 
 
-def _recv_exact(sock: socket.socket, n: int, eof_ok: bool = False) -> bytes:
-    """Read exactly n bytes. ``eof_ok`` permits a clean EOF *before the
-    first byte* (returning b"") — EOF mid-message always raises."""
-    chunks = []
-    want = n
-    while want:
-        b = sock.recv(min(want, 1 << 20))
-        if not b:
-            if eof_ok and want == n:
-                return b""
+def _recv_into(sock: socket.socket, view: memoryview,
+               eof_ok: bool = False) -> bool:
+    """Fill ``view`` exactly. ``eof_ok`` permits a clean EOF *before the
+    first byte* (returns False) — EOF mid-message always raises."""
+    n = len(view)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, 1 << 20))
+        if r == 0:
+            if eof_ok and got == 0:
+                return False
             raise OcmProtocolError("peer closed mid-message")
-        chunks.append(b)
-        want -= len(b)
-    return b"".join(chunks)
+        got += r
+    return True
 
 
-def recv_msg(sock: socket.socket) -> Message:
+def _recv_exact(sock: socket.socket, n: int, eof_ok: bool = False):
+    """Read exactly n bytes into one fresh buffer (no chunk-list join)."""
+    buf = bytearray(n)
+    if not _recv_into(sock, memoryview(buf), eof_ok=eof_ok):
+        return b""
+    return buf
+
+
+class RecvScratch:
+    """Reusable receive buffer for the data-plane hot loops: a fresh
+    bytearray per 8 MiB reply chunk costs an allocation + kernel zeroing
+    each time. A payload decoded into scratch is a VIEW valid only until
+    the next recv on the same socket — use only where the message is
+    fully consumed before the next receive (the pipelined client loop,
+    the daemon serve loop)."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+
+    def get(self, n: int) -> memoryview:
+        if len(self.buf) < n:
+            self.buf = bytearray(max(n, 2 * len(self.buf)))
+        return memoryview(self.buf)[:n]
+
+
+def recv_msg(sock: socket.socket, scratch: RecvScratch | None = None) -> Message:
     header = _recv_exact(sock, HEADER.size, eof_ok=True)
     if not header:
         # Clean disconnect at a frame boundary — ordinary, not an anomaly.
@@ -326,7 +386,13 @@ def recv_msg(sock: socket.socket) -> Message:
     _, _, _, _, plen = HEADER.unpack(header)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"advertised payload {plen} exceeds cap")
-    payload = _recv_exact(sock, plen) if plen else b""
+    if plen == 0:
+        payload = b""
+    elif scratch is not None and plen >= (64 << 10):
+        payload = scratch.get(plen)
+        _recv_into(sock, payload)
+    else:
+        payload = _recv_exact(sock, plen)
     return unpack(header, payload)
 
 

@@ -201,3 +201,29 @@ def test_ceiling_probes_interpret():
     run = ceiling._read_stream_loop(256 << 10, 64 << 10, iters=2)
     out = np.asarray(run(jax.device_put(buf))).reshape(-1)
     np.testing.assert_array_equal(out, buf)
+
+
+def test_dcn_loopback_bench_measures_and_verifies():
+    """BASELINE config 2's bench stage: daemon-path put/get bandwidth
+    through real daemon processes, roundtrip-verified. Small sizes here;
+    bench.py runs 256 MiB."""
+    from oncilla_tpu.benchmarks.dcn import dcn_loopback_bench
+
+    r = dcn_loopback_bench(nbytes=8 << 20, iters=2, native=False)
+    assert r["verified"]
+    assert r["put_gbps"] > 0 and r["get_gbps"] > 0
+    assert r["nbytes"] == 8 << 20
+
+
+def test_dcn_loopback_bench_native_daemons():
+    import pytest
+
+    from oncilla_tpu.benchmarks.dcn import dcn_loopback_bench
+    from oncilla_tpu.runtime.native import native
+
+    try:
+        native.build()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+    r = dcn_loopback_bench(nbytes=8 << 20, iters=2, native=True)
+    assert r["verified"] and r["native_daemons"]
