@@ -71,7 +71,7 @@ class PeerPool {
     std::unique_lock<std::mutex> g(c->mu, std::adopt_lock);
     try {
       send_msg(c->fd, m);
-      Message r = recv_msg(c->fd);
+      Message r = recv_msg(c->fd, &c->scratch);
       g.unlock();
       cv_.notify_all();  // a cap-blocked lease() can have this conn now
       return r;
@@ -103,6 +103,9 @@ class PeerPool {
   struct Conn {
     int fd = -1;  // -1 until dial succeeds: ~Conn must never close(0)
     std::mutex mu;
+    // Receive scratch reused across requests on this connection (the
+    // holder of mu owns it; replies are consumed before the next recv).
+    std::vector<uint8_t> scratch;
     ~Conn() {
       if (fd >= 0) ::close(fd);
     }
@@ -579,10 +582,13 @@ class Daemon {
 
   void serve(int fd) {
     // inbound_thread analogue (mem.c:319-393): loop until peer closes.
+    // Per-connection receive scratch: every bulk payload is consumed by
+    // its handler before the next recv (net.hh recv_msg contract).
+    std::vector<uint8_t> scratch;
     while (running_) {
       Message msg;
       try {
-        msg = recv_msg(fd);
+        msg = recv_msg(fd, &scratch);
       } catch (const ProtocolError& e) {
         // Clean close at a frame boundary is normal; anything else —
         // malformed wire input, truncation, a reset from a crashed peer —

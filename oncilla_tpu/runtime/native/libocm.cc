@@ -45,6 +45,8 @@ std::string g_init_err;  // ocmc_last_error(NULL)
 struct DataConn {
   int fd = -1;
   std::mutex mu;
+  // Receive scratch reused across chunks (holder of mu owns it).
+  std::vector<uint8_t> scratch;
   ~DataConn() {
     if (fd >= 0) ::close(fd);
   }
@@ -193,7 +195,7 @@ struct ocmc_ctx {
           pos += n;
         }
         if (window.empty()) break;
-        Message r = recv_msg(c->fd);
+        Message r = recv_msg(c->fd, &c->scratch);
         auto [start, n] = window.front();
         window.pop_front();
         if (r.type == MsgType::ERR) {

@@ -83,13 +83,40 @@ inline void send_msg(int fd, const Message& m) {
   send_all(fd, buf.data(), buf.size());
 }
 
-inline Message recv_msg(int fd) {
+// With `scratch`, small payloads land in a REUSED buffer, and BULK
+// payloads of fixed-field messages (DATA_PUT/DATA_GET_OK chunks) are
+// received STRAIGHT into Message::data — no intermediate buffer, no
+// extra copy per 8 MiB chunk. Pass one scratch per connection in the
+// data-plane loops.
+inline Message recv_msg(int fd, std::vector<uint8_t>* scratch = nullptr) {
   uint8_t header[kHeaderSize];
   if (!recv_all(fd, header, kHeaderSize, /*eof_ok=*/true))
     throw ProtocolError("peer closed");
   uint64_t plen = 0;
   for (int i = 0; i < 4; ++i) plen |= uint64_t(header[8 + i]) << (8 * i);
   if (plen > kMaxPayload) throw ProtocolError("advertised payload too large");
+  size_t ffix = SIZE_MAX;
+  if (plen >= (64u << 10)) {
+    try {
+      ffix = fixed_fields_size(MsgType(header[5]));
+    } catch (const ProtocolError&) {
+      ffix = SIZE_MAX;  // unknown type: let unpack raise the real error
+    }
+  }
+  if (ffix != SIZE_MAX && ffix <= 64 && plen >= ffix &&
+      (plen - ffix) >= (64u << 10)) {
+    uint8_t fields[64];
+    if (ffix) recv_all(fd, fields, ffix);
+    Message m = unpack_fields(header, fields, ffix);
+    m.data.resize(plen - ffix);
+    recv_all(fd, m.data.data(), m.data.size());
+    return m;
+  }
+  if (scratch) {
+    if (scratch->size() < plen) scratch->resize(plen);
+    if (plen) recv_all(fd, scratch->data(), plen);
+    return unpack(header, scratch->data(), plen);
+  }
   std::vector<uint8_t> payload(plen);
   if (plen) recv_all(fd, payload.data(), plen);
   return unpack(header, payload.data(), plen);

@@ -163,15 +163,11 @@ std::vector<uint8_t> pack(const Message& m) {
   return out;
 }
 
-Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen) {
-  if (std::memcmp(header, kMagic, 4) != 0) throw ProtocolError("bad magic");
-  if (header[4] != kVersion) throw ProtocolError("unsupported version");
-  uint64_t want = get_le(header + 8, 4);
-  if (want != plen) throw ProtocolError("length mismatch");
+namespace {
 
-  Message m;
-  m.type = MsgType(header[5]);
-  const std::vector<Field>& sch = schema(m.type);  // throws on unknown type
+// Parses fields per the schema; returns the offset where data starts.
+size_t parse_fields(const std::vector<Field>& sch, const uint8_t* payload,
+                    size_t plen, Message& m) {
   size_t off = 0;
   auto need = [&](size_t n) {
     if (off + n > plen) throw ProtocolError("truncated payload");
@@ -219,7 +215,49 @@ Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen) {
       }
     }
   }
+  return off;
+}
+
+void check_header(const uint8_t* header) {
+  if (std::memcmp(header, kMagic, 4) != 0) throw ProtocolError("bad magic");
+  if (header[4] != kVersion) throw ProtocolError("unsupported version");
+}
+
+}  // namespace
+
+Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen) {
+  check_header(header);
+  uint64_t want = get_le(header + 8, 4);
+  if (want != plen) throw ProtocolError("length mismatch");
+
+  Message m;
+  m.type = MsgType(header[5]);
+  const std::vector<Field>& sch = schema(m.type);  // throws on unknown type
+  size_t off = parse_fields(sch, payload, plen, m);
   m.data.assign(payload + off, payload + plen);
+  return m;
+}
+
+size_t fixed_fields_size(MsgType t) {
+  size_t n = 0;
+  for (const Field& f : schema(t)) {  // throws on unknown type
+    switch (f.fmt) {
+      case 'q': case 'Q': case 'd': n += 8; break;
+      case 'I': n += 4; break;
+      case 'B': n += 1; break;
+      default: return SIZE_MAX;  // variable-width (strings)
+    }
+  }
+  return n;
+}
+
+Message unpack_fields(const uint8_t* header, const uint8_t* fields,
+                      size_t flen) {
+  check_header(header);
+  Message m;
+  m.type = MsgType(header[5]);
+  size_t off = parse_fields(schema(m.type), fields, flen, m);
+  if (off != flen) throw ProtocolError("trailing bytes in field prefix");
   return m;
 }
 

@@ -125,5 +125,12 @@ std::vector<uint8_t> pack(const Message& m);
 // write instead of copying the payload into a contiguous frame.
 std::vector<uint8_t> pack_prefix(const Message& m);
 Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen);
+// Encoded size of a type's fields when the schema is fixed-width
+// (SIZE_MAX when it contains strings): lets recv_msg receive a bulk
+// payload's trailing data STRAIGHT into Message::data.
+size_t fixed_fields_size(MsgType t);
+// Parse fields from an exactly-flen buffer; Message::data left empty.
+Message unpack_fields(const uint8_t* header, const uint8_t* fields,
+                      size_t flen);
 
 }  // namespace ocm

@@ -375,6 +375,10 @@ class RecvScratch:
     def get(self, n: int) -> memoryview:
         if len(self.buf) < n:
             self.buf = bytearray(max(n, 2 * len(self.buf)))
+        elif len(self.buf) > (32 << 20) and n < len(self.buf) // 4:
+            # Don't pin a burst's high-water buffer on a long-lived
+            # connection that went back to small messages.
+            self.buf = bytearray(n)
         return memoryview(self.buf)[:n]
 
 
