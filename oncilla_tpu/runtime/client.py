@@ -420,7 +420,8 @@ class ControlPlaneClient:
     # daemon (extoll.c:47-173 scheme over TCP). On a peer ERROR reply the
     # remaining in-flight replies are drained before raising, keeping the
     # pooled connection in sync; transport errors evict it.
-    def _pipelined(self, handle: OcmAlloc, total: int, make_req, on_reply) -> None:
+    def _pipelined(self, handle: OcmAlloc, total: int, make_req, on_reply,
+                   data_sink=None) -> None:
         """DATA_PUT/DATA_GET are idempotent (same bytes, same offsets), so a
         transport failure mid-transfer gets one full retry — through the
         membership table's address for the owner rank, covering daemons that
@@ -428,7 +429,8 @@ class ControlPlaneClient:
         owner_addr or a dead pooled connection."""
         try:
             self._pipelined_once(handle, total, make_req, on_reply,
-                                 self._owner_addr(handle))
+                                 self._owner_addr(handle),
+                                 data_sink=data_sink)
             return
         except (OSError, OcmConnectError, OcmProtocolError) as err:
             if isinstance(err, OcmRemoteError):
@@ -438,10 +440,12 @@ class ControlPlaneClient:
             printd("retrying transfer via membership address %s:%d",
                    e.connect_host, e.port)
             self._pipelined_once(handle, total, make_req, on_reply,
-                                 (e.connect_host, e.port))
+                                 (e.connect_host, e.port),
+                                 data_sink=data_sink)
 
     def _pipelined_once(
-        self, handle: OcmAlloc, total: int, make_req, on_reply, addr
+        self, handle: OcmAlloc, total: int, make_req, on_reply, addr,
+        data_sink=None,
     ) -> None:
         host, port = addr
         entry = self._pool.lease(host, port)  # exclusive for the pipeline
@@ -463,7 +467,17 @@ class ControlPlaneClient:
                     pos += n
                 if not inflight:
                     break
-                r = recv_msg(s, scratch)
+                # Replies are FIFO, so the expected chunk's destination is
+                # known BEFORE the recv: a matching fixed-field reply
+                # (DATA_GET_OK) lands its payload straight there — no
+                # scratch hop, no copy. An ERROR reply (strings) or a
+                # length mismatch ignores the sink and takes the normal
+                # path below.
+                sink = (
+                    data_sink(inflight[0][0], inflight[0][1])
+                    if data_sink is not None and failure is None else None
+                )
+                r = recv_msg(s, scratch, data_into=sink)
                 start, n = inflight.pop(0)
                 if r.type == MsgType.ERROR:
                     # Remember the first failure; keep draining replies
@@ -473,6 +487,8 @@ class ControlPlaneClient:
                             r.fields["code"], r.fields["detail"]
                         )
                 elif failure is None:
+                    if sink is not None and r.data is sink:
+                        continue  # payload already landed in place
                     try:
                         on_reply(r, start, n)
                     except (OSError, OcmProtocolError):
@@ -515,6 +531,7 @@ class ControlPlaneClient:
 
     def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int) -> np.ndarray:
         out = np.empty(nbytes, dtype=np.uint8)
+        out_mv = memoryview(out)
 
         def make_req(pos: int, n: int) -> Message:
             return Message(
@@ -527,10 +544,15 @@ class ControlPlaneClient:
             )
 
         def on_reply(r: Message, start: int, n: int) -> None:
+            # Fallback path only: matching DATA_GET_OK chunks land
+            # directly in `out` via the data_sink.
             out[start : start + n] = np.frombuffer(r.data, dtype=np.uint8)
 
         with self.tracer.span("dcn_get", nbytes=nbytes):
-            self._pipelined(handle, nbytes, make_req, on_reply)
+            self._pipelined(
+                handle, nbytes, make_req, on_reply,
+                data_sink=lambda start, n: out_mv[start:start + n],
+            )
         return out
 
     def _owner_addr(self, handle: OcmAlloc) -> tuple[str, int]:

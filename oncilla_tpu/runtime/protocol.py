@@ -267,6 +267,33 @@ def pack(msg: Message) -> bytes:
     return _pack_prefix(msg) + bytes(msg.data)
 
 
+def _parse_fields(mtype: MsgType, payload) -> tuple[dict, int]:
+    """Parse the schema'd fields; returns (fields, data offset). The
+    payload is untrusted wire input: truncated fields and invalid UTF-8
+    must surface as protocol errors, not struct/unicode internals."""
+    schema = _SCHEMAS[mtype]
+    fields: dict = {}
+    off = 0
+    try:
+        for name, fmt in schema:
+            if fmt == "s":
+                fields[name], off = _unpack_str(payload, off)
+            else:
+                st = struct.Struct("<" + fmt)
+                (fields[name],) = st.unpack_from(payload, off)
+                off += st.size
+    except (struct.error, UnicodeDecodeError) as e:
+        raise OcmProtocolError(
+            f"malformed {mtype.name} payload: {e}"
+        ) from e
+    return fields, off
+
+
+def _unpack_fields(mtype: MsgType, fields_buf) -> Message:
+    fields, _ = _parse_fields(mtype, fields_buf)
+    return Message(mtype, fields, b"")
+
+
 def unpack(header: bytes, payload: bytes) -> Message:
     try:
         magic, version, mtype, _flags, plen = HEADER.unpack(header)
@@ -282,23 +309,7 @@ def unpack(header: bytes, payload: bytes) -> Message:
         mtype = MsgType(mtype)
     except ValueError as e:
         raise OcmProtocolError(f"unknown message type {mtype}") from e
-    schema = _SCHEMAS[mtype]
-    fields = {}
-    off = 0
-    # The payload is untrusted wire input: truncated fields and invalid
-    # UTF-8 must surface as protocol errors, not struct/unicode internals.
-    try:
-        for name, fmt in schema:
-            if fmt == "s":
-                fields[name], off = _unpack_str(payload, off)
-            else:
-                st = struct.Struct("<" + fmt)
-                (fields[name],) = st.unpack_from(payload, off)
-                off += st.size
-    except (struct.error, UnicodeDecodeError) as e:
-        raise OcmProtocolError(
-            f"malformed {mtype.name} payload: {e}"
-        ) from e
+    fields, off = _parse_fields(mtype, payload)
     # Bulk payloads stay a zero-copy view into the receive buffer (an
     # 8 MiB DATA_PUT chunk would otherwise be copied once more here);
     # small ones become plain bytes, the friendliest type for callers.
@@ -382,14 +393,49 @@ class RecvScratch:
         return memoryview(self.buf)[:n]
 
 
-def recv_msg(sock: socket.socket, scratch: RecvScratch | None = None) -> Message:
+# Encoded size of each type's fields when the schema is fixed-width
+# (absent when it contains strings): lets recv_msg land a bulk payload's
+# data STRAIGHT in the caller's destination buffer.
+_FIXED_FIELD_SIZE: dict[MsgType, int] = {
+    t: sum(struct.calcsize("<" + fmt) for _, fmt in schema)
+    for t, schema in _SCHEMAS.items()
+    if all(fmt != "s" for _, fmt in schema)
+}
+
+
+def recv_msg(
+    sock: socket.socket,
+    scratch: RecvScratch | None = None,
+    data_into: memoryview | None = None,
+) -> Message:
+    """Receive one message. With ``data_into`` (pipelined readers that
+    know the expected reply), a fixed-field message whose data length
+    matches lands its payload DIRECTLY in that buffer — ``Message.data``
+    IS ``data_into`` then (identity-comparable by the caller); any other
+    message (an ERROR reply, a length mismatch) falls back to the normal
+    path untouched."""
     header = _recv_exact(sock, HEADER.size, eof_ok=True)
     if not header:
         # Clean disconnect at a frame boundary — ordinary, not an anomaly.
         raise OcmProtocolError("peer closed")
-    _, _, _, _, plen = HEADER.unpack(header)
+    magic, version, mtype_raw, _, plen = HEADER.unpack(header)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"advertised payload {plen} exceeds cap")
+    if data_into is not None and magic == MAGIC and version == VERSION:
+        # Magic/version checked HERE (the normal path does it in unpack):
+        # a corrupt or wrong-version frame must raise, never land bytes
+        # in the caller's buffer.
+        try:
+            mt = MsgType(mtype_raw)
+            ffix = _FIXED_FIELD_SIZE.get(mt)
+        except ValueError:
+            ffix = None  # unknown type: let unpack raise the real error
+        if ffix is not None and plen - ffix == len(data_into):
+            fields = _recv_exact(sock, ffix) if ffix else b""
+            _recv_into(sock, data_into)
+            msg = _unpack_fields(mt, fields)
+            msg.data = data_into
+            return msg
     if plen == 0:
         payload = b""
     elif scratch is not None and plen >= (64 << 10):
