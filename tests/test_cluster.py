@@ -631,3 +631,77 @@ def test_reaped_device_extent_scrubbed_for_next_tenant(rng):
                 break
         assert got is not None, "reclaimed extent never re-issued"
         assert not got.any(), "reaped tenant's bytes leaked to the new one"
+
+
+def test_fuzz_relay_and_demotion_against_model(rng):
+    """The round-5 surfaces under the same model-based fuzz: (a) a
+    PLANE-LESS client whose device-kind ops ride the daemon relay, and
+    (b) a 1-node cluster where every remote kind DEMOTES to a
+    daemon-owned local handle — both against byte-exact shadows with
+    leak-free teardown."""
+    from oncilla_tpu.ops.ici import SpmdIciPlane
+
+    def run_ops(ctx, kinds, steps):
+        live: list = []
+        for _ in range(steps):
+            op = rng.choice(["alloc", "free", "put", "get", "copy"])
+            if op == "alloc" or not live:
+                if len(live) >= 8:
+                    continue
+                nb = int(rng.integers(1, 9)) * 4096
+                kind = kinds[int(rng.integers(len(kinds)))]
+                h = ctx.alloc(nb, kind)
+                live.append((h, np.zeros(nb, np.uint8)))
+            elif op == "free":
+                h, _ = live.pop(int(rng.integers(len(live))))
+                ctx.free(h)
+            elif op == "put":
+                h, sh = live[int(rng.integers(len(live)))]
+                off = int(rng.integers(0, h.nbytes))
+                n = int(rng.integers(1, h.nbytes - off + 1))
+                data = rng.integers(0, 256, n, dtype=np.uint8)
+                ctx.put(h, data, offset=off)
+                sh[off:off + n] = data
+            elif op == "get":
+                h, sh = live[int(rng.integers(len(live)))]
+                off = int(rng.integers(0, h.nbytes))
+                n = int(rng.integers(1, h.nbytes - off + 1))
+                np.testing.assert_array_equal(
+                    np.asarray(ctx.get(h, nbytes=n, offset=off)),
+                    sh[off:off + n],
+                )
+            else:
+                hs, ss = live[int(rng.integers(len(live)))]
+                hd, sd = live[int(rng.integers(len(live)))]
+                if hd is hs:
+                    continue
+                n = int(rng.integers(1, min(hs.nbytes, hd.nbytes) + 1))
+                ctx.copy(hd, hs, nbytes=n)
+                sd[:n] = ss[:n]
+        for h, sh in live:
+            np.testing.assert_array_equal(np.asarray(ctx.get(h)), sh)
+        for h, _ in live:
+            ctx.free(h)
+
+    # (a) plane-less client on a 2-node cluster: REMOTE_DEVICE rides the
+    # relay, REMOTE_HOST the DCN path, LOCAL_* the app arenas.
+    cfg = small_cfg()
+    with local_cluster(2, config=cfg) as c:
+        plane = SpmdIciPlane(config=cfg, devices_per_rank=1)
+        c.client(0, ici_plane=plane)  # controller serves the plane
+        ctx_b = c.context(1)
+        run_ops(ctx_b, [OcmKind.LOCAL_HOST, OcmKind.REMOTE_HOST,
+                        OcmKind.REMOTE_DEVICE], steps=90)
+        assert all(d.registry.live_count() == 0 for d in c.daemons)
+
+    # (b) single-node demotion: remote kinds come back daemon-owned
+    # LOCAL_*; the plane serves the demoted device bytes.
+    with local_cluster(1, config=cfg) as c:
+        plane = SpmdIciPlane(config=cfg, devices_per_rank=1)
+        ctx = c.context(0, ici_plane=plane)
+        run_ops(ctx, [OcmKind.LOCAL_HOST, OcmKind.REMOTE_HOST,
+                      OcmKind.REMOTE_DEVICE], steps=90)
+        d = c.daemons[0]
+        assert d.registry.live_count() == 0
+        assert d.host_arena.allocator.bytes_live == 0
+        assert all(b.bytes_live == 0 for b in d.device_books)
