@@ -70,4 +70,40 @@ print("one-sided put/get roundtrip ok")
 ctx.free(h)
 ocm.ocm_tini(ctx)
 PY
+# Device kinds from pure C (the full taxonomy cross-process): a Python
+# SPMD controller attaches with an ICI plane — auto-registering its
+# plane endpoint — and the daemons relay the C app's one-sided
+# device-kind ops to it (PLANE_PUT/PLANE_GET).
+echo "== C app device-kind leg (daemon relay to the SPMD controller) =="
+READY=$(mktemp -u)
+JAX_PLATFORMS=cpu OCM_NODEFILE="$NODEFILE" OCM_READY_FILE="$READY" \
+python - <<'PY' &
+import os
+import time
+
+from oncilla_tpu.utils.platform import force_cpu_devices
+
+# One plane row per cluster device: 2 ranks x 1 device each.
+force_cpu_devices(2)
+import oncilla_tpu as ocm
+from oncilla_tpu.ops.ici import SpmdIciPlane
+from oncilla_tpu.utils.config import OcmConfig
+
+cfg = OcmConfig(rank=0)
+plane = SpmdIciPlane(config=cfg, devices_per_rank=1)
+ctx = ocm.ocm_init(cfg, ici_plane=plane)
+open(os.environ["OCM_READY_FILE"], "w").write("ready")
+print("controller: plane serving", flush=True)
+time.sleep(120)  # killed by the script once the C leg finishes
+PY
+CTRL=$!
+trap 'kill $D0 $D1 $CTRL 2>/dev/null || true; rm -f "$NODEFILE" "$READY"' EXIT
+i=0
+while [ ! -f "$READY" ] && [ $i -lt 300 ]; do
+  kill -0 $CTRL 2>/dev/null || { echo "FAIL: controller died at startup"; exit 1; }
+  sleep 0.1; i=$((i+1))
+done
+[ -f "$READY" ] || { echo "FAIL: controller never served its plane"; exit 1; }
+LD_LIBRARY_PATH="$NATIVE" "$NATIVE/ocm_c_demo" "$NODEFILE" 0 262144 2 device
+kill $CTRL 2>/dev/null || true
 echo "== two-daemon walkthrough ok =="

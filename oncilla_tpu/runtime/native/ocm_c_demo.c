@@ -5,7 +5,11 @@
  * buffers and through the handle's localbuf via ocmc_copy_onesided), and
  * test 3's host arm (handle-to-handle ocmc_copy).
  *
- * Usage: ocm_c_demo NODEFILE RANK [NBYTES [EXPECT_NNODES]]
+ * Usage: ocm_c_demo NODEFILE RANK [NBYTES [EXPECT_NNODES [KIND]]]
+ * KIND "device" runs the journey on OCMC_KIND_REMOTE_DEVICE — the bytes
+ * live in the SPMD controller's plane arena and the daemons relay this
+ * app's one-sided ops there (a controller with ici_plane= must be
+ * attached somewhere in the cluster).
  * With EXPECT_NNODES > 1 the demo first polls the master's membership
  * until that many daemons joined (a still-joining cluster demotes remote
  * requests to the local arm, alloc.c:82-83), then REQUIRES the
@@ -22,7 +26,8 @@
 
 int main(int argc, char** argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s NODEFILE RANK [NBYTES [EXPECT_NNODES]]\n",
+    fprintf(stderr,
+            "usage: %s NODEFILE RANK [NBYTES [EXPECT_NNODES [host|device]]]\n",
             argv[0]);
     return -1;
   }
@@ -30,6 +35,15 @@ int main(int argc, char** argv) {
   long rank = strtol(argv[2], NULL, 10);
   unsigned long long n = argc > 3 ? strtoull(argv[3], NULL, 10) : (1u << 20);
   long expect_nnodes = argc > 4 ? strtol(argv[4], NULL, 10) : 0;
+  int kind = OCMC_KIND_REMOTE_HOST;
+  if (argc > 5) {
+    if (strcmp(argv[5], "device") == 0) {
+      kind = OCMC_KIND_REMOTE_DEVICE;
+    } else if (strcmp(argv[5], "host") != 0) {
+      fprintf(stderr, "unknown KIND %s (use 'host' or 'device')\n", argv[5]);
+      return -1;
+    }
+  }
 
   ocmc_ctx* ctx = ocmc_init(nodefile, rank, 2.0);
   if (!ctx) {
@@ -56,7 +70,7 @@ int main(int argc, char** argv) {
   ocmc_handle h;
   unsigned char *src = NULL, *dst = NULL;
   int rc = -1;
-  if (ocmc_alloc(ctx, n, OCMC_KIND_REMOTE_HOST, &h) != 0) {
+  if (ocmc_alloc(ctx, n, (uint8_t)kind, &h) != 0) {
     fprintf(stderr, "FAIL: alloc: %s\n", ocmc_last_error(ctx));
     goto done;
   }
@@ -127,7 +141,7 @@ int main(int argc, char** argv) {
   /* Handle-to-handle copy (ocm_copy host arm, lib.c:502-665). */
   {
     ocmc_handle h2;
-    if (ocmc_alloc(ctx, n, OCMC_KIND_REMOTE_HOST, &h2) != 0) {
+    if (ocmc_alloc(ctx, n, (uint8_t)kind, &h2) != 0) {
       fprintf(stderr, "FAIL: alloc2: %s\n", ocmc_last_error(ctx));
       goto done;
     }
