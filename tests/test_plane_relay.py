@@ -42,13 +42,28 @@ def cfg(**kw):
     return OcmConfig(**d)
 
 
-def test_planeless_client_reaches_device_bytes(rng):
+def _make_plane(kind: str, config):
+    if kind == "spmd":
+        return SpmdIciPlane(config=config, devices_per_rank=1)
+    from oncilla_tpu.ops.ici import IciDataPlane
+
+    import jax
+
+    return IciDataPlane(
+        config=config, devices=[jax.devices()[0]] * 2, devices_per_rank=1
+    )
+
+
+@pytest.mark.parametrize("plane_kind", ["spmd", "controller"])
+def test_planeless_client_reaches_device_bytes(rng, plane_kind):
     """Client B (no ici_plane) allocs REMOTE_DEVICE and round-trips data;
     the bytes land in controller A's plane arena and A reads the same
-    bytes through the same handle."""
+    bytes through the same handle. Both plane flavors serve the relay:
+    the mesh-sharded SpmdIciPlane and the controller-orchestrated
+    IciDataPlane."""
     config = cfg()
     with local_cluster(2, config=config) as cl:
-        plane = SpmdIciPlane(config=config, devices_per_rank=1)
+        plane = _make_plane(plane_kind, config)
         a = cl.client(0, ici_plane=plane)  # controller: serves its plane
         b = cl.client(1)                    # plane-less process stand-in
         ctx_b = Ocm(config=config, remote=b)
