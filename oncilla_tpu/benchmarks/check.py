@@ -1,0 +1,93 @@
+"""Grade a banked bench JSON against the round-5 targets.
+
+The judged perf claims each have a concrete bar (VERDICT r4 "do this"
+1-4); this turns a ``BENCH_SELF_r0N.json`` / ``BENCH_r0N.json`` line into
+pass/fail verdicts so a late tunnel recovery needs zero analysis lag:
+
+    python -m oncilla_tpu.benchmarks.check BENCH_SELF_r05.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def grade(doc: dict) -> list[tuple[str, str, str]]:
+    """Returns (target, verdict, evidence) rows; verdict in
+    PASS / FAIL / NO DATA."""
+    d = doc.get("detail", {})
+    rows: list[tuple[str, str, str]] = []
+
+    def row(name, ok, evidence):
+        rows.append((name, "NO DATA" if ok is None else
+                     ("PASS" if ok else "FAIL"), evidence))
+
+    # 1. Headline copy bandwidth vs the 0.80 x 819 GB/s target.
+    v = doc.get("value", 0.0)
+    row("headline copy >= target (vs_baseline >= 1.0)",
+        None if not v else doc.get("vs_baseline", 0.0) >= 1.0,
+        f"value={v} GB/s vs_baseline={doc.get('vs_baseline')}")
+
+    # 2. GB-read leg within 2x of the DMA copy figure (r4 weak #1: the
+    #    row-kernel routing's first hardware run must land hundreds of
+    #    GB/s, not r3's 14).
+    sweep = d.get("gb_sweep") or {}
+    pallas = d.get("pallas_gbps")
+    read_1g = None
+    for size, legs in sweep.items():
+        if str(size) in ("1073741824", "1g", "1G") and isinstance(legs, list):
+            read_1g = legs[1] if len(legs) > 1 else None
+    if read_1g is None and sweep:
+        # Largest size present.
+        try:
+            k = max(sweep, key=lambda s: int(s))
+            legs = sweep[k]
+            read_1g = legs[1] if isinstance(legs, list) and len(legs) > 1 else None
+        except (ValueError, TypeError):
+            read_1g = None
+    row("GB-sweep read leg >= pallas_gbps / 2",
+        None if read_1g is None or not pallas else read_1g >= pallas / 2,
+        f"read={read_1g} GB/s pallas={pallas} GB/s")
+
+    # 3. Ceiling probe ran (closes or caps the 655.2 target with data).
+    ceil = d.get("ceiling") or {}
+    row("ceiling probe banked (read_only + stream sweep)",
+        None if not ceil else all(
+            ceil.get(k, -1) not in (None, -1)
+            for k in ("read_only_gbps", "vmem_roundtrip_gbps")
+        ),
+        json.dumps(ceil) if ceil else "absent")
+
+    # 4. Train MFU >= 0.60 (r4 "do this" #4).
+    mfu_t = d.get("mfu_train")
+    row("mfu_train >= 0.60", None if mfu_t is None else mfu_t >= 0.60,
+        f"mfu_train={mfu_t} variants={len(d.get('mfu_train_variants') or [])}")
+
+    # 5. Page-fused paged decode >= plain decode tok/s.
+    kv = d.get("kv_decode_tok_s") or {}
+    fused, plain = kv.get("device_fused"), kv.get("plain")
+    row("paged device_fused >= plain tok/s",
+        None if fused is None or plain is None else fused >= plain,
+        f"device_fused={fused} plain={plain}")
+
+    # 6. DCN daemon-path bandwidth recorded (config 2; chip-free).
+    dcn = d.get("dcn") or {}
+    row("dcn banked and verified",
+        None if not dcn else bool(dcn.get("verified")),
+        json.dumps(dcn) if dcn else "absent")
+    return rows
+
+
+def main(argv: list[str]) -> int:
+    path = argv[1] if len(argv) > 1 else "BENCH_SELF_r05.json"
+    doc = json.loads(open(path).read().strip().splitlines()[-1])
+    rows = grade(doc)
+    width = max(len(r[0]) for r in rows)
+    for name, verdict, evidence in rows:
+        print(f"{name:<{width}}  {verdict:<8}  {evidence}")
+    return 0 if all(v != "FAIL" for _, v, _ in rows) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -227,3 +227,35 @@ def test_dcn_loopback_bench_native_daemons():
         pytest.skip(f"native build unavailable: {e}")
     r = dcn_loopback_bench(nbytes=8 << 20, iters=2, native=True)
     assert r["verified"] and r["native_daemons"]
+
+
+def test_bench_check_grades_known_docs(tmp_path):
+    """The target grader: NO DATA on a wedge doc, PASS/FAIL on synthetic
+    healthy docs."""
+    import json
+
+    from oncilla_tpu.benchmarks.check import grade
+
+    wedge = {"value": 0.0, "vs_baseline": 0.0, "detail": {}}
+    assert all(v == "NO DATA" for _, v, _ in grade(wedge))
+
+    healthy = {
+        "value": 700.0, "vs_baseline": 1.07,
+        "detail": {
+            "pallas_gbps": 580.0,
+            "gb_sweep": {"1073741824": [5.0, 400.0]},
+            "ceiling": {"read_only_gbps": 750.0, "vmem_roundtrip_gbps": 366.0},
+            "mfu_train": 0.61, "mfu_train_variants": [{}],
+            "kv_decode_tok_s": {"device_fused": 120.0, "plain": 100.0},
+            "dcn": {"verified": True},
+        },
+    }
+    verdicts = {name: v for name, v, _ in grade(healthy)}
+    assert all(v == "PASS" for v in verdicts.values()), verdicts
+
+    weak = json.loads(json.dumps(healthy))
+    weak["detail"]["mfu_train"] = 0.55
+    weak["detail"]["gb_sweep"] = {"1073741824": [5.0, 14.0]}
+    verdicts = {name: v for name, v, _ in grade(weak)}
+    assert verdicts["mfu_train >= 0.60"] == "FAIL"
+    assert verdicts["GB-sweep read leg >= pallas_gbps / 2"] == "FAIL"
