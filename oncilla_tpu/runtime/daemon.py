@@ -507,6 +507,14 @@ class Daemon:
             self.entries[f["rank"]] = NodeEntry(
                 f["rank"], f["host"], f["port"], prev.addr
             )
+        # A (re)joining daemon starts with no in-memory plane endpoint:
+        # queue it for the reaper's gossip so relays work there promptly
+        # (the client's periodic re-registration is the slower backstop).
+        # Same bounds guard as the entries update above: an out-of-range
+        # rank would IndexError inside the reaper and kill it.
+        if self.plane_addr is not None and 0 <= f["rank"] < len(self.entries):
+            with self._plane_sync_lock:
+                self._plane_unsynced.add(f["rank"])
         return Message(MsgType.ADD_NODE_OK, {"nnodes": self.policy.nnodes})
 
     # REQ_ALLOC: non-masters proxy the request to rank 0 (the placement leg,
@@ -725,15 +733,21 @@ class Daemon:
     def _on_plane_serve(self, msg: Message) -> Message:
         f = msg.fields
         new_addr = (f["host"], f["port"]) if f["port"] else None  # 0=clear
-        if new_addr == self.plane_addr:
-            # Periodic client re-registration of the same endpoint: no
-            # re-broadcast churn.
+        changed = new_addr != self.plane_addr
+        if not changed and f.get("relay", 0):
+            # Gossiped copy of what we already hold: nothing to do.
             return Message(MsgType.PLANE_SERVE_OK, {"port": f["port"]})
         self.plane_addr = new_addr
-        printd("daemon %d: device plane %s", self.rank,
-               f"registered at {f['host']}:{f['port']}" if new_addr
-               else "deregistered")
+        if changed:
+            printd("daemon %d: device plane %s", self.rank,
+                   f"registered at {f['host']}:{f['port']}" if new_addr
+                   else "deregistered")
         if not f.get("relay", 0):
+            # Even an UNCHANGED client re-registration re-arms the gossip:
+            # a peer daemon that restarted (losing its in-memory endpoint)
+            # re-learns it on the next reaper tick; receivers that already
+            # hold the endpoint no-op above, so the steady-state cost is
+            # one tiny message per peer per re-registration period.
             # Fresh (de)registration from a local client: every other
             # daemon must learn it too (owner daemons relay device ops
             # there; the master is the fallback hop, so it matters MOST).

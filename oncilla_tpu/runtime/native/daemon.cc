@@ -701,9 +701,18 @@ class Daemon {
     placement_.add_node(std::move(r));
     int64_t rank = m.i("rank");
     if (rank >= 0 && size_t(rank) < entries_.size()) {
-      std::lock_guard<std::mutex> g(entries_mu_);
-      entries_[rank] = {rank, m.s("host"), int(m.u("port")),
-                        entries_[rank].addr};
+      {
+        std::lock_guard<std::mutex> g(entries_mu_);
+        entries_[rank] = {rank, m.s("host"), int(m.u("port")),
+                          entries_[rank].addr};
+      }
+      // A (re)joining daemon holds no plane endpoint: queue it for the
+      // reaper's gossip — AFTER the entries update so the gossip dials
+      // the replacement's address, never the dead predecessor's, and
+      // only for in-range ranks (an out-of-range one would throw in the
+      // reaper every tick and never be erased). daemon.py twin.
+      std::lock_guard<std::mutex> g(plane_mu_);
+      if (!plane_host_.empty()) plane_unsynced_.insert(rank);
     }
     return {MsgType::ADD_NODE_OK, {{"nnodes", Value::I(placement_.nnodes())}}, {}};
   }
@@ -1042,8 +1051,10 @@ class Daemon {
     int port = int(m.u("port"));
     {
       std::lock_guard<std::mutex> g(plane_mu_);
-      if (host == plane_host_ && port == plane_port_) {
-        // Periodic client re-registration of the same endpoint: no-op.
+      if (host == plane_host_ && port == plane_port_ && m.u("relay") != 0) {
+        // Gossiped copy of what we already hold: nothing to do. (An
+        // UNCHANGED client re-registration still re-arms the gossip
+        // below — a restarted peer daemon re-learns the endpoint.)
         return {MsgType::PLANE_SERVE_OK, {{"port", Value::U(m.u("port"))}},
                 {}};
       }

@@ -560,3 +560,40 @@ print("CHILD_OK", h.rank, h.device_index, h.extent.offset, flush=True)
     finally:
         for d in daemons:
             d.stop()
+
+
+def test_restarted_daemon_relearns_plane_endpoint(rng):
+    """A daemon restart loses the in-memory plane endpoint; the client's
+    periodic re-registration re-arms the gossip (an unchanged endpoint
+    must NOT be deduped into silence) and the master queues rejoining
+    ranks, so the replacement daemon re-learns the endpoint and serves
+    relays again without any operator action."""
+    import time as _time
+
+    from oncilla_tpu.runtime.daemon import Daemon
+
+    config = cfg(heartbeat_s=0.1)  # re-registration every ~1.5 s
+    with local_cluster(3, config=config) as cl:
+        plane = SpmdIciPlane(config=config, devices_per_rank=1)
+        cl.client(0, ici_plane=plane)
+        ctx_b = Ocm(config=config, remote=cl.client(1))
+        h = ctx_b.alloc(64 << 10, OcmKind.REMOTE_DEVICE)
+        data = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        ctx_b.put(h, data)
+
+        # Restart a BYSTANDER daemon (rank 2 — neither the client's local
+        # daemon nor the extent's owner): its replacement must re-learn
+        # the endpoint purely through gossip.
+        cl.daemons[2].stop()
+        replacement = Daemon(2, cl.entries, config=config)
+        replacement.start()
+        cl.daemons[2] = replacement
+        deadline = _time.time() + 20
+        while _time.time() < deadline and replacement.plane_addr is None:
+            _time.sleep(0.1)
+        assert replacement.plane_addr is not None, (
+            "restarted daemon never re-learned the plane endpoint"
+        )
+        # And the data plane still works end to end.
+        np.testing.assert_array_equal(np.asarray(ctx_b.get(h)), data)
+        ctx_b.free(h)
