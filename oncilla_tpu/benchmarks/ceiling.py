@@ -110,9 +110,16 @@ def _read_stream_loop(total_bytes: int, chunk_bytes: int, iters: int):
 
 
 def hbm_read_gbps(
-    total_bytes: int = 256 << 20, chunk_bytes: int = 2 << 20, iters: int = 8
+    total_bytes: int = 256 << 20, chunk_bytes: int = 2 << 20, iters: int = 600
 ) -> float:
-    """Read-only HBM stream rate (GB/s of HBM read traffic)."""
+    """Read-only HBM stream rate (GB/s of HBM read traffic).
+
+    ``iters`` must put the device time well past the tunnel's dispatch +
+    readback latency (~30 ms): 8 sweeps (~2 GiB, ~3 ms of engine time)
+    measured the tunnel, not HBM — the r5 first run banked 59.9 GB/s for
+    a read-only stream while copies did 579, a physical impossibility.
+    600 sweeps ≈ 157 GB ≈ 0.2+ s of engine time, >85 % of the timed
+    window on the worst tunnel observed."""
     run = _read_stream_loop(total_bytes, chunk_bytes, iters)
     buf = _fresh(total_bytes)
     buf = run(buf)
@@ -187,10 +194,13 @@ def copy_gbps(
     streams: int,
     total_bytes: int = 128 << 20,
     nbytes: int = 64 << 20,
-    iters: int = 500,
+    iters: int = 2000,
 ) -> float:
     """HBM→HBM copy traffic (2·nbytes per iteration) with ``streams``
-    persistent in-flight descriptors."""
+    persistent in-flight descriptors. 2000 iterations matches the bench
+    headline loop: at 500 the ~30 ms tunnel sync was ~20 % of the timed
+    window and the sweep under-read the engine by ~25 % (455 vs 579 in
+    the r5 first run)."""
     run = _copy_stream_loop(total_bytes, nbytes, iters, streams)
     buf = _fresh(total_bytes)
     buf = run(buf)
@@ -268,7 +278,7 @@ def _vmem_roundtrip_loop(total_bytes: int, nbytes: int, iters: int,
 
 
 def vmem_roundtrip_gbps(
-    total_bytes: int = 128 << 20, nbytes: int = 64 << 20, iters: int = 100,
+    total_bytes: int = 128 << 20, nbytes: int = 64 << 20, iters: int = 400,
     chunk_bytes: int = 2 << 20,
 ) -> float:
     """Copy traffic (2·nbytes per iteration of HBM read+write) when staged

@@ -34,16 +34,27 @@ def grade(doc: dict) -> list[tuple[str, str, str]]:
     #    GB/s, not r3's 14).
     sweep = d.get("gb_sweep") or {}
     pallas = d.get("pallas_gbps")
+
+    def best_read(legs):
+        """Amortized routed-DMA leg when present (legs[2]), else the
+        per-op leg — per-op timing on a tunneled dev chip measures the
+        ~70 ms dispatch round-trip, not the engine (sweep.py leg
+        semantics)."""
+        if not isinstance(legs, list):
+            return None
+        if len(legs) > 2 and legs[2]:
+            return legs[2]
+        return legs[1] if len(legs) > 1 else None
+
     read_1g = None
     for size, legs in sweep.items():
-        if str(size) in ("1073741824", "1g", "1G") and isinstance(legs, list):
-            read_1g = legs[1] if len(legs) > 1 else None
+        if str(size) in ("1073741824", "1g", "1G"):
+            read_1g = best_read(legs)
     if read_1g is None and sweep:
         # Largest size present.
         try:
-            k = max(sweep, key=lambda s: int(s))
-            legs = sweep[k]
-            read_1g = legs[1] if isinstance(legs, list) and len(legs) > 1 else None
+            k = max((s for s in sweep if str(s).isdigit()), key=int)
+            read_1g = best_read(sweep[k])
         except (ValueError, TypeError):
             read_1g = None
     row("GB-sweep read leg >= pallas_gbps / 2",

@@ -104,6 +104,25 @@ def test_dma_row_kernels_interpret(rng):
         np.testing.assert_array_equal(flat[2 << 20: 3 << 20], buf[: 1 << 20])
 
 
+def test_read_rows_loop_matches_single(rng):
+    """pallas_read_rows_loop (the dispatch-amortized bench leg) returns
+    the same bytes as a single pallas_read_rows for every k, on both
+    arena layouts — k only folds dispatches, never changes the data."""
+    import jax
+
+    from oncilla_tpu.ops import pallas_ici as pi
+
+    buf = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+    for shape in ((2 << 20,), ((2 << 20) // _BLOCK, _BLOCK)):
+        x = jax.device_put(buf.reshape(shape))
+        want = buf[1 << 20: (1 << 20) + (512 << 10)]
+        for k in (1, 3):
+            got = np.asarray(
+                pi.pallas_read_rows_loop(x, 1 << 20, 512 << 10, k)
+            )
+            np.testing.assert_array_equal(got, want)
+
+
 def test_dma_routing_in_arena(monkeypatch, rng):
     """With the TPU gate forced open, DeviceArena routes aligned >=1 MiB
     extents through the DMA kernels (interpret machine here) and the
