@@ -121,6 +121,7 @@ def mfu_train(
     steps: int = 6,
     remat=False,
     ce_block: int | None = None,
+    mu_dtype=None,
 ) -> dict:
     """Train-step MFU (fwd + bwd + optimizer) on a single-device mesh.
 
@@ -129,8 +130,10 @@ def mfu_train(
     params pinned to the input specs, so XLA updates weights and Adam
     moments in place — no extra weight copies live across the step. The
     remaining knobs are ``remat`` ("dots" keeps matmul outputs, recomputes
-    elementwise — batch can grow with ~zero extra MXU work) and
-    ``ce_block`` (blocked vocab-head CE — no (B, S, V) logits tensor);
+    elementwise — batch can grow with ~zero extra MXU work), ``ce_block``
+    (blocked vocab-head CE — no (B, S, V) logits tensor) and ``mu_dtype``
+    (bf16 Adam µ — halves µ footprint+traffic, frees ~2 GB of HBM on the
+    flagship so bigger batches fit WITHOUT paying the blocked-CE tax);
     :func:`mfu_train_best` sweeps them."""
     from oncilla_tpu.models import train
 
@@ -139,7 +142,9 @@ def mfu_train(
     mesh = train.make_mesh(1)
     # Host-side init (same rationale as mfu_forward); the optimizer is the
     # production one from train.py, so this measures the real train step.
-    params, opt_state, tx = train.make_train_state_host(0, cfg, mesh)
+    params, opt_state, tx = train.make_train_state_host(
+        0, cfg, mesh, mu_dtype=mu_dtype
+    )
     step = train.make_train_step(cfg, mesh, tx, use_ring=False,
                                  remat=remat, ce_block=ce_block)
     rng = np.random.default_rng(0)
@@ -174,6 +179,7 @@ def mfu_train(
         "batch": batch,
         "remat": str(remat),
         "ce_block": ce_block,
+        "mu_dtype": str(mu_dtype.__name__) if mu_dtype is not None else None,
     }
 
 
@@ -181,44 +187,55 @@ def mfu_train_best(deadline: float | None = None) -> dict:
     """Sweep the memory-layout variants of the train step and keep the
     best MFU. The analytic FLOP count (3x forward) is identical for every
     variant, so wall time alone decides — a variant that recomputes more
-    must win on time to win here. The leading hypothesis is batch 8 +
-    dots-remat + blocked CE: double the batch (Adam's ~24 GB of moment
-    traffic amortizes over 2x the FLOPs) at ~zero extra MXU work, fitting
-    only because dots-remat + blocked CE free the activation HBM that
-    made batch 8 OOM at r3; the trailing entry is the r3 batch-4
-    baseline (0.558) as the floor.
+    must win on time to win here.
 
-    The sweep covers the two axes VERDICT r4 called out as unexplored:
-    ce_block size (CE-scan step count vs per-step logits memory) and the
-    remat policy ladder (False / "dots" / True), plus a larger batch that
-    only full remat could fit. With ``deadline`` (time.monotonic()),
-    later variants are skipped once it passes — the order is
-    expected-value descending so a tight deadline still measures the
-    likely champions; a variant that fails (e.g. OOM at compile) is
-    recorded and skipped."""
+    Variant order encodes what the r5 first-light measurements showed:
+    batch 4 with UNBLOCKED CE (r3: 0.554) beats batch 8 with blocked CE
+    (r5: 0.525-0.531) — the CE scan's small per-block head matmuls cost
+    more MFU than batch-8's Adam amortization buys. So the leading
+    hypothesis is batch 8 + dots-remat + *unblocked* CE, which only fits
+    in 16 GB because bf16-µ (``mu_dtype``) frees ~2.2 GB of moment
+    footprint; then the amortization ladder (batch 16 needs blocked CE
+    again — its full logits don't fit at any µ dtype), then the measured
+    incumbents as floors. With ``deadline`` (time.monotonic()), later
+    variants are skipped once it passes; a variant that fails (e.g. OOM
+    at compile) is recorded and skipped."""
+    import jax.numpy as jnp
+
     cfg, batch4, seq = train_sized_config()
+    bf16 = jnp.bfloat16
+    # ce_block never exceeds the effective sequence (seq-1 = 1023, padded
+    # to the block size): 1024 is one near-exact chunk; a 2048 block would
+    # pad HALF the chunk with masked positions and materialize MORE logits
+    # than the unblocked head it exists to avoid.
     variants = [
-        dict(batch=8, remat="dots", ce_block=512),   # r4's expected champion
-        dict(batch=8, remat="dots", ce_block=1024),  # fewer CE-scan steps
-        dict(batch=8, remat="dots", ce_block=256),   # smaller logits tile
-        dict(batch=16, remat="dots", ce_block=512),  # 4x Adam amortization
-        dict(batch=16, remat=True, ce_block=512),    # full remat to fit b16
-        dict(batch=8, remat=False, ce_block=512),    # no recompute at all
-        dict(batch=8, remat=True, ce_block=512),     # max-memory-saving ref
-        dict(batch=batch4, remat=False, ce_block=None),  # r3 baseline
+        # (the champion hypothesis: no CE-blocking tax, Adam amortized)
+        dict(batch=8, remat="dots", ce_block=None, mu_dtype=bf16),
+        dict(batch=16, remat="dots", ce_block=1024, mu_dtype=bf16),
+        dict(batch=batch4, remat=False, ce_block=None, mu_dtype=bf16),
+        dict(batch=16, remat="dots", ce_block=1024, mu_dtype=None),
+        dict(batch=batch4, remat=False, ce_block=None, mu_dtype=None),  # r3 floor
+        dict(batch=8, remat="dots", ce_block=1024, mu_dtype=None),      # r5 floor
+        dict(batch=16, remat=True, ce_block=1024, mu_dtype=bf16),
     ]
     best, tried = None, []
     for v in variants:
+        label = {
+            **v,
+            "mu_dtype": v["mu_dtype"].__name__ if v["mu_dtype"] else None,
+        }
         if deadline is not None and time.monotonic() > deadline:
-            tried.append({**v, "skipped": "deadline"})
+            tried.append({**label, "skipped": "deadline"})
             continue
         try:
             r = mfu_train(cfg, v["batch"], seq, remat=v["remat"],
-                          ce_block=v["ce_block"])
+                          ce_block=v["ce_block"], mu_dtype=v["mu_dtype"])
         except Exception as e:  # noqa: BLE001 — an OOM variant is data
-            tried.append({**v, "error": f"{type(e).__name__}"})
+            tried.append({**label, "error": f"{type(e).__name__}"})
             continue
-        tried.append({k: r[k] for k in ("batch", "remat", "ce_block", "mfu")})
+        tried.append(
+            {k: r[k] for k in ("batch", "remat", "ce_block", "mu_dtype", "mfu")}
+        )
         if best is None or r["mfu"] > best["mfu"]:
             best = r
     if best is None:

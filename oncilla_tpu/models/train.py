@@ -69,18 +69,22 @@ def data_spec() -> P:
 
 
 def _sharded_state(params_host: dict, specs: dict, mesh: Mesh, lr: float,
-                   offload_opt: bool = False):
+                   offload_opt: bool = False, mu_dtype=None):
     """Shared state factory: device_put each leaf under its spec + adamw.
     With ``offload_opt``, the optimizer state lives in the TPU-VM host's
     pinned memory (same partition specs, ``memory_kind="pinned_host"``) —
     the HBM footprint drops by ~2 weight copies and the step pays a
     host<->HBM round-trip for the moments (the ZeRO-offload trade, here a
-    first-class placement like every other OCM memory kind)."""
+    first-class placement like every other OCM memory kind).
+    ``mu_dtype`` (e.g. ``jnp.bfloat16``) stores Adam's first moment in a
+    reduced dtype (optax's native knob, cast up for the update math): µ
+    traffic and footprint halve, the variance ν stays fp32 — the common
+    memory-efficient-Adam deployment trade."""
     params = {
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params_host.items()
     }
-    tx = optax.adamw(lr, weight_decay=0.01)
+    tx = optax.adamw(lr, weight_decay=0.01, mu_dtype=mu_dtype)
     opt_state = tx.init(params)
     if offload_opt:
         opt_state = jax.tree.map(
@@ -156,15 +160,16 @@ def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx,
 
 
 def make_train_state(key, cfg: LlamaConfig, mesh: Mesh, lr: float = 3e-4,
-                     offload_opt: bool = False):
+                     offload_opt: bool = False, mu_dtype=None):
     return _sharded_state(
         init_params(key, cfg), param_specs(cfg), mesh, lr,
-        offload_opt=offload_opt,
+        offload_opt=offload_opt, mu_dtype=mu_dtype,
     )
 
 
 def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
-                          lr: float = 3e-4, offload_opt: bool = False):
+                          lr: float = 3e-4, offload_opt: bool = False,
+                          mu_dtype=None):
     """Same state as :func:`make_train_state` but with numpy host-side
     param init (init values differ; optimizer identical) — the jax.random
     path compiles one kernel per weight shape, minutes of wall time on a
@@ -173,7 +178,7 @@ def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
 
     return _sharded_state(
         init_params_host(seed, cfg), param_specs(cfg), mesh, lr,
-        offload_opt=offload_opt,
+        offload_opt=offload_opt, mu_dtype=mu_dtype,
     )
 
 

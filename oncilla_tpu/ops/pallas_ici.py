@@ -466,7 +466,9 @@ def pallas_read_rows(buf: jax.Array, start: int, nbytes: int) -> jax.Array:
     moved by the DMA engine (not an XLA slice). ``buf`` is the arena in
     either flat or blocked shape; ``start`` is a byte offset."""
     assert start % BLOCK == 0 and nbytes % BLOCK == 0 and nbytes > 0
-    return _cached_rows_read(nbytes // BLOCK, buf.shape, _interpret_mode())(
+    # k passed explicitly: lru_cache keys f(a, b, c) and f(a, b, c, 1)
+    # differently, and the loop flavor's k=1 must hit THIS cache entry.
+    return _cached_rows_read(nbytes // BLOCK, buf.shape, _interpret_mode(), 1)(
         jnp.stack([jnp.int32(start // BLOCK)]), buf
     )
 

@@ -93,6 +93,35 @@ def test_mfu_measurement_runs():
     assert r["tflops"] > 0 and 0 <= r["mfu"] < 1
     r2 = mfu.mfu_train(LlamaConfig.tiny(), batch=2, seq=32, steps=1)
     assert r2["tflops"] > 0 and np.isfinite(r2["loss"])
+    assert r2["mu_dtype"] is None
+
+
+def test_mfu_train_bf16_moments():
+    """The mu_dtype lever: Adam's µ leaves live in bf16 (halved moment
+    footprint — what lets the flagship fit unblocked CE at batch 8), the
+    step still trains (finite, decreasable loss), and ν stays fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    from oncilla_tpu.benchmarks import mfu
+    from oncilla_tpu.models import train
+    from oncilla_tpu.models.llama import LlamaConfig
+
+    r = mfu.mfu_train(
+        LlamaConfig.tiny(), batch=2, seq=32, steps=2, mu_dtype=jnp.bfloat16
+    )
+    assert r["tflops"] > 0 and np.isfinite(r["loss"])
+    assert r["mu_dtype"] == "bfloat16"
+
+    cfg = LlamaConfig.tiny()
+    mesh = train.make_mesh(1)
+    _, opt_state, _ = train.make_train_state_host(
+        0, cfg, mesh, mu_dtype=jnp.bfloat16
+    )
+    mus = jax.tree_util.tree_leaves(opt_state[0].mu)
+    nus = jax.tree_util.tree_leaves(opt_state[0].nu)
+    assert all(m.dtype == jnp.bfloat16 for m in mus)
+    assert all(n.dtype == jnp.float32 for n in nus)
 
 
 def test_size_sweep_blocked_arena():
