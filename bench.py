@@ -20,16 +20,18 @@ numbers (BASELINE.md), so the target transplanted from the north star
 a copy touches each byte twice (read + write), so we credit 2·nbytes of
 HBM traffic per copy.
 
-Ceiling evidence (MEASURED IN ROUND 3 — the round-4 tunnel wedge allowed
-no re-measurement; every run re-derives it fresh in ``detail.ceiling``):
-the ~0.88 vs_baseline was the DMA copy engine's plateau, not a tuning
-gap. Swept on-chip then (fresh process per variant): 1/2/4/8 persistent
-streams all landed 442-584 GB/s of combined traffic (stream count
-immaterial — the engine saturates), a chunked/windowed descriptor scheme
-added nothing, and a VMEM-round-trip grid memcpy was strictly worse
-(~366 GB/s: each byte makes two DMA hops). A copy's read-write
-turnaround keeps HBM below the read-only line rate the 819 figure
-describes. Trust the current run's ``detail`` block over these numbers.
+Ceiling evidence: the ~0.88 vs_baseline is the DMA copy engine's
+plateau, not a tuning gap — and it REPRODUCES across sessions: round 3
+measured 580.3 GB/s, round 5 first light 578.74 (same 2-stream winner,
+s4 within 0.4%, remote-DMA loopback 469.2 vs 469.0). The r3 sweep showed
+1/2/4/8 persistent streams all saturate the engine, descriptor schemes
+add nothing, and a VMEM-round-trip memcpy is strictly worse (each byte
+makes two DMA hops). A copy's read-write turnaround keeps HBM below the
+read-only line rate the 819 figure describes; ``detail.ceiling``
+re-derives all three probes fresh every run (iteration counts sized so
+engine time dominates the tunnel's ~30 ms dispatch latency — the r5
+first-light ceiling numbers predate that fix and under-read). Trust the
+current run's ``detail`` block over these numbers.
 """
 
 from __future__ import annotations
