@@ -357,3 +357,12 @@ def test_bench_check_grades_known_docs(tmp_path):
     }
     verdicts = {name: v for name, v, _ in grade(amortized)}
     assert verdicts["GB-sweep read leg >= pallas_gbps / 2"] == "PASS"
+
+    # A deadline-truncated ceiling probe (-1 legs) is NO DATA, not FAIL —
+    # partial evidence means "rerun with budget", not "plateau refuted".
+    partial = json.loads(json.dumps(healthy))
+    partial["detail"]["ceiling"] = {
+        "read_only_gbps": 750.0, "vmem_roundtrip_gbps": -1.0,
+    }
+    verdicts = {name: v for name, v, _ in grade(partial)}
+    assert verdicts["ceiling probe banked (read_only + stream sweep)"] == "NO DATA"
