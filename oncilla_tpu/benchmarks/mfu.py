@@ -183,6 +183,35 @@ def mfu_train(
     }
 
 
+def train_variants() -> list[dict]:
+    """The ONE sweep grid, shared by :func:`mfu_train_best` and the
+    recovery driver (examples/r5_recovery.sh) so the two can't drift.
+    Expected-value-descending; see mfu_train_best for the rationale.
+    ce_block never exceeds the effective sequence (seq-1 = 1023, padded
+    to the block size): 1024 is one near-exact chunk; a 2048 block would
+    pad HALF the chunk with masked positions and materialize MORE logits
+    than the unblocked head it exists to avoid."""
+    import jax.numpy as jnp
+
+    _, batch4, _ = train_sized_config()
+    bf16 = jnp.bfloat16
+    return [
+        # (the champion hypothesis: no CE-blocking tax, Adam amortized)
+        dict(batch=8, remat="dots", ce_block=None, mu_dtype=bf16),
+        dict(batch=16, remat="dots", ce_block=1024, mu_dtype=bf16),
+        dict(batch=batch4, remat=False, ce_block=None, mu_dtype=bf16),
+        dict(batch=16, remat="dots", ce_block=1024, mu_dtype=None),
+        dict(batch=batch4, remat=False, ce_block=None, mu_dtype=None),  # r3 floor
+        dict(batch=8, remat="dots", ce_block=1024, mu_dtype=None),      # r5 floor
+        dict(batch=16, remat=True, ce_block=1024, mu_dtype=bf16),
+    ]
+
+
+def variant_label(v: dict) -> dict:
+    """JSON-serializable form of a sweep-grid entry (mu_dtype by name)."""
+    return {**v, "mu_dtype": v["mu_dtype"].__name__ if v["mu_dtype"] else None}
+
+
 def mfu_train_best(deadline: float | None = None) -> dict:
     """Sweep the memory-layout variants of the train step and keep the
     best MFU. The analytic FLOP count (3x forward) is identical for every
@@ -200,30 +229,10 @@ def mfu_train_best(deadline: float | None = None) -> dict:
     incumbents as floors. With ``deadline`` (time.monotonic()), later
     variants are skipped once it passes; a variant that fails (e.g. OOM
     at compile) is recorded and skipped."""
-    import jax.numpy as jnp
-
-    cfg, batch4, seq = train_sized_config()
-    bf16 = jnp.bfloat16
-    # ce_block never exceeds the effective sequence (seq-1 = 1023, padded
-    # to the block size): 1024 is one near-exact chunk; a 2048 block would
-    # pad HALF the chunk with masked positions and materialize MORE logits
-    # than the unblocked head it exists to avoid.
-    variants = [
-        # (the champion hypothesis: no CE-blocking tax, Adam amortized)
-        dict(batch=8, remat="dots", ce_block=None, mu_dtype=bf16),
-        dict(batch=16, remat="dots", ce_block=1024, mu_dtype=bf16),
-        dict(batch=batch4, remat=False, ce_block=None, mu_dtype=bf16),
-        dict(batch=16, remat="dots", ce_block=1024, mu_dtype=None),
-        dict(batch=batch4, remat=False, ce_block=None, mu_dtype=None),  # r3 floor
-        dict(batch=8, remat="dots", ce_block=1024, mu_dtype=None),      # r5 floor
-        dict(batch=16, remat=True, ce_block=1024, mu_dtype=bf16),
-    ]
+    cfg, _, seq = train_sized_config()
     best, tried = None, []
-    for v in variants:
-        label = {
-            **v,
-            "mu_dtype": v["mu_dtype"].__name__ if v["mu_dtype"] else None,
-        }
+    for v in train_variants():
+        label = variant_label(v)
         if deadline is not None and time.monotonic() > deadline:
             tried.append({**label, "skipped": "deadline"})
             continue
