@@ -5,7 +5,8 @@ path)" (≙ the reference's ocm_test test 2 / extoll_rma2_transfer timing,
 /root/reference/test/ocm_test.c:132-206, src/extoll.c:47-173). Two
 daemons on this host, a client attached to rank 0, a REMOTE_HOST
 allocation placed on rank 1, and timed whole-region put/get through the
-chunked pipelined engine (8 MiB x 2 in flight). On one host this rides
+chunked pipelined engine (16 MiB x 2 in flight; see OcmConfig's
+chunk_bytes rationale). On one host this rides
 loopback TCP, so the number is an upper bound on protocol+engine
 overhead rather than a fabric measurement — but unlike every chip
 metric it needs no TPU, so a wedged-tunnel bench still banks it.
@@ -96,7 +97,7 @@ def _daemon_pair(cfg: OcmConfig, native: bool):
 def dcn_loopback_bench(
     nbytes: int = 256 << 20,
     iters: int = 3,
-    chunk_bytes: int = 8 << 20,
+    chunk_bytes: int = 16 << 20,
     inflight: int = 2,
     native: bool = True,
 ) -> dict:
@@ -104,7 +105,7 @@ def dcn_loopback_bench(
     daemon PROCESSES (loopback). Returns GB/s per direction (best of
     ``iters``) plus the verified-roundtrip flag."""
     cfg = OcmConfig(
-        host_arena_bytes=nbytes + (8 << 20),
+        host_arena_bytes=nbytes + chunk_bytes,
         device_arena_bytes=1 << 20,
         chunk_bytes=chunk_bytes,
         inflight_ops=inflight,

@@ -19,6 +19,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <functional>
@@ -59,8 +60,16 @@ struct ocmc_ctx {
   int64_t rank = 0;
   int64_t pid = 0;
   int64_t nnodes = 0;
-  uint64_t chunk_bytes = 8u << 20;  // extoll.c:49-51
-  int inflight = 2;                 // extoll.c:44-47
+  // Same defaults as OcmConfig (utils/config.py): 2-deep pipelining per
+  // the reference's scheme (extoll.c:44-47), 16 MiB chunks (the
+  // reference's 8 MB was an EXTOLL hardware cap; 16 MiB measured best on
+  // this transport). OCM_CHUNK_BYTES overrides, like the Python side.
+  uint64_t chunk_bytes = [] {
+    const char* v = std::getenv("OCM_CHUNK_BYTES");
+    return v && *v ? std::strtoull(v, nullptr, 10)
+                   : (uint64_t(16) << 20);
+  }();
+  int inflight = 2;  // extoll.c:44-47
   int ctrl_fd = -1;
   std::mutex ctrl_mu;
   std::map<std::string, std::shared_ptr<DataConn>> data_conns;
