@@ -65,9 +65,20 @@ struct ocmc_ctx {
   // reference's 8 MB was an EXTOLL hardware cap; 16 MiB measured best on
   // this transport). OCM_CHUNK_BYTES overrides, like the Python side.
   uint64_t chunk_bytes = [] {
+    const uint64_t kDefault = uint64_t(16) << 20;
     const char* v = std::getenv("OCM_CHUNK_BYTES");
-    return v && *v ? std::strtoull(v, nullptr, 10)
-                   : (uint64_t(16) << 20);
+    if (!v || !*v) return kDefault;
+    char* end = nullptr;
+    uint64_t n = std::strtoull(v, &end, 10);
+    // A malformed or zero value must not reach the transfer engine: a
+    // 0-byte chunk never advances `pos` and the client loops forever
+    // (the Python twin's int() raises at config time instead).
+    if (end == v || *end != '\0' || n == 0) {
+      std::fprintf(stderr,
+                   "libocm: ignoring invalid OCM_CHUNK_BYTES=%s\n", v);
+      return kDefault;
+    }
+    return n;
   }();
   int inflight = 2;  // extoll.c:44-47
   int ctrl_fd = -1;

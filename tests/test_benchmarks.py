@@ -163,6 +163,26 @@ def test_size_sweep_write_cap_and_amortized_legs():
     ocm.ocm_tini(ctx)
 
 
+def test_size_sweep_amortized_leg_interpret(monkeypatch):
+    """With the TPU gate forced open (the test_hbm_blocked recipe), the
+    amortized leg actually executes the k-folded routed read through the
+    interpret machine and yields a positive rate — CI coverage for the
+    leg that otherwise only runs on hardware."""
+    import oncilla_tpu.core.hbm as hbm
+
+    monkeypatch.setattr(hbm, "_on_tpu", lambda: True)
+    cfg = OcmConfig(host_arena_bytes=1 << 20, device_arena_bytes=4 << 20)
+    ctx = ocm.ocm_init(cfg)
+    res = size_sweep(
+        ctx, OcmKind.LOCAL_DEVICE, min_bytes=1 << 20, max_bytes=2 << 20,
+        iters=1, amortize_k=2, amortize_min_bytes=1 << 20,
+    )
+    assert not res.errors, res.errors
+    for p in res.points:
+        assert p.read_amortized_gbps is not None and p.read_amortized_gbps > 0
+    ocm.ocm_tini(ctx)
+
+
 def test_size_sweep_descending_banks_largest_first(monkeypatch):
     """descending=True visits the largest (judged) size first, so budget
     exhaustion drops the small sizes — not the 1 GiB-analogue point the
