@@ -16,6 +16,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
@@ -69,11 +70,15 @@ struct ocmc_ctx {
     const char* v = std::getenv("OCM_CHUNK_BYTES");
     if (!v || !*v) return kDefault;
     char* end = nullptr;
+    errno = 0;
     uint64_t n = std::strtoull(v, &end, 10);
-    // A malformed or zero value must not reach the transfer engine: a
-    // 0-byte chunk never advances `pos` and the client loops forever
-    // (the Python twin's int() raises at config time instead).
-    if (end == v || *end != '\0' || n == 0) {
+    // A malformed, zero, negative (strtoull wraps "-1" to 2^64-1) or
+    // overflowing value must not reach the transfer engine: a 0-byte
+    // chunk never advances `pos` and loops forever, and a wrapped giant
+    // defeats the 2 x chunk_bytes buffering bound (the Python twin
+    // raises at config construction instead, utils/config.py).
+    if (end == v || *end != '\0' || n == 0 || v[0] == '-' ||
+        errno == ERANGE || n > (uint64_t(1) << 40)) {
       std::fprintf(stderr,
                    "libocm: ignoring invalid OCM_CHUNK_BYTES=%s\n", v);
       return kDefault;

@@ -63,3 +63,17 @@ class OcmConfig:
     # /root/reference/src/main.c:6-7).
     lease_s: float = 30.0
     heartbeat_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        # A 0-byte chunk livelocks every chunked transfer loop
+        # (n = min(chunk_bytes, total - pos) never advances pos) and a
+        # non-positive in-flight window never issues a request — fail at
+        # config construction, where OCM_CHUNK_BYTES=0 would otherwise
+        # slip past int() (the C twin clamps to its default instead,
+        # libocm.cc).
+        if self.chunk_bytes <= 0:
+            raise ValueError(f"chunk_bytes must be > 0 (got {self.chunk_bytes})")
+        if self.inflight_ops <= 0:
+            raise ValueError(
+                f"inflight_ops must be > 0 (got {self.inflight_ops})"
+            )
