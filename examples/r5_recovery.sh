@@ -34,9 +34,10 @@ for v in mfu.train_variants():
     t0 = time.time()
     try:
         r = mfu.mfu_train(cfg, v["batch"], seq, remat=v["remat"],
-                          ce_block=v["ce_block"], mu_dtype=v["mu_dtype"])
+                          ce_block=v["ce_block"], mu_dtype=v["mu_dtype"],
+                          fold=v.get("fold", False))
         out = {k: r[k] for k in ("batch", "remat", "ce_block", "mu_dtype",
-                                 "mfu", "tflops")}
+                                 "fold", "mfu", "tflops")}
     except Exception as e:
         out = {**mfu.variant_label(v), "error": f"{type(e).__name__}: {e}"[:200]}
     out["wall_s"] = round(time.time() - t0, 1)

@@ -106,7 +106,8 @@ def _spec_of(x) -> P:
 
 
 def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx,
-              offload_opt: bool = False, opt_state_example=None):
+              offload_opt: bool = False, opt_state_example=None,
+              fold_steps: int = 0):
     """Shared step factory: jit value_and_grad + adamw update with the
     params' in/out shardings pinned. Output params MUST be pinned to the
     input specs, or the compiler may pick different output shardings and
@@ -149,10 +150,29 @@ def _jit_step(loss_of, specs: dict, mesh: Mesh, data_pspec: P, tx,
             opt_state = jax.tree.map(jax.device_put, opt_state, opt_host)
         return params, opt_state, loss
 
+    run = step
+    if fold_steps:
+        # ``fold_steps`` gradient steps on the same batch in ONE compiled
+        # dispatch (lax.scan over the (params, opt_state) carry). Two uses:
+        # tight inner training loops where per-step dispatch latency
+        # matters, and honest MFU measurement on a tunneled dev chip whose
+        # ~tens-of-ms dispatch round-trip is a harness artifact a TPU-VM
+        # consumer would not pay (same rationale as
+        # ops/pallas_ici.pallas_read_rows_loop).
+        def run(params, opt_state, tokens):
+            def body(carry, _):
+                p, o, loss = step(*carry, tokens)
+                return (p, o), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=fold_steps
+            )
+            return params, opt_state, losses[-1]
+
     pshard = {k: NamedSharding(mesh, s) for k, s in specs.items()}
     dshard = NamedSharding(mesh, data_pspec)
     return jax.jit(
-        step,
+        run,
         in_shardings=(pshard, None, dshard),
         out_shardings=(pshard, None, None),
         donate_argnums=(0, 1),
@@ -184,7 +204,8 @@ def make_train_state_host(seed: int, cfg: LlamaConfig, mesh: Mesh,
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
                     remat=False, offload_opt: bool = False,
-                    opt_state=None, ce_block: int | None = None):
+                    opt_state=None, ce_block: int | None = None,
+                    fold_steps: int = 0):
     """The jitted full training step (forward + backward + adamw update),
     sharded over the (dp, tp, sp) mesh. ``remat`` checkpoints each block
     (recompute-in-backward) to fit longer sequences / bigger batches —
@@ -193,7 +214,9 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
     the blocked vocab-head CE (no (B, S, V) logits materialized);
     ``offload_opt`` keeps Adam state in TPU-VM host memory — pass the
     state built by ``make_train_state*(offload_opt=True)`` as
-    ``opt_state`` so the step knows its leaf specs.
+    ``opt_state`` so the step knows its leaf specs. ``fold_steps`` > 0
+    returns a step that runs that many gradient steps on its batch in one
+    compiled dispatch (see _jit_step).
 
     offload_opt platform note: TPU-only in the current jax/XLA build.
     The CPU backend cannot execute the memory-kind placement custom call
@@ -210,6 +233,7 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, tx, use_ring: bool = True,
         ),
         param_specs(cfg), mesh, data_spec(), tx,
         offload_opt=offload_opt, opt_state_example=opt_state,
+        fold_steps=fold_steps,
     )
 
 

@@ -71,8 +71,12 @@ class OcmConfig:
         # config construction, where OCM_CHUNK_BYTES=0 would otherwise
         # slip past int() (the C twin clamps to its default instead,
         # libocm.cc).
-        if self.chunk_bytes <= 0:
-            raise ValueError(f"chunk_bytes must be > 0 (got {self.chunk_bytes})")
+        if not 0 < self.chunk_bytes <= (1 << 40):
+            raise ValueError(
+                "chunk_bytes must be in (0, 2^40] — a 0 chunk livelocks "
+                "the transfer loops and a giant one defeats the "
+                f"2 x chunk_bytes buffering bound (got {self.chunk_bytes})"
+            )
         if self.inflight_ops <= 0:
             raise ValueError(
                 f"inflight_ops must be > 0 (got {self.inflight_ops})"
