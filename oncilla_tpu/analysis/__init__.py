@@ -1,0 +1,27 @@
+"""Project-native static analysis for the Python control plane.
+
+The reference OncillaMem shipped known data races (reply-before-listen
+mem.c:350-354, unlocked shared lists rdma.c:147-149 — SURVEY.md §5.2) with
+zero tooling to catch them. The native daemon gets ThreadSanitizer coverage
+(tests/test_native_tsan.py); this package is the Python-side twin:
+
+- :mod:`~oncilla_tpu.analysis.lint` — AST checks tuned to THIS codebase:
+  blocking calls inside ``with <lock>:`` scopes, silently swallowed broad
+  exceptions in runtime paths, host-side numpy calls inside ``jax.jit``-
+  traced functions.
+- :mod:`~oncilla_tpu.analysis.project` — whole-project protocol checks:
+  every request :class:`MsgType` has a daemon handler, every type has a
+  schema, and every schema survives an encode/decode roundtrip.
+- :mod:`~oncilla_tpu.analysis.lockwatch` — a runtime lock-order watchdog
+  (``OCM_LOCKWATCH=1``): records the cross-thread lock acquisition-order
+  graph, reports cycles (potential deadlocks) and over-threshold holds.
+
+CLI: ``python -m oncilla_tpu.analysis`` — exits nonzero on findings not
+covered by the checked-in baseline (``analysis_baseline.json``). See
+docs/ANALYSIS.md.
+"""
+
+from oncilla_tpu.analysis.lint import Finding, scan_paths
+from oncilla_tpu.analysis.project import check_protocol
+
+__all__ = ["Finding", "scan_paths", "check_protocol"]

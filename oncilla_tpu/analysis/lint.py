@@ -1,0 +1,426 @@
+"""AST lint rules tuned to this codebase's failure modes.
+
+Rules (each suppressible per-line with ``# ocm-lint: allow[<rule>]``):
+
+``blocking-call-under-lock``
+    A blocking call — socket send/recv/accept/dial, ``time.sleep``,
+    ``subprocess.*``, thread ``.join``/``.wait``, or the project's blocking
+    wire helpers (``request``/``send_msg``/``recv_msg``) — lexically inside
+    a ``with <lock>:`` body. Holding a mutex across a network round-trip is
+    exactly the shape that wedged the reference's control plane (one
+    connection per peer + a mutex across the round trip couples the
+    waits-for graph, see runtime/pool.py's module docstring).
+
+``swallowed-exception``
+    ``except Exception:`` / bare ``except:`` whose body is only ``pass`` or
+    ``continue``. Broad-and-silent hides protocol desyncs and lost
+    shutdowns; narrow the type or log via ``utils.debug.printd``.
+
+``jit-host-call``
+    A host-side call inside a ``jax.jit``-traced function: ``np.asarray`` /
+    ``np.frombuffer`` / ``np.random.*`` (and friends) on traced values bake
+    a host constant into the compiled graph (or fail at trace time), and
+    ``print``/``time.*`` silently run once at trace, not per step. Also
+    flags in-place subscript stores to traced parameters.
+
+The scanner is deliberately lexical: it prefers a small number of
+high-confidence findings plus an explicit suppression comment over a
+whole-program points-to analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+BLOCKING_NAME_CALLS = {
+    # (module alias, attr) pairs flagged as blocking when called.
+    ("time", "sleep"),
+    ("socket", "create_connection"),
+    ("subprocess", "run"),
+    ("subprocess", "Popen"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("select", "select"),
+}
+# Bare-name calls that are blocking wire round-trips in this project.
+BLOCKING_BARE_CALLS = {"request", "send_msg", "recv_msg"}
+# Blocking methods on sockets / threads / processes / events.
+BLOCKING_METHODS = {
+    "recv", "recv_into", "send", "sendall", "sendmsg", "accept",
+    "connect", "join", "wait",
+}
+# Host-side numpy functions that must not run under a jax.jit trace.
+JIT_HOST_NP_CALLS = {
+    "asarray", "ascontiguousarray", "array", "frombuffer", "copyto",
+    "fromfile", "save", "load", "loadtxt", "genfromtxt", "tobytes",
+}
+JIT_HOST_TIME_CALLS = {"sleep", "time", "perf_counter", "monotonic"}
+
+SUPPRESS_TAG = "ocm-lint: allow[{rule}]"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    def key(self) -> str:
+        """Stable baseline key: no line numbers (they churn on every
+        edit); rule + file + enclosing symbol."""
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _is_lockish(name: str) -> bool:
+    n = name.lower()
+    return (
+        n.endswith(("lock", "mutex", "_mu", "_cond"))
+        or n in ("mu", "cond", "lck")
+    )
+
+
+def _terminal_name(node: ast.expr) -> str | None:
+    """The rightmost identifier of a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.expr) -> str | None:
+    """'a.b.c' for a pure Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _suppressed(lines: list[str], lineno: int, rule: str) -> bool:
+    if 1 <= lineno <= len(lines):
+        return SUPPRESS_TAG.format(rule=rule) in lines[lineno - 1]
+    return False
+
+
+class _FuncStack(ast.NodeVisitor):
+    """Base visitor tracking the enclosing function qualname."""
+
+    def __init__(self) -> None:
+        self._stack: list[str] = []
+
+    @property
+    def symbol(self) -> str:
+        return ".".join(self._stack) or "<module>"
+
+    def _visit_scope(self, node) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+
+class _LockScopeChecker(_FuncStack):
+    """blocking-call-under-lock."""
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__()
+        self.path = path
+        self.lines = lines
+        self.findings: list[Finding] = []
+        # Names of lock objects whose `with` bodies we are inside.
+        self._held: list[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        held_here = []
+        for item in node.items:
+            name = _terminal_name(item.context_expr)
+            if name is not None and _is_lockish(name):
+                held_here.append(name)
+        self._held.extend(held_here)
+        self.generic_visit(node)
+        if held_here:
+            del self._held[-len(held_here):]
+
+    def _visit_scope(self, node) -> None:
+        # A def nested inside a `with lock:` body runs later, not under
+        # the lock — analyze it with a clean held-set.
+        saved, self._held = self._held, []
+        _FuncStack._visit_scope(self, node)
+        self._held = saved
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._held:
+            desc = self._blocking_desc(node)
+            if desc is not None and not _suppressed(
+                self.lines, node.lineno, "blocking-call-under-lock"
+            ):
+                self.findings.append(Finding(
+                    rule="blocking-call-under-lock",
+                    path=self.path,
+                    line=node.lineno,
+                    symbol=self.symbol,
+                    message=(
+                        f"blocking call {desc} while holding "
+                        f"{'/'.join(self._held)}"
+                    ),
+                ))
+        self.generic_visit(node)
+
+    def _blocking_desc(self, node: ast.Call) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in BLOCKING_BARE_CALLS:
+                return f"{f.id}()"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        dotted = _dotted(f)
+        if dotted is not None:
+            head = dotted.split(".", 1)[0]
+            if (head, f.attr) in BLOCKING_NAME_CALLS:
+                return f"{dotted}()"
+        if f.attr in BLOCKING_METHODS:
+            recv = _terminal_name(f.value)
+            if recv is None:
+                # `",".join(...)`, chained-call receivers: not a socket.
+                return None
+            if f.attr in ("wait", "join") and _is_lockish(recv):
+                # Condition.wait RELEASES the lock — the sanctioned wait
+                # pattern, not a hold-across-block.
+                return None
+            if f.attr == "join" and not (
+                "thread" in recv.lower() or recv in ("t", "r", "proc", "p")
+            ):
+                return None  # list/str joins etc.
+            # `lock.acquire` ordering is lockwatch's job, not lint's.
+            return f"{recv}.{f.attr}()"
+        if f.attr in ("request", "_request"):
+            recv = _terminal_name(f.value)
+            if recv is not None:
+                return f"{recv}.{f.attr}()"
+        return None
+
+
+class _SwallowChecker(_FuncStack):
+    """swallowed-exception."""
+
+    BROAD = {"Exception", "BaseException"}
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__()
+        self.path = path
+        self.lines = lines
+        self.findings: list[Finding] = []
+
+    def _is_broad(self, t: ast.expr | None) -> bool:
+        if t is None:
+            return True  # bare except
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad(e) for e in t.elts)
+        return _terminal_name(t) in self.BROAD
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        silent = all(isinstance(s, (ast.Pass, ast.Continue)) for s in node.body)
+        if (
+            silent
+            and self._is_broad(node.type)
+            and not _suppressed(self.lines, node.lineno, "swallowed-exception")
+        ):
+            caught = "bare except" if node.type is None else (
+                _dotted(node.type) or "Exception"
+            )
+            self.findings.append(Finding(
+                rule="swallowed-exception",
+                path=self.path,
+                line=node.lineno,
+                symbol=self.symbol,
+                message=(
+                    f"{caught} silently swallowed — narrow the type or "
+                    "log via utils.debug.printd"
+                ),
+            ))
+        self.generic_visit(node)
+
+
+def _jit_decorated(node: ast.AST) -> bool:
+    """Is this def decorated @jax.jit / @jit / @partial(jax.jit, ...)?"""
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _dotted(target) or ""
+        if dotted in ("jax.jit", "jit"):
+            return True
+        if dotted in ("partial", "functools.partial") and isinstance(dec, ast.Call):
+            if dec.args and (_dotted(dec.args[0]) or "") in ("jax.jit", "jit"):
+                return True
+    return False
+
+
+class _JitPurityChecker(_FuncStack):
+    """jit-host-call."""
+
+    def __init__(self, path: str, lines: list[str], tree: ast.Module):
+        super().__init__()
+        self.path = path
+        self.lines = lines
+        self.findings: list[Finding] = []
+        self.np_alias = "np"
+        for stmt in ast.walk(tree):
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    if a.name == "numpy":
+                        self.np_alias = a.asname or "numpy"
+        # Functions handed to jax.jit(fn, ...) by name anywhere in the
+        # module (the `return jax.jit(run)` factory idiom).
+        self.jitted_names: set[str] = set()
+        for n in ast.walk(tree):
+            if (
+                isinstance(n, ast.Call)
+                and (_dotted(n.func) or "") in ("jax.jit", "jit")
+                and n.args
+                and isinstance(n.args[0], ast.Name)
+            ):
+                self.jitted_names.add(n.args[0].id)
+        self._jit_depth = 0
+        self._params: set[str] = set()
+
+    def _visit_scope(self, node) -> None:
+        entering = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and (_jit_decorated(node) or node.name in self.jitted_names)
+        saved_params = self._params
+        if entering:
+            self._jit_depth += 1
+            a = node.args
+            self._params = {
+                p.arg for p in (
+                    a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])
+                )
+            }
+        _FuncStack._visit_scope(self, node)
+        if entering:
+            self._jit_depth -= 1
+            self._params = saved_params
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        if not _suppressed(self.lines, node.lineno, "jit-host-call"):
+            self.findings.append(Finding(
+                rule="jit-host-call",
+                path=self.path,
+                line=node.lineno,
+                symbol=self.symbol,
+                message=f"{what} inside a jax.jit-traced function",
+            ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._jit_depth:
+            f = node.func
+            dotted = _dotted(f) or ""
+            parts = dotted.split(".")
+            if parts[0] == self.np_alias and len(parts) >= 2:
+                if parts[1] == "random":
+                    self._flag(node, f"host RNG call {dotted}()")
+                elif parts[-1] in JIT_HOST_NP_CALLS:
+                    self._flag(node, f"host numpy call {dotted}()")
+            elif dotted == "print":
+                self._flag(node, "print() (runs once at trace time)")
+            elif parts[0] == "time" and len(parts) == 2 and (
+                parts[1] in JIT_HOST_TIME_CALLS
+            ):
+                self._flag(node, f"host clock call {dotted}()")
+            elif isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+                self._flag(node, ".block_until_ready()")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._jit_depth:
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in self._params
+                ):
+                    self._flag(
+                        node,
+                        f"in-place store {t.value.id}[...] = ... on a traced "
+                        "argument (use .at[].set())",
+                    )
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str) -> list[Finding]:
+    """Run every AST rule over one module's source."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", path=path, line=e.lineno or 0,
+            symbol="<module>", message=str(e),
+        )]
+    lines = source.splitlines()
+    checkers = [
+        _LockScopeChecker(path, lines),
+        _SwallowChecker(path, lines),
+        _JitPurityChecker(path, lines, tree),
+    ]
+    findings: list[Finding] = []
+    for c in checkers:
+        c.visit(tree)
+        findings.extend(c.findings)
+    return findings
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                # "fixtures" holds seeded-violation modules for the
+                # analyzer's own tests — scanned explicitly, never by walk.
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", "build", ".git", "native",
+                                 "fixtures")
+                ]
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames if f.endswith(".py")
+                )
+    return sorted(out)
+
+
+def scan_paths(paths: list[str], rel_to: str | None = None) -> list[Finding]:
+    """Lint every ``.py`` under ``paths``; paths in findings are relative
+    to ``rel_to`` (for stable baseline keys across checkouts)."""
+    findings: list[Finding] = []
+    for fp in iter_py_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            src = fh.read()
+        shown = os.path.relpath(fp, rel_to) if rel_to else fp
+        findings.extend(lint_source(src, shown))
+    return findings

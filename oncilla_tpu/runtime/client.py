@@ -20,6 +20,7 @@ import threading
 
 import numpy as np
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import Extent
 from oncilla_tpu.core.errors import (
     OcmConnectError,
@@ -202,13 +203,13 @@ class ControlPlaneClient:
                 f"local daemon unreachable at {me.connect_host}:{me.port}: {e}"
             ) from e
         self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._ctrl_lock = threading.Lock()
+        self._ctrl_lock = make_lock("client._ctrl_lock")
         # Which ranks own this app's live remote allocations (rank -> count).
         # Reported on HEARTBEAT/DISCONNECT so daemons relay/reclaim with
         # O(owners) fan-out instead of broadcasting to every node; app-side
         # because the handles live here and the set survives daemon restarts.
         self._owner_ranks: dict[int, int] = {}
-        self._owner_lock = threading.Lock()
+        self._owner_lock = make_lock("client._owner_lock")
         # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132).
         r = self._request(Message(MsgType.CONNECT, {"pid": self.pid, "rank": rank}))
         if r.type != MsgType.CONNECT_CONFIRM:
@@ -235,8 +236,13 @@ class ControlPlaneClient:
     # -- plumbing --------------------------------------------------------
 
     def _request(self, msg: Message) -> Message:
+        # Held across the round-trip on purpose: the ctrl socket IS the
+        # serialized resource (one framed request/reply stream to the
+        # local daemon), and _ctrl_lock's only job is that framing. It is
+        # a leaf lock — nothing is acquired under it — so it cannot take
+        # part in an ordering cycle (lockwatch verifies this).
         with self._ctrl_lock:
-            return request(self._ctrl, msg)
+            return request(self._ctrl, msg)  # ocm-lint: allow[blocking-call-under-lock]
 
     def _owners_field(self) -> str:
         with self._owner_lock:

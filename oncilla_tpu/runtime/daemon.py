@@ -25,6 +25,7 @@ import socket
 import threading
 import time
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 from oncilla_tpu.core.errors import (
     OcmBoundsError,
@@ -109,7 +110,7 @@ class Daemon:
         # reaper loop.
         self.plane_addr: tuple[str, int] | None = None
         self._plane_unsynced: set[int] = set()
-        self._plane_sync_lock = threading.Lock()
+        self._plane_sync_lock = make_lock("daemon._plane_sync_lock")
         # True once this daemon has relayed a device-kind write: from then
         # on freed device extents MUST be scrubbed through the plane even
         # if the local endpoint is momentarily unknown (master hop).
@@ -119,7 +120,7 @@ class Daemon:
         self._running = threading.Event()
         self._started_ok = False
         self._conns: set[socket.socket] = set()
-        self._conns_mu = threading.Lock()
+        self._conns_mu = make_lock("daemon._conns_mu")
 
     # -- lifecycle -------------------------------------------------------
 

@@ -15,6 +15,7 @@ import socket
 from dataclasses import dataclass
 
 from oncilla_tpu.core.errors import OcmError
+from oncilla_tpu.utils.debug import printd
 
 
 @dataclass(frozen=True)
@@ -94,8 +95,8 @@ def detect_rank(entries: list[NodeEntry]) -> int:
 
         if jax.process_count() == len(entries):
             return int(jax.process_index())
-    except Exception:  # noqa: BLE001 — no initialized distributed runtime
-        pass
+    except Exception as e:  # noqa: BLE001 — no initialized distributed runtime
+        printd("detect_rank: jax distributed probe failed: %s", e)
     raise OcmError(f"hostname {hostname!r} not present in nodefile")
 
 

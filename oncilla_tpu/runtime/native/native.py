@@ -28,6 +28,21 @@ def _stale(target: Path) -> bool:
     return target.stat().st_mtime < max(p.stat().st_mtime for p in srcs)
 
 
+def _run_logged(cmd: list[str], what: str) -> None:
+    """Run a build step; on failure raise with the tool's actual output
+    (a bare CalledProcessError hides the CMake/compiler error behind
+    'returned non-zero exit status', which makes skip messages useless)."""
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise RuntimeError(f"{what} failed: {cmd[0]} not installed") from e
+    except subprocess.CalledProcessError as e:
+        detail = "\n".join(
+            filter(None, [(e.stdout or "")[-2000:], (e.stderr or "")[-2000:]])
+        ).strip()
+        raise RuntimeError(f"{what} failed (exit {e.returncode}):\n{detail}") from e
+
+
 def build(force: bool = False, tsan: bool = False) -> Path:
     """Build oncillamemd with CMake (+ Ninja when available); cached, but
     rebuilt whenever any native source is newer than the binary (a stale
@@ -39,10 +54,8 @@ def build(force: bool = False, tsan: bool = False) -> Path:
     cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
     if tsan:
         cfg.append("-DOCM_TSAN=ON")
-    subprocess.run(cfg, check=True, capture_output=True)
-    subprocess.run(
-        ["cmake", "--build", str(BUILD_DIR)], check=True, capture_output=True
-    )
+    _run_logged(cfg, "cmake configure")
+    _run_logged(["cmake", "--build", str(BUILD_DIR)], "cmake build")
     return target
 
 
@@ -60,10 +73,13 @@ def spawn(
     snapshot: str | None = None,
     env: dict | None = None,
     log_path: str | None = None,
+    binary: Path | None = None,
 ) -> subprocess.Popen:
     """Launch one native daemon process (``bin/oncillamem nodefile``
-    analogue)."""
-    binary = build(tsan=tsan)
+    analogue). Pass ``binary`` (e.g. a fixture's cached build) to skip
+    the per-spawn build staleness probe entirely."""
+    if binary is None:
+        binary = build(tsan=tsan)
     cmd = [
         str(binary),
         "--nodefile", nodefile,

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.errors import OcmPlacementError
 from oncilla_tpu.core.kinds import OcmKind
 
@@ -48,7 +49,7 @@ class PlacementPolicy:
     def __init__(self):
         self._nodes: dict[int, NodeResources] = {}
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("placement._lock")
 
     # -- membership ------------------------------------------------------
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 import socket
 import threading
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.errors import (
     OcmConnectError,
     OcmProtocolError,
@@ -45,7 +46,7 @@ class PoolEntry:
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
-        self.lock = threading.Lock()
+        self.lock = make_lock("pool.entry")
         self.dead = False
 
 
@@ -57,7 +58,7 @@ class PeerPool:
         self._timeout = timeout
         self._per_peer = per_peer
         self._conns: dict[tuple[str, int], list[PoolEntry]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("pool._lock")
         self._cond = threading.Condition(self._lock)
         self._closed = False
 

@@ -14,6 +14,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import Extent
 from oncilla_tpu.core.errors import OcmInvalidHandle
 from oncilla_tpu.core.kinds import OcmKind
@@ -42,7 +43,7 @@ class AllocRegistry:
         self._lease_s = lease_s
         self._counter = 0
         self._entries: dict[int, RegEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("registry._lock")
 
     def next_id(self) -> int:
         with self._lock:

@@ -12,7 +12,7 @@ import logging
 import os
 import threading
 import time
-from collections import defaultdict
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -36,7 +36,10 @@ class OpStats:
     count: int = 0
     total_s: float = 0.0
     total_bytes: int = 0
-    samples_s: list[float] = field(default_factory=list)
+    # Ring buffer: a deque with maxlen keeps the LATEST max_samples
+    # latencies (a capped list kept only the oldest and froze p50 at the
+    # warm-up distribution, and could overshoot the cap under races).
+    samples_s: "deque[float]" = field(default_factory=deque)
 
     @property
     def p50_s(self) -> float:
@@ -55,9 +58,17 @@ class Tracer:
     ``tracer.stats("put")`` reports count / p50 latency / GB/s."""
 
     def __init__(self, max_samples: int = 4096):
-        self._stats: dict[str, OpStats] = defaultdict(OpStats)
+        self._stats: dict[str, OpStats] = {}
         self._lock = threading.Lock()
         self._max_samples = max_samples
+
+    def _get_locked(self, op: str) -> OpStats:
+        st = self._stats.get(op)
+        if st is None:
+            st = self._stats[op] = OpStats(
+                samples_s=deque(maxlen=self._max_samples)
+            )
+        return st
 
     @contextmanager
     def span(self, op: str, nbytes: int = 0):
@@ -73,17 +84,25 @@ class Tracer:
         finally:
             dt = time.perf_counter() - t0
             with self._lock:
-                st = self._stats[op]
+                st = self._get_locked(op)
                 st.count += 1
                 st.total_s += dt
                 st.total_bytes += nbytes
-                if len(st.samples_s) < self._max_samples:
-                    st.samples_s.append(dt)
+                st.samples_s.append(dt)  # deque(maxlen) evicts the oldest
             printd("op=%s nbytes=%d dt_us=%.1f", op, nbytes, dt * 1e6)
 
     def stats(self, op: str) -> OpStats:
+        """A consistent SNAPSHOT of the op's stats: copied under the lock,
+        so concurrent span() completions can't mutate the samples mid-sort
+        in the caller's p50 computation."""
         with self._lock:
-            return self._stats[op]
+            st = self._get_locked(op)
+            return OpStats(
+                count=st.count,
+                total_s=st.total_s,
+                total_bytes=st.total_bytes,
+                samples_s=deque(st.samples_s),
+            )
 
     def snapshot(self) -> dict[str, dict]:
         with self._lock:

@@ -89,5 +89,7 @@ def drop_tunnel_plugin(name: str = "axon") -> None:
         import jax._src.xla_bridge as xb
 
         xb._backend_factories.pop(name, None)
-    except Exception:  # noqa: BLE001 — registry layout changed
-        pass
+    except Exception as e:  # noqa: BLE001 — registry layout changed
+        from oncilla_tpu.utils.debug import printd
+
+        printd("drop_tunnel_plugin: xla_bridge registry probe failed: %s", e)

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Single-command correctness gate: ruff -> mypy -> project analysis ->
+# tier-1 tests. Each tool-based stage degrades to a notice when the tool
+# is not installed (the CI container bakes neither ruff nor mypy); the
+# project analyzer and the test suite always run and always gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check oncilla_tpu tests || fail=1
+else
+    echo "check.sh: ruff not installed - skipping (pip install ruff)"
+fi
+
+echo "== mypy (runtime package) =="
+if command -v mypy >/dev/null 2>&1; then
+    mypy oncilla_tpu/runtime || fail=1
+else
+    echo "check.sh: mypy not installed - skipping (pip install mypy)"
+fi
+
+echo "== project analysis =="
+python -m oncilla_tpu.analysis || fail=1
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "check.sh: FAILED"
+    exit 1
+fi
+echo "check.sh: all gates clean"

@@ -4,6 +4,8 @@ helpers that were previously copy-pasted per suite)."""
 import socket
 import time
 
+from oncilla_tpu.core.errors import OcmError
+
 
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
@@ -42,7 +44,7 @@ def wait_nnodes(port: int, n: int, deadline_s: float = 30.0) -> bool:
                     return True
             finally:
                 s.close()
-        except Exception:  # noqa: BLE001 — daemon still starting
+        except (OSError, OcmError):  # daemon still starting
             pass
         time.sleep(0.05)
     return False

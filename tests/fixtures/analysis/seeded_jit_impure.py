@@ -1,0 +1,37 @@
+"""Seeded violation: host-side calls inside jax.jit-traced functions."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_impure(x):
+    y = np.asarray(x)          # FINDING: host numpy call on a traced value
+    print("tracing", y)        # FINDING: runs once at trace time
+    return jnp.sum(x)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def partial_impure(x, n):
+    noise = np.random.normal(size=n)  # FINDING: host RNG inside jit
+    return x + jnp.asarray(noise)
+
+
+def factory(scale):
+    def run(x):
+        x[0] = scale           # FINDING: in-place store on traced arg
+        return x * scale
+
+    return jax.jit(run)        # marks `run` as jit-traced
+
+
+@jax.jit
+def pure(x):
+    return jnp.tanh(x) * jnp.float32(2.0)  # NOT a finding
+
+
+def host_helper(x):
+    return np.asarray(x)       # NOT a finding: not jit-traced

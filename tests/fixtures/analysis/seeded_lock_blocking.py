@@ -1,0 +1,53 @@
+"""Seeded violation: blocking calls inside ``with <lock>:`` scopes.
+
+Scanned explicitly by tests/test_analysis.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks (lint.iter_py_files skips
+``fixtures`` directories). Every construct here must fire
+``blocking-call-under-lock`` (or prove a documented non-finding).
+"""
+
+import threading
+import time
+
+_mu = threading.Lock()
+_cond = threading.Condition(_mu)
+
+
+def sleep_under_lock():
+    with _mu:
+        time.sleep(0.5)  # FINDING: sleep while holding _mu
+
+
+def wire_roundtrip_under_lock(sock, msg, send_msg):
+    with _mu:
+        send_msg(sock, msg)   # FINDING: project wire helper under _mu
+        sock.recv(4096)       # FINDING: socket recv under _mu
+
+
+def dial_under_lock():
+    import socket
+
+    with _mu:
+        socket.create_connection(("127.0.0.1", 1))  # FINDING: dial under _mu
+
+
+def ok_condition_wait():
+    with _cond:
+        _cond.wait(timeout=1.0)  # NOT a finding: wait() releases the lock
+
+
+def ok_str_join(parts):
+    with _mu:
+        return ",".join(parts)  # NOT a finding: constant receiver
+
+
+def ok_deferred_callback(sock):
+    with _mu:
+        def later():
+            sock.recv(1)  # NOT a finding: runs after the with block
+        return later
+
+
+def ok_suppressed(sock):
+    with _mu:
+        sock.sendall(b"x")  # ocm-lint: allow[blocking-call-under-lock]

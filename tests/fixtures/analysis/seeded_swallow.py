@@ -1,0 +1,37 @@
+"""Seeded violation: silently swallowed broad exceptions."""
+
+
+def swallow_exception(op):
+    try:
+        op()
+    except Exception:  # FINDING: broad and silent
+        pass
+
+
+def swallow_bare(op):
+    for _ in range(3):
+        try:
+            op()
+        except:  # noqa: E722 — FINDING: bare and silent
+            continue
+
+
+def ok_narrow(op):
+    try:
+        op()
+    except OSError:  # NOT a finding: narrow type
+        pass
+
+
+def ok_logged(op, log):
+    try:
+        op()
+    except Exception as e:  # NOT a finding: body does something
+        log(e)
+
+
+def ok_suppressed(op):
+    try:
+        op()
+    except Exception:  # ocm-lint: allow[swallowed-exception]
+        pass

@@ -1,0 +1,181 @@
+"""The analyzer analyzed: every seeded violation fixture must fire its
+rule, documented non-findings must stay silent, the protocol checks must
+catch seeded drift, and the default-scan gate must be clean on this tree
+(beyond the checked-in baseline) — the acceptance contract of
+``python -m oncilla_tpu.analysis``."""
+
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from oncilla_tpu.analysis import check_protocol, scan_paths
+from oncilla_tpu.analysis.__main__ import main as analysis_main
+from oncilla_tpu.analysis.lint import lint_source
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- AST rules on the seeded fixtures ----------------------------------
+
+
+def test_lock_blocking_fixture_fires():
+    fs = scan_paths([str(FIXTURES / "seeded_lock_blocking.py")])
+    assert _rules(fs) == ["blocking-call-under-lock"] * 4, fs
+    lines = {f.line for f in fs}
+    # One finding per seeded site; none from the ok_* functions.
+    assert len(lines) == 4
+    syms = {f.symbol for f in fs}
+    assert syms == {
+        "sleep_under_lock", "wire_roundtrip_under_lock", "dial_under_lock",
+    }
+
+
+def test_swallow_fixture_fires():
+    fs = scan_paths([str(FIXTURES / "seeded_swallow.py")])
+    assert _rules(fs) == ["swallowed-exception"] * 2, fs
+    assert {f.symbol for f in fs} == {"swallow_exception", "swallow_bare"}
+
+
+def test_jit_purity_fixture_fires():
+    fs = scan_paths([str(FIXTURES / "seeded_jit_impure.py")])
+    assert _rules(fs) == ["jit-host-call"] * 4, fs
+    assert {f.symbol for f in fs} == {
+        "decorated_impure", "partial_impure", "factory.run",
+    }
+
+
+def test_suppression_comment_is_per_rule():
+    src = (
+        "import threading, time\n"
+        "_mu = threading.Lock()\n"
+        "def f():\n"
+        "    with _mu:\n"
+        "        time.sleep(1)  # ocm-lint: allow[swallowed-exception]\n"
+    )
+    # Wrong rule name in the comment: the finding still fires.
+    assert _rules(lint_source(src, "x.py")) == ["blocking-call-under-lock"]
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    fs = lint_source("def broken(:\n", "bad.py")
+    assert _rules(fs) == ["syntax-error"]
+
+
+# -- protocol exhaustiveness / roundtrip -------------------------------
+
+
+def test_protocol_checks_clean_on_tree():
+    assert check_protocol() == []
+
+
+def test_unhandled_request_type_detected(monkeypatch):
+    from oncilla_tpu.runtime import daemon
+    from oncilla_tpu.runtime.protocol import MsgType
+
+    monkeypatch.delitem(daemon._HANDLERS, MsgType.DATA_PUT)
+    fs = check_protocol()
+    assert any(
+        f.rule == "protocol-exhaustiveness" and "DATA_PUT" in f.message
+        and "no daemon handler" in f.message
+        for f in fs
+    ), fs
+
+
+def test_missing_schema_detected(monkeypatch):
+    from oncilla_tpu.runtime import protocol
+    from oncilla_tpu.runtime.protocol import MsgType
+
+    monkeypatch.delitem(protocol._SCHEMAS, MsgType.STATUS_OK)
+    fs = check_protocol()
+    assert any("STATUS_OK has no payload schema" in f.message for f in fs), fs
+
+
+# -- the CLI gate -------------------------------------------------------
+
+
+def test_cli_nonzero_on_seeded_fixture(capsys):
+    rc = analysis_main([str(FIXTURES / "seeded_swallow.py")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "swallowed-exception" in out
+
+
+def test_cli_clean_on_tree(capsys):
+    """The acceptance gate itself: default scan of the package + tests,
+    protocol checks included, modulo the checked-in baseline."""
+    rc = analysis_main([])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_baseline_suppresses_known_findings(tmp_path, capsys):
+    fixture = str(FIXTURES / "seeded_swallow.py")
+    baseline = tmp_path / "baseline.json"
+    rc = analysis_main([fixture, "--write-baseline",
+                        "--baseline", str(baseline)])
+    assert rc == 0
+    data = json.loads(baseline.read_text())
+    assert sum(data["findings"].values()) == 2
+    # Same findings again: fully baselined -> clean exit.
+    rc = analysis_main([fixture, "--baseline", str(baseline)])
+    assert rc == 0
+    assert "2 baselined" in capsys.readouterr().out
+    # A baseline for a DIFFERENT file doesn't cover new findings.
+    rc = analysis_main([str(FIXTURES / "seeded_jit_impure.py"),
+                        "--baseline", str(baseline)])
+    assert rc == 1
+
+
+# -- Tracer ring buffer (satellite: utils/debug.py) --------------------
+
+
+def test_tracer_ring_buffer_caps_and_rolls():
+    from oncilla_tpu.utils.debug import Tracer
+
+    tr = Tracer(max_samples=16)
+    for _ in range(100):
+        with tr.span("op", nbytes=4):
+            pass
+    st = tr.stats("op")
+    assert st.count == 100
+    assert st.total_bytes == 400
+    assert len(st.samples_s) == 16  # ring: latest 16, not first 16
+
+
+def test_tracer_thread_safety_8_threads():
+    from oncilla_tpu.utils.debug import Tracer
+
+    tr = Tracer(max_samples=64)
+    n_threads, n_iter = 8, 500
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(n_iter):
+                with tr.span("hot", nbytes=8):
+                    pass
+                # stats() must return a stable snapshot even mid-hammer.
+                st = tr.stats("hot")
+                assert len(st.samples_s) <= 64
+                _ = st.p50_s  # sorts the snapshot; must not race appends
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs, errs
+    st = tr.stats("hot")
+    assert st.count == n_threads * n_iter
+    assert len(st.samples_s) == 64
+    # Snapshot semantics: mutating the returned stats must not touch the
+    # tracer's internal state.
+    st.samples_s.clear()
+    assert len(tr.stats("hot").samples_s) == 64

@@ -9,9 +9,20 @@ import pytest
 
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
+from oncilla_tpu.analysis import lockwatch
 from oncilla_tpu.core.arena import Extent
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import Fabric
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch(monkeypatch):
+    """Watchdog-enabled runs: the lock acquisition-order graph recorded
+    across each test must stay acyclic (see analysis/lockwatch.py)."""
+    monkeypatch.setenv("OCM_LOCKWATCH", "1")
+    lockwatch.reset()
+    yield
+    lockwatch.assert_acyclic()
 
 
 def test_concurrent_puts_same_device_arena():

@@ -11,8 +11,21 @@ import pytest
 
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
+from oncilla_tpu.analysis import lockwatch
 from oncilla_tpu.runtime.cluster import local_cluster
 from oncilla_tpu.utils.config import OcmConfig
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch(monkeypatch):
+    """Run every stress test with the lock-order watchdog live: locks
+    created while OCM_LOCKWATCH=1 record the cross-thread acquisition
+    graph, and a cycle (a potential deadlock, even if this run got lucky)
+    fails the test."""
+    monkeypatch.setenv("OCM_LOCKWATCH", "1")
+    lockwatch.reset()
+    yield
+    lockwatch.assert_acyclic()
 
 
 def cfg(**kw):

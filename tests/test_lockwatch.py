@@ -1,0 +1,123 @@
+"""The lock-order watchdog watched: cycle detection on a seeded ABBA
+ordering, nonblocking-probe exemption, Condition interop, hold-time
+reporting, and the zero-overhead disabled default."""
+
+import threading
+
+import pytest
+
+from oncilla_tpu.analysis import lockwatch
+from oncilla_tpu.analysis.lockwatch import WatchedLock, make_lock
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph(monkeypatch):
+    monkeypatch.setenv("OCM_LOCKWATCH", "1")
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+def test_disabled_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv("OCM_LOCKWATCH", raising=False)
+    lk = make_lock("x")
+    assert not isinstance(lk, WatchedLock)
+    with lk:
+        pass  # plain threading.Lock: no recording, no overhead
+
+
+def test_abba_ordering_reports_a_cycle():
+    a, b = WatchedLock("site.a"), WatchedLock("site.b")
+    done = threading.Event()
+
+    def t1():
+        with a:
+            with b:  # A -> B
+                pass
+        done.set()
+
+    def t2():
+        done.wait()  # sequence the threads: order evidence, no deadlock
+        with b:
+            with a:  # B -> A: the opposite order
+                pass
+
+    ths = [threading.Thread(target=t1), threading.Thread(target=t2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=30)
+    cyc = lockwatch.cycles()
+    assert cyc, lockwatch.snapshot()
+    assert {"site.a", "site.b"} <= set(cyc[0])
+    with pytest.raises(AssertionError, match="lock-order cycles"):
+        lockwatch.assert_acyclic()
+
+
+def test_consistent_ordering_is_acyclic():
+    a, b, c = (WatchedLock(f"ord.{n}") for n in "abc")
+    for _ in range(3):
+        with a:
+            with b:
+                with c:
+                    pass
+    edges = lockwatch.snapshot()["edges"]
+    assert edges["ord.a"]["ord.b"] >= 3
+    assert edges["ord.b"]["ord.c"] >= 3
+    lockwatch.assert_acyclic()
+
+
+def test_nonblocking_probe_records_no_edge():
+    a, b = WatchedLock("probe.a"), WatchedLock("probe.b")
+    with a:
+        assert b.acquire(blocking=False)
+        b.release()
+    edges = lockwatch.snapshot()["edges"]
+    # A try-acquire cannot deadlock: the pool's lease fast path relies on
+    # this exemption (try-acquire of entry locks under the pool cond).
+    assert "probe.a" not in edges
+
+
+def test_condition_wait_drops_out_of_held_stack():
+    lk = WatchedLock("cond.lock")
+    cond = threading.Condition(lk)
+    other = WatchedLock("cond.other")
+    ready = threading.Event()
+
+    def waiter():
+        with cond:
+            ready.set()
+            cond.wait(timeout=10)
+            # Re-acquired by wait(): still inside the with.
+            with other:  # edge cond.lock -> cond.other
+                pass
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    ready.wait(10)
+    with cond:
+        cond.notify()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    edges = lockwatch.snapshot()["edges"]
+    assert edges.get("cond.lock", {}).get("cond.other", 0) >= 1
+    lockwatch.assert_acyclic()
+
+
+def test_long_hold_reported(monkeypatch):
+    monkeypatch.setenv("OCM_LOCKWATCH_HOLD_MS", "10")
+    lk = WatchedLock("slow.lock")
+    import time
+
+    with lk:
+        time.sleep(0.05)
+    holds = lockwatch.snapshot()["long_holds"]
+    assert any(site == "slow.lock" and s >= 0.01 for site, s in holds), holds
+
+
+def test_acquire_timeout_signature_matches_threading_lock():
+    lk = WatchedLock("timeout.lock")
+    assert lk.acquire(timeout=0.5)
+    assert lk.locked()
+    lk.release()
+    assert not lk.locked()

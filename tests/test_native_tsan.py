@@ -26,10 +26,17 @@ TSAN_EXIT = 66
 
 @pytest.fixture(scope="module")
 def tsan_binary():
+    """Build ONCE per module and hand the binary path to every spawn —
+    native.build's staleness probe never re-runs mid-module, and a
+    toolchain failure skips with the underlying CMake/compiler error
+    (native._run_logged embeds the tool output) instead of a bare
+    'returned non-zero exit status'."""
     try:
         return native.build(tsan=True)
     except Exception as e:  # noqa: BLE001
-        pytest.skip(f"TSan build unavailable: {e}")
+        reason = f"TSan build unavailable: {e}"
+        print(f"\n[tsan skip] {reason}", flush=True)
+        pytest.skip(reason)
 
 
 def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
@@ -47,7 +54,7 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
             host_arena_bytes=16 << 20, device_arena_bytes=8 << 20,
             heartbeat_s=0.2, lease_s=30.0, env=env,
             snapshot=snap_path if r == 1 else None,
-            log_path=logs[r],
+            log_path=logs[r], binary=tsan_binary,
         )
         for r in range(2)
     ]
