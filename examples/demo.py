@@ -34,22 +34,27 @@ from oncilla_tpu import OcmKind
 
 def local_memory():
     print("== 1. Local allocations (ocm_test.c test 1/2 shape) ==")
-    ctx = ocm.ocm_init(ocm.OcmConfig(
+    # Ocm is a context manager: leaving the block runs tini(), which
+    # reclaims any handle the app forgot (and — with OCM_ALLOCTRACE=1 —
+    # reports each leak's allocation site).
+    with ocm.ocm_init(ocm.OcmConfig(
         host_arena_bytes=32 << 20, device_arena_bytes=32 << 20,
-    ))
-    h = ctx.alloc(1 << 20, OcmKind.LOCAL_DEVICE)
-    data = np.random.default_rng(0).integers(0, 256, 1 << 20, dtype=np.uint8)
-    ctx.put(h, data)                       # one-sided write
-    back = np.asarray(ctx.get(h))          # one-sided read
-    assert np.array_equal(back, data)
-    print(f"   put/get {h.nbytes >> 10} KiB on {h.kind.name}: roundtrip ok")
+    )) as ctx:
+        h = ctx.alloc(1 << 20, OcmKind.LOCAL_DEVICE)
+        data = np.random.default_rng(0).integers(
+            0, 256, 1 << 20, dtype=np.uint8
+        )
+        ctx.put(h, data)                       # one-sided write
+        back = np.asarray(ctx.get(h))          # one-sided read
+        assert np.array_equal(back, data)
+        print(f"   put/get {h.nbytes >> 10} KiB on {h.kind.name}: "
+              "roundtrip ok")
 
-    h2 = ctx.alloc(1 << 20, OcmKind.LOCAL_HOST)
-    ctx.copy(h2, h)                        # kind×kind copy matrix
-    assert np.array_equal(np.asarray(ctx.get(h2)), data)
-    print("   device->host ocm_copy: ok")
-    ctx.free(h), ctx.free(h2)
-    ocm.ocm_tini(ctx)
+        h2 = ctx.alloc(1 << 20, OcmKind.LOCAL_HOST)
+        ctx.copy(h2, h)                        # kind×kind copy matrix
+        assert np.array_equal(np.asarray(ctx.get(h2)), data)
+        print("   device->host ocm_copy: ok")
+        ctx.free(h), ctx.free(h2)
 
 
 def cluster_and_checkpoint():
@@ -106,21 +111,22 @@ def model_and_paged_decode():
     print(f"   3 sharded train steps on mesh {dict(mesh.shape)}: "
           f"loss={float(loss):.3f}")
 
-    ctx = ocm.ocm_init(ocm.OcmConfig(
+    with ocm.ocm_init(ocm.OcmConfig(
         host_arena_bytes=16 << 20, device_arena_bytes=4 << 20,
-    ))
-    dec = BucketedPagedDecoder(
-        params, cfg, ctx, batch=1, page_tokens=8,
-        kind=OcmKind.LOCAL_HOST, dtype="float32",
-    )
-    ids = np.random.default_rng(3).integers(0, cfg.vocab, 24, dtype=np.int32)
-    logits = None
-    for t in ids:
-        logits = dec.step(jnp.asarray([t]))
-    print(f"   24 decode steps, KV paged through OCM "
-          f"({len(dec.cache.pages)} pages shipped): logits {logits.shape}")
-    dec.close()
-    ocm.ocm_tini(ctx)
+    )) as ctx:
+        dec = BucketedPagedDecoder(
+            params, cfg, ctx, batch=1, page_tokens=8,
+            kind=OcmKind.LOCAL_HOST, dtype="float32",
+        )
+        ids = np.random.default_rng(3).integers(
+            0, cfg.vocab, 24, dtype=np.int32
+        )
+        logits = None
+        for t in ids:
+            logits = dec.step(jnp.asarray([t]))
+        print(f"   24 decode steps, KV paged through OCM "
+              f"({len(dec.cache.pages)} pages shipped): logits {logits.shape}")
+        dec.close()
 
 
 if __name__ == "__main__":

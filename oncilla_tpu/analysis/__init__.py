@@ -9,6 +9,12 @@ zero tooling to catch them. The native daemon gets ThreadSanitizer coverage
   blocking calls inside ``with <lock>:`` scopes, silently swallowed broad
   exceptions in runtime paths, host-side numpy calls inside ``jax.jit``-
   traced functions.
+- :mod:`~oncilla_tpu.analysis.lifecycle` — CFG-based intraprocedural
+  dataflow over alloc handles: ``handle-leak-on-path``,
+  ``use-after-free``, ``double-free``.
+- :mod:`~oncilla_tpu.analysis.alloctrace` — the lifecycle pass's runtime
+  twin (``OCM_ALLOCTRACE=1``): an allocation ledger recording site,
+  thread, and timestamp per alloc; ``Ocm.tini()`` reports leaks.
 - :mod:`~oncilla_tpu.analysis.project` — whole-project protocol checks:
   every request :class:`MsgType` has a daemon handler, every type has a
   schema, and every schema survives an encode/decode roundtrip.
@@ -21,7 +27,11 @@ covered by the checked-in baseline (``analysis_baseline.json``). See
 docs/ANALYSIS.md.
 """
 
+from oncilla_tpu.analysis.lifecycle import analyze_source, scan_lifecycle
 from oncilla_tpu.analysis.lint import Finding, scan_paths
 from oncilla_tpu.analysis.project import check_protocol
 
-__all__ = ["Finding", "scan_paths", "check_protocol"]
+__all__ = [
+    "Finding", "scan_paths", "check_protocol", "scan_lifecycle",
+    "analyze_source",
+]

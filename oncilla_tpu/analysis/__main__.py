@@ -1,8 +1,12 @@
 """``python -m oncilla_tpu.analysis`` — the static-analysis gate.
 
-Scans the package (and ``tests/`` when present) with the project lint
-rules, runs the protocol exhaustiveness/roundtrip checks, subtracts the
-checked-in baseline, and exits nonzero on anything new.
+Scans the package (and ``tests/`` when present) with both analysis
+families — the concurrency lint (:mod:`~.lint`) and the handle-lifecycle
+dataflow pass (:mod:`~.lifecycle`) — runs the protocol exhaustiveness/
+roundtrip checks, subtracts the checked-in baseline, and exits nonzero on
+anything new. The summary line carries per-family counts so CI logs show
+which gate tripped; baseline entries whose symbol no longer produces a
+finding are reported as stale (fix: re-run ``--write-baseline``).
 
 Usage::
 
@@ -26,12 +30,24 @@ import os
 import sys
 from collections import Counter
 
+from oncilla_tpu.analysis.lifecycle import LIFECYCLE_RULES, scan_lifecycle
 from oncilla_tpu.analysis.lint import Finding, scan_paths
 from oncilla_tpu.analysis.project import check_protocol
 
 PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROOT = os.path.dirname(PKG_DIR)
 DEFAULT_BASELINE = os.path.join(ROOT, "analysis_baseline.json")
+
+
+def family(rule: str) -> str:
+    """Which analysis family a rule belongs to (for the summary line)."""
+    return "lifecycle" if rule in LIFECYCLE_RULES else "concurrency"
+
+
+def family_counts(findings: list[Finding]) -> Counter:
+    counts = Counter({"concurrency": 0, "lifecycle": 0})
+    counts.update(family(f.rule) for f in findings)
+    return counts
 
 
 def load_baseline(path: str) -> Counter:
@@ -42,8 +58,9 @@ def load_baseline(path: str) -> Counter:
 
 def apply_baseline(
     findings: list[Finding], allowed: Counter
-) -> tuple[list[Finding], int]:
-    """Consume baseline allowances; returns (new findings, #suppressed)."""
+) -> tuple[list[Finding], int, list[str]]:
+    """Consume baseline allowances; returns (new findings, #suppressed,
+    stale allowance keys that matched nothing — symbols fixed or gone)."""
     budget = Counter(allowed)
     new: list[Finding] = []
     suppressed = 0
@@ -53,7 +70,8 @@ def apply_baseline(
             suppressed += 1
         else:
             new.append(f)
-    return new, suppressed
+    stale = sorted(k for k, v in budget.items() if v > 0)
+    return new, suppressed, stale
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -83,6 +101,7 @@ def main(argv: list[str] | None = None) -> int:
         paths = args.paths
 
     findings = scan_paths(paths, rel_to=ROOT)
+    findings.extend(scan_lifecycle(paths, rel_to=ROOT))
     if default_scan:
         # Exhaustiveness/roundtrip needs the real modules; explicit-path
         # scans (fixtures, pre-commit on a file) stay hermetic.
@@ -101,8 +120,9 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     suppressed = 0
+    stale: list[str] = []
     if not args.no_baseline and os.path.exists(baseline_path):
-        findings, suppressed = apply_baseline(
+        findings, suppressed, stale = apply_baseline(
             findings, load_baseline(baseline_path)
         )
 
@@ -114,11 +134,17 @@ def main(argv: list[str] | None = None) -> int:
     else:
         for f in findings:
             print(f.render())
+        for key in stale:
+            print(f"analysis: stale baseline entry (symbol no longer "
+                  f"present): {key}")
+        fams = family_counts(findings)
+        per_family = ", ".join(f"{k} {v}" for k, v in sorted(fams.items()))
         tail = f" ({suppressed} baselined)" if suppressed else ""
         if findings:
-            print(f"analysis: {len(findings)} finding(s){tail}")
+            print(f"analysis: {len(findings)} finding(s) "
+                  f"({per_family}){tail}")
         else:
-            print(f"analysis: clean{tail}")
+            print(f"analysis: clean ({per_family}){tail}")
     return 1 if findings else 0
 
 

@@ -19,6 +19,7 @@ import bisect
 import threading
 from dataclasses import dataclass
 
+from oncilla_tpu.analysis import alloctrace
 from oncilla_tpu.core.errors import OcmBoundsError, OcmInvalidHandle, OcmOutOfMemory
 
 
@@ -58,6 +59,9 @@ class ArenaAllocator:
             raise ValueError("alignment must be a positive power of two")
         self.capacity = capacity
         self.alignment = alignment
+        # OCM_ALLOCTRACE ledger scope; extents are keyed by offset (unique
+        # while live, exactly like the free-list's own bookkeeping).
+        self._trace_scope = f"arena:{id(self):#x}"
         self._lock = threading.Lock()
         # Sorted list of free (offset, nbytes) spans, coalesced.
         self._free: list[tuple[int, int]] = [(0, capacity)]
@@ -95,6 +99,7 @@ class ArenaAllocator:
                     else:
                         self._free[i] = (off + need, span - need)
                     self._live[off] = need
+                    alloctrace.note_alloc(self._trace_scope, off, nbytes)
                     return Extent(offset=off, nbytes=nbytes)
         raise OcmOutOfMemory(
             f"arena of {self.capacity} B cannot fit {nbytes} B "
@@ -120,6 +125,7 @@ class ArenaAllocator:
                     if tail:
                         self._free.insert(i, (offset + need, tail))
                     self._live[offset] = need
+                    alloctrace.note_alloc(self._trace_scope, offset, nbytes)
                     return Extent(offset=offset, nbytes=nbytes)
         raise OcmInvalidHandle(
             f"cannot reserve [{offset}, {offset + need}): overlaps live extent"
@@ -133,6 +139,7 @@ class ArenaAllocator:
                     f"free of unknown or already-freed extent at offset {extent.offset}"
                 )
             self._insert_free(extent.offset, need)
+        alloctrace.note_free(self._trace_scope, extent.offset)
 
     def _insert_free(self, off: int, span: int) -> None:
         # Insert keeping sorted order, then coalesce with neighbors.
@@ -158,3 +165,4 @@ class ArenaAllocator:
         with self._lock:
             self._free = [(0, self.capacity)]
             self._live.clear()
+        alloctrace.drop_scope(self._trace_scope)

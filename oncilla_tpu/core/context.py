@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oncilla_tpu.analysis import alloctrace
 from oncilla_tpu.core.arena import Extent, check_bounds
 from oncilla_tpu.core.errors import (
     OcmConnectError,
@@ -80,13 +81,38 @@ class Ocm:
         self._owns_remote = False
         self._lock = threading.Lock()
         self.tracer = GLOBAL_TRACER
+        # Scope key for the OCM_ALLOCTRACE=1 allocation ledger (id-based:
+        # contexts sharing a backend must not share a ledger scope).
+        self._trace_scope = f"ctx:{id(self):#x}"
 
     # -- lifecycle -------------------------------------------------------
+
+    def __enter__(self) -> "Ocm":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.tini()
 
     def tini(self) -> None:
         """Free every live handle and detach from the daemon (``ocm_tini``,
         lib.c:160; also covers the reference's missing app-death
         reclamation, main.c:6-7)."""
+        if alloctrace.enabled():
+            # Still-live handles here were leaked by the app (tini is the
+            # reclaim-of-last-resort): report each with its allocation
+            # site before the frees below erase the evidence.
+            report = alloctrace.note_tini(self._trace_scope)
+            if report["count"]:
+                printd(
+                    "tini: %d leaked alloc(s) totalling %d B reclaimed",
+                    report["count"], report["bytes"],
+                )
+                for entry in report["live"]:
+                    printd(
+                        "tini leak: alloc %d (%d B, %s) from %s [%s]",
+                        entry["alloc_id"], entry["nbytes"], entry["kind"],
+                        entry["site"], entry["thread"],
+                    )
         with self._lock:
             handles = list(self._allocs.values())
         for h in handles:
@@ -165,6 +191,9 @@ class Ocm:
                 h.local_nbytes = local_nbytes
             with self._lock:
                 self._allocs[h.alloc_id] = h
+            alloctrace.note_alloc(
+                self._trace_scope, h.alloc_id, nbytes, h.kind.name
+            )
             printd("alloc id=%d kind=%s nbytes=%d", h.alloc_id, kind, nbytes)
             return h
 
@@ -189,6 +218,7 @@ class Ocm:
         else:
             self._remote_or_raise(handle.kind).free(handle)
         handle.freed = True
+        alloctrace.note_free(self._trace_scope, handle.alloc_id)
 
     # -- one-sided ops ---------------------------------------------------
 

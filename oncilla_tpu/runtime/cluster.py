@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from contextlib import contextmanager
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.context import Ocm
 from oncilla_tpu.runtime.client import ControlPlaneClient
 from oncilla_tpu.runtime.daemon import Daemon
@@ -41,13 +42,18 @@ class LocalCluster:
             d.start()
             self.daemons.append(d)
         self.clients: list[ControlPlaneClient] = []
+        # Stress suites call client() from many worker threads at once; the
+        # clients list is the only mutable shared state here. Lockwatch
+        # site so the watchdog sees it alongside the runtime's own locks.
+        self._lock = make_lock("cluster._lock")
 
     def client(self, rank: int, ici_plane=None, heartbeat: bool = True) -> ControlPlaneClient:
         c = ControlPlaneClient(
             self.entries, rank, config=self.config, ici_plane=ici_plane,
             heartbeat=heartbeat,
         )
-        self.clients.append(c)
+        with self._lock:
+            self.clients.append(c)
         return c
 
     def context(self, rank: int, ici_plane=None, **kw) -> Ocm:
@@ -55,7 +61,9 @@ class LocalCluster:
         return Ocm(config=self.config, remote=self.client(rank, ici_plane=ici_plane, **kw))
 
     def stop(self) -> None:
-        for c in self.clients:
+        with self._lock:
+            clients, self.clients = self.clients, []
+        for c in clients:
             c.close()
         for d in self.daemons:
             d.stop()

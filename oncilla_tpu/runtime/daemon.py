@@ -25,6 +25,7 @@ import socket
 import threading
 import time
 
+from oncilla_tpu.analysis import alloctrace
 from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 from oncilla_tpu.core.errors import (
@@ -121,6 +122,9 @@ class Daemon:
         self._started_ok = False
         self._conns: set[socket.socket] = set()
         self._conns_mu = make_lock("daemon._conns_mu")
+        # OCM_ALLOCTRACE ledger scope for registry entries this daemon
+        # owns (id-qualified: one process hosts many daemons in tests).
+        self._trace_scope = f"daemon:r{self.rank}:{id(self):#x}"
 
     # -- lifecycle -------------------------------------------------------
 
@@ -603,6 +607,7 @@ class Daemon:
                 lease_expiry=self.registry.new_lease_deadline(),
             )
         )
+        alloctrace.note_alloc(self._trace_scope, alloc_id, nbytes, kind.name)
         return alloc_id, extent.offset
 
     # REQ_FREE from an app: forward to the owner (msg_send_req_free
@@ -656,6 +661,7 @@ class Daemon:
                 except (OSError, OcmError):
                     pass
             self.device_books[e.device_index].free(e.extent)
+        alloctrace.note_free(self._trace_scope, alloc_id)
         self._note_free_rank0(e)
 
     def _note_free_rank0(self, e: RegEntry) -> None:

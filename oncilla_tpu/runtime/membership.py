@@ -14,8 +14,24 @@ from __future__ import annotations
 import socket
 from dataclasses import dataclass
 
+from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.errors import OcmError
 from oncilla_tpu.utils.debug import printd
+
+# Hostname resolution is a syscall hit on every detect_rank() (one per
+# context attach; the soak suites attach from dozens of threads) and the
+# answer never changes within a process: memoize it. Lockwatch site so
+# the acquisition graph covers membership alongside the runtime locks.
+_hostname_lock = make_lock("membership._hostname_lock")
+_hostname_cache: str | None = None
+
+
+def _hostname() -> str:
+    global _hostname_cache
+    with _hostname_lock:
+        if _hostname_cache is None:
+            _hostname_cache = socket.gethostname()
+        return _hostname_cache
 
 
 @dataclass(frozen=True)
@@ -86,7 +102,7 @@ def detect_rank(entries: list[NodeEntry]) -> int:
     back to ``jax.process_index()`` when the nodefile hosts don't resolve
     to this machine but the pod shape matches (multi-host TPU pods, where
     nodefile hosts may be pod DNS names the VM's gethostname won't match)."""
-    hostname = socket.gethostname()
+    hostname = _hostname()
     for e in entries:
         if e.host in (hostname, hostname.split(".")[0], "localhost", "127.0.0.1"):
             return e.rank

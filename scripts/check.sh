@@ -23,7 +23,17 @@ else
 fi
 
 echo "== project analysis =="
-python -m oncilla_tpu.analysis || fail=1
+# Both families (concurrency lint + handle-lifecycle dataflow) gate here;
+# surface the per-family counts so CI logs show which one tripped.
+alog=$(mktemp)
+if python -m oncilla_tpu.analysis | tee "$alog"; then
+    :
+else
+    fail=1
+fi
+summary=$(grep -E '^analysis: ' "$alog" | tail -1 || true)
+echo "check.sh: findings by family: ${summary#analysis: }"
+rm -f "$alog"
 
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,124 @@
+"""Seeded handle-lifecycle violations for the analyzer's own tests.
+
+Scanned explicitly by tests/test_lifecycle.py (the fixtures directory is
+excluded from default tree walks); never imported. Each seeded_* function
+must yield exactly one finding of its rule; each ok_* function documents
+an exemption and must stay silent.
+"""
+
+import pytest
+
+from oncilla_tpu.core.errors import OcmInvalidHandle
+from oncilla_tpu.core.context import ocm_init
+
+
+# -- seeded violations (one finding each) -------------------------------
+
+
+def seeded_leak_on_branch(ctx, cond):
+    h = ctx.alloc(4096)
+    if cond:
+        ctx.free(h)
+    # fall-through path reaches function exit with h still live
+
+
+def seeded_leak_on_raise(ctx, n):
+    h = ctx.alloc(n)
+    if n > 4096:
+        raise ValueError("too big")  # exception edge out of a try-less body
+    ctx.free(h)
+
+
+def seeded_use_after_free(ctx):
+    h = ctx.alloc(64)
+    ctx.free(h)
+    ctx.put(h, b"x")
+
+
+def seeded_double_free(ctx):
+    h = ctx.alloc(64)
+    ctx.free(h)
+    ctx.free(h)
+
+
+def seeded_discarded_alloc(ctx):
+    ctx.alloc(128)
+
+
+# -- exemptions (silent) ------------------------------------------------
+
+
+def ok_free_on_every_path(ctx, cond):
+    h = ctx.alloc(64)
+    if cond:
+        ctx.free(h)
+    else:
+        ctx.free(h)
+
+
+def ok_escape_by_return(ctx):
+    h = ctx.alloc(64)
+    return h
+
+
+def ok_escape_by_store(registry, ctx, cond):
+    h = ctx.alloc(64)
+    if cond:
+        ctx.free(h)
+        return
+    registry["h"] = h
+
+
+class OkHolder:
+    def __init__(self, ctx):
+        self.h = ctx.alloc(64)
+
+    def stash(self, ctx, cond):
+        h = ctx.alloc(64)
+        if cond:
+            ctx.free(h)
+        else:
+            self.h = h
+
+
+def ok_expected_error_is_exempt(ctx):
+    h = ctx.alloc(64)
+    ctx.free(h)
+    with pytest.raises(OcmInvalidHandle):
+        ctx.free(h)  # the runtime rejecting a double free IS the test
+
+
+def ok_reassignment_kills_tracking(ctx):
+    h = ctx.alloc(64)
+    ctx.free(h)
+    h = ctx.alloc(64)
+    ctx.put(h, b"y")
+    ctx.free(h)
+
+
+def ok_with_ocm_init_releases(cond):
+    with ocm_init() as ctx:
+        h = ctx.alloc(64)
+        if cond:
+            ctx.free(h)
+        # __exit__ -> tini() reclaims every live handle
+
+
+def ok_tini_releases(ctx, cond):
+    h = ctx.alloc(64)
+    if cond:
+        ctx.free(h)
+    ctx.tini()
+
+
+def ok_try_finally_covers_raise(ctx, risky):
+    h = ctx.alloc(64)
+    try:
+        if risky:
+            raise RuntimeError("op failed")
+    finally:
+        ctx.free(h)
+
+
+def ok_suppressed(ctx):
+    ctx.alloc(64)  # ocm-lint: allow[handle-leak-on-path] — reaper fixture

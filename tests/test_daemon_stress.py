@@ -11,21 +11,31 @@ import pytest
 
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
-from oncilla_tpu.analysis import lockwatch
+from oncilla_tpu.analysis import alloctrace, lockwatch
 from oncilla_tpu.runtime.cluster import local_cluster
 from oncilla_tpu.utils.config import OcmConfig
 
 
 @pytest.fixture(autouse=True)
-def _lockwatch(monkeypatch):
-    """Run every stress test with the lock-order watchdog live: locks
+def _watchdogs(monkeypatch):
+    """Run every stress test with both runtime watchdogs live: locks
     created while OCM_LOCKWATCH=1 record the cross-thread acquisition
-    graph, and a cycle (a potential deadlock, even if this run got lucky)
-    fails the test."""
+    graph (a cycle — a potential deadlock, even if this run got lucky —
+    fails the test), and OCM_ALLOCTRACE=1 records every alloc/free into
+    the allocation ledger, which must drain to empty once the workload
+    has freed everything (the dynamic twin of the static lifecycle
+    pass's leak rule)."""
     monkeypatch.setenv("OCM_LOCKWATCH", "1")
+    monkeypatch.setenv("OCM_ALLOCTRACE", "1")
     lockwatch.reset()
+    alloctrace.reset()
     yield
     lockwatch.assert_acyclic()
+    leaked = alloctrace.live()
+    assert not leaked, (
+        f"allocation ledger not clean after stress: "
+        f"{[r.describe() for r in leaked]}"
+    )
 
 
 def cfg(**kw):

@@ -206,7 +206,9 @@ def test_native_lease_reaping(binary, tmp_path):
                 except OSError:
                     time.sleep(0.05)
         client = ControlPlaneClient(entries, 0, heartbeat=False)
-        client.alloc(4096, OcmKind.REMOTE_HOST)
+        # Deliberate leak: no heartbeat + no free, so ONLY the native
+        # daemon's lease reaper can reclaim it (the property under test).
+        client.alloc(4096, OcmKind.REMOTE_HOST)  # ocm-lint: allow[handle-leak-on-path]
         deadline = time.time() + 5
         while time.time() < deadline:
             if client.status(rank=1)["live_allocs"] == 0:

@@ -34,6 +34,24 @@ SOAK_S = float(os.environ.get("OCM_SOAK_S", "20"))
 TSAN_EXIT = 66
 
 
+@pytest.fixture(autouse=True)
+def _alloctrace(monkeypatch):
+    """Soak with the allocation ledger live: every ctx/arena/daemon
+    alloc records its site, and after the workload has freed its handles
+    the ledger must be empty — a leak here is a real accounting bug even
+    when the registries happen to balance."""
+    from oncilla_tpu.analysis import alloctrace
+
+    monkeypatch.setenv("OCM_ALLOCTRACE", "1")
+    alloctrace.reset()
+    yield
+    leaked = alloctrace.live()
+    assert not leaked, (
+        f"allocation ledger not clean after soak: "
+        f"{[r.describe() for r in leaked]}"
+    )
+
+
 def cfg(**kw):
     d = dict(
         host_arena_bytes=32 << 20,
