@@ -49,6 +49,60 @@ def check_protocol() -> list[Finding]:
                 where="oncilla_tpu/runtime/daemon.py",
             )
 
+    # Flag exhaustiveness: every header-flag bit the protocol declares
+    # valid on a REQUEST type must be claimed as handled by the daemon
+    # (_FLAGS_HANDLED) — an unhandled combination would silently degrade
+    # (or desync the reply stream) under load instead of failing here.
+    # Declared flags must also survive a pack/unpack roundtrip, and
+    # undeclared bits must be REJECTED at pack time.
+    flags_handled = getattr(daemon, "_FLAGS_HANDLED", {})
+    for t, mask in protocol.VALID_FLAGS.items():
+        if t not in schemas:
+            continue  # already flagged above
+        if _is_request(t.name):
+            unhandled = mask & ~flags_handled.get(t, 0)
+            if unhandled:
+                flag(
+                    t.name,
+                    f"MsgType.{t.name} declares flag bits {unhandled:#x} in "
+                    "VALID_FLAGS with no daemon handling "
+                    "(_FLAGS_HANDLED in runtime/daemon.py)",
+                    where="oncilla_tpu/runtime/daemon.py",
+                )
+        fields = {name: _DUMMY[fmt] for name, fmt in schemas[t]}
+        msg = protocol.Message(t, dict(fields), b"", flags=mask)
+        try:
+            buf = protocol.pack(msg)
+            out = protocol.unpack(
+                bytes(buf[: protocol.HEADER.size]),
+                bytes(buf[protocol.HEADER.size:]),
+            )
+        except Exception as e:  # noqa: BLE001 — any codec blowup is a finding
+            flag(t.name, f"MsgType.{t.name} flags={mask:#x} roundtrip "
+                         f"raised {type(e).__name__}: {e}")
+        else:
+            if out.flags != mask:
+                flag(t.name, f"MsgType.{t.name} flags {mask:#x} not "
+                             f"preserved by the codec (got {out.flags:#x})")
+        bad_bit = 0x8000  # no capability uses the top bit
+        try:
+            protocol.pack(protocol.Message(t, dict(fields), b"",
+                                           flags=mask | bad_bit))
+        except protocol.OcmProtocolError:
+            pass
+        else:
+            flag(t.name, f"MsgType.{t.name} accepted undeclared flag bit "
+                         f"{bad_bit:#x} at pack time")
+    for t, mask in flags_handled.items():
+        extra = mask & ~protocol.VALID_FLAGS.get(t, 0)
+        if extra:
+            flag(
+                t.name,
+                f"daemon claims to handle flag bits {extra:#x} on "
+                f"MsgType.{t.name} that VALID_FLAGS never declares",
+                where="oncilla_tpu/runtime/daemon.py",
+            )
+
     # Encode/decode roundtrip for every schema, with and without a bulk
     # data tail (the codec must keep fields and data separable).
     for t, schema in schemas.items():

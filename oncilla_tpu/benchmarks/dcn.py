@@ -5,8 +5,9 @@ path)" (≙ the reference's ocm_test test 2 / extoll_rma2_transfer timing,
 /root/reference/test/ocm_test.c:132-206, src/extoll.c:47-173). Two
 daemons on this host, a client attached to rank 0, a REMOTE_HOST
 allocation placed on rank 1, and timed whole-region put/get through the
-chunked pipelined engine (16 MiB x 2 in flight; see OcmConfig's
-chunk_bytes rationale). On one host this rides
+striped pipelined engine (multi-stream + ACK coalescing + adaptive
+windowing; ``dcn_stripe_sweep`` maps the stripe-count × window grid and
+pins the single-stream baseline). On one host this rides
 loopback TCP, so the number is an upper bound on protocol+engine
 overhead rather than a fabric measurement — but unlike every chip
 metric it needs no TPU, so a wedged-tunnel bench still banks it.
@@ -94,25 +95,28 @@ def _daemon_pair(cfg: OcmConfig, native: bool):
         os.unlink(nf.name)
 
 
-def dcn_loopback_bench(
-    nbytes: int = 256 << 20,
-    iters: int = 3,
-    chunk_bytes: int = 16 << 20,
-    inflight: int = 2,
-    native: bool = True,
-) -> dict:
-    """Timed put/get of a ``nbytes`` REMOTE_HOST region through two live
-    daemon PROCESSES (loopback). Returns GB/s per direction (best of
-    ``iters``) plus the verified-roundtrip flag."""
-    cfg = OcmConfig(
+def _make_cfg(
+    nbytes: int, chunk_bytes: int, inflight: int, stripes: int,
+    adaptive: bool,
+) -> OcmConfig:
+    return OcmConfig(
         host_arena_bytes=nbytes + chunk_bytes,
         device_arena_bytes=1 << 20,
         chunk_bytes=chunk_bytes,
         inflight_ops=inflight,
+        dcn_stripes=stripes,
+        dcn_adaptive=adaptive,
         heartbeat_s=5.0,
     )
-    with _daemon_pair(cfg, native=native) as entries:
-        client = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+
+
+def _timed_roundtrip(
+    entries, cfg: OcmConfig, nbytes: int, iters: int, data,
+) -> dict:
+    """One client against live daemons: timed whole-region put/get (best
+    of ``iters``) + the verified-roundtrip flag."""
+    client = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+    try:
         # Full membership before placement (a 1-node cluster demotes).
         deadline = time.time() + 30
         while time.time() < deadline and client.status()["nnodes"] < 2:
@@ -124,26 +128,172 @@ def dcn_loopback_bench(
         ctx = Ocm(config=cfg, remote=client, devices=[])
         h = ctx.alloc(nbytes, OcmKind.REMOTE_HOST)
         assert h.is_remote, "placement demoted; membership race?"
-        data = np.random.default_rng(0).integers(
-            0, 256, nbytes, dtype=np.uint8
-        )
         put_s, get_s = [], []
-        got = None
+        # Reused destination buffer (the registered-receive-buffer idiom,
+        # as ocm_test reuses its buffer across iterations): a fresh
+        # destination per get would bill one page fault per 4 KiB to the
+        # data plane.
+        got = np.empty(nbytes, dtype=np.uint8)
         for _ in range(iters):
             t0 = time.perf_counter()
             ctx.put(h, data)
             put_s.append(time.perf_counter() - t0)
+            got[:] = 0
             t0 = time.perf_counter()
-            got = np.asarray(ctx.get(h))
+            ctx.get(h, out=got)
             get_s.append(time.perf_counter() - t0)
         ok = bool(np.array_equal(got, data))
         ctx.free(h)
+    finally:
         client.close()
     return {
         "put_gbps": nbytes / min(put_s) / 1e9,
         "get_gbps": nbytes / min(get_s) / 1e9,
+        "verified": ok,
+    }
+
+
+def dcn_loopback_bench(
+    nbytes: int = 256 << 20,
+    iters: int = 3,
+    chunk_bytes: int = 16 << 20,
+    inflight: int = 2,
+    native: bool = True,
+    stripes: int = 4,
+    adaptive: bool = True,
+) -> dict:
+    """Timed put/get of a ``nbytes`` REMOTE_HOST region through two live
+    daemon PROCESSES (loopback). Returns GB/s per direction (best of
+    ``iters``) plus the verified-roundtrip flag. ``stripes=1`` selects
+    the original single-stream engine (the OCM_DCN_STRIPES=1 path)."""
+    cfg = _make_cfg(nbytes, chunk_bytes, inflight, stripes, adaptive)
+    with _daemon_pair(cfg, native=native) as entries:
+        r = _timed_roundtrip(entries, cfg, nbytes, iters, _bench_data(nbytes))
+    r.update({
         "nbytes": nbytes,
         "iters": iters,
         "native_daemons": native,
-        "verified": ok,
+        "stripes": stripes,
+    })
+    return r
+
+
+def _bench_data(nbytes: int) -> np.ndarray:
+    return np.random.default_rng(0).integers(0, 256, nbytes, dtype=np.uint8)
+
+
+def dcn_stripe_sweep(
+    nbytes: int = 256 << 20,
+    stripes: tuple = (1, 2, 4, 8),
+    windows: tuple = (2, 4),
+    chunk_bytes: int = 16 << 20,
+    iters: int = 1,
+    native: bool = True,
+) -> dict:
+    """Stripe-count × window-depth sweep over ONE live daemon pair: the
+    trajectory record for the multi-stream data plane. Adaptive tuning is
+    pinned OFF inside the sweep so each cell measures exactly the
+    (stripes, window) it names; ``s1`` cells are the single-stream
+    baseline the striped cells are judged against."""
+    cfg0 = _make_cfg(nbytes, chunk_bytes, max(windows), max(stripes), False)
+    data = _bench_data(nbytes)
+    cells: dict[str, dict] = {}
+    with _daemon_pair(cfg0, native=native) as entries:
+        for s in stripes:
+            for w in windows:
+                cfg = _make_cfg(nbytes, chunk_bytes, w, s, False)
+                r = _timed_roundtrip(entries, cfg, nbytes, iters, data)
+                cells[f"s{s}_w{w}"] = {
+                    "put_gbps": round(r["put_gbps"], 3),
+                    "get_gbps": round(r["get_gbps"], 3),
+                    "verified": r["verified"],
+                }
+    single = [v for k, v in cells.items() if k.startswith("s1_")]
+    multi = [v for k, v in cells.items() if not k.startswith("s1_")]
+    best = max(cells.values(), key=lambda v: v["put_gbps"] + v["get_gbps"])
+    best_key = next(k for k, v in cells.items() if v is best)
+    return {
+        "nbytes": nbytes,
+        "native_daemons": native,
+        "cells": cells,
+        "best": best_key,
+        "put_gbps": best["put_gbps"],
+        "get_gbps": best["get_gbps"],
+        "single_put_gbps": max(v["put_gbps"] for v in single),
+        "single_get_gbps": max(v["get_gbps"] for v in single),
+        "striped_put_gbps": max((v["put_gbps"] for v in multi), default=0.0),
+        "striped_get_gbps": max((v["get_gbps"] for v in multi), default=0.0),
+        "verified": all(v["verified"] for v in cells.values()),
     }
+
+
+def smoke(nbytes: int = 4 << 20) -> dict:
+    """Seconds-scale loopback DCN smoke for CI (scripts/check.sh): a tiny
+    striped put/get roundtrip through an in-process 2-daemon cluster,
+    asserting byte-exactness, plus a single-stream roundtrip so BOTH
+    protocol variants (coalesced/striped and lockstep) are exercised."""
+    from oncilla_tpu.runtime.cluster import local_cluster
+
+    out = {}
+    data = _bench_data(nbytes)
+    for stripes in (4, 1):
+        cfg = OcmConfig(
+            host_arena_bytes=nbytes + (1 << 20),
+            device_arena_bytes=1 << 20,
+            chunk_bytes=256 << 10,
+            inflight_ops=2,
+            dcn_stripes=stripes,
+            dcn_stripe_min_bytes=256 << 10,
+        )
+        with local_cluster(2, config=cfg) as cluster:
+            client = cluster.client(0, heartbeat=False)
+            h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+            try:
+                t0 = time.perf_counter()
+                client.put(h, data)
+                got = client.get(h, nbytes)
+                dt = time.perf_counter() - t0
+                if not np.array_equal(got, data):
+                    raise AssertionError(
+                        f"DCN smoke roundtrip mismatch at stripes={stripes}"
+                    )
+            finally:
+                client.free(h)
+            out[f"stripes{stripes}_roundtrip_s"] = round(dt, 3)
+    out["verified"] = True
+    return out
+
+
+def main(argv=None) -> int:
+    """``python -m oncilla_tpu.benchmarks.dcn --smoke`` (the CI gate) or
+    ``--sweep`` for the full stripe/window sweep."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description="DCN data-plane benchmarks")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny in-process striped roundtrip (seconds)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="stripe x window sweep against daemon processes")
+    ap.add_argument("--nbytes", type=int, default=None)
+    ap.add_argument("--python-daemons", action="store_true",
+                    help="skip the C++ twin even if it builds")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = smoke(args.nbytes or (4 << 20))
+    elif args.sweep:
+        try:
+            out = dcn_stripe_sweep(
+                args.nbytes or (256 << 20),
+                native=not args.python_daemons,
+            )
+        except Exception:  # noqa: BLE001 — C++ twin unavailable
+            out = dcn_stripe_sweep(args.nbytes or (256 << 20), native=False)
+    else:
+        out = dcn_loopback_bench(args.nbytes or (256 << 20))
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

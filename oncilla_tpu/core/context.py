@@ -244,13 +244,42 @@ class Ocm:
             else:
                 self._remote_or_raise(handle.kind).put(handle, data, offset)
 
-    def get(self, handle: OcmAlloc, nbytes: int | None = None, offset: int = 0):
+    def get(self, handle: OcmAlloc, nbytes: int | None = None, offset: int = 0,
+            out=None):
         """One-sided read (``ocm_copy_onesided`` op_flag=0). Returns uint8
-        bytes: numpy for host arms, jax.Array for device arms."""
+        bytes: numpy for host arms, jax.Array for device arms.
+
+        ``out`` (a writable C-contiguous uint8 array) selects the
+        registered-receive-buffer idiom: the bytes land in the caller's
+        buffer (sized by ``out``; via zero-copy ``recv_into`` on the DCN
+        path, a fallback copy elsewhere) and ``out`` is returned — a
+        fresh destination array per get costs a page fault per 4 KiB,
+        which at GB scale is most of the transfer time."""
         self._check_live(handle)
-        if nbytes is None:
+        if out is not None:
+            nbytes = out.nbytes
+        elif nbytes is None:
             nbytes = handle.nbytes - offset
         with self.tracer.span("get", nbytes=nbytes):
+            if out is not None:
+                backend = (
+                    self._remote_or_raise(handle.kind)
+                    if (handle.daemon_owned or handle.kind.is_remote)
+                    else None
+                )
+                get_into = getattr(backend, "get_into", None)
+                if get_into is not None and handle.kind in (
+                    OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST
+                ):
+                    return get_into(handle, out, offset)
+                res = (
+                    backend.get(handle, nbytes, offset)
+                    if backend is not None
+                    else self.get(handle, nbytes, offset)
+                )
+                flat = out.reshape(-1)
+                flat[:] = np.asarray(res).view(np.uint8).reshape(-1)
+                return out
             if handle.daemon_owned:
                 return self._remote_or_raise(handle.kind).get(
                     handle, nbytes, offset

@@ -7,9 +7,12 @@ through it, and talks **directly** to the owner daemon for REMOTE_HOST data
 SURVEY.md §1). REMOTE_DEVICE data rides the ICI plane supplied by the SPMD
 app (:mod:`oncilla_tpu.ops.ici`).
 
-Large host transfers are chunked and pipelined with a bounded in-flight
-window — the scheme of ``extoll_rma2_transfer`` (8 MB chunks, 2 overlapped
-ops, /root/reference/src/extoll.c:47-173).
+Large host transfers are chunked, pipelined, and STRIPED across parallel
+pooled connections — the scheme of ``extoll_rma2_transfer`` (8 MB chunks,
+2 overlapped ops, /root/reference/src/extoll.c:47-173) widened to
+multi-rail: per-stripe FIFO windows, ACK coalescing negotiated by a
+CONNECT capability bit, and window/chunk autotuning from observed RTT
+(docs/ARCHITECTURE.md "DCN data plane").
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 
 import numpy as np
 
@@ -34,6 +38,8 @@ from oncilla_tpu.core.kinds import Fabric, OcmKind
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime.protocol import (
+    FLAG_CAP_COALESCE,
+    FLAG_MORE,
     WIRE_KIND,
     WIRE_KIND_INV,
     Message,
@@ -43,7 +49,7 @@ from oncilla_tpu.runtime.protocol import (
     request,
     send_msg,
 )
-from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.config import MAX_CHUNK_BYTES, OcmConfig
 from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
 
 
@@ -167,6 +173,56 @@ class _PlaneServer:
             pass
 
 
+class _PeerTuner:
+    """Adaptive windowing for one owner daemon: autotunes the pipelined
+    window depth and chunk size from observed per-chunk RTT instead of
+    pinning the hardcoded ``inflight_ops`` × ``chunk_bytes``.
+
+    Two rules, both damped to one step per completed transfer so a single
+    noisy measurement cannot swing the plan:
+
+    - **window** targets pipe-fill: enough chunks in flight to cover one
+      observed RTT at the achieved rate (+1 for the send leg), clamped to
+      [2, 8] — beyond that the extra requests only queue at the daemon.
+    - **chunk** amortizes per-op overhead: p50 RTT under ~20 ms means the
+      frame overhead is a visible fraction (double the chunk, up to the
+      wire cap); over ~250 ms means one chunk monopolizes the stream and
+      retry/error latency balloons (halve, floor 1 MiB).
+
+    Shared across concurrent stripes to the same peer; all state moves
+    under one leaf lock.
+    """
+
+    MIN_WINDOW, MAX_WINDOW = 2, 8
+    MIN_CHUNK = 1 << 20
+
+    def __init__(self, config: OcmConfig):
+        self.adaptive = config.dcn_adaptive
+        self._window = max(1, config.inflight_ops)
+        self._chunk = config.chunk_bytes
+        self._lock = make_lock("client._tuner_lock")
+
+    def plan(self) -> tuple[int, int]:
+        """Current (chunk_bytes, window) to run a stripe with."""
+        with self._lock:
+            return self._chunk, self._window
+
+    def observe(self, rtt_p50_s: float, achieved_bps: float) -> None:
+        """Feed one completed stripe's p50 chunk RTT + achieved bytes/s."""
+        if not self.adaptive or rtt_p50_s <= 0:
+            return
+        with self._lock:
+            if achieved_bps > 0:
+                per_chunk_s = self._chunk / achieved_bps
+                want = round(rtt_p50_s / per_chunk_s) + 1
+                want = min(self.MAX_WINDOW, max(self.MIN_WINDOW, want))
+                self._window += (want > self._window) - (want < self._window)
+            if rtt_p50_s < 0.02 and self._chunk * 2 <= MAX_CHUNK_BYTES:
+                self._chunk *= 2
+            elif rtt_p50_s > 0.25 and self._chunk // 2 >= self.MIN_CHUNK:
+                self._chunk //= 2
+
+
 class ControlPlaneClient:
     """Connects an app process to its local daemon (and, for data, directly
     to owner daemons). Implements the RemoteBackend protocol of
@@ -210,6 +266,12 @@ class ControlPlaneClient:
         # because the handles live here and the set survives daemon restarts.
         self._owner_ranks: dict[int, int] = {}
         self._owner_lock = make_lock("client._owner_lock")
+        # DCN data-plane state per owner daemon addr: negotiated capability
+        # bits (None until probed on the first leased data socket) and the
+        # adaptive window/chunk tuner. One leaf lock covers both maps.
+        self._dcn_caps: dict[tuple[str, int], int] = {}
+        self._dcn_tuners: dict[tuple[str, int], _PeerTuner] = {}
+        self._dcn_lock = make_lock("client._dcn_lock")
         # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132).
         r = self._request(Message(MsgType.CONNECT, {"pid": self.pid, "rank": rank}))
         if r.type != MsgType.CONNECT_CONFIRM:
@@ -423,80 +485,327 @@ class ControlPlaneClient:
         return self._dcn_get(handle, nbytes, offset)
 
     # DCN path: chunked, pipelined DATA_PUT/GET straight to the owner
-    # daemon (extoll.c:47-173 scheme over TCP). On a peer ERROR reply the
-    # remaining in-flight replies are drained before raising, keeping the
-    # pooled connection in sync; transport errors evict it.
-    def _pipelined(self, handle: OcmAlloc, total: int, make_req, on_reply,
-                   data_sink=None) -> None:
-        """DATA_PUT/DATA_GET are idempotent (same bytes, same offsets), so a
-        transport failure mid-transfer gets one full retry — through the
-        membership table's address for the owner rank, covering daemons that
-        restarted (snapshot restore) on a new port with a stale cached
-        owner_addr or a dead pooled connection."""
+    # daemon (extoll.c:47-173 scheme over TCP), STRIPED across parallel
+    # pooled connections for large transfers (the UCX/NCCL multi-rail
+    # scheme): the byte range splits into contiguous per-stripe ranges,
+    # each stripe runs the pipelined window on its OWN leased socket, so
+    # replies stay FIFO per socket and the RecvScratch contract holds per
+    # stripe. On a peer ERROR reply the remaining in-flight replies are
+    # drained before raising, keeping the pooled connection in sync;
+    # transport errors evict the connection and retry the STRIPE (not the
+    # whole transfer) once via the membership address.
+
+    def _dcn_caps_for(self, addr: tuple[str, int], sock) -> int:
+        """Negotiated capability bits for the daemon at ``addr``, probed
+        once per address on the first leased data socket: a CONNECT
+        offering FLAG_CAP_COALESCE; the reply's echoed bits are what the
+        peer grants. Old Python daemons and the unmodified C++ daemon
+        reply with flags=0 — the probe is how the new client discovers it
+        must stay on the lockstep one-ACK-per-chunk protocol."""
+        with self._dcn_lock:
+            caps = self._dcn_caps.get(addr)
+        if caps is not None:
+            return caps
+        if not self.config.dcn_coalesce:
+            caps = 0  # capability never offered: lockstep by configuration
+        else:
+            r = request(sock, Message(
+                MsgType.CONNECT, {"pid": self.pid, "rank": self.rank},
+                flags=FLAG_CAP_COALESCE,
+            ))
+            caps = (
+                r.flags & FLAG_CAP_COALESCE
+                if r.type == MsgType.CONNECT_CONFIRM else 0
+            )
+        with self._dcn_lock:
+            self._dcn_caps[addr] = caps
+        return caps
+
+    def _tuner_for(self, addr: tuple[str, int]) -> _PeerTuner:
+        with self._dcn_lock:
+            t = self._dcn_tuners.get(addr)
+            if t is None:
+                t = self._dcn_tuners[addr] = _PeerTuner(self.config)
+            return t
+
+    def _plan_stripes(self, total: int) -> int:
+        """How many stripes a ``total``-byte transfer is worth: capped by
+        config, and shrunk so each stripe moves at least
+        ``dcn_stripe_min_bytes`` (a thread + socket per few hundred KiB
+        would cost more than the parallelism buys)."""
+        per = max(1, self.config.dcn_stripe_min_bytes)
+        return max(1, min(self.config.dcn_stripes, total // per))
+
+    def _dcn_transfer(
+        self, handle: OcmAlloc, total: int, offset: int,
+        put_mv: memoryview | None = None,
+        get_arr: np.ndarray | None = None,
+    ) -> dict:
+        """Move ``total`` bytes at handle-relative ``offset``: the striped
+        engine behind put (``put_mv`` = source view) and get (``get_arr``
+        = destination array, stripes land in disjoint views of it).
+        Returns the transfer stats for telemetry."""
+        nstripes = self._plan_stripes(total)
+        addr = self._owner_addr(handle)
+        stats: dict = {
+            "retries": [0] * nstripes,
+            "window": [0] * nstripes,
+            "chunk": [0] * nstripes,
+            "coalesced": [False] * nstripes,
+        }
+        if nstripes == 1:
+            self._stripe_run(handle, 0, total, offset, put_mv, get_arr,
+                             addr, None, stats, 0)
+            stats["stripes"] = 1
+            return stats
         try:
-            self._pipelined_once(handle, total, make_req, on_reply,
-                                 self._owner_addr(handle),
-                                 data_sink=data_sink)
+            entries = self._pool.lease_set(addr[0], addr[1], nstripes)
+        except OcmConnectError:
+            # Stale cached owner_addr (owner daemon restarted on a new
+            # port): same membership-table fallback the per-stripe retry
+            # uses, applied to the stripe-set lease itself.
+            e = self.entries[handle.rank]
+            handle.owner_addr = addr = (e.connect_host, e.port)
+            printd("leasing stripe set via membership address %s:%d",
+                   e.connect_host, e.port)
+            entries = self._pool.lease_set(addr[0], addr[1], nstripes)
+        # Contention shrank the set: re-split so every leased socket
+        # still carries a contiguous range of its fair share.
+        nstripes = len(entries)
+        for key in ("retries", "window", "chunk", "coalesced"):
+            stats[key] = stats[key][:nstripes]
+        stats["stripes"] = nstripes
+        base = total // nstripes
+        rem = total % nstripes
+        ranges = []
+        start = 0
+        for i in range(nstripes):
+            length = base + (1 if i < rem else 0)
+            ranges.append((start, length))
+            start += length
+        errors: list[BaseException | None] = [None] * nstripes
+
+        def worker(i: int) -> None:
+            s0, ln = ranges[i]
+            try:
+                self._stripe_run(handle, s0, ln, offset, put_mv, get_arr,
+                                 addr, entries[i], stats, i)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                errors[i] = exc
+
+        threads = [
+            threading.Thread(
+                target=worker, args=(i,), name=f"ocm-stripe-{i}",
+            )
+            for i in range(1, nstripes)
+        ]
+        for t in threads:
+            t.start()
+        worker(0)
+        for t in threads:
+            t.join()
+        failures = [e for e in errors if e is not None]
+        if failures:
+            # Prefer the typed application error (the transfer itself was
+            # rejected) over transport noise from sibling stripes.
+            for e in failures:
+                if isinstance(e, OcmRemoteError):
+                    raise e
+            raise failures[0]
+        return stats
+
+    def _stripe_run(
+        self, handle: OcmAlloc, start: int, length: int, offset: int,
+        put_mv, get_arr, addr, entry, stats: dict, idx: int,
+    ) -> None:
+        """One stripe with the idempotent-retry contract: DATA_PUT/DATA_GET
+        carry absolute offsets (same bytes, same places), so a transport
+        failure mid-stripe gets one full re-run of THIS stripe — through
+        the membership table's address for the owner rank, covering
+        daemons that restarted (snapshot restore) on a new port with a
+        stale cached owner_addr or a dead pooled connection. A failed
+        stripe only ever rewrites its own byte range, so sibling stripes'
+        destination views stay intact."""
+        try:
+            self._stripe_once(handle, start, length, offset, put_mv,
+                              get_arr, addr, entry, stats, idx)
             return
         except (OSError, OcmConnectError, OcmProtocolError) as err:
             if isinstance(err, OcmRemoteError):
                 raise  # application error: the transfer itself was rejected
             e = self.entries[handle.rank]
             handle.owner_addr = (e.connect_host, e.port)
-            printd("retrying transfer via membership address %s:%d",
-                   e.connect_host, e.port)
-            self._pipelined_once(handle, total, make_req, on_reply,
-                                 (e.connect_host, e.port),
-                                 data_sink=data_sink)
+            stats["retries"][idx] += 1
+            printd("retrying stripe %d via membership address %s:%d",
+                   idx, e.connect_host, e.port)
+            self._stripe_once(handle, start, length, offset, put_mv,
+                              get_arr, (e.connect_host, e.port), None,
+                              stats, idx)
 
-    def _pipelined_once(
-        self, handle: OcmAlloc, total: int, make_req, on_reply, addr,
-        data_sink=None,
+    def _stripe_once(
+        self, handle: OcmAlloc, start: int, length: int, offset: int,
+        put_mv, get_arr, addr, entry, stats: dict, idx: int,
     ) -> None:
         host, port = addr
-        entry = self._pool.lease(host, port)  # exclusive for the pipeline
+        if entry is None:
+            entry = self._pool.lease(host, port)  # exclusive for the stripe
         s = entry.sock
-        chunk = self.config.chunk_bytes
-        window = max(1, self.config.inflight_ops)
-        inflight: list[tuple[int, int]] = []  # (chunk_offset, nbytes)
-        pos = 0
-        failure: OcmRemoteError | None = None
-        # Reusable reply buffer: each DATA_GET_OK chunk is consumed by
-        # on_reply before the next recv, the RecvScratch contract.
-        scratch = RecvScratch()
         try:
-            while pos < total or inflight:
-                while pos < total and len(inflight) < window and failure is None:
-                    n = min(chunk, total - pos)
-                    send_msg(s, make_req(pos, n))
-                    inflight.append((pos, n))
-                    pos += n
-                if not inflight:
-                    break
-                # Replies are FIFO, so the expected chunk's destination is
-                # known BEFORE the recv: a matching fixed-field reply
-                # (DATA_GET_OK) lands its payload straight there — no
-                # scratch hop, no copy. An ERROR reply (strings) or a
-                # length mismatch ignores the sink and takes the normal
-                # path below.
-                sink = (
-                    data_sink(inflight[0][0], inflight[0][1])
-                    if data_sink is not None and failure is None else None
+            caps = self._dcn_caps_for(addr, s)
+        except BaseException:
+            # Probe failed mid-exchange: connection unusable, lease must
+            # not leak (same contract as the pipeline body below).
+            self._pool.discard(host, port, entry)
+            raise
+        tuner = self._tuner_for(addr)
+        chunk, window = tuner.plan()
+        stats["window"][idx] = window
+        stats["chunk"][idx] = chunk
+        coalesce = (
+            put_mv is not None
+            and bool(caps & FLAG_CAP_COALESCE)
+            and length > chunk  # a single-chunk burst is already one ACK
+        )
+        stats["coalesced"][idx] = coalesce
+        t0 = time.perf_counter()
+        rtts: list[float] = []
+        try:
+            if coalesce:
+                self._stripe_put_coalesced(
+                    s, handle, start, length, offset, put_mv, chunk
                 )
-                r = recv_msg(s, scratch, data_into=sink)
-                start, n = inflight.pop(0)
-                if r.type == MsgType.ERROR:
-                    # Remember the first failure; keep draining replies
-                    # for chunks already on the wire.
-                    if failure is None:
-                        failure = OcmRemoteError(
-                            r.fields["code"], r.fields["detail"]
-                        )
-                elif failure is None:
-                    if sink is not None and r.data is sink:
-                        continue  # payload already landed in place
+            else:
+                self._stripe_windowed(
+                    s, handle, start, length, offset, put_mv, get_arr,
+                    chunk, window, rtts,
+                )
+        except OcmRemoteError:
+            # Typed peer rejection, raised only AFTER the reply stream was
+            # fully drained — the connection is still in sync, keep it.
+            self._pool.release(host, port, entry)
+            raise
+        except BaseException:
+            # Anything else escaped mid-exchange with replies possibly
+            # still on the wire — the connection cannot be trusted and
+            # the lease must not leak.
+            self._pool.discard(host, port, entry)
+            raise
+        self._pool.release(host, port, entry)
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            rtt_p50 = sorted(rtts)[len(rtts) // 2] if rtts else dt
+            tuner.observe(rtt_p50, length / dt)
+
+    def _stripe_put_coalesced(
+        self, s, handle, start, length, offset, put_mv, chunk,
+    ) -> None:
+        """ACK-coalesced put burst: every chunk but the last carries
+        FLAG_MORE, the daemon applies them silently and answers ONCE at
+        the final chunk — the stripe streams at TCP speed instead of
+        lockstepping a reply per chunk. One reply per burst also means
+        the error path stays in sync: a burst ERROR arrives exactly where
+        the single ACK would."""
+        end = start + length
+        pos = start
+        while pos < end:
+            n = min(chunk, end - pos)
+            last = pos + n >= end
+            send_msg(s, Message(
+                MsgType.DATA_PUT,
+                {
+                    "alloc_id": handle.alloc_id,
+                    "offset": offset + pos,
+                    "nbytes": n,
+                },
+                put_mv[pos:pos + n],
+                flags=0 if last else FLAG_MORE,
+            ))
+            pos += n
+        r = recv_msg(s)
+        if r.type == MsgType.ERROR:
+            raise OcmRemoteError(r.fields["code"], r.fields["detail"])
+        if r.type != MsgType.DATA_PUT_OK or r.fields["nbytes"] != length:
+            raise OcmProtocolError(
+                f"coalesced burst ack mismatch: {r.type.name} "
+                f"{r.fields.get('nbytes')} != {length}"
+            )
+
+    def _stripe_windowed(
+        self, s, handle, start, length, offset, put_mv, get_arr,
+        chunk, window, rtts: list[float],
+    ) -> None:
+        """The lockstep-compatible pipelined window over one stripe's
+        range [start, start+length): up to ``window`` requests in flight,
+        one reply consumed per chunk in FIFO order. Runs against ANY v2
+        daemon (it is the pre-capability protocol unchanged) and doubles
+        as the get path everywhere — get replies carry the data, so there
+        is nothing to coalesce."""
+        window = max(1, window)
+        is_put = put_mv is not None
+        get_mv = memoryview(get_arr) if get_arr is not None else None
+        end = start + length
+        inflight: list[tuple[int, int, float]] = []  # (pos, nbytes, t_send)
+        pos = start
+        failure: OcmRemoteError | None = None
+        # Reusable reply buffer: each DATA_GET_OK chunk is consumed
+        # before the next recv, the RecvScratch contract (per stripe,
+        # because the scratch is per socket).
+        scratch = RecvScratch()
+        while pos < end or inflight:
+            while pos < end and len(inflight) < window and failure is None:
+                n = min(chunk, end - pos)
+                if is_put:
+                    req = Message(
+                        MsgType.DATA_PUT,
+                        {
+                            "alloc_id": handle.alloc_id,
+                            "offset": offset + pos,
+                            "nbytes": n,
+                        },
+                        put_mv[pos:pos + n],
+                    )
+                else:
+                    req = Message(
+                        MsgType.DATA_GET,
+                        {
+                            "alloc_id": handle.alloc_id,
+                            "offset": offset + pos,
+                            "nbytes": n,
+                        },
+                    )
+                send_msg(s, req)
+                inflight.append((pos, n, time.perf_counter()))
+                pos += n
+            if not inflight:
+                break
+            # Replies are FIFO, so the expected chunk's destination is
+            # known BEFORE the recv: a matching fixed-field reply
+            # (DATA_GET_OK) lands its payload straight in the disjoint
+            # destination view — no scratch hop, no copy. An ERROR reply
+            # (strings) or a length mismatch ignores the sink and takes
+            # the normal path below.
+            sink = (
+                get_mv[inflight[0][0]:inflight[0][0] + inflight[0][1]]
+                if get_mv is not None and failure is None else None
+            )
+            r = recv_msg(s, scratch, data_into=sink)
+            c_pos, n, t_send = inflight.pop(0)
+            rtts.append(time.perf_counter() - t_send)
+            if r.type == MsgType.ERROR:
+                # Remember the first failure; keep draining replies
+                # for chunks already on the wire.
+                if failure is None:
+                    failure = OcmRemoteError(
+                        r.fields["code"], r.fields["detail"]
+                    )
+            elif failure is None:
+                if sink is not None and r.data is sink:
+                    continue  # payload already landed in place
+                if not is_put and get_arr is not None:
                     try:
-                        on_reply(r, start, n)
+                        get_arr[c_pos:c_pos + n] = np.frombuffer(
+                            r.data, dtype=np.uint8
+                        )
                     except (OSError, OcmProtocolError):
                         raise
                     except Exception as exc:
@@ -507,59 +816,58 @@ class ControlPlaneClient:
                         raise OcmProtocolError(
                             f"malformed {r.type.name} reply payload: {exc}"
                         ) from exc
-        except BaseException:
-            # Whatever escaped, the pipeline stopped mid-exchange with
-            # replies possibly still on the wire — the connection cannot
-            # be trusted and the lease must not leak.
-            self._pool.discard(host, port, entry)
-            raise
-        self._pool.release(host, port, entry)
         if failure is not None:
             raise failure
 
     def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
-        mv = memoryview(raw)  # chunks stay zero-copy views; send_msg
-        # scatter-gathers them onto the wire without concatenation
-
-        def make_req(pos: int, n: int) -> Message:
-            return Message(
-                MsgType.DATA_PUT,
-                {
-                    "alloc_id": handle.alloc_id,
-                    "offset": offset + pos,
-                    "nbytes": n,
-                },
-                mv[pos : pos + n],
-            )
-
+        mv = memoryview(raw)  # stripes/chunks stay zero-copy views;
+        # send_msg scatter-gathers them onto the wire without concatenation
+        t0 = time.perf_counter()
         with self.tracer.span("dcn_put", nbytes=raw.nbytes):
-            self._pipelined(handle, raw.nbytes, make_req, lambda r, s0, n: None)
+            stats = self._dcn_transfer(handle, raw.nbytes, offset, put_mv=mv)
+        self._note_dcn(stats, "put", raw.nbytes, time.perf_counter() - t0)
+
+    def get_into(self, handle: OcmAlloc, out: np.ndarray,
+                 offset: int = 0) -> np.ndarray:
+        """One-sided get landing in a CALLER-OWNED buffer: the registered-
+        receive-buffer idiom (the reference posts recvs into pre-registered
+        NIC buffers; a fresh destination array per get costs one page
+        fault per 4 KiB, ~4x the warm-copy cost at 256 MiB). ``out`` must
+        be a writable C-contiguous uint8 array; stripes land via
+        recv_into directly into disjoint views of it."""
+        if handle.kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE):
+            raise OcmError("get_into serves host-kind handles only")
+        if (
+            out.dtype != np.uint8 or not out.flags.c_contiguous
+            or not out.flags.writeable
+        ):
+            raise ValueError("out must be a writable C-contiguous uint8 array")
+        # reshape(-1) of a C-contiguous array is a VIEW — stripes index a
+        # flat byte range of the caller's buffer.
+        self._dcn_get_into(handle, out.reshape(-1), out.nbytes, offset)
+        return out
 
     def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int) -> np.ndarray:
         out = np.empty(nbytes, dtype=np.uint8)
-        out_mv = memoryview(out)
-
-        def make_req(pos: int, n: int) -> Message:
-            return Message(
-                MsgType.DATA_GET,
-                {
-                    "alloc_id": handle.alloc_id,
-                    "offset": offset + pos,
-                    "nbytes": n,
-                },
-            )
-
-        def on_reply(r: Message, start: int, n: int) -> None:
-            # Fallback path only: matching DATA_GET_OK chunks land
-            # directly in `out` via the data_sink.
-            out[start : start + n] = np.frombuffer(r.data, dtype=np.uint8)
-
-        with self.tracer.span("dcn_get", nbytes=nbytes):
-            self._pipelined(
-                handle, nbytes, make_req, on_reply,
-                data_sink=lambda start, n: out_mv[start:start + n],
-            )
+        self._dcn_get_into(handle, out, nbytes, offset)
         return out
+
+    def _dcn_get_into(self, handle: OcmAlloc, out: np.ndarray, nbytes: int,
+                      offset: int) -> None:
+        t0 = time.perf_counter()
+        with self.tracer.span("dcn_get", nbytes=nbytes):
+            stats = self._dcn_transfer(handle, nbytes, offset, get_arr=out)
+        self._note_dcn(stats, "get", nbytes, time.perf_counter() - t0)
+
+    def _note_dcn(self, stats: dict, op: str, nbytes: int, dt: float) -> None:
+        self.tracer.note_transfer(
+            op, nbytes, dt,
+            stripes=stats["stripes"],
+            window=max(stats["window"]) if stats["window"] else 0,
+            chunk_bytes=max(stats["chunk"]) if stats["chunk"] else 0,
+            retries=sum(stats["retries"]),
+            coalesced=any(stats["coalesced"]),
+        )
 
     def _owner_addr(self, handle: OcmAlloc) -> tuple[str, int]:
         addr = getattr(handle, "owner_addr", None)
@@ -572,10 +880,31 @@ class ControlPlaneClient:
 
     def status(self, rank: int | None = None) -> dict:
         if rank is None or rank == self.rank:
-            return self._request(Message(MsgType.STATUS, {})).fields
+            return self._status_fields(
+                self._request(Message(MsgType.STATUS, {}))
+            )
         e = self.entries[rank]
         s = socket.create_connection((e.connect_host, e.port), timeout=30.0)
         try:
-            return request(s, Message(MsgType.STATUS, {})).fields
+            return self._status_fields(
+                request(s, Message(MsgType.STATUS, {}))
+            )
         finally:
             s.close()
+
+    def _status_fields(self, r: Message) -> dict:
+        """STATUS_OK fields + data-plane telemetry: the daemon's served-side
+        records ride as a JSON data tail (absent from the C++ daemon — a
+        v2 reply without a tail is simply reported without it), and the
+        client's own per-transfer ring (bytes, stripes, window, achieved
+        Gbps, retries) is merged under ``dcn_client``."""
+        f = dict(r.fields)
+        if r.data:
+            import json
+
+            try:
+                f.update(json.loads(bytes(r.data)))
+            except (ValueError, UnicodeDecodeError):
+                pass  # tail from a future daemon we don't understand
+        f["dcn_client"] = {"transfers": self.tracer.transfers(last=32)}
+        return f

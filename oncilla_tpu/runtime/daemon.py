@@ -48,6 +48,8 @@ from oncilla_tpu.runtime.placement import (
     Placement,
 )
 from oncilla_tpu.runtime.protocol import (
+    FLAG_CAP_COALESCE,
+    FLAG_MORE,
     WIRE_KIND,
     WIRE_KIND_INV,
     ErrCode,
@@ -60,7 +62,7 @@ from oncilla_tpu.runtime.protocol import (
 )
 from oncilla_tpu.runtime.registry import AllocRegistry, RegEntry
 from oncilla_tpu.utils.config import OcmConfig
-from oncilla_tpu.utils.debug import printd
+from oncilla_tpu.utils.debug import Tracer, printd
 
 
 class Daemon:
@@ -125,6 +127,16 @@ class Daemon:
         # OCM_ALLOCTRACE ledger scope for registry entries this daemon
         # owns (id-qualified: one process hosts many daemons in tests).
         self._trace_scope = f"daemon:r{self.rank}:{id(self):#x}"
+        # Served data-plane telemetry: per-op stats plus the per-transfer
+        # ring (bytes/Gbps of each coalesced burst), surfaced as the JSON
+        # data tail of STATUS_OK — trailing data on a reply is invisible
+        # to old clients, so the schema stays v2-compatible.
+        self.tracer = Tracer()
+        # Per-serve-thread reusable DATA_GET_OK snapshot buffer: a fresh
+        # bytes() per 16 MiB chunk costs an allocation + page faults each
+        # time (measured ~4x the warm-copy cost); each connection has its
+        # own serve thread, so thread-local reuse needs no locking.
+        self._get_buf = threading.local()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -369,15 +381,29 @@ class Daemon:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        """Per-connection handler (inbound_thread analogue, mem.c:319-393)."""
+        """Per-connection handler (inbound_thread analogue, mem.c:319-393).
+
+        ACK coalescing: a DATA_PUT carrying FLAG_MORE is a non-final chunk
+        of a burst — it is applied but NOT answered; the first chunk
+        without the bit closes the burst and gets ONE reply covering all
+        of it (total bytes on success, the burst's first ERROR otherwise).
+        Replies stay FIFO per connection; there are simply fewer of them.
+        Burst state is per-connection local, so concurrent stripes on
+        sibling sockets never interact.
+        """
         # Reusable receive buffer: every inbound bulk payload (DATA_PUT
         # chunks) is fully consumed by its handler before the next recv —
         # the RecvScratch contract.
         scratch = RecvScratch()
+        burst_nbytes = 0        # DATA_PUT_OK bytes accumulated this burst
+        burst_err: Message | None = None  # first failure, reported once
+        burst_open = False
+        burst_t0 = 0.0
         try:
             while self._running.is_set():
                 try:
-                    msg = recv_msg(conn, scratch)
+                    msg = recv_msg(conn, scratch,
+                                   data_router=self._route_put_payload)
                 except OcmProtocolError as e:
                     # Clean EOF between frames is normal disconnect; any
                     # other decode failure (truncated frame, bad magic,
@@ -387,8 +413,23 @@ class Daemon:
                         printd("daemon %d: dropping conn on malformed "
                                "input: %s", self.rank, e)
                     return
+                is_put = msg.type == MsgType.DATA_PUT
+                if burst_open and not is_put:
+                    # A sender may not interleave other requests inside an
+                    # unfinished burst — the reply stream would desync.
+                    burst_nbytes, burst_err, burst_open = 0, None, False
+                    send_msg(conn, _err(
+                        ErrCode.BAD_MSG,
+                        f"{msg.type.name} inside an open DATA_PUT burst",
+                    ))
+                    continue
                 try:
-                    reply = self._dispatch(msg)
+                    if is_put or msg.type == MsgType.DATA_GET:
+                        op = "dcn_put_srv" if is_put else "dcn_get_srv"
+                        with self.tracer.span(op, nbytes=msg.fields["nbytes"]):
+                            reply = self._dispatch(msg)
+                    else:
+                        reply = self._dispatch(msg)
                 except OcmOutOfMemory as e:
                     reply = _err(ErrCode.OOM, str(e))
                 except OcmBoundsError as e:
@@ -402,6 +443,26 @@ class Daemon:
                 except Exception as e:  # noqa: BLE001 — always answer with a
                     # typed ERROR frame rather than killing the connection.
                     reply = _err(ErrCode.UNKNOWN, f"{type(e).__name__}: {e}")
+                more = is_put and bool(msg.flags & FLAG_MORE)
+                if is_put and (more or burst_open):
+                    if not burst_open:
+                        burst_open, burst_t0 = True, time.perf_counter()
+                    if reply.type == MsgType.ERROR:
+                        if burst_err is None:
+                            burst_err = reply
+                    else:
+                        burst_nbytes += reply.fields["nbytes"]
+                    if more:
+                        continue  # reply deferred to the burst's last chunk
+                    reply = burst_err or Message(
+                        MsgType.DATA_PUT_OK, {"nbytes": burst_nbytes}
+                    )
+                    if burst_err is None:
+                        self.tracer.note_transfer(
+                            "put_srv", burst_nbytes,
+                            time.perf_counter() - burst_t0, coalesced=True,
+                        )
+                    burst_nbytes, burst_err, burst_open = 0, None, False
                 send_msg(conn, reply)
         except OSError:
             pass
@@ -441,6 +502,9 @@ class Daemon:
     # CONNECT: app attach (process_msg MSG_CONNECT analogue, main.c:58-103).
     def _on_connect(self, msg: Message) -> Message:
         printd("daemon %d: app pid %d connected", self.rank, msg.fields["pid"])
+        # Capability negotiation: grant exactly the offered bits we
+        # implement. Peers that never offer (old clients, the C++ daemon's
+        # own dials) get flags=0 and the lockstep protocol unchanged.
         return Message(
             MsgType.CONNECT_CONFIRM,
             {
@@ -448,6 +512,7 @@ class Daemon:
                 "nnodes": self.policy.nnodes if self.rank == 0
                 else len(self.entries),
             },
+            flags=msg.flags & FLAG_CAP_COALESCE,
         )
 
     def _on_disconnect(self, msg: Message) -> Message:
@@ -698,6 +763,36 @@ class Daemon:
 
     # -- DCN data plane: one-sided put/get into the daemon's host arena ---
 
+    def _route_put_payload(self, msg: Message, n_data: int):
+        """recv_msg data router: land a DATA_PUT payload DIRECTLY in the
+        destination arena extent — the recv IS the write (no scratch hop,
+        no numpy copy; on this path the daemon does zero per-byte work
+        beyond the kernel's socket copy). Only a chunk that fully
+        validates routes; anything questionable returns None and takes
+        the copy path, where the handler raises the typed error.
+
+        TOCTOU note: a concurrent free could recycle the extent between
+        this lookup and the recv completing. The window is the same class
+        the copy path already has (lookup, then write) — only wider by
+        the recv — and reachable only by an app freeing or abandoning an
+        allocation while actively writing it; the handler revalidates
+        after the recv and answers BAD_ALLOC_ID so such a writer cannot
+        treat the landing as durable."""
+        f = msg.fields
+        if msg.type != MsgType.DATA_PUT or n_data != f["nbytes"]:
+            return None
+        try:
+            e = self.registry.lookup(f["alloc_id"])
+            if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                return None  # device relay needs the payload as a message
+            check_bounds(
+                Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"]
+            )
+        except OcmError:
+            return None
+        view = memoryview(self.host_arena.view(e.extent))
+        return view[f["offset"]:f["offset"] + n_data]
+
     def _on_data_put(self, msg: Message) -> Message:
         f = msg.fields
         e = self.registry.lookup(f["alloc_id"])
@@ -706,6 +801,11 @@ class Daemon:
         if len(msg.data) != f["nbytes"]:
             raise OcmProtocolError("DATA_PUT length mismatch")
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
+        if getattr(msg, "data_landed", False):
+            # Payload already recv'd straight into the arena extent by
+            # _route_put_payload; the lookup above re-validated the alloc
+            # is still live post-recv.
+            return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
         import numpy as np
 
         self.host_arena.write(
@@ -724,10 +824,21 @@ class Daemon:
         # TCP send — a reaper-expired lease could recycle the extent
         # mid-send and leak the next tenant's bytes), but skip the old
         # tobytes + frame-concat copies via send_msg's scatter-gather.
-        data = bytes(memoryview(self.host_arena.view(e.extent))[
-            f["offset"]:f["offset"] + f["nbytes"]
-        ])
-        return Message(MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]}, data)
+        # The snapshot lands in a per-serve-thread REUSABLE buffer: the
+        # reply is fully on the wire before this thread recvs the next
+        # request, so the buffer is free again by then, and reuse avoids
+        # a fresh 16 MiB allocation's page faults per chunk.
+        n = f["nbytes"]
+        buf = getattr(self._get_buf, "buf", None)
+        if buf is None or len(buf) < n or (
+            len(buf) > (32 << 20) and n < len(buf) // 4
+        ):
+            buf = self._get_buf.buf = bytearray(n)
+        sink = memoryview(buf)[:n]
+        sink[:] = memoryview(self.host_arena.view(e.extent))[
+            f["offset"]:f["offset"] + n
+        ]
+        return Message(MsgType.DATA_GET_OK, {"nbytes": n}, sink)
 
     # -- cross-process device plane (PLANE_SERVE / PLANE_PUT / PLANE_GET) --
     #
@@ -873,6 +984,20 @@ class Daemon:
         return Message(MsgType.HEARTBEAT_OK, {"lease_s": self.registry.lease_s})
 
     def _on_status(self, msg: Message) -> Message:
+        import json
+
+        # Data-plane telemetry rides as a JSON data tail: v2 clients parse
+        # the fixed fields and ignore trailing data, so the schema needs
+        # no new wire fields (the C++ daemon simply sends no tail).
+        detail = {
+            "dcn": {
+                "ops": {
+                    k: v for k, v in self.tracer.snapshot().items()
+                    if k.startswith("dcn_")
+                },
+                "transfers": self.tracer.transfers(last=32),
+            }
+        }
         return Message(
             MsgType.STATUS_OK,
             {
@@ -884,6 +1009,7 @@ class Daemon:
                     b.bytes_live for b in self.device_books
                 ),
             },
+            json.dumps(detail, separators=(",", ":")).encode(),
         )
 
 
@@ -950,6 +1076,19 @@ def main(argv=None) -> int:
     d.stop()
     return 0
 
+
+# Flag bits the daemon acts on, per request type. The protocol
+# exhaustiveness gate (analysis/project.py) checks every bit declared in
+# protocol.VALID_FLAGS for a request type appears here — a flag added to
+# the wire without daemon support fails lint instead of silently
+# degrading to lockstep (or worse, desyncing the reply stream) under
+# load. CONNECT's capability offer is handled in _on_connect (echo of
+# the implemented subset); DATA_PUT's FLAG_MORE in _serve_conn's burst
+# loop.
+_FLAGS_HANDLED = {
+    MsgType.CONNECT: FLAG_CAP_COALESCE,
+    MsgType.DATA_PUT: FLAG_MORE,
+}
 
 _HANDLERS = {
     MsgType.CONNECT: Daemon._on_connect,

@@ -46,16 +46,40 @@ def _run_logged(cmd: list[str], what: str) -> None:
 def build(force: bool = False, tsan: bool = False) -> Path:
     """Build oncillamemd with CMake (+ Ninja when available); cached, but
     rebuilt whenever any native source is newer than the binary (a stale
-    cached binary would silently test old daemon code)."""
+    cached binary would silently test old daemon code). Containers
+    without cmake fall back to a direct compiler invocation of the same
+    two translation units — the daemon needs nothing from the build
+    system beyond -pthread, and skipping every native test for want of
+    cmake would leave the one-protocol property (Python client vs C++
+    daemon) unverified exactly where CI runs."""
     target = BUILD_DIR / ("oncillamemd_tsan" if tsan else "oncillamemd")
     if target.exists() and not force and not _stale(target):
         return target
+    if shutil.which("cmake") is None:
+        return _build_direct(target, tsan)
     gen = ["-G", "Ninja"] if shutil.which("ninja") else []
     cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
     if tsan:
         cfg.append("-DOCM_TSAN=ON")
     _run_logged(cfg, "cmake configure")
     _run_logged(["cmake", "--build", str(BUILD_DIR)], "cmake build")
+    return target
+
+
+def _build_direct(target: Path, tsan: bool) -> Path:
+    """cmake-less daemon build: g++/c++ on daemon.cc + protocol.cc with
+    the CMakeLists' exact flag set."""
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise RuntimeError("native build failed: no cmake and no C++ compiler")
+    BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        cxx, "-std=c++17", "-Wall", "-Wextra", "-pthread",
+        *(["-fsanitize=thread", "-g", "-O1"] if tsan else ["-O2"]),
+        str(NATIVE_DIR / "daemon.cc"), str(NATIVE_DIR / "protocol.cc"),
+        "-o", str(target),
+    ]
+    _run_logged(cmd, "direct compile")
     return target
 
 

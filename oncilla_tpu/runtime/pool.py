@@ -85,10 +85,16 @@ class PeerPool:
                 # (release or discard notifies); the timeout is a
                 # belt-and-braces rescan, not the wakeup mechanism.
                 self._cond.wait(timeout=1.0)
+        return self._dial(key)
+
+    def _dial(self, key: tuple[str, int]) -> PoolEntry:
+        """Dial a fresh connection to ``key`` and register it, leased."""
         try:
             s = socket.create_connection(key, timeout=self._timeout)
         except OSError as e:
-            raise OcmConnectError(f"peer {host}:{port} unreachable: {e}") from e
+            raise OcmConnectError(
+                f"peer {key[0]}:{key[1]} unreachable: {e}"
+            ) from e
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Large buffers so an 8 MiB pipelined chunk streams without the
         # sender stalling on the default ~208 KiB window (the kernel may
@@ -106,6 +112,48 @@ class PeerPool:
                 raise OcmConnectError("peer pool is shut down")
             self._conns.setdefault(key, []).append(entry)
         return entry
+
+    def lease_set(self, host: str, port: int, n: int) -> list[PoolEntry]:
+        """Lease up to ``n`` connections to one peer — the stripe set of a
+        multi-stream transfer (one logical transfer split across parallel
+        sockets, each with its own FIFO request/reply stream). The first
+        lease has :meth:`lease` semantics (may block at the cap); the rest
+        are OPPORTUNISTIC — an idle cached entry or a fresh dial while the
+        peer is under its cap — so two concurrent striped transfers to one
+        peer degrade to fewer stripes each instead of deadlocking on each
+        other's leases. Always returns at least one entry; callers size
+        their stripes to ``len(result)``."""
+        entries = [self.lease(host, port)]
+        key = (host, port)
+        while len(entries) < n:
+            fresh_ok = False
+            with self._cond:
+                if self._closed:
+                    break
+                lst = self._conns.setdefault(key, [])
+                got = None
+                for e in lst:
+                    if (
+                        e not in entries
+                        and not e.dead
+                        and e.lock.acquire(blocking=False)
+                    ):
+                        if e.dead:  # discarded between scan and acquire
+                            e.lock.release()
+                            continue
+                        got = e
+                        break
+                if got is not None:
+                    entries.append(got)
+                    continue
+                fresh_ok = len(lst) < self._per_peer
+            if not fresh_ok:
+                break  # at the cap: never wait for siblings' leases
+            try:
+                entries.append(self._dial(key))
+            except OcmConnectError:
+                break  # a dial failure shrinks the stripe set, not the op
+        return entries
 
     def release(self, host: str, port: int, entry: PoolEntry) -> None:
         """Return a healthy leased connection to the pool."""

@@ -32,6 +32,36 @@ VERSION = 2  # v2: owners field on DISCONNECT/HEARTBEAT, RECLAIM_APP
 HEADER = struct.Struct("<4sBBHI")  # magic, version, type, flags, payload_len
 MAX_PAYLOAD = 64 << 20  # sanity cap; large transfers are chunked above this
 
+# Header-flag bits (the u16 the v2 frame always carried but never used).
+# Capabilities ride the SAME frame format, so a v2 peer that ignores flags
+# (the unmodified C++ daemon packs and parses flags as 0) interoperates
+# unmodified: it simply never grants a capability.
+#
+# FLAG_MORE on DATA_PUT marks a non-final chunk of a coalesced burst: the
+# daemon applies the chunk but defers its reply, answering ONCE — at the
+# first chunk without the bit — with a DATA_PUT_OK covering the whole
+# burst (or the burst's first ERROR). Senders may only set it after the
+# peer granted FLAG_CAP_COALESCE.
+FLAG_MORE = 0x0001
+# FLAG_CAP_COALESCE on CONNECT offers ACK coalescing; a daemon that
+# implements it echoes the bit on CONNECT_CONFIRM. A flags=0 reply (old
+# Python daemon, native C++ daemon) declines, and the sender stays on the
+# lockstep one-reply-per-chunk protocol.
+FLAG_CAP_COALESCE = 0x0002
+
+# Which flag bits each message type may carry on the wire. pack() rejects
+# undeclared bits (a typo'd flag must fail at the sender, not surface as
+# peer misbehavior); receivers stay tolerant and just expose msg.flags.
+# The analysis gate (analysis/project.py) checks every declared request
+# bit against the daemon's handled-flags table, so a bit added here
+# without daemon support fails lint rather than turning into silent
+# lockstep behavior under load.
+VALID_FLAGS: dict["MsgType", int] = {}
+
+
+def _valid_flags(mtype: "MsgType") -> int:
+    return VALID_FLAGS.get(mtype, 0)
+
 
 class MsgType(enum.IntEnum):
     # app <-> local daemon (reference: pmsg mailbox messages)
@@ -88,6 +118,12 @@ WIRE_KIND = {
 }
 WIRE_KIND_INV = {v: k for k, v in WIRE_KIND.items()}
 
+VALID_FLAGS.update({
+    MsgType.CONNECT: FLAG_CAP_COALESCE,          # client offers
+    MsgType.CONNECT_CONFIRM: FLAG_CAP_COALESCE,  # daemon grants
+    MsgType.DATA_PUT: FLAG_MORE,                 # coalesced-burst chunk
+})
+
 
 def _pack_str(s: str) -> bytes:
     b = s.encode("utf-8")
@@ -109,9 +145,14 @@ class Message:
     type: MsgType
     fields: dict = field(default_factory=dict)
     data: bytes = b""
+    flags: int = 0  # header-flag bits (FLAG_*), preserved by the codec
 
     def __repr__(self) -> str:  # data elided for log hygiene
-        return f"Message({self.type.name}, {self.fields}, data={len(self.data)}B)"
+        fl = f", flags={self.flags:#x}" if self.flags else ""
+        return (
+            f"Message({self.type.name}, {self.fields}, "
+            f"data={len(self.data)}B{fl})"
+        )
 
 
 # Payload schemas: (field_name, struct_char or "s" for string) in order.
@@ -260,7 +301,12 @@ def _pack_prefix(msg: Message) -> bytes:
     plen = len(fields) + len(msg.data)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"payload {plen} exceeds cap")
-    return HEADER.pack(MAGIC, VERSION, int(msg.type), 0, plen) + fields
+    if msg.flags & ~_valid_flags(msg.type):
+        raise OcmProtocolError(
+            f"flags {msg.flags:#x} invalid for {msg.type.name} "
+            f"(allowed mask {_valid_flags(msg.type):#x})"
+        )
+    return HEADER.pack(MAGIC, VERSION, int(msg.type), msg.flags, plen) + fields
 
 
 def pack(msg: Message) -> bytes:
@@ -296,7 +342,7 @@ def _unpack_fields(mtype: MsgType, fields_buf) -> Message:
 
 def unpack(header: bytes, payload: bytes) -> Message:
     try:
-        magic, version, mtype, _flags, plen = HEADER.unpack(header)
+        magic, version, mtype, flags, plen = HEADER.unpack(header)
     except struct.error as e:
         raise OcmProtocolError(f"short header: {e}") from e
     if magic != MAGIC:
@@ -318,7 +364,9 @@ def unpack(header: bytes, payload: bytes) -> Message:
         memoryview(payload)[off:] if n_data >= (64 << 10)
         else bytes(payload[off:])
     )
-    return Message(mtype, fields, data)
+    # Receivers are TOLERANT of unknown flag bits (only senders validate):
+    # the bits are exposed as-is and handlers act on the ones they know.
+    return Message(mtype, fields, data, flags=flags)
 
 
 # -- blocking socket transport (conn_put/conn_get analogue, sock.c:215-253) --
@@ -407,18 +455,29 @@ def recv_msg(
     sock: socket.socket,
     scratch: RecvScratch | None = None,
     data_into: memoryview | None = None,
+    data_router=None,
 ) -> Message:
     """Receive one message. With ``data_into`` (pipelined readers that
     know the expected reply), a fixed-field message whose data length
     matches lands its payload DIRECTLY in that buffer — ``Message.data``
     IS ``data_into`` then (identity-comparable by the caller); any other
     message (an ERROR reply, a length mismatch) falls back to the normal
-    path untouched."""
+    path untouched.
+
+    ``data_router`` is the server-side twin for readers that DON'T know
+    what arrives next: called as ``data_router(msg, n_data)`` after the
+    fixed fields of a bulk message are decoded (but before its payload is
+    read), it may return a writable memoryview of exactly ``n_data``
+    bytes to land the payload into (e.g. the destination arena extent of
+    a DATA_PUT — the recv IS the write, no scratch hop, no copy). The
+    returned message then has ``data_landed = True`` set on it. Any
+    ``None``/mis-sized return or router exception falls back to the
+    scratch path; string-schema'd types bypass routing entirely."""
     header = _recv_exact(sock, HEADER.size, eof_ok=True)
     if not header:
         # Clean disconnect at a frame boundary — ordinary, not an anomaly.
         raise OcmProtocolError("peer closed")
-    magic, version, mtype_raw, _, plen = HEADER.unpack(header)
+    magic, version, mtype_raw, flags, plen = HEADER.unpack(header)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"advertised payload {plen} exceeds cap")
     if data_into is not None and magic == MAGIC and version == VERSION:
@@ -435,6 +494,37 @@ def recv_msg(
             _recv_into(sock, data_into)
             msg = _unpack_fields(mt, fields)
             msg.data = data_into
+            msg.flags = flags
+            return msg
+    if data_router is not None and magic == MAGIC and version == VERSION:
+        try:
+            mt = MsgType(mtype_raw)
+            ffix = _FIXED_FIELD_SIZE.get(mt)
+        except ValueError:
+            ffix = None  # unknown type: let unpack raise the real error
+        if ffix is not None and plen >= ffix:
+            fields_buf = _recv_exact(sock, ffix) if ffix else b""
+            msg = _unpack_fields(mt, fields_buf)
+            msg.flags = flags
+            n_data = plen - ffix
+            if n_data == 0:
+                return msg
+            sink = None
+            try:
+                sink = data_router(msg, n_data)
+            except Exception:  # noqa: BLE001 — routing is best-effort;
+                sink = None  # the handler re-raises the real error later
+            if sink is not None and len(sink) == n_data:
+                _recv_into(sock, sink)
+                msg.data = sink
+                msg.data_landed = True
+                return msg
+            if scratch is not None and n_data >= (64 << 10):
+                payload = scratch.get(n_data)
+                _recv_into(sock, payload)
+                msg.data = payload
+            else:
+                msg.data = bytes(_recv_exact(sock, n_data))
             return msg
     if plen == 0:
         payload = b""

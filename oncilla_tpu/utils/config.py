@@ -19,6 +19,16 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v else default
 
 
+# Wire-frame payload cap (protocol.MAX_PAYLOAD's value) minus slack for the
+# frame's fixed fields: a DATA_PUT chunk is fixed fields + chunk payload in
+# ONE frame, so a chunk_bytes above this encodes to a frame the peer must
+# reject (OcmProtocolError at the daemon — a config-legal value turning
+# into a wire error mid-transfer). Kept as a literal rather than an import
+# because utils.config must stay import-light (no runtime package pull-in
+# at config time); test_dcn_stripe.py pins it against protocol.MAX_PAYLOAD.
+MAX_CHUNK_BYTES = (64 << 20) - 4096
+
+
 @dataclass
 class OcmConfig:
     # Arena capacities. The reference sizes buffers per-allocation at
@@ -59,6 +69,30 @@ class OcmConfig:
     )
     inflight_ops: int = field(default_factory=lambda: _env_int("OCM_INFLIGHT", 2))
 
+    # Multi-stream striping: large DCN transfers split into N contiguous
+    # byte ranges, each pipelined over its OWN pooled connection (parallel
+    # TCP streams to the owner daemon — the UCX/NCCL multi-rail scheme).
+    # 1 = the original single-stream path. Stripes below stripe_min_bytes
+    # are not worth a thread + socket; transfers shrink their stripe count
+    # so every stripe moves at least that much.
+    dcn_stripes: int = field(
+        default_factory=lambda: _env_int("OCM_DCN_STRIPES", 4)
+    )
+    dcn_stripe_min_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_DCN_STRIPE_MIN_BYTES", 8 << 20)
+    )
+    # Adaptive windowing: autotune the in-flight window and chunk size per
+    # peer from observed per-chunk RTT (0 = pin the configured values).
+    dcn_adaptive: bool = field(
+        default_factory=lambda: bool(_env_int("OCM_DCN_ADAPTIVE", 1))
+    )
+    # Offer FLAG_CAP_COALESCE at CONNECT so capable daemons ACK a put
+    # stripe once per burst instead of once per chunk (0 = always lockstep;
+    # peers that don't grant the capability get lockstep regardless).
+    dcn_coalesce: bool = field(
+        default_factory=lambda: bool(_env_int("OCM_DCN_COALESCE", 1))
+    )
+
     # Liveness (capability upgrade over the reference's unresolved TODO,
     # /root/reference/src/main.c:6-7).
     lease_s: float = 30.0
@@ -71,13 +105,25 @@ class OcmConfig:
         # config construction, where OCM_CHUNK_BYTES=0 would otherwise
         # slip past int() (the C twin clamps to its default instead,
         # libocm.cc).
-        if not 0 < self.chunk_bytes <= (1 << 40):
+        if not 0 < self.chunk_bytes <= MAX_CHUNK_BYTES:
             raise ValueError(
-                "chunk_bytes must be in (0, 2^40] — a 0 chunk livelocks "
-                "the transfer loops and a giant one defeats the "
-                f"2 x chunk_bytes buffering bound (got {self.chunk_bytes})"
+                f"chunk_bytes must be in (0, {MAX_CHUNK_BYTES}] — a 0 chunk "
+                "livelocks the transfer loops, and a chunk above "
+                "MAX_PAYLOAD minus fixed-field slack encodes to a wire "
+                "frame the peer daemon rejects mid-transfer "
+                f"(got {self.chunk_bytes})"
             )
         if self.inflight_ops <= 0:
             raise ValueError(
                 f"inflight_ops must be > 0 (got {self.inflight_ops})"
+            )
+        if self.dcn_stripes <= 0:
+            raise ValueError(
+                f"dcn_stripes must be >= 1 (got {self.dcn_stripes}); "
+                "1 selects the single-stream path"
+            )
+        if self.dcn_stripe_min_bytes <= 0:
+            raise ValueError(
+                "dcn_stripe_min_bytes must be > 0 "
+                f"(got {self.dcn_stripe_min_bytes})"
             )

@@ -57,10 +57,15 @@ class Tracer:
     """Per-op timing registry. ``tracer.span("put", nbytes=...)`` wraps an op;
     ``tracer.stats("put")`` reports count / p50 latency / GB/s."""
 
-    def __init__(self, max_samples: int = 4096):
+    def __init__(self, max_samples: int = 4096, max_transfers: int = 256):
         self._stats: dict[str, OpStats] = {}
         self._lock = threading.Lock()
         self._max_samples = max_samples
+        # Per-transfer records of the DCN data plane (bytes, stripes,
+        # window, achieved Gbps, retries) — the ring the STATUS endpoint
+        # surfaces so operators see data-plane throughput without a
+        # profiler attached.
+        self._transfers: "deque[dict]" = deque(maxlen=max_transfers)
 
     def _get_locked(self, op: str) -> OpStats:
         st = self._stats.get(op)
@@ -103,6 +108,39 @@ class Tracer:
                 total_bytes=st.total_bytes,
                 samples_s=deque(st.samples_s),
             )
+
+    def note_transfer(
+        self,
+        op: str,
+        nbytes: int,
+        seconds: float,
+        *,
+        stripes: int = 1,
+        window: int = 0,
+        chunk_bytes: int = 0,
+        retries: int = 0,
+        coalesced: bool = False,
+    ) -> None:
+        """Record one completed data-plane transfer in the ring buffer."""
+        rec = {
+            "op": op,
+            "bytes": int(nbytes),
+            "seconds": seconds,
+            "gbps": (nbytes * 8 / seconds / 1e9) if seconds > 0 else 0.0,
+            "stripes": int(stripes),
+            "window": int(window),
+            "chunk_bytes": int(chunk_bytes),
+            "retries": int(retries),
+            "coalesced": bool(coalesced),
+        }
+        with self._lock:
+            self._transfers.append(rec)
+
+    def transfers(self, last: int | None = None) -> list[dict]:
+        """Copies of the most recent transfer records (all by default)."""
+        with self._lock:
+            recs = list(self._transfers)
+        return recs if last is None else recs[-last:]
 
     def snapshot(self) -> dict[str, dict]:
         with self._lock:

@@ -35,6 +35,12 @@ summary=$(grep -E '^analysis: ' "$alog" | tail -1 || true)
 echo "check.sh: findings by family: ${summary#analysis: }"
 rm -f "$alog"
 
+echo "== dcn smoke =="
+# Loopback DCN data-plane smoke: tiny striped + single-stream put/get
+# roundtrips through an in-process 2-daemon cluster, byte-exactness
+# asserted; runs in seconds and needs no chip.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke || fail=1
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -1,0 +1,420 @@
+"""Multi-stream striped DCN data plane: stripe planning, capability
+negotiation (ACK coalescing), adaptive windowing, zero-copy get_into,
+mid-stripe fault injection/retry, and per-transfer telemetry."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from oncilla_tpu import OcmKind
+from oncilla_tpu.runtime import client as client_mod
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import _PeerTuner
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import MAX_CHUNK_BYTES, OcmConfig
+
+
+def _cfg(**kw) -> OcmConfig:
+    """Small-chunk config so a ~MiB transfer exercises multi-chunk,
+    multi-stripe paths in milliseconds."""
+    base = dict(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=64 << 10,
+        inflight_ops=2,
+        dcn_stripes=4,
+        dcn_stripe_min_bytes=64 << 10,
+        heartbeat_s=5.0,
+    )
+    base.update(kw)
+    return OcmConfig(**base)
+
+
+# -- config validation (the chunk_bytes / MAX_PAYLOAD satellite) ---------
+
+
+def test_chunk_bytes_capped_at_wire_frame():
+    # Regression: chunk_bytes up to 2^40 used to validate, then explode
+    # as OcmProtocolError at pack time — a legal config must never encode
+    # to a frame the peer rejects.
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        OcmConfig(chunk_bytes=P.MAX_PAYLOAD)
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        OcmConfig(chunk_bytes=1 << 40)
+    cfg = OcmConfig(chunk_bytes=MAX_CHUNK_BYTES)
+    assert cfg.chunk_bytes == MAX_CHUNK_BYTES
+
+
+def test_max_chunk_frame_actually_fits():
+    # The config cap and the wire cap must agree: a DATA_PUT carrying a
+    # maximal chunk packs, and the slack covers the fixed fields.
+    fixed = sum(
+        {"q": 8, "Q": 8, "I": 4, "B": 1, "d": 8}[fmt]
+        for _, fmt in P._SCHEMAS[P.MsgType.DATA_PUT]
+    )
+    assert MAX_CHUNK_BYTES + fixed <= P.MAX_PAYLOAD
+    msg = P.Message(
+        P.MsgType.DATA_PUT,
+        {"alloc_id": 1, "offset": 0, "nbytes": MAX_CHUNK_BYTES},
+        bytes(1),  # placeholder byte; length is what pack() checks
+    )
+    P.pack(msg)  # must not raise
+
+
+def test_stripe_config_validated():
+    with pytest.raises(ValueError, match="dcn_stripes"):
+        OcmConfig(dcn_stripes=0)
+    with pytest.raises(ValueError, match="dcn_stripe_min_bytes"):
+        OcmConfig(dcn_stripe_min_bytes=0)
+
+
+# -- stripe planning and the adaptive tuner ------------------------------
+
+
+def test_plan_stripes_respects_min_bytes():
+    cfg = _cfg(dcn_stripes=8, dcn_stripe_min_bytes=1 << 20)
+    with local_cluster(2, config=cfg) as cluster:
+        c = cluster.client(0, heartbeat=False)
+        assert c._plan_stripes(512 << 10) == 1   # below one stripe's worth
+        assert c._plan_stripes(2 << 20) == 2     # two stripes' worth
+        assert c._plan_stripes(64 << 20) == 8    # capped by config
+
+
+def test_tuner_grows_and_shrinks():
+    cfg = _cfg(chunk_bytes=1 << 20, inflight_ops=2, dcn_adaptive=True)
+    t = _PeerTuner(cfg)
+    chunk0, win0 = t.plan()
+    # Fast chunks at a rate that wants a deeper pipe: window steps up,
+    # chunk doubles.
+    t.observe(0.010, achieved_bps=1e9)
+    chunk1, win1 = t.plan()
+    assert chunk1 == chunk0 * 2
+    assert win1 >= win0
+    # Pathologically slow chunks: chunk halves (never below the floor).
+    for _ in range(20):
+        t.observe(1.0, achieved_bps=1e6)
+    chunk2, _ = t.plan()
+    assert chunk2 == _PeerTuner.MIN_CHUNK
+
+
+def test_tuner_pinned_when_adaptive_off():
+    cfg = _cfg(dcn_adaptive=False)
+    t = _PeerTuner(cfg)
+    t.observe(0.001, achieved_bps=1e9)
+    t.observe(10.0, achieved_bps=1e3)
+    assert t.plan() == (cfg.chunk_bytes, cfg.inflight_ops)
+
+
+# -- striped transfers through a live cluster ----------------------------
+
+
+def _roundtrip(cluster, nbytes: int, rng) -> tuple:
+    client = cluster.client(0, heartbeat=False)
+    h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+    client.put(h, data)
+    got = client.get(h, nbytes)
+    return client, h, data, got
+
+
+def test_striped_roundtrip_byte_exact(rng):
+    with local_cluster(2, config=_cfg()) as cluster:
+        client, h, data, got = _roundtrip(cluster, 2 << 20, rng)
+        np.testing.assert_array_equal(got, data)
+        # Striping actually engaged, and the Python daemon granted the
+        # coalescing capability at the data-plane CONNECT probe.
+        rec = client.tracer.transfers()[-2:]
+        assert [r["op"] for r in rec] == ["put", "get"]
+        assert rec[0]["stripes"] == 4 and rec[1]["stripes"] == 4
+        assert rec[0]["coalesced"] is True   # put bursts coalesce
+        assert rec[1]["coalesced"] is False  # get replies carry the data
+        addr = client._owner_addr(h)
+        assert client._dcn_caps[addr] == P.FLAG_CAP_COALESCE
+        # Offset writes ride the same engine.
+        client.put(h, data[: 256 << 10], offset=512 << 10)
+        np.testing.assert_array_equal(
+            client.get(h, 256 << 10, offset=512 << 10), data[: 256 << 10]
+        )
+        client.free(h)
+
+
+def test_single_stream_path_selectable(rng):
+    # OCM_DCN_STRIPES=1 (here: the config field it feeds) must keep the
+    # original one-socket engine.
+    with local_cluster(2, config=_cfg(dcn_stripes=1)) as cluster:
+        client, h, data, got = _roundtrip(cluster, 1 << 20, rng)
+        np.testing.assert_array_equal(got, data)
+        assert client.tracer.transfers()[-1]["stripes"] == 1
+        client.free(h)
+
+
+def test_lockstep_fallback_when_coalesce_disabled(rng):
+    with local_cluster(2, config=_cfg(dcn_coalesce=False)) as cluster:
+        client, h, data, got = _roundtrip(cluster, 1 << 20, rng)
+        np.testing.assert_array_equal(got, data)
+        rec = client.tracer.transfers()[-2]
+        assert rec["op"] == "put" and rec["coalesced"] is False
+        assert client._dcn_caps[client._owner_addr(h)] == 0
+        client.free(h)
+
+
+def test_get_into_reuses_caller_buffer(rng):
+    with local_cluster(2, config=_cfg()) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h, data)
+        out = np.zeros(1 << 20, dtype=np.uint8)
+        ret = client.get_into(h, out)
+        assert ret is out
+        np.testing.assert_array_equal(out, data)
+        with pytest.raises(ValueError, match="uint8"):
+            client.get_into(h, np.zeros(4, np.float32))
+        client.free(h)
+
+
+def test_context_get_out_param(rng):
+    with local_cluster(2, config=_cfg()) as cluster:
+        ctx = cluster.context(0, heartbeat=False)
+        h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        ctx.put(h, data)
+        out = np.zeros(1 << 20, dtype=np.uint8)
+        assert ctx.get(h, out=out) is out
+        np.testing.assert_array_equal(out, data)
+        ctx.free(h)
+
+
+# -- mid-stripe fault injection ------------------------------------------
+
+
+@pytest.mark.parametrize("stripes", [1, 4])
+@pytest.mark.parametrize("direction", ["put", "get"])
+def test_mid_stripe_socket_kill_retries(rng, monkeypatch, stripes, direction):
+    """Kill the leased socket mid-stripe: the stripe's retry path must
+    re-lease and complete byte-exactly, and a failed stripe must not
+    corrupt sibling stripes' destination ranges."""
+    kill_type = (
+        P.MsgType.DATA_PUT if direction == "put" else P.MsgType.DATA_GET
+    )
+    with local_cluster(2, config=_cfg(dcn_stripes=stripes)) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        nbytes = 2 << 20
+        h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+        if direction == "get":
+            client.put(h, data)  # stage content before the faulty get
+
+        real_send = client_mod.send_msg
+        fired = []
+        lock = threading.Lock()
+
+        def flaky(sock, msg):
+            if msg.type == kill_type:
+                with lock:
+                    first = not fired
+                    if first:
+                        fired.append(1)
+                if first:
+                    # Simulate the peer dropping the leased connection
+                    # mid-pipeline.
+                    sock.shutdown(socket.SHUT_RDWR)
+            return real_send(sock, msg)
+
+        monkeypatch.setattr(client_mod, "send_msg", flaky)
+        if direction == "put":
+            client.put(h, data)
+            got = client.get(h, nbytes)
+        else:
+            got = client.get(h, nbytes)
+        monkeypatch.setattr(client_mod, "send_msg", real_send)
+        assert fired, "fault was never injected"
+        np.testing.assert_array_equal(got, data)
+        # The retry is visible in the transfer record.
+        recs = [r for r in client.tracer.transfers() if r["op"] == direction]
+        assert recs[-1]["retries"] >= 1
+        client.free(h)
+
+
+def test_failed_stripe_does_not_corrupt_siblings(rng, monkeypatch):
+    """A stripe that dies on its FIRST attempt must leave sibling
+    stripes' already-landed destination views intact (disjoint ranges)."""
+    with local_cluster(2, config=_cfg(dcn_stripes=4)) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        nbytes = 2 << 20
+        h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+        client.put(h, data)
+
+        real_recv = client_mod.recv_msg
+        state = {"n": 0}
+        lock = threading.Lock()
+
+        def flaky_recv(sock, *a, **kw):
+            # Kill one stripe's socket after a few replies landed.
+            with lock:
+                state["n"] += 1
+                kill = state["n"] == 3
+            if kill:
+                sock.shutdown(socket.SHUT_RDWR)
+            return real_recv(sock, *a, **kw)
+
+        monkeypatch.setattr(client_mod, "recv_msg", flaky_recv)
+        got = client.get(h, nbytes)
+        monkeypatch.setattr(client_mod, "recv_msg", real_recv)
+        np.testing.assert_array_equal(got, data)
+        client.free(h)
+
+
+def test_stale_owner_addr_falls_back_to_membership(rng):
+    """A cached owner_addr pointing at a dead port (owner daemon
+    restarted elsewhere) must fall back to the membership table for the
+    stripe-set lease itself, not just for mid-stripe failures."""
+    with local_cluster(2, config=_cfg()) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        nbytes = 1 << 20
+        h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+        # Poison the cached data-plane address with a port nothing serves.
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        h.owner_addr = ("127.0.0.1", dead_port)
+        data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+        client.put(h, data)  # multi-stripe: lease_set fallback engages
+        np.testing.assert_array_equal(client.get(h, nbytes), data)
+        assert h.owner_addr == (
+            cluster.entries[h.rank].connect_host, cluster.entries[h.rank].port
+        )
+        client.free(h)
+
+
+# -- protocol-level burst hygiene ----------------------------------------
+
+
+def test_interleaved_request_inside_burst_rejected():
+    """A non-DATA_PUT frame inside an open FLAG_MORE burst is a protocol
+    violation: the daemon must answer BAD_MSG, not desync."""
+    with local_cluster(2, config=_cfg()) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+        owner = cluster.entries[h.rank]
+        s = socket.create_connection((owner.connect_host, owner.port))
+        try:
+            P.send_msg(s, P.Message(
+                P.MsgType.DATA_PUT,
+                {"alloc_id": h.alloc_id, "offset": 0, "nbytes": 1024},
+                bytes(1024),
+                flags=P.FLAG_MORE,
+            ))
+            P.send_msg(s, P.Message(P.MsgType.STATUS, {}))
+            r = P.recv_msg(s)
+            assert r.type == P.MsgType.ERROR
+            assert r.fields["code"] == int(P.ErrCode.BAD_MSG)
+        finally:
+            s.close()
+        client.free(h)
+
+
+def test_coalesced_burst_error_reported_once():
+    """A burst whose chunks fail (bad alloc) must produce exactly ONE
+    ERROR reply at burst end."""
+    with local_cluster(2, config=_cfg()) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+        owner = cluster.entries[h.rank]
+        s = socket.create_connection((owner.connect_host, owner.port))
+        try:
+            for i in range(3):
+                P.send_msg(s, P.Message(
+                    P.MsgType.DATA_PUT,
+                    {"alloc_id": 999999, "offset": i * 1024, "nbytes": 1024},
+                    bytes(1024),
+                    flags=P.FLAG_MORE if i < 2 else 0,
+                ))
+            r = P.recv_msg(s)
+            assert r.type == P.MsgType.ERROR
+            assert r.fields["code"] == int(P.ErrCode.BAD_ALLOC_ID)
+            # The connection is still in sync: a follow-up valid exchange
+            # works on the same socket.
+            P.send_msg(s, P.Message(P.MsgType.STATUS, {}))
+            assert P.recv_msg(s).type == P.MsgType.STATUS_OK
+        finally:
+            s.close()
+        client.free(h)
+
+
+# -- telemetry surfaced through STATUS -----------------------------------
+
+
+def test_status_reports_data_plane_throughput(rng):
+    with local_cluster(2, config=_cfg()) as cluster:
+        client, h, data, got = _roundtrip(cluster, 1 << 20, rng)
+        np.testing.assert_array_equal(got, data)
+        # Client-side ring: every record carries the full telemetry shape.
+        st = client.status()
+        recs = st["dcn_client"]["transfers"]
+        assert recs, "no client transfer records"
+        for rec in recs:
+            assert {
+                "op", "bytes", "seconds", "gbps", "stripes", "window",
+                "chunk_bytes", "retries", "coalesced",
+            } <= set(rec)
+        last_put = [r for r in recs if r["op"] == "put"][-1]
+        assert last_put["bytes"] == 1 << 20 and last_put["gbps"] > 0
+        # Daemon-side: the owner daemon's STATUS carries served-op stats
+        # (JSON data tail of STATUS_OK).
+        owner_st = client.status(rank=h.rank)
+        assert "dcn" in owner_st, owner_st.keys()
+        assert "dcn_put_srv" in owner_st["dcn"]["ops"]
+        assert owner_st["dcn"]["ops"]["dcn_put_srv"]["total_bytes"] >= 1 << 20
+        # Coalesced put bursts land in the daemon's transfer ring too.
+        assert any(
+            t["op"] == "put_srv" and t["coalesced"]
+            for t in owner_st["dcn"]["transfers"]
+        )
+        client.free(h)
+
+
+def test_status_fields_keep_v2_shape(rng):
+    # The original STATUS_OK fixed fields survive alongside the tail.
+    with local_cluster(2, config=_cfg()) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        st = client.status()
+        for k in ("rank", "nnodes", "live_allocs", "host_bytes_live",
+                  "device_bytes_live"):
+            assert k in st
+
+
+# -- concurrent striped transfers share the pool safely ------------------
+
+
+def test_concurrent_striped_transfers(rng):
+    """Two threads striping to the same owner at once: the stripe sets
+    degrade gracefully under the pool cap and both transfers stay
+    byte-exact."""
+    with local_cluster(2, config=_cfg()) as cluster:
+        client = cluster.client(0, heartbeat=False)
+        n = 1 << 20
+        handles = [client.alloc(n, OcmKind.REMOTE_HOST) for _ in range(2)]
+        datas = [rng.integers(0, 256, n, dtype=np.uint8) for _ in range(2)]
+        errs = []
+
+        def mover(i):
+            try:
+                client.put(handles[i], datas[i])
+                got = client.get(handles[i], n)
+                np.testing.assert_array_equal(got, datas[i])
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=mover, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        for h in handles:
+            client.free(h)

@@ -181,6 +181,39 @@ def test_native_pipelined_error_does_not_desync(native_cluster, rng):
     client.close()
 
 
+def test_native_v2_peer_capability_negotiation(native_cluster, rng):
+    """The unmodified C++ daemon is a v2 (non-striping, non-coalescing)
+    peer: the new client's CONNECT capability probe must come back
+    DECLINED (flags=0 — the native codec always packs zero flags), the
+    transfer must fall back to the lockstep one-ACK-per-chunk protocol,
+    and a striped put/get must still complete byte-exact."""
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        dcn_stripes=4,
+        dcn_stripe_min_bytes=64 << 10,
+    )
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    h = client.alloc(2 << 20, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 2 << 20), data)
+    # Negotiation outcome: capability declined, lockstep engaged, but the
+    # transfer still striped across parallel sockets.
+    assert client._dcn_caps[client._owner_addr(h)] == 0
+    put_rec = [r for r in client.tracer.transfers() if r["op"] == "put"][-1]
+    assert put_rec["coalesced"] is False
+    assert put_rec["stripes"] == 4
+    # The native daemon's STATUS_OK has no telemetry tail — the client
+    # must surface the v2 fields unchanged and only its own ring.
+    st = client.status(rank=h.rank)
+    assert "dcn" not in st and st["live_allocs"] == 1
+    client.free(h)
+    client.close()
+
+
 def test_native_lease_reaping(binary, tmp_path):
     ports = free_ports(2)
     nodefile = tmp_path / "nf"

@@ -85,6 +85,60 @@ def test_header_layout_stable():
     assert struct.unpack("<qq", b[12:28]) == (1, 0)
 
 
+def test_flags_roundtrip():
+    # Capability/flag bits ride the header's u16 and must survive the
+    # codec on every type that declares them.
+    m = P.Message(P.MsgType.CONNECT, {"pid": 1, "rank": 0},
+                  flags=P.FLAG_CAP_COALESCE)
+    assert roundtrip(m).flags == P.FLAG_CAP_COALESCE
+    m = P.Message(P.MsgType.CONNECT_CONFIRM, {"rank": 0, "nnodes": 2},
+                  flags=P.FLAG_CAP_COALESCE)
+    assert roundtrip(m).flags == P.FLAG_CAP_COALESCE
+    m = P.Message(
+        P.MsgType.DATA_PUT,
+        {"alloc_id": 7, "offset": 0, "nbytes": 4},
+        b"abcd",
+        flags=P.FLAG_MORE,
+    )
+    out = roundtrip(m)
+    assert out.flags == P.FLAG_MORE and out.data == b"abcd"
+
+
+def test_flags_default_zero_everywhere():
+    # Old-protocol interop: a sender that never sets flags produces
+    # byte-identical frames to the pre-capability codec.
+    for mtype, schema in P._SCHEMAS.items():
+        if P.VALID_FLAGS.get(mtype):
+            continue
+        msg = P.Message(mtype, {k: {
+            "q": 1, "Q": 2, "I": 3, "B": 1, "d": 1.0, "s": "x"
+        }[fmt] for k, fmt in schema})
+        assert roundtrip(msg).flags == 0
+
+
+def test_undeclared_flags_rejected_at_pack():
+    # A typo'd or un-negotiated bit must fail at the SENDER, not surface
+    # as peer misbehavior.
+    with pytest.raises(OcmProtocolError, match="flags"):
+        P.pack(P.Message(
+            P.MsgType.DATA_GET,
+            {"alloc_id": 1, "offset": 0, "nbytes": 4},
+            flags=P.FLAG_MORE,  # FLAG_MORE is a DATA_PUT bit
+        ))
+    with pytest.raises(OcmProtocolError, match="flags"):
+        P.pack(P.Message(P.MsgType.CONNECT, {"pid": 1, "rank": 0},
+                         flags=0x8000))
+
+
+def test_unknown_flags_tolerated_on_unpack():
+    # Receivers stay tolerant: a future sender's unknown bit decodes and
+    # is exposed as-is (the receiver acts only on bits it knows).
+    b = bytearray(P.pack(P.Message(P.MsgType.STATUS, {})))
+    b[6] = 0xFF  # low byte of the header's flags u16
+    out = P.unpack(bytes(b[: P.HEADER.size]), bytes(b[P.HEADER.size:]))
+    assert out.flags == 0xFF
+
+
 def test_unpack_fuzz_never_crashes():
     # Arbitrary garbage must surface as OcmProtocolError (or parse cleanly),
     # never as an unhandled exception — the wire is untrusted input.
