@@ -757,6 +757,9 @@ def bench_dcn(errors: dict) -> dict:
             "single_get_gbps": round(r["single_get_gbps"], 3),
             "striped_put_gbps": round(r["striped_put_gbps"], 3),
             "striped_get_gbps": round(r["striped_get_gbps"], 3),
+            # Unit break vs rounds <= r5: dcn gbps keys were gigaBYTES/s
+            # there; unified on gigabits/s with every other gbps key.
+            "unit": r.get("unit", "Gbit/s"),
             "best": r["best"],
             "cells": r["cells"],
             "nbytes": r["nbytes"],

@@ -23,6 +23,13 @@ Rules (each suppressible per-line with ``# ocm-lint: allow[<rule>]``):
     ``print``/``time.*`` silently run once at trace, not per step. Also
     flags in-place subscript stores to traced parameters.
 
+``printd-eager-format``
+    An f-string, ``%``-formatted string, or ``.format()`` call passed to
+    ``printd``: the formatting runs EVERY call, even with ``OCM_VERBOSE``
+    unset — on hot paths that is work (repr of arrays, string building)
+    done purely to be thrown away. Pass lazy logging args instead:
+    ``printd("x=%d", x)``.
+
 The scanner is deliberately lexical: it prefers a small number of
 high-confidence findings plus an explicit suppression comment over a
 whole-program points-to analysis.
@@ -260,6 +267,58 @@ class _SwallowChecker(_FuncStack):
         self.generic_visit(node)
 
 
+class _PrintdFormatChecker(_FuncStack):
+    """printd-eager-format."""
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__()
+        self.path = path
+        self.lines = lines
+        self.findings: list[Finding] = []
+
+    def _eager_desc(self, arg: ast.expr) -> str | None:
+        if isinstance(arg, ast.JoinedStr):
+            return "an f-string"
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod):
+            # "..." % x (or an f-string on the left — doubly eager).
+            if isinstance(arg.left, (ast.Constant, ast.JoinedStr)) and (
+                not isinstance(arg.left, ast.Constant)
+                or isinstance(arg.left.value, str)
+            ):
+                return "a %-formatted string"
+            return None
+        if (
+            isinstance(arg, ast.Call)
+            and isinstance(arg.func, ast.Attribute)
+            and arg.func.attr == "format"
+        ):
+            return "a .format() call"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name == "printd" and node.args:
+            desc = self._eager_desc(node.args[0])
+            if desc is not None and not _suppressed(
+                self.lines, node.lineno, "printd-eager-format"
+            ):
+                self.findings.append(Finding(
+                    rule="printd-eager-format",
+                    path=self.path,
+                    line=node.lineno,
+                    symbol=self.symbol,
+                    message=(
+                        f"{desc} passed to printd formats even when "
+                        "OCM_VERBOSE is unset — use lazy logging args "
+                        '(printd("x=%d", x))'
+                    ),
+                ))
+        self.generic_visit(node)
+
+
 def _jit_decorated(node: ast.AST) -> bool:
     """Is this def decorated @jax.jit / @jit / @partial(jax.jit, ...)?"""
     for dec in getattr(node, "decorator_list", []):
@@ -384,6 +443,7 @@ def lint_source(source: str, path: str) -> list[Finding]:
         _LockScopeChecker(path, lines),
         _SwallowChecker(path, lines),
         _JitPurityChecker(path, lines, tree),
+        _PrintdFormatChecker(path, lines),
     ]
     findings: list[Finding] = []
     for c in checkers:

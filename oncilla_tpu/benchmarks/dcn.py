@@ -147,8 +147,12 @@ def _timed_roundtrip(
     finally:
         client.close()
     return {
-        "put_gbps": nbytes / min(put_s) / 1e9,
-        "get_gbps": nbytes / min(get_s) / 1e9,
+        # gigaBITS/s: the unit every `gbps` key reports (Tracer's
+        # note_transfer / snapshot and the STATUS JSON were unified on
+        # it; this bench used to emit gigaBYTES under the same key).
+        "put_gbps": nbytes * 8 / min(put_s) / 1e9,
+        "get_gbps": nbytes * 8 / min(get_s) / 1e9,
+        "unit": "Gbit/s",
         "verified": ok,
     }
 
@@ -163,7 +167,7 @@ def dcn_loopback_bench(
     adaptive: bool = True,
 ) -> dict:
     """Timed put/get of a ``nbytes`` REMOTE_HOST region through two live
-    daemon PROCESSES (loopback). Returns GB/s per direction (best of
+    daemon PROCESSES (loopback). Returns Gbit/s per direction (best of
     ``iters``) plus the verified-roundtrip flag. ``stripes=1`` selects
     the original single-stream engine (the OCM_DCN_STRIPES=1 path)."""
     cfg = _make_cfg(nbytes, chunk_bytes, inflight, stripes, adaptive)
@@ -215,6 +219,7 @@ def dcn_stripe_sweep(
     return {
         "nbytes": nbytes,
         "native_daemons": native,
+        "unit": "Gbit/s",
         "cells": cells,
         "best": best_key,
         "put_gbps": best["put_gbps"],

@@ -103,7 +103,7 @@ def _read_amortized_gbps(
         # abort the sweep and discard the points already measured (e.g. an
         # HBM OOM compiling the k-unrolled loop against a >2 GiB arena).
         errors[f"amortized:{nbytes}"] = f"{type(exc).__name__}: {exc}"
-        printd(f"amortized read leg failed at {nbytes} B: {exc!r}")
+        printd("amortized read leg failed at %d B: %r", nbytes, exc)
         return None
 
 

@@ -442,12 +442,35 @@ class Ocm:
     # -- introspection (oncillamem.h parity) ----------------------------
 
     def status(self, rank: int | None = None) -> dict:
-        """Live daemon status (rank, nnodes, live_allocs, bytes live) —
-        the STATUS endpoint. On the rank-0 master ``nnodes`` is the JOINED
-        count; poll it before depending on remote placement (a
-        still-joining cluster demotes remote requests, alloc.c:82-83)."""
+        """Live daemon status (rank, nnodes, live_allocs, bytes live,
+        lease/heartbeat health under ``leases``) — the STATUS endpoint.
+        On the rank-0 master ``nnodes`` is the JOINED count; poll it
+        before depending on remote placement (a still-joining cluster
+        demotes remote requests, alloc.c:82-83)."""
         backend = self._remote_or_raise("status")
         return backend.status(rank)
+
+    def export_trace(self, path: str, cluster: bool = True) -> dict:
+        """Write a Perfetto/Chrome-trace JSON merging this process's
+        event journal (``OCM_EVENTS=1``) with — when ``cluster`` and a
+        control plane is attached — every reachable daemon's journal
+        (STATUS_EVENTS), trace_ids stitched as flows across pid tracks.
+        Returns the exporter summary ({events, spans, tracks, flows})."""
+        from oncilla_tpu.obs import export, journal
+
+        streams = [journal.events()]
+        backend = self._remote
+        fetch = getattr(backend, "fetch_events", None)
+        if cluster and fetch is not None:
+            nnodes = len(getattr(backend, "entries", []) or [])
+            for rank in range(nnodes):
+                try:
+                    streams.append(fetch(rank))
+                except Exception as e:  # noqa: BLE001 — merge survivors;
+                    # a down daemon must not void the local journal
+                    printd("export_trace: rank %d journal unavailable: %s",
+                           rank, e)
+        return export.write_chrome_trace(export.merge(*streams), path)
 
     @staticmethod
     def is_remote(handle: OcmAlloc) -> bool:

@@ -1,0 +1,251 @@
+"""``python -m oncilla_tpu.obs`` — the cluster observability CLI.
+
+Polls every daemon in the membership table over the ordinary control
+port (STATUS / STATUS_PROM / STATUS_EVENTS — observability is in-band,
+no extra listener) and renders:
+
+- the default **cluster table**: per-rank op counts, p50/p99 serve
+  latency, recent data-plane Gbit/s, live bytes, and lease pressure
+  (renewals / reaper reclaims / expired / oldest heartbeat age);
+- ``--prom <rank>``: that rank's Prometheus text exposition, for piping
+  into a pushgateway or eyeballing a scrape;
+- ``--trace out.json``: every rank's event journal (plus any local
+  ``--journal`` JSONL files) merged into one Perfetto/Chrome-trace JSON
+  with cross-process flows stitched by trace_id;
+- ``--smoke``: a self-contained end-to-end proof on an in-process
+  cluster (put/get under journaling, export, validate ≥1 cross-track
+  flow) — the CI stage in scripts/check.sh.
+
+Membership comes from ``--nodefile`` or ``$OCM_NODEFILE`` (the same file
+the daemons were started with).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+
+from oncilla_tpu.obs import export
+
+
+def _rank_request(entry, msg):
+    from oncilla_tpu.runtime.protocol import request
+
+    s = socket.create_connection(
+        (entry.connect_host, entry.port), timeout=10.0
+    )
+    try:
+        return request(s, msg)
+    finally:
+        s.close()
+
+
+def _poll_status(entry) -> dict | None:
+    from oncilla_tpu.runtime.protocol import Message, MsgType
+
+    try:
+        r = _rank_request(entry, Message(MsgType.STATUS, {}))
+    except Exception as e:  # noqa: BLE001 — a down daemon is a table row,
+        return {"error": f"{type(e).__name__}: {e}"}  # not a CLI crash
+    f = dict(r.fields)
+    if r.data:
+        try:
+            f.update(json.loads(bytes(r.data)))
+        except (ValueError, UnicodeDecodeError):
+            pass
+    return f
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _table(entries) -> int:
+    cols = ["rank", "nodes", "allocs", "live", "ops", "p50_us", "p99_us",
+            "gbit/s", "leases r/x/e", "hb_age_s"]
+    rows = []
+    any_ok = False
+    for e in entries:
+        st = _poll_status(e)
+        if "error" in st:
+            rows.append([str(e.rank), "-", "-", "-", "-", "-", "-", "-",
+                         "-", st["error"][:40]])
+            continue
+        any_ok = True
+        ops = (st.get("dcn") or {}).get("ops") or {}
+        count = sum(v.get("count", 0) for v in ops.values())
+        p50 = max((v.get("p50_us", 0.0) for v in ops.values()), default=0.0)
+        p99 = max((v.get("p99_us", 0.0) for v in ops.values()), default=0.0)
+        transfers = (st.get("dcn") or {}).get("transfers") or []
+        gbps = transfers[-1].get("gbps", 0.0) if transfers else 0.0
+        leases = st.get("leases") or {}
+        apps = leases.get("apps") or {}
+        rows.append([
+            str(st.get("rank", e.rank)),
+            str(st.get("nnodes", "-")),
+            str(st.get("live_allocs", 0)),
+            _fmt_bytes(st.get("host_bytes_live", 0)
+                       + st.get("device_bytes_live", 0)),
+            str(count),
+            f"{p50:.0f}",
+            f"{p99:.0f}",
+            f"{gbps:.2f}",
+            (f"{leases.get('renewals', 0)}/{leases.get('reclaims', 0)}"
+             f"/{leases.get('expired', 0)}"),
+            f"{max(apps.values()):.1f}" if apps else "-",
+        ])
+    widths = [
+        max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+        for i, c in enumerate(cols)
+    ]
+    print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
+    for r in rows:
+        print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    return 0 if any_ok else 1
+
+
+def _prom(entries, rank: int) -> int:
+    from oncilla_tpu.runtime.protocol import Message, MsgType
+
+    if not 0 <= rank < len(entries):
+        print(f"rank {rank} not in the {len(entries)}-node membership",
+              file=sys.stderr)
+        return 2
+    r = _rank_request(entries[rank], Message(MsgType.STATUS_PROM, {}))
+    sys.stdout.write(bytes(r.data).decode("utf-8"))
+    return 0
+
+
+def _trace(entries, out_path: str, journal_files: list[str]) -> int:
+    from oncilla_tpu.obs import journal
+    from oncilla_tpu.runtime.protocol import Message, MsgType
+
+    streams: list[list[dict]] = [journal.events()]
+    for path in journal_files:
+        streams.append(journal.load_jsonl(path))
+    polled = 0
+    for e in entries:
+        try:
+            r = _rank_request(e, Message(MsgType.STATUS_EVENTS, {}))
+        except Exception as exc:  # noqa: BLE001 — keep merging survivors
+            print(f"rank {e.rank}: journal unavailable "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+            continue
+        polled += 1
+        streams.append([
+            json.loads(line)
+            for line in bytes(r.data).decode("utf-8").splitlines()
+            if line.strip()
+        ])
+    merged = export.merge(*streams)
+    summary = export.write_chrome_trace(merged, out_path)
+    print(f"{out_path}: {summary['spans']} spans on {summary['tracks']} "
+          f"tracks, {summary['flows']} cross-track flow(s), "
+          f"{summary['events']} events from {polled} daemon(s) + "
+          f"{len(journal_files)} file(s)")
+    return 0 if merged else 1
+
+
+def _smoke() -> int:
+    """End-to-end proof with no external cluster: put/get over an
+    in-process 2-daemon cluster under journaling, export the merged
+    trace, and validate the JSON parses with ≥1 cross-track flow."""
+    import tempfile
+
+    import numpy as np
+
+    from oncilla_tpu.obs import journal
+    from oncilla_tpu.runtime.cluster import local_cluster
+    from oncilla_tpu.utils.config import OcmConfig
+
+    was_journaling = journal.enabled()
+    journal.set_enabled(True)
+    cfg = OcmConfig(
+        host_arena_bytes=8 << 20, device_arena_bytes=1 << 20,
+        chunk_bytes=256 << 10, dcn_stripes=2,
+        dcn_stripe_min_bytes=256 << 10, heartbeat_s=5.0,
+    )
+    try:
+        with local_cluster(2, config=cfg) as c:
+            ctx = c.context(0, heartbeat=False)
+            from oncilla_tpu.core.kinds import OcmKind
+
+            h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+            try:
+                data = np.arange(1 << 20, dtype=np.uint8)
+                ctx.put(h, data)
+                got = np.asarray(ctx.get(h))
+            finally:
+                ctx.free(h)
+            if not np.array_equal(got, data):
+                print("obs smoke: put/get roundtrip mismatch",
+                      file=sys.stderr)
+                return 1
+    finally:
+        journal.set_enabled(was_journaling)
+    with tempfile.NamedTemporaryFile(
+        "r", suffix=".trace.json", delete=False
+    ) as tf:
+        out_path = tf.name
+    summary = export.write_chrome_trace(export.merge(journal.events()),
+                                        out_path)
+    with open(out_path, encoding="utf-8") as fh:
+        trace = json.load(fh)  # must parse as Chrome-trace JSON
+    ok = (
+        isinstance(trace.get("traceEvents"), list)
+        and summary["spans"] > 0
+        and summary["tracks"] >= 2
+        and summary["flows"] >= 1
+    )
+    print(f"obs smoke: {summary['spans']} spans, {summary['tracks']} "
+          f"tracks, {summary['flows']} cross-track flow(s) -> "
+          f"{'OK' if ok else 'FAILED'} ({out_path})")
+    os.unlink(out_path)
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m oncilla_tpu.obs",
+        description="oncilla-tpu cluster observability",
+    )
+    ap.add_argument("--nodefile", default=None,
+                    help="membership nodefile (default: $OCM_NODEFILE)")
+    ap.add_argument("--prom", type=int, metavar="RANK", default=None,
+                    help="print RANK's Prometheus text exposition")
+    ap.add_argument("--trace", metavar="OUT", default=None,
+                    help="write the merged Perfetto/Chrome trace JSON")
+    ap.add_argument("--journal", action="append", default=[],
+                    metavar="FILE",
+                    help="extra local journal JSONL file(s) to merge "
+                         "into --trace")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-contained end-to-end validation "
+                         "(in-process cluster; ignores --nodefile)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke()
+
+    nodefile = args.nodefile or os.environ.get("OCM_NODEFILE")
+    if not nodefile:
+        ap.error("--nodefile (or $OCM_NODEFILE) is required")
+    from oncilla_tpu.runtime.membership import parse_nodefile
+
+    entries = parse_nodefile(nodefile)
+    if args.prom is not None:
+        return _prom(entries, args.prom)
+    if args.trace is not None:
+        return _trace(entries, args.trace, args.journal)
+    return _table(entries)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""Bounded per-process structured event journal (``OCM_EVENTS=1``).
+
+A ring of small dict events — spans, lease renewals/reclaims, stripe
+retries, tuner window changes, slow-op flags — each stamped with both
+wall-clock (``ts``, seconds since the epoch; what exporters align
+processes on) and monotonic (``mono``; what in-process ordering and
+latency math should use), plus the recording thread. The ring is capped
+(``OCM_EVENTS_CAP``, default 8192 events) so an always-on journal can
+never grow a long-lived daemon without bound: old events fall off, which
+for a flight recorder is the point.
+
+Events never leave the process on their own; exporters pull them — the
+``python -m oncilla_tpu.obs`` CLI over the STATUS_EVENTS protocol
+request, or :func:`dump_jsonl` to a file for offline merging.
+
+Stdlib-only on purpose (see ``obs/__init__``): ``utils.debug`` imports
+this at module level, possibly mid-package-import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+
+_ENABLED = os.environ.get("OCM_EVENTS", "") not in ("", "0")
+_CAP = int(os.environ.get("OCM_EVENTS_CAP", "") or 8192)
+
+# Journal identity: exporters merging event streams from several sources
+# must drop duplicates when two sources turn out to be the SAME journal
+# (the in-process test cluster serves its daemons' STATUS_EVENTS from the
+# one ring the client also reads). (jid, seq) is that identity.
+_JID = f"{os.getpid():x}-{random.Random(os.urandom(4)).getrandbits(32):08x}"
+
+_lock = threading.Lock()
+_ring: "deque[dict]" = deque(maxlen=_CAP)
+_seq = 0
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """Test hook / programmatic enable (the env var is read at import)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def record(ev: str, *, force: bool = False, **fields) -> None:
+    """Append one event when journaling is on. ``force`` records even
+    with journaling off — the slow-op watchdog's channel, which must not
+    require ``OCM_EVENTS`` to be useful."""
+    global _seq
+    if not (_ENABLED or force):
+        return
+    t = threading.current_thread()
+    rec = {
+        "ev": ev,
+        "ts": time.time(),
+        "mono": time.monotonic(),
+        "pid": os.getpid(),
+        "tid": t.ident or 0,
+        "thread": t.name,
+        **fields,
+    }
+    with _lock:
+        _seq += 1
+        rec["jid"] = _JID
+        rec["seq"] = _seq
+        _ring.append(rec)
+
+
+def events() -> list[dict]:
+    """Snapshot copy of the ring (oldest first)."""
+    with _lock:
+        return list(_ring)
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()
+
+
+def dump_jsonl(evts: list[dict] | None = None) -> str:
+    """The ring (or an explicit event list) as JSONL text."""
+    evts = events() if evts is None else evts
+    return "".join(
+        json.dumps(e, separators=(",", ":"), default=str) + "\n" for e in evts
+    )
+
+
+def dump(path: str) -> int:
+    """Write the ring to ``path`` as JSONL; returns the event count."""
+    evts = events()
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(dump_jsonl(evts))
+    return len(evts)
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Read one journal file back (blank lines tolerated; a malformed
+    line raises — a corrupt journal must not silently drop evidence)."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,131 @@
+"""Prometheus text exposition of one daemon's metrics.
+
+Renders the dict ``Daemon._metrics_meta()`` builds — Tracer op counters,
+the DCN transfer ring, arena occupancy, live-alloc and lease health —
+in the text format (version 0.0.4) standard scrapers parse: one
+``# HELP``/``# TYPE`` pair per family, then its samples, no duplicate
+series. Served in-band through the STATUS_PROM protocol request (no
+extra listening port on the daemon); ``python -m oncilla_tpu.obs
+--prom <rank>`` is the scrape-side shim.
+
+Every series carries a ``rank`` label so a scraper federating several
+daemons through one relabeling path keeps them apart.
+"""
+
+from __future__ import annotations
+
+_ESC = str.maketrans({"\\": r"\\", '"': r'\"', "\n": r"\n"})
+
+
+def _label(**labels: object) -> str:
+    inner = ",".join(
+        f'{k}="{str(v).translate(_ESC)}"' for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
+def _num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _Doc:
+    """Accumulates samples per family; :meth:`text` renders each family
+    as one HELP line, one TYPE line, then ALL its samples consecutively —
+    the format forbids interleaving a family's samples with another's,
+    so grouping is deferred to render time."""
+
+    def __init__(self) -> None:
+        # family -> (kind, help, [sample lines]); insertion-ordered.
+        self._fams: dict[str, tuple[str, str, list[str]]] = {}
+
+    def sample(self, family: str, kind: str, help_: str,
+               value: float, **labels: object) -> None:
+        fam = self._fams.get(family)
+        if fam is None:
+            fam = self._fams[family] = (kind, help_, [])
+        fam[2].append(f"{family}{_label(**labels)} {_num(value)}")
+
+    def text(self) -> str:
+        lines: list[str] = []
+        for family, (kind, help_, samples) in self._fams.items():
+            lines.append(f"# HELP {family} {help_}")
+            lines.append(f"# TYPE {family} {kind}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+def render(meta: dict) -> str:
+    rank = meta.get("rank", 0)
+    doc = _Doc()
+    doc.sample("ocm_nnodes", "gauge", "Cluster size as this daemon sees it.",
+               meta.get("nnodes", 0), rank=rank)
+    doc.sample("ocm_live_allocs", "gauge",
+               "Live allocations registered on this daemon.",
+               meta.get("live_allocs", 0), rank=rank)
+
+    for op, st in sorted(meta.get("ops", {}).items()):
+        doc.sample("ocm_op_total", "counter",
+                   "Completed Tracer spans per op.",
+                   st.get("count", 0), rank=rank, op=op)
+        doc.sample("ocm_op_bytes_total", "counter",
+                   "Bytes moved by completed spans per op.",
+                   st.get("total_bytes", 0), rank=rank, op=op)
+        doc.sample("ocm_op_p50_seconds", "gauge",
+                   "p50 span latency over the sample ring.",
+                   st.get("p50_us", 0.0) / 1e6, rank=rank, op=op)
+        doc.sample("ocm_op_p99_seconds", "gauge",
+                   "p99 span latency over the sample ring.",
+                   st.get("p99_us", 0.0) / 1e6, rank=rank, op=op)
+        doc.sample("ocm_op_gigabits_per_second", "gauge",
+                   "Lifetime mean throughput per op (gigabits/s).",
+                   st.get("gbps", 0.0), rank=rank, op=op)
+
+    arena = meta.get("host_arena", {})
+    doc.sample("ocm_arena_live_bytes", "gauge",
+               "Bytes currently reserved in an arena.",
+               arena.get("live_bytes", 0), rank=rank, arena="host")
+    doc.sample("ocm_arena_capacity_bytes", "gauge",
+               "Arena capacity in bytes.",
+               arena.get("capacity_bytes", 0), rank=rank, arena="host")
+    for i, book in enumerate(meta.get("device_books", [])):
+        doc.sample("ocm_arena_live_bytes", "gauge",
+                   "Bytes currently reserved in an arena.",
+                   book.get("live_bytes", 0), rank=rank, arena=f"device{i}")
+        doc.sample("ocm_arena_capacity_bytes", "gauge",
+                   "Arena capacity in bytes.",
+                   book.get("capacity_bytes", 0),
+                   rank=rank, arena=f"device{i}")
+
+    leases = meta.get("leases", {})
+    doc.sample("ocm_lease_renewals_total", "counter",
+               "Heartbeat-driven lease renewals processed.",
+               leases.get("renewals", 0), rank=rank)
+    doc.sample("ocm_lease_reclaims_total", "counter",
+               "Allocations the lease reaper took back.",
+               leases.get("reclaims", 0), rank=rank)
+    doc.sample("ocm_leases_expired", "gauge",
+               "Live allocations currently past their lease.",
+               leases.get("expired", 0), rank=rank)
+    for app, age_s in sorted(leases.get("apps", {}).items()):
+        doc.sample("ocm_app_heartbeat_age_seconds", "gauge",
+                   "Seconds since an app's last heartbeat.",
+                   age_s, rank=rank, app=app)
+
+    # The transfer ring is bounded, so ring-derived figures are gauges
+    # over the recent window, never counters.
+    transfers = meta.get("transfers", [])
+    by_op: dict[str, list[dict]] = {}
+    for t in transfers:
+        by_op.setdefault(str(t.get("op", "?")), []).append(t)
+    for op, recs in sorted(by_op.items()):
+        doc.sample("ocm_transfer_recent_gigabits_per_second", "gauge",
+                   "Throughput of the most recent transfer (gigabits/s).",
+                   recs[-1].get("gbps", 0.0), rank=rank, op=op)
+        doc.sample("ocm_transfer_recent_retries", "gauge",
+                   "Stripe retries across the recent-transfer ring.",
+                   sum(r.get("retries", 0) for r in recs), rank=rank, op=op)
+        doc.sample("ocm_transfer_recent_bytes", "gauge",
+                   "Bytes moved across the recent-transfer ring.",
+                   sum(r.get("bytes", 0) for r in recs), rank=rank, op=op)
+    return doc.text()

@@ -35,11 +35,16 @@ from oncilla_tpu.core.errors import (
 )
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import Fabric, OcmKind
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.obs import trace as obs_trace
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_TRACE,
     FLAG_MORE,
+    FLAG_TRACE_CTX,
+    VALID_FLAGS,
     WIRE_KIND,
     WIRE_KIND_INV,
     Message,
@@ -212,6 +217,7 @@ class _PeerTuner:
         if not self.adaptive or rtt_p50_s <= 0:
             return
         with self._lock:
+            prev = (self._window, self._chunk)
             if achieved_bps > 0:
                 per_chunk_s = self._chunk / achieved_bps
                 want = round(rtt_p50_s / per_chunk_s) + 1
@@ -221,6 +227,14 @@ class _PeerTuner:
                 self._chunk *= 2
             elif rtt_p50_s > 0.25 and self._chunk // 2 >= self.MIN_CHUNK:
                 self._chunk //= 2
+            cur = (self._window, self._chunk)
+        if cur != prev:
+            obs_journal.record(
+                "tuner_window",
+                window=cur[0], chunk_bytes=cur[1],
+                prev_window=prev[0], prev_chunk_bytes=prev[1],
+                rtt_p50_us=round(rtt_p50_s * 1e6, 1),
+            )
 
 
 class ControlPlaneClient:
@@ -272,10 +286,18 @@ class ControlPlaneClient:
         self._dcn_caps: dict[tuple[str, int], int] = {}
         self._dcn_tuners: dict[tuple[str, int], _PeerTuner] = {}
         self._dcn_lock = make_lock("client._dcn_lock")
-        # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132).
-        r = self._request(Message(MsgType.CONNECT, {"pid": self.pid, "rank": rank}))
+        # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132), offering
+        # the trace capability: granted bits gate whether _request may
+        # prefix trace context on this ctrl stream. Must be 0 while the
+        # handshake itself is in flight.
+        self._ctrl_caps = 0
+        r = self._request(Message(
+            MsgType.CONNECT, {"pid": self.pid, "rank": rank},
+            flags=FLAG_CAP_TRACE if self.config.trace else 0,
+        ))
         if r.type != MsgType.CONNECT_CONFIRM:
             raise OcmConnectError(f"bad handshake reply {r.type.name}")
+        self._ctrl_caps = r.flags & FLAG_CAP_TRACE
         self.nnodes = r.fields["nnodes"]
         self._plane_server: _PlaneServer | None = None
         if ici_plane is not None and serve_plane:
@@ -298,6 +320,21 @@ class ControlPlaneClient:
     # -- plumbing --------------------------------------------------------
 
     def _request(self, msg: Message) -> Message:
+        # Trace propagation: an ambient span context (Ocm.put/get/alloc
+        # wrap ops in Tracer.span) rides the request as a 16-byte data
+        # prefix — only on types the wire declares traceable and only
+        # after the daemon granted FLAG_CAP_TRACE at CONNECT. Attach to a
+        # shallow copy so a caller-retained Message is never mutated.
+        ctx = obs_trace.current()
+        if (
+            ctx is not None
+            and self._ctrl_caps & FLAG_CAP_TRACE
+            and VALID_FLAGS.get(msg.type, 0) & FLAG_TRACE_CTX
+        ):
+            msg = obs_trace.attach(
+                Message(msg.type, msg.fields, msg.data, msg.flags),
+                ctx, FLAG_TRACE_CTX,
+            )
         # Held across the round-trip on purpose: the ctrl socket IS the
         # serialized resource (one framed request/reply stream to the
         # local daemon), and _ctrl_lock's only job is that framing. It is
@@ -498,23 +535,28 @@ class ControlPlaneClient:
     def _dcn_caps_for(self, addr: tuple[str, int], sock) -> int:
         """Negotiated capability bits for the daemon at ``addr``, probed
         once per address on the first leased data socket: a CONNECT
-        offering FLAG_CAP_COALESCE; the reply's echoed bits are what the
-        peer grants. Old Python daemons and the unmodified C++ daemon
-        reply with flags=0 — the probe is how the new client discovers it
-        must stay on the lockstep one-ACK-per-chunk protocol."""
+        offering FLAG_CAP_COALESCE and/or FLAG_CAP_TRACE (each gated by
+        config); the reply's echoed bits are what the peer grants. Old
+        Python daemons and the unmodified C++ daemon reply with flags=0 —
+        the probe is how the new client discovers it must stay on the
+        lockstep one-ACK-per-chunk protocol and ship plain untraced
+        frames."""
         with self._dcn_lock:
             caps = self._dcn_caps.get(addr)
         if caps is not None:
             return caps
-        if not self.config.dcn_coalesce:
-            caps = 0  # capability never offered: lockstep by configuration
+        offer = (FLAG_CAP_COALESCE if self.config.dcn_coalesce else 0) | (
+            FLAG_CAP_TRACE if self.config.trace else 0
+        )
+        if not offer:
+            caps = 0  # nothing to negotiate: lockstep by configuration
         else:
             r = request(sock, Message(
                 MsgType.CONNECT, {"pid": self.pid, "rank": self.rank},
-                flags=FLAG_CAP_COALESCE,
+                flags=offer,
             ))
             caps = (
-                r.flags & FLAG_CAP_COALESCE
+                r.flags & offer
                 if r.type == MsgType.CONNECT_CONFIRM else 0
             )
         with self._dcn_lock:
@@ -584,12 +626,17 @@ class ControlPlaneClient:
             ranges.append((start, length))
             start += length
         errors: list[BaseException | None] = [None] * nstripes
+        # The ambient trace context is thread-local; stripe workers run
+        # in fresh threads, so carry it across explicitly or stripes
+        # 1..N would ship untraced chunks.
+        tctx = obs_trace.current()
 
         def worker(i: int) -> None:
             s0, ln = ranges[i]
             try:
-                self._stripe_run(handle, s0, ln, offset, put_mv, get_arr,
-                                 addr, entries[i], stats, i)
+                with obs_trace.use_ctx(tctx):
+                    self._stripe_run(handle, s0, ln, offset, put_mv,
+                                     get_arr, addr, entries[i], stats, i)
             except BaseException as exc:  # noqa: BLE001 — re-raised below
                 errors[i] = exc
 
@@ -636,6 +683,11 @@ class ControlPlaneClient:
             e = self.entries[handle.rank]
             handle.owner_addr = (e.connect_host, e.port)
             stats["retries"][idx] += 1
+            obs_journal.record(
+                "stripe_retry",
+                stripe=idx, alloc_id=handle.alloc_id, owner_rank=handle.rank,
+                nbytes=length, error=f"{type(err).__name__}: {err}",
+            )
             printd("retrying stripe %d via membership address %s:%d",
                    idx, e.connect_host, e.port)
             self._stripe_once(handle, start, length, offset, put_mv,
@@ -667,17 +719,20 @@ class ControlPlaneClient:
             and length > chunk  # a single-chunk burst is already one ACK
         )
         stats["coalesced"][idx] = coalesce
+        # Ambient trace context rides this stripe's requests only when
+        # the owner daemon granted FLAG_CAP_TRACE at the probe.
+        tctx = obs_trace.current() if caps & FLAG_CAP_TRACE else None
         t0 = time.perf_counter()
         rtts: list[float] = []
         try:
             if coalesce:
                 self._stripe_put_coalesced(
-                    s, handle, start, length, offset, put_mv, chunk
+                    s, handle, start, length, offset, put_mv, chunk, tctx
                 )
             else:
                 self._stripe_windowed(
                     s, handle, start, length, offset, put_mv, get_arr,
-                    chunk, window, rtts,
+                    chunk, window, rtts, tctx,
                 )
         except OcmRemoteError:
             # Typed peer rejection, raised only AFTER the reply stream was
@@ -697,20 +752,25 @@ class ControlPlaneClient:
             tuner.observe(rtt_p50, length / dt)
 
     def _stripe_put_coalesced(
-        self, s, handle, start, length, offset, put_mv, chunk,
+        self, s, handle, start, length, offset, put_mv, chunk, tctx=None,
     ) -> None:
         """ACK-coalesced put burst: every chunk but the last carries
         FLAG_MORE, the daemon applies them silently and answers ONCE at
         the final chunk — the stripe streams at TCP speed instead of
         lockstepping a reply per chunk. One reply per burst also means
         the error path stays in sync: a burst ERROR arrives exactly where
-        the single ACK would."""
+        the single ACK would.
+
+        Trace context (``tctx``) rides the burst-CLOSING chunk only: a
+        prefix on every chunk would disqualify each one from the daemon's
+        zero-copy recv-into-arena landing, and one stitched hop per burst
+        is all the exported trace needs."""
         end = start + length
         pos = start
         while pos < end:
             n = min(chunk, end - pos)
             last = pos + n >= end
-            send_msg(s, Message(
+            req = Message(
                 MsgType.DATA_PUT,
                 {
                     "alloc_id": handle.alloc_id,
@@ -719,7 +779,10 @@ class ControlPlaneClient:
                 },
                 put_mv[pos:pos + n],
                 flags=0 if last else FLAG_MORE,
-            ))
+            )
+            if last and tctx is not None:
+                obs_trace.attach(req, tctx, FLAG_TRACE_CTX)
+            send_msg(s, req)
             pos += n
         r = recv_msg(s)
         if r.type == MsgType.ERROR:
@@ -732,14 +795,19 @@ class ControlPlaneClient:
 
     def _stripe_windowed(
         self, s, handle, start, length, offset, put_mv, get_arr,
-        chunk, window, rtts: list[float],
+        chunk, window, rtts: list[float], tctx=None,
     ) -> None:
         """The lockstep-compatible pipelined window over one stripe's
         range [start, start+length): up to ``window`` requests in flight,
         one reply consumed per chunk in FIFO order. Runs against ANY v2
         daemon (it is the pre-capability protocol unchanged) and doubles
         as the get path everywhere — get replies carry the data, so there
-        is nothing to coalesce."""
+        is nothing to coalesce.
+
+        Trace context: every DATA_GET carries it (the request has no
+        payload, so the 16-byte prefix costs nothing); DATA_PUT carries
+        it on the stripe's FINAL chunk only, preserving the body chunks'
+        zero-copy recv-into-arena eligibility at the daemon."""
         window = max(1, window)
         is_put = put_mv is not None
         get_mv = memoryview(get_arr) if get_arr is not None else None
@@ -764,6 +832,8 @@ class ControlPlaneClient:
                         },
                         put_mv[pos:pos + n],
                     )
+                    if tctx is not None and pos + n >= end:
+                        obs_trace.attach(req, tctx, FLAG_TRACE_CTX)
                 else:
                     req = Message(
                         MsgType.DATA_GET,
@@ -773,6 +843,8 @@ class ControlPlaneClient:
                             "nbytes": n,
                         },
                     )
+                    if tctx is not None:
+                        obs_trace.attach(req, tctx, FLAG_TRACE_CTX)
                 send_msg(s, req)
                 inflight.append((pos, n, time.perf_counter()))
                 pos += n
@@ -878,19 +950,40 @@ class ControlPlaneClient:
 
     # -- introspection ---------------------------------------------------
 
-    def status(self, rank: int | None = None) -> dict:
+    def _rank_request(self, rank: int | None, msg: Message) -> Message:
+        """One STATUS-family request to a rank's daemon: the ctrl stream
+        for the local rank, a short-lived direct dial otherwise."""
         if rank is None or rank == self.rank:
-            return self._status_fields(
-                self._request(Message(MsgType.STATUS, {}))
-            )
+            return self._request(msg)
         e = self.entries[rank]
         s = socket.create_connection((e.connect_host, e.port), timeout=30.0)
         try:
-            return self._status_fields(
-                request(s, Message(MsgType.STATUS, {}))
-            )
+            return request(s, msg)
         finally:
             s.close()
+
+    def status(self, rank: int | None = None) -> dict:
+        return self._status_fields(
+            self._rank_request(rank, Message(MsgType.STATUS, {}))
+        )
+
+    def fetch_prom(self, rank: int | None = None) -> str:
+        """A rank's Prometheus text exposition (STATUS_PROM), served
+        in-band — no scrape port to open on the daemon."""
+        r = self._rank_request(rank, Message(MsgType.STATUS_PROM, {}))
+        return bytes(r.data).decode("utf-8")
+
+    def fetch_events(self, rank: int | None = None) -> list[dict]:
+        """A rank's journal ring (STATUS_EVENTS) as a list of event
+        dicts — what trace exporters merge across the cluster."""
+        import json
+
+        r = self._rank_request(rank, Message(MsgType.STATUS_EVENTS, {}))
+        return [
+            json.loads(line)
+            for line in bytes(r.data).decode("utf-8").splitlines()
+            if line.strip()
+        ]
 
     def _status_fields(self, r: Message) -> dict:
         """STATUS_OK fields + data-plane telemetry: the daemon's served-side

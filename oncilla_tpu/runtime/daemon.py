@@ -47,9 +47,14 @@ from oncilla_tpu.runtime.placement import (
     NodeResources,
     Placement,
 )
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.obs import trace as obs_trace
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_TRACE,
     FLAG_MORE,
+    FLAG_TRACE_CTX,
+    VALID_FLAGS,
     WIRE_KIND,
     WIRE_KIND_INV,
     ErrCode,
@@ -130,8 +135,17 @@ class Daemon:
         # Served data-plane telemetry: per-op stats plus the per-transfer
         # ring (bytes/Gbps of each coalesced burst), surfaced as the JSON
         # data tail of STATUS_OK — trailing data on a reply is invisible
-        # to old clients, so the schema stays v2-compatible.
-        self.tracer = Tracer()
+        # to old clients, so the schema stays v2-compatible. The track
+        # label keys this daemon's timeline in exported traces (one test
+        # process hosts many daemons; pid alone cannot tell them apart).
+        self.tracer = Tracer(track=f"daemon-r{self.rank}")
+        # Trace-capability bits per peer address, probed lazily with a
+        # CONNECT on the first forwarded hop that has a context to carry
+        # (the client-side _dcn_caps precedent) — a capability is a
+        # property of the peer's software, not of one connection, so one
+        # probe covers every pooled socket to that address.
+        self._peer_caps: dict[tuple[str, int], int] = {}
+        self._peer_caps_lock = make_lock("daemon._peer_caps_lock")
         # Per-serve-thread reusable DATA_GET_OK snapshot buffer: a fresh
         # bytes() per 16 MiB chunk costs an allocation + page faults each
         # time (measured ~4x the warm-copy cost); each connection has its
@@ -413,6 +427,17 @@ class Daemon:
                         printd("daemon %d: dropping conn on malformed "
                                "input: %s", self.rank, e)
                     return
+                # Inbound trace context: a FLAG_TRACE_CTX request carries
+                # a 16-byte context prefix on its data tail. Strip it
+                # BEFORE any length-validating handler sees the payload,
+                # and install it around dispatch so this daemon's serve
+                # spans (and any hop it forwards) join the client's trace.
+                tctx = None
+                if msg.flags & FLAG_TRACE_CTX:
+                    tctx, rest = obs_trace.split(msg.data)
+                    if tctx is not None:
+                        msg.data = rest
+                        msg.flags &= ~FLAG_TRACE_CTX
                 is_put = msg.type == MsgType.DATA_PUT
                 if burst_open and not is_put:
                     # A sender may not interleave other requests inside an
@@ -426,7 +451,17 @@ class Daemon:
                 try:
                     if is_put or msg.type == MsgType.DATA_GET:
                         op = "dcn_put_srv" if is_put else "dcn_get_srv"
-                        with self.tracer.span(op, nbytes=msg.fields["nbytes"]):
+                        with obs_trace.use_ctx(tctx), \
+                                self.tracer.span(op,
+                                                 nbytes=msg.fields["nbytes"]):
+                            reply = self._dispatch(msg)
+                    elif tctx is not None:
+                        # A traced control op gets a serve-side span so the
+                        # exported trace shows the daemon hop, not just the
+                        # client's view of the round-trip.
+                        with obs_trace.use_ctx(tctx), \
+                                self.tracer.span(
+                                    "srv_" + msg.type.name.lower()):
                             reply = self._dispatch(msg)
                     else:
                         reply = self._dispatch(msg)
@@ -487,9 +522,67 @@ class Daemon:
                 try:
                     self._do_free_local(e.alloc_id)
                 except OcmInvalidHandle:
-                    pass
+                    continue
+                self.registry.note_reclaim()
+                obs_journal.record(
+                    "lease_reclaim", track=self.tracer.track,
+                    alloc_id=e.alloc_id, nbytes=e.nbytes,
+                    origin_pid=e.origin_pid, origin_rank=e.origin_rank,
+                )
             if self._plane_unsynced:
                 self._sync_plane_endpoint()
+
+    # -- trace-aware peer forwarding -------------------------------------
+
+    def _peer_caps_for(self, host: str, port: int) -> int:
+        """Negotiated capability bits for the daemon at (host, port),
+        probed once per address with a CONNECT offering FLAG_CAP_TRACE.
+        Un-upgraded v2 peers and the native C++ daemon echo flags=0 —
+        decline by silence — and this daemon then never prefixes trace
+        context on hops to them. Probe failures are NOT cached (the peer
+        may simply be restarting); the forwarded request itself will
+        surface the real error."""
+        key = (host, port)
+        with self._peer_caps_lock:
+            caps = self._peer_caps.get(key)
+        if caps is not None:
+            return caps
+        import os as _os
+
+        try:
+            r = self.peers.request(host, port, Message(
+                MsgType.CONNECT,
+                {"pid": _os.getpid(), "rank": self.rank},
+                flags=FLAG_CAP_TRACE,
+            ))
+            caps = (
+                r.flags & FLAG_CAP_TRACE
+                if r.type == MsgType.CONNECT_CONFIRM else 0
+            )
+        except (OSError, OcmError):
+            return 0
+        with self._peer_caps_lock:
+            self._peer_caps[key] = caps
+        return caps
+
+    def _peer_request(self, host: str, port: int, msg: Message) -> Message:
+        """peers.request plus trace propagation: when a trace context is
+        ambient (this request relays a traced serve) and the peer granted
+        FLAG_CAP_TRACE, the context rides the forwarded message — the hop
+        that stitches client span → local daemon span → peer daemon span.
+        Attaches to a shallow copy: relay loops reuse one Message for
+        several peers."""
+        ctx = obs_trace.current()
+        if (
+            ctx is not None
+            and VALID_FLAGS.get(msg.type, 0) & FLAG_TRACE_CTX
+            and self._peer_caps_for(host, port) & FLAG_CAP_TRACE
+        ):
+            msg = obs_trace.attach(
+                Message(msg.type, msg.fields, msg.data, msg.flags),
+                ctx, FLAG_TRACE_CTX,
+            )
+        return self.peers.request(host, port, msg)
 
     # -- dispatch --------------------------------------------------------
 
@@ -512,7 +605,7 @@ class Daemon:
                 "nnodes": self.policy.nnodes if self.rank == 0
                 else len(self.entries),
             },
-            flags=msg.flags & FLAG_CAP_COALESCE,
+            flags=msg.flags & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE),
         )
 
     def _on_disconnect(self, msg: Message) -> Message:
@@ -529,7 +622,7 @@ class Daemon:
                 continue
             e = self.entries[r]
             try:
-                self.peers.request(
+                self._peer_request(
                     e.connect_host, e.port,
                     Message(MsgType.RECLAIM_APP,
                             {"pid": pid, "rank": self.rank}),
@@ -595,7 +688,7 @@ class Daemon:
         f = msg.fields
         if self.rank != 0:
             r0 = self.entries[0]
-            return self.peers.request(r0.connect_host, r0.port, msg)
+            return self._peer_request(r0.connect_host, r0.port, msg)
         kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         nbytes = f["nbytes"]
         placed = self.policy.place(f["orig_rank"], kind, nbytes)
@@ -606,7 +699,7 @@ class Daemon:
                 f["pid"],
             )
         else:
-            r = self.peers.request(
+            r = self._peer_request(
                 owner.connect_host,
                 owner.port,
                 Message(
@@ -687,7 +780,7 @@ class Daemon:
             self._do_free_local(f["alloc_id"])
         else:
             owner = self.entries[owner_rank]
-            self.peers.request(
+            self._peer_request(
                 owner.connect_host, owner.port,
                 Message(MsgType.DO_FREE, {"alloc_id": f["alloc_id"]}),
             )
@@ -744,7 +837,7 @@ class Daemon:
         else:
             r0 = self.entries[0]
             try:
-                self.peers.request(r0.connect_host, r0.port, note)
+                self._peer_request(r0.connect_host, r0.port, note)
             except (OSError, OcmConnectError):
                 printd("daemon %d: NOTE_FREE to rank0 failed", self.rank)
 
@@ -969,6 +1062,11 @@ class Daemon:
         so they are not re-relayed (no forwarding loop)."""
         f = msg.fields
         self.registry.renew_leases(f["pid"], f["rank"])
+        obs_journal.record(
+            "lease_renew", track=self.tracer.track,
+            app_pid=f["pid"], app_rank=f["rank"],
+            relayed=f["rank"] != self.rank,
+        )
         if f["rank"] == self.rank:
             # Relay only to the ranks the app says own its allocations —
             # O(owners) per beat, not an O(nnodes) broadcast per app.
@@ -977,7 +1075,7 @@ class Daemon:
                     continue
                 e = self.entries[r]
                 try:
-                    self.peers.request(e.connect_host, e.port, msg)
+                    self._peer_request(e.connect_host, e.port, msg)
                 except (OSError, OcmConnectError):
                     printd("daemon %d: heartbeat relay to %d failed",
                            self.rank, e.rank)
@@ -986,9 +1084,10 @@ class Daemon:
     def _on_status(self, msg: Message) -> Message:
         import json
 
-        # Data-plane telemetry rides as a JSON data tail: v2 clients parse
-        # the fixed fields and ignore trailing data, so the schema needs
-        # no new wire fields (the C++ daemon simply sends no tail).
+        # Data-plane telemetry + lease health ride as a JSON data tail:
+        # v2 clients parse the fixed fields and ignore trailing data, so
+        # the schema needs no new wire fields (the C++ daemon simply
+        # sends no tail).
         detail = {
             "dcn": {
                 "ops": {
@@ -996,7 +1095,8 @@ class Daemon:
                     if k.startswith("dcn_")
                 },
                 "transfers": self.tracer.transfers(last=32),
-            }
+            },
+            "leases": self.registry.lease_stats(),
         }
         return Message(
             MsgType.STATUS_OK,
@@ -1010,6 +1110,46 @@ class Daemon:
                 ),
             },
             json.dumps(detail, separators=(",", ":")).encode(),
+        )
+
+    def _metrics_meta(self) -> dict:
+        """Everything the Prometheus endpoint and the cluster CLI render:
+        op counters, the transfer ring, arena occupancy, lease health."""
+        return {
+            "rank": self.rank,
+            "nnodes": self.policy.nnodes if self.rank == 0
+            else len(self.entries),
+            "ops": self.tracer.snapshot(),
+            "transfers": self.tracer.transfers(last=32),
+            "live_allocs": self.registry.live_count(),
+            "host_arena": {
+                "live_bytes": self.host_arena.allocator.bytes_live,
+                "capacity_bytes": self.config.host_arena_bytes,
+            },
+            "device_books": [
+                {
+                    "live_bytes": b.bytes_live,
+                    "capacity_bytes": self.config.device_arena_bytes,
+                }
+                for b in self.device_books
+            ],
+            "leases": self.registry.lease_stats(),
+        }
+
+    def _on_status_prom(self, msg: Message) -> Message:
+        from oncilla_tpu.obs import prom
+
+        text = prom.render(self._metrics_meta())
+        return Message(
+            MsgType.STATUS_PROM_OK, {"rank": self.rank}, text.encode()
+        )
+
+    def _on_status_events(self, msg: Message) -> Message:
+        evs = obs_journal.events()
+        return Message(
+            MsgType.STATUS_EVENTS_OK,
+            {"rank": self.rank, "count": len(evs)},
+            obs_journal.dump_jsonl(evs).encode(),
         )
 
 
@@ -1085,9 +1225,24 @@ def main(argv=None) -> int:
 # load. CONNECT's capability offer is handled in _on_connect (echo of
 # the implemented subset); DATA_PUT's FLAG_MORE in _serve_conn's burst
 # loop.
+# FLAG_TRACE_CTX is handled GENERICALLY in _serve_conn (the context
+# prefix is stripped and installed around dispatch before any handler
+# runs), so every traced request type claims it here.
 _FLAGS_HANDLED = {
-    MsgType.CONNECT: FLAG_CAP_COALESCE,
-    MsgType.DATA_PUT: FLAG_MORE,
+    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE,
+    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX,
+    MsgType.DATA_GET: FLAG_TRACE_CTX,
+    MsgType.REQ_ALLOC: FLAG_TRACE_CTX,
+    MsgType.DO_ALLOC: FLAG_TRACE_CTX,
+    MsgType.REQ_FREE: FLAG_TRACE_CTX,
+    MsgType.DO_FREE: FLAG_TRACE_CTX,
+    MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
+    MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
+    MsgType.NOTE_FREE: FLAG_TRACE_CTX,
+    MsgType.HEARTBEAT: FLAG_TRACE_CTX,
+    MsgType.STATUS: FLAG_TRACE_CTX,
+    MsgType.STATUS_PROM: FLAG_TRACE_CTX,
+    MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
 }
 
 _HANDLERS = {
@@ -1109,6 +1264,8 @@ _HANDLERS = {
     MsgType.PLANE_SCRUB: Daemon._on_plane_relay,
     MsgType.HEARTBEAT: Daemon._on_heartbeat,
     MsgType.STATUS: Daemon._on_status,
+    MsgType.STATUS_PROM: Daemon._on_status_prom,
+    MsgType.STATUS_EVENTS: Daemon._on_status_events,
 }
 
 if __name__ == "__main__":

@@ -36,6 +36,7 @@ from oncilla_tpu.core.errors import (
     OcmProtocolError,
     OcmRemoteError,
 )
+from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.runtime.protocol import Message, request
 
 
@@ -164,6 +165,10 @@ class PeerPool:
     def discard(self, host: str, port: int, entry: PoolEntry) -> None:
         """Drop a broken leased connection (closes it, ends the lease);
         waiters at the cap are woken because the peer's list shrank."""
+        # Connection churn is a leading indicator of a flapping peer —
+        # journaled (OCM_EVENTS=1) so the obs CLI's merged timeline shows
+        # discards next to the stripe retries they caused.
+        obs_journal.record("pool_discard", host=host, port=port)
         entry.dead = True
         with self._cond:
             lst = self._conns.get((host, port), [])

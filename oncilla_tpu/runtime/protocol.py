@@ -48,6 +48,18 @@ FLAG_MORE = 0x0001
 # Python daemon, native C++ daemon) declines, and the sender stays on the
 # lockstep one-reply-per-chunk protocol.
 FLAG_CAP_COALESCE = 0x0002
+# FLAG_CAP_TRACE on CONNECT offers distributed-trace propagation (the
+# same offer/echo dance as FLAG_CAP_COALESCE). Only after the peer
+# echoes it may a sender set FLAG_TRACE_CTX on requests; a flags=0 reply
+# (un-upgraded v2 daemon, native C++ daemon) declines by silence and the
+# sender ships plain frames — interop untouched.
+FLAG_CAP_TRACE = 0x0004
+# FLAG_TRACE_CTX on a request: the first 16 bytes of the data tail are a
+# trace context (obs/trace.py: trace_id u64 | span_id u64), NOT payload.
+# Receivers strip the prefix before dispatch and attach the context to
+# their serve-side spans / forwarded hops. Replies never carry it (the
+# requester already owns the context).
+FLAG_TRACE_CTX = 0x0008
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -93,6 +105,13 @@ class MsgType(enum.IntEnum):
     HEARTBEAT_OK = 41
     STATUS = 42
     STATUS_OK = 43
+    # STATUS family extensions (obs/): Prometheus text exposition and the
+    # structured event journal, served in-band so observability needs no
+    # extra listening port. Replies carry the document as the data tail.
+    STATUS_PROM = 44
+    STATUS_PROM_OK = 45
+    STATUS_EVENTS = 46
+    STATUS_EVENTS_OK = 47
     # cross-process device plane: the SPMD controller's client registers
     # its plane endpoint (PLANE_SERVE -> master), and daemons relay
     # device-kind data ops to it as PLANE_PUT/PLANE_GET enriched with the
@@ -119,9 +138,26 @@ WIRE_KIND = {
 WIRE_KIND_INV = {v: k for k, v in WIRE_KIND.items()}
 
 VALID_FLAGS.update({
-    MsgType.CONNECT: FLAG_CAP_COALESCE,          # client offers
-    MsgType.CONNECT_CONFIRM: FLAG_CAP_COALESCE,  # daemon grants
-    MsgType.DATA_PUT: FLAG_MORE,                 # coalesced-burst chunk
+    # Capability offer/echo bits.
+    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE,
+    MsgType.CONNECT_CONFIRM: FLAG_CAP_COALESCE | FLAG_CAP_TRACE,
+    # Requests that may carry a trace-context prefix once the peer
+    # granted FLAG_CAP_TRACE. DATA_PUT also keeps the coalesced-burst
+    # bit; its trace prefix rides the burst-CLOSING chunk only, so the
+    # body chunks stay eligible for the zero-copy recv-into-arena path.
+    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX,
+    MsgType.DATA_GET: FLAG_TRACE_CTX,
+    MsgType.REQ_ALLOC: FLAG_TRACE_CTX,
+    MsgType.DO_ALLOC: FLAG_TRACE_CTX,
+    MsgType.REQ_FREE: FLAG_TRACE_CTX,
+    MsgType.DO_FREE: FLAG_TRACE_CTX,
+    MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
+    MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
+    MsgType.NOTE_FREE: FLAG_TRACE_CTX,
+    MsgType.HEARTBEAT: FLAG_TRACE_CTX,
+    MsgType.STATUS: FLAG_TRACE_CTX,
+    MsgType.STATUS_PROM: FLAG_TRACE_CTX,
+    MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
 })
 
 
@@ -144,6 +180,11 @@ def _unpack_str(buf, off: int) -> tuple[str, int]:
 class Message:
     type: MsgType
     fields: dict = field(default_factory=dict)
+    # On SEND, ``data`` may also be a list/tuple of buffers — the vectored
+    # form obs/trace.attach uses to prefix a 16-byte trace context onto a
+    # bulk payload without copying it (send_msg scatter-gathers the parts;
+    # the wire bytes are identical to the concatenation). Received
+    # messages always carry one contiguous buffer.
     data: bytes = b""
     flags: int = 0  # header-flag bits (FLAG_*), preserved by the codec
 
@@ -151,8 +192,18 @@ class Message:
         fl = f", flags={self.flags:#x}" if self.flags else ""
         return (
             f"Message({self.type.name}, {self.fields}, "
-            f"data={len(self.data)}B{fl})"
+            f"data={_data_len(self.data)}B{fl})"
         )
+
+
+def _data_parts(data) -> list:
+    return list(data) if isinstance(data, (list, tuple)) else [data]
+
+
+def _data_len(data) -> int:
+    if isinstance(data, (list, tuple)):
+        return sum(len(p) for p in data)
+    return len(data)
 
 
 # Payload schemas: (field_name, struct_char or "s" for string) in order.
@@ -228,6 +279,13 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
     MsgType.HEARTBEAT: [("rank", "q"), ("pid", "q"), ("owners", "s")],
     MsgType.HEARTBEAT_OK: [("lease_s", "d")],
     MsgType.STATUS: [],
+    # Prometheus text exposition / event-journal JSONL ride as the reply
+    # data tail (documents, not fields — same pattern as STATUS_OK's
+    # telemetry tail).
+    MsgType.STATUS_PROM: [],
+    MsgType.STATUS_PROM_OK: [("rank", "q")],
+    MsgType.STATUS_EVENTS: [],
+    MsgType.STATUS_EVENTS_OK: [("rank", "q"), ("count", "Q")],
     MsgType.STATUS_OK: [
         ("rank", "q"),
         ("nnodes", "q"),
@@ -298,7 +356,7 @@ def _pack_prefix(msg: Message) -> bytes:
             fields += _pack_str(v)
         else:
             fields += struct.pack("<" + fmt, v)
-    plen = len(fields) + len(msg.data)
+    plen = len(fields) + _data_len(msg.data)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"payload {plen} exceeds cap")
     if msg.flags & ~_valid_flags(msg.type):
@@ -310,7 +368,9 @@ def _pack_prefix(msg: Message) -> bytes:
 
 
 def pack(msg: Message) -> bytes:
-    return _pack_prefix(msg) + bytes(msg.data)
+    return _pack_prefix(msg) + b"".join(
+        bytes(p) for p in _data_parts(msg.data)
+    )
 
 
 def _parse_fields(mtype: MsgType, payload) -> tuple[dict, int]:
@@ -388,10 +448,15 @@ def _sendall_vec(sock: socket.socket, parts: list) -> None:
 
 def send_msg(sock: socket.socket, msg: Message) -> None:
     prefix = _pack_prefix(msg)
-    if len(msg.data) >= (64 << 10):
-        _sendall_vec(sock, [prefix, msg.data])
+    n_data = _data_len(msg.data)
+    if n_data >= (64 << 10):
+        _sendall_vec(sock, [prefix, *_data_parts(msg.data)])
+    elif n_data:
+        sock.sendall(
+            prefix + b"".join(bytes(p) for p in _data_parts(msg.data))
+        )
     else:
-        sock.sendall(prefix + bytes(msg.data) if msg.data else prefix)
+        sock.sendall(prefix)
 
 
 def _recv_into(sock: socket.socket, view: memoryview,

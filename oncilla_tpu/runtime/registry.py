@@ -44,6 +44,13 @@ class AllocRegistry:
         self._counter = 0
         self._entries: dict[int, RegEntry] = {}
         self._lock = make_lock("registry._lock")
+        # Lease/heartbeat health counters — what Ocm.status() surfaces so
+        # the cluster CLI's "lease pressure" column has real data: how
+        # often leases were renewed, how many the reaper took back, and
+        # when each app was last heard from.
+        self._renewals = 0
+        self._reclaims = 0
+        self._last_beat: dict[tuple[int, int], float] = {}  # (pid, rank)
 
     def next_id(self) -> int:
         with self._lock:
@@ -78,11 +85,47 @@ class AllocRegistry:
         return e
 
     def renew_leases(self, origin_pid: int, origin_rank: int) -> None:
-        deadline = time.monotonic() + self._lease_s
+        now = time.monotonic()
+        deadline = now + self._lease_s
         with self._lock:
+            self._renewals += 1
+            self._last_beat[(origin_pid, origin_rank)] = now
             for e in self._entries.values():
                 if e.origin_pid == origin_pid and e.origin_rank == origin_rank:
                     e.lease_expiry = deadline
+
+    def note_reclaim(self, n: int = 1) -> None:
+        """Count allocations the lease reaper took back."""
+        with self._lock:
+            self._reclaims += n
+
+    def lease_stats(self, now: float | None = None) -> dict:
+        """Lease/heartbeat health: renewal + reaper-reclaim totals, how
+        many live entries are past their lease right now, and seconds
+        since each app's last heartbeat. Apps silent for 10 lease periods
+        are pruned from the per-app view (the dict must not grow with
+        every app that ever attached)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stale = [
+                k for k, t in self._last_beat.items()
+                if now - t > 10 * self._lease_s
+            ]
+            for k in stale:
+                del self._last_beat[k]
+            return {
+                "renewals": self._renewals,
+                "reclaims": self._reclaims,
+                "expired": sum(
+                    1 for e in self._entries.values()
+                    if e.lease_expiry < now
+                ),
+                "lease_s": self._lease_s,
+                "apps": {
+                    f"{pid}@r{rank}": round(now - t, 3)
+                    for (pid, rank), t in self._last_beat.items()
+                },
+            }
 
     def for_app(self, origin_pid: int, origin_rank: int) -> list[RegEntry]:
         """Every allocation originated by an app — feeds the disconnect-time

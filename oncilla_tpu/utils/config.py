@@ -93,6 +93,16 @@ class OcmConfig:
         default_factory=lambda: bool(_env_int("OCM_DCN_COALESCE", 1))
     )
 
+    # Distributed tracing (obs/): offer FLAG_CAP_TRACE at CONNECT and
+    # prefix requests with a 16-byte trace context once granted, so one
+    # trace_id stitches client → local daemon → peer daemon spans.
+    # Always-on by the Dapper premise (ids are too cheap to gate);
+    # OCM_TRACE=0 opts the process out entirely (never offered, never
+    # attached). Journal recording is gated separately by OCM_EVENTS.
+    trace: bool = field(
+        default_factory=lambda: bool(_env_int("OCM_TRACE", 1))
+    )
+
     # Liveness (capability upgrade over the reference's unresolved TODO,
     # /root/reference/src/main.c:6-7).
     lease_s: float = 30.0

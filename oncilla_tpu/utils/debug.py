@@ -16,6 +16,12 @@ from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+# Cross-process observability (obs/ is stdlib-only by contract, so these
+# imports are safe even while the package root is still mid-import).
+from oncilla_tpu.obs import journal as _journal
+from oncilla_tpu.obs import trace as _trace
+from oncilla_tpu.obs import watchdog as _watchdog
+
 _logger = logging.getLogger("oncilla_tpu")
 if os.environ.get("OCM_VERBOSE"):
     logging.basicConfig(
@@ -41,6 +47,12 @@ class OpStats:
     # warm-up distribution, and could overshoot the cap under races).
     samples_s: "deque[float]" = field(default_factory=deque)
 
+    def _quantile(self, q: float) -> float:
+        if not self.samples_s:
+            return 0.0
+        s = sorted(self.samples_s)
+        return s[min(int(len(s) * q), len(s) - 1)]
+
     @property
     def p50_s(self) -> float:
         if not self.samples_s:
@@ -49,23 +61,54 @@ class OpStats:
         return s[len(s) // 2]
 
     @property
+    def p99_s(self) -> float:
+        return self._quantile(0.99)
+
+    @property
     def gbps(self) -> float:
-        return self.total_bytes / self.total_s / 1e9 if self.total_s else 0.0
+        """GigaBITS per second — the unit every ``gbps`` key in this
+        codebase reports (Tracer.note_transfer set the precedent; this
+        property used to report gigaBYTES under the same key, so the
+        status JSON showed op throughput 8x below the transfer ring's)."""
+        return (
+            self.total_bytes * 8 / self.total_s / 1e9 if self.total_s else 0.0
+        )
 
 
 class Tracer:
     """Per-op timing registry. ``tracer.span("put", nbytes=...)`` wraps an op;
-    ``tracer.stats("put")`` reports count / p50 latency / GB/s."""
+    ``tracer.stats("put")`` reports count / p50 latency / Gbit/s.
 
-    def __init__(self, max_samples: int = 4096, max_transfers: int = 256):
+    Spans participate in distributed tracing (obs/): each span adopts the
+    thread's active :class:`~oncilla_tpu.obs.trace.TraceCtx` as its
+    parent (minting a fresh root when there is none) and installs its own
+    context for the duration, so nested spans — and wire hops that attach
+    the ambient context — stitch into one trace_id. ``track`` labels this
+    tracer's timeline in exported traces (one in-process test cluster
+    hosts many daemons, so pid alone cannot tell their spans apart).
+    """
+
+    def __init__(self, max_samples: int = 4096, max_transfers: int = 256,
+                 track: str | None = None):
         self._stats: dict[str, OpStats] = {}
         self._lock = threading.Lock()
         self._max_samples = max_samples
+        self.track = track or f"pid{os.getpid()}"
         # Per-transfer records of the DCN data plane (bytes, stripes,
         # window, achieved Gbps, retries) — the ring the STATUS endpoint
         # surfaces so operators see data-plane throughput without a
         # profiler attached.
         self._transfers: "deque[dict]" = deque(maxlen=max_transfers)
+        # Open (in-flight) spans, keyed by record identity — what the
+        # slow-op watchdog scans. Touched only when OCM_SLOWOP_US is set.
+        self._open: dict[int, dict] = {}
+        self._open_lock = threading.Lock()
+        _watchdog.register(self)
+
+    def open_spans(self) -> list[dict]:
+        """Snapshot of in-flight span records (for the watchdog)."""
+        with self._open_lock:
+            return list(self._open.values())
 
     def _get_locked(self, op: str) -> OpStats:
         st = self._stats.get(op)
@@ -79,21 +122,57 @@ class Tracer:
     def span(self, op: str, nbytes: int = 0):
         cls = _annotation_cls()
         annotation = cls(f"ocm:{op}") if cls is not None else None
+        # Trace context: child of the ambient span (an inbound wire hop or
+        # an enclosing local span), else a fresh root — the client-side
+        # "mint a (trace_id, span_id) per logical op".
+        ctx = None
+        if _trace.enabled():
+            parent = _trace.current()
+            ctx = _trace.child(parent) if parent is not None else _trace.mint()
+        journal_on = _journal.enabled()
+        wall0 = time.time() if journal_on else 0.0
+        slow_us = _watchdog.threshold_us()
+        rec = None
         t0 = time.perf_counter()
+        if slow_us > 0:
+            rec = {
+                "op": op, "track": self.track, "t0": t0, "nbytes": nbytes,
+                "trace_id": ctx.trace_id if ctx else 0,
+                "span_id": ctx.span_id if ctx else 0,
+            }
+            with self._open_lock:
+                self._open[id(rec)] = rec
         try:
-            if annotation is None:
-                yield
-            else:
-                with annotation:
+            with _trace.use_ctx(ctx):
+                if annotation is None:
                     yield
+                else:
+                    with annotation:
+                        yield
         finally:
             dt = time.perf_counter() - t0
+            if rec is not None:
+                with self._open_lock:
+                    self._open.pop(id(rec), None)
+                # Slow-but-finished spans flag at close; the watchdog scan
+                # only sees the ones still open between its ticks.
+                if dt * 1e6 >= slow_us and not rec.get("flagged"):
+                    rec["flagged"] = True
+                    _watchdog.flag(rec, dt * 1e6)
             with self._lock:
                 st = self._get_locked(op)
                 st.count += 1
                 st.total_s += dt
                 st.total_bytes += nbytes
                 st.samples_s.append(dt)  # deque(maxlen) evicts the oldest
+            if journal_on:
+                _journal.record(
+                    "span", op=op, track=self.track, nbytes=nbytes,
+                    t_wall=wall0, dur_us=round(dt * 1e6, 1),
+                    trace_id=ctx.trace_id if ctx else 0,
+                    span_id=ctx.span_id if ctx else 0,
+                    parent_span_id=ctx.parent_span_id if ctx else 0,
+                )
             printd("op=%s nbytes=%d dt_us=%.1f", op, nbytes, dt * 1e6)
 
     def stats(self, op: str) -> OpStats:
@@ -143,11 +222,14 @@ class Tracer:
         return recs if last is None else recs[-last:]
 
     def snapshot(self) -> dict[str, dict]:
+        """Per-op counters; ``gbps`` is gigaBITS/s, same unit as the
+        transfer ring (tests/test_obs.py pins the two paths together)."""
         with self._lock:
             return {
                 k: {
                     "count": v.count,
                     "p50_us": v.p50_s * 1e6,
+                    "p99_us": v.p99_s * 1e6,
                     "gbps": v.gbps,
                     "total_bytes": v.total_bytes,
                 }

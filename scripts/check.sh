@@ -35,6 +35,12 @@ summary=$(grep -E '^analysis: ' "$alog" | tail -1 || true)
 echo "check.sh: findings by family: ${summary#analysis: }"
 rm -f "$alog"
 
+echo "== obs smoke =="
+# End-to-end observability proof: a put/get over an in-process cluster
+# under OCM_EVENTS=1, exported to a merged Perfetto/Chrome trace, which
+# must parse as JSON and contain >= 1 cross-track (client->daemon) flow.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.obs --smoke || fail=1
+
 echo "== dcn smoke =="
 # Loopback DCN data-plane smoke: tiny striped + single-stream put/get
 # roundtrips through an in-process 2-daemon cluster, byte-exactness

@@ -1,0 +1,29 @@
+"""Seeded violation: eagerly-formatted arguments to printd (they format
+on every call, even with OCM_VERBOSE unset)."""
+
+from oncilla_tpu.utils.debug import printd
+
+
+def eager_fstring(nbytes, exc):
+    printd(f"transfer of {nbytes} B failed: {exc!r}")  # FINDING
+
+
+def eager_percent(rank):
+    printd("daemon %d wedged" % rank)  # FINDING
+
+
+def eager_format(op, dt):
+    printd("op {} took {:.1f} us".format(op, dt))  # FINDING
+
+
+def ok_lazy(nbytes, exc):
+    printd("transfer of %d B failed: %r", nbytes, exc)  # NOT a finding
+
+
+def ok_plain():
+    printd("daemon started")  # NOT a finding: constant string
+
+
+def ok_suppressed(path):
+    # Deliberate eager formatting (cold path, justified):
+    printd(f"snapshot at {path}")  # ocm-lint: allow[printd-eager-format]

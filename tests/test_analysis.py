@@ -5,6 +5,7 @@ catch seeded drift, and the default-scan gate must be clean on this tree
 ``python -m oncilla_tpu.analysis``."""
 
 import json
+import os
 import threading
 from pathlib import Path
 
@@ -48,6 +49,24 @@ def test_jit_purity_fixture_fires():
     assert {f.symbol for f in fs} == {
         "decorated_impure", "partial_impure", "factory.run",
     }
+
+
+def test_printd_eager_format_fixture_fires():
+    fs = scan_paths([str(FIXTURES / "seeded_printd_eager.py")])
+    assert _rules(fs) == ["printd-eager-format"] * 3, fs
+    assert {f.symbol for f in fs} == {
+        "eager_fstring", "eager_percent", "eager_format",
+    }
+
+
+def test_printd_eager_format_clean_on_tree():
+    # The satellite fix: benchmarks/sweep.py's f-string printd (and any
+    # other eager call) must be gone package-wide.
+    import oncilla_tpu
+
+    pkg = os.path.dirname(oncilla_tpu.__file__)
+    fs = [f for f in scan_paths([pkg]) if f.rule == "printd-eager-format"]
+    assert fs == [], [f.render() for f in fs]
 
 
 def test_suppression_comment_is_per_rule():

@@ -143,6 +143,37 @@ def test_status_endpoint():
         client.free(h)
 
 
+def test_status_surfaces_lease_health():
+    # Satellite: renewals, reaper reclaims, expired count, and
+    # seconds-since-last-heartbeat per app ride Ocm.status() — the data
+    # behind the CLI's "lease pressure" column.
+    cfg = small_cfg(lease_s=0.5, heartbeat_s=0.1)
+    with local_cluster(2, config=cfg) as c:
+        client = c.client(0)  # heartbeating app
+        h = client.alloc(4096, OcmKind.REMOTE_HOST)
+        time.sleep(0.4)  # a few heartbeats relay to the owner
+        st = client.status(rank=1)
+        leases = st["leases"]
+        assert leases["renewals"] >= 1
+        assert leases["reclaims"] == 0 and leases["expired"] == 0
+        (age,) = leases["apps"].values()  # exactly our app, fresh
+        assert age < cfg.lease_s
+        client.free(h)
+        # Now orphan an allocation (no heartbeats) and let the reaper
+        # take it: reclaims must show up in status. Rank 1, because app
+        # identity is (pid, rank) — at rank 0 the still-heartbeating
+        # first client would keep renewing the orphan's lease.
+        orphan = c.client(1, heartbeat=False)
+        h2 = orphan.alloc(4096, OcmKind.REMOTE_HOST)
+        owner = c.daemons[h2.rank]
+        deadline = time.time() + 5.0
+        while owner.registry.live_count() and time.time() < deadline:
+            time.sleep(0.1)
+        st = client.status(rank=h2.rank)
+        assert st["leases"]["reclaims"] >= 1
+        assert st["live_allocs"] == 0
+
+
 def test_lease_expiry_reaps_orphans():
     # Kill the app (stop heartbeats) and the owner reclaims — the
     # capability the reference left as TODO (main.c:6-7).

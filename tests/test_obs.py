@@ -1,0 +1,537 @@
+"""Cross-node observability: wire trace propagation (capability
+negotiation, prefix stripping), the event journal, the Perfetto/Chrome
+trace exporter, the Prometheus exposition endpoint, the cluster CLI,
+and the slow-op watchdog."""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oncilla_tpu.obs import export, journal, prom
+from oncilla_tpu.obs import trace as obs_trace
+from oncilla_tpu.obs.__main__ import main as obs_main
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.runtime.daemon import Daemon
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import OpStats, Tracer
+
+from oncilla_tpu import OcmKind
+
+
+def _cfg(**kw) -> OcmConfig:
+    base = dict(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=128 << 10,
+        dcn_stripes=2,
+        dcn_stripe_min_bytes=128 << 10,
+        heartbeat_s=5.0,
+    )
+    base.update(kw)
+    return OcmConfig(**base)
+
+
+@pytest.fixture
+def journaling():
+    """Journal on, ring clean, restored afterwards."""
+    was = journal.enabled()
+    journal.set_enabled(True)
+    journal.clear()
+    yield journal
+    journal.set_enabled(was)
+    journal.clear()
+
+
+# -- trace context primitives -------------------------------------------
+
+
+def test_ctx_encode_decode_roundtrip():
+    ctx = obs_trace.mint()
+    assert len(ctx.encode()) == obs_trace.CTX_BYTES == 16
+    back = obs_trace.decode(ctx.encode())
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+
+
+def test_child_keeps_trace_id_and_parents():
+    root = obs_trace.mint()
+    kid = obs_trace.child(root)
+    assert kid.trace_id == root.trace_id
+    assert kid.span_id != root.span_id
+    assert kid.parent_span_id == root.span_id
+
+
+def test_use_ctx_nests_and_restores():
+    a, b = obs_trace.mint(), obs_trace.mint()
+    assert obs_trace.current() is None
+    with obs_trace.use_ctx(a):
+        assert obs_trace.current() is a
+        with obs_trace.use_ctx(b):
+            assert obs_trace.current() is b
+        with obs_trace.use_ctx(None):  # None = no-op, not a clear
+            assert obs_trace.current() is a
+        assert obs_trace.current() is a
+    assert obs_trace.current() is None
+
+
+def test_attach_split_roundtrip_small_and_vectored():
+    ctx = obs_trace.mint()
+    # Control message (small tail): contiguous prefix.
+    m = P.Message(P.MsgType.REQ_FREE, {"alloc_id": 1, "rank": 0})
+    obs_trace.attach(m, ctx, P.FLAG_TRACE_CTX)
+    assert m.flags & P.FLAG_TRACE_CTX
+    got, rest = obs_trace.split(m.data)
+    assert got.trace_id == ctx.trace_id and len(rest) == 0
+    # Bulk payload: the vectored [prefix, payload] form, no copy — and
+    # pack() flattens to the same wire bytes as a manual concatenation.
+    payload = bytes(range(256)) * 64  # 16 KiB >= the no-copy threshold
+    m2 = P.Message(
+        P.MsgType.DATA_PUT,
+        {"alloc_id": 1, "offset": 0, "nbytes": len(payload)},
+        payload,
+    )
+    obs_trace.attach(m2, ctx, P.FLAG_TRACE_CTX)
+    assert isinstance(m2.data, list) and m2.data[1] is payload
+    buf = P.pack(m2)
+    out = P.unpack(bytes(buf[:P.HEADER.size]), bytes(buf[P.HEADER.size:]))
+    got2, rest2 = obs_trace.split(out.data)
+    assert got2.span_id == ctx.span_id
+    assert bytes(rest2) == payload
+
+
+def test_split_tolerates_short_tail():
+    got, rest = obs_trace.split(b"\x01\x02")
+    assert got is None and rest == b"\x01\x02"
+
+
+# -- the throughput-unit satellite: gbps is gigaBITS everywhere ----------
+
+
+def test_gbps_unit_unified_between_snapshot_and_transfer_ring():
+    # 1 GB moved in 8 s = exactly 1.0 gigabit/s in BOTH code paths.
+    st = OpStats(count=1, total_s=8.0, total_bytes=10**9)
+    assert st.gbps == pytest.approx(1.0)
+    tr = Tracer()
+    tr.note_transfer("put", 10**9, 8.0)
+    assert tr.transfers()[-1]["gbps"] == pytest.approx(1.0)
+    # And through snapshot() (what the STATUS JSON serves).
+    with tr._lock:
+        tr._stats["put"] = st
+    assert tr.snapshot()["put"]["gbps"] == pytest.approx(1.0)
+
+
+# -- journal -------------------------------------------------------------
+
+
+def test_journal_ring_caps_and_orders(journaling):
+    for i in range(20):
+        journal.record("span", op=f"op{i}")
+    evs = journal.events()
+    assert [e["op"] for e in evs[-3:]] == ["op17", "op18", "op19"]
+    assert all(e["jid"] == evs[0]["jid"] for e in evs)
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+
+
+def test_journal_disabled_records_nothing_without_force():
+    was = journal.enabled()
+    journal.set_enabled(False)
+    try:
+        n0 = len(journal.events())
+        journal.record("span", op="dropped")
+        assert len(journal.events()) == n0
+        journal.record("slow_op", force=True, op="kept")
+        assert journal.events()[-1]["op"] == "kept"
+    finally:
+        journal.set_enabled(was)
+        journal.clear()
+
+
+def test_journal_jsonl_dump_load_roundtrip(journaling, tmp_path):
+    journal.record("span", op="x", nbytes=3)
+    p = tmp_path / "j.jsonl"
+    n = journal.dump(str(p))
+    assert n == 1
+    back = journal.load_jsonl(str(p))
+    assert back[0]["op"] == "x" and back[0]["nbytes"] == 3
+
+
+# -- exporter ------------------------------------------------------------
+
+
+def test_merge_dedupes_on_jid_seq():
+    evs = [
+        {"ev": "span", "ts": 1.0, "jid": "a", "seq": 1, "op": "x"},
+        {"ev": "span", "ts": 2.0, "jid": "a", "seq": 2, "op": "y"},
+    ]
+    merged = export.merge(evs, evs, [{"ev": "span", "ts": 0.5, "op": "z"}])
+    assert len(merged) == 3
+    assert [e.get("op") for e in merged] == ["z", "x", "y"]
+
+
+def test_chrome_trace_tracks_and_flows():
+    tid = 0xABC
+    evs = [
+        {"ev": "span", "ts": 1.0, "t_wall": 1.0, "dur_us": 50.0,
+         "track": "client", "tid": 1, "thread": "main", "op": "put",
+         "trace_id": tid, "span_id": 1, "parent_span_id": 0},
+        {"ev": "span", "ts": 1.00001, "t_wall": 1.00001, "dur_us": 20.0,
+         "track": "daemon-r1", "tid": 9, "thread": "srv", "op": "dcn_put_srv",
+         "trace_id": tid, "span_id": 2, "parent_span_id": 0},
+        {"ev": "lease_renew", "ts": 1.1, "track": "daemon-r1", "tid": 9,
+         "thread": "srv", "app_pid": 7},
+    ]
+    trace = export.chrome_trace(evs)
+    tev = trace["traceEvents"]
+    names = {
+        e["args"]["name"] for e in tev
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert names == {"client", "daemon-r1"}
+    xs = [e for e in tev if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"put", "dcn_put_srv"}
+    assert len({e["pid"] for e in xs}) == 2  # different pid tracks
+    assert export.cross_track_flows(trace) == 1
+    assert any(e["ph"] == "i" and e["name"] == "lease_renew" for e in tev)
+
+
+def test_single_track_trace_has_no_flows():
+    evs = [
+        {"ev": "span", "ts": 1.0, "t_wall": 1.0, "dur_us": 5.0,
+         "track": "client", "tid": 1, "op": "a",
+         "trace_id": 5, "span_id": 1},
+        {"ev": "span", "ts": 1.1, "t_wall": 1.1, "dur_us": 5.0,
+         "track": "client", "tid": 1, "op": "b",
+         "trace_id": 5, "span_id": 2},
+    ]
+    assert export.cross_track_flows(export.chrome_trace(evs)) == 0
+
+
+# -- end-to-end: one trace_id stitches client and daemon spans -----------
+
+
+def test_end_to_end_trace_export(journaling, tmp_path):
+    """Acceptance: put + get over local_cluster with tracing -> Perfetto
+    JSON where client and daemon spans on different pid tracks share one
+    trace_id, and the file parses as Chrome-trace JSON."""
+    with local_cluster(2, config=_cfg()) as c:
+        ctx = c.context(0, heartbeat=False)
+        h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = np.random.default_rng(3).integers(0, 256, 1 << 20, np.uint8)
+        ctx.put(h, data)
+        np.testing.assert_array_equal(np.asarray(ctx.get(h)), data)
+        ctx.free(h)
+        out = tmp_path / "trace.json"
+        summary = ctx.export_trace(str(out))
+    with open(out, encoding="utf-8") as fh:
+        trace = json.load(fh)  # must parse as valid Chrome-trace JSON
+    assert isinstance(trace["traceEvents"], list)
+    assert summary["spans"] > 0 and summary["flows"] >= 1
+    # The put's trace_id appears on spans of at least two pid tracks,
+    # one of them a daemon serve span.
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    by_trace: dict[str, set] = {}
+    srv_traces = set()
+    for e in spans:
+        tid = e["args"]["trace_id"]
+        by_trace.setdefault(tid, set()).add(e["pid"])
+        if e["name"].endswith("_srv") or e["name"].startswith("srv_"):
+            srv_traces.add(tid)
+    stitched = {t for t, pids in by_trace.items() if len(pids) >= 2}
+    assert stitched & srv_traces, (by_trace, srv_traces)
+    # Journal captured both sides: client dcn spans AND daemon serve
+    # spans with the same trace ids.
+    tracks = {e.get("track") for e in journal.events() if e["ev"] == "span"}
+    assert any(t.startswith("daemon-r") for t in tracks)
+    assert any(not t.startswith("daemon-r") for t in tracks)
+
+
+def test_trace_relay_stitches_alloc_hop(journaling):
+    """A REQ_ALLOC from rank 0's client placed on rank 1 relays through
+    rank 0's daemon (DO_ALLOC): all three spans share the trace_id."""
+    with local_cluster(2, config=_cfg()) as c:
+        ctx = c.context(0, heartbeat=False)
+        h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        assert h.rank == 1  # placed off-origin: the relay actually ran
+        ctx.free(h)
+    spans = [e for e in journal.events() if e["ev"] == "span"]
+    alloc_span = next(e for e in spans if e["op"] == "alloc")
+    chain = [
+        e for e in spans if e["trace_id"] == alloc_span["trace_id"]
+    ]
+    ops = {(e["track"], e["op"]) for e in chain}
+    assert ("daemon-r0", "srv_req_alloc") in ops, ops
+    assert ("daemon-r1", "srv_do_alloc") in ops, ops
+
+
+# -- capability negotiation: un-upgraded v2 peers interop untouched ------
+
+
+def test_v2_peer_declines_trace_by_silence(monkeypatch, journaling):
+    """Acceptance: a flags=0 CONNECT_CONFIRM (un-upgraded v2 daemon)
+    means tracing was declined — put/get still completes and no
+    data-tail prefix is ever sent."""
+    from oncilla_tpu.runtime import daemon as daemon_mod
+
+    plain_connect = Daemon._on_connect
+
+    def v2_connect(self, msg):
+        r = plain_connect(self, msg)
+        r.flags = 0  # an old daemon echoes nothing
+        return r
+
+    # Dispatch goes through the _HANDLERS table, not the class attribute.
+    monkeypatch.setitem(daemon_mod._HANDLERS, P.MsgType.CONNECT, v2_connect)
+    sent_traced = []
+    orig_attach = obs_trace.attach
+
+    def spy_attach(msg, ctx, flag):
+        sent_traced.append(msg.type)
+        return orig_attach(msg, ctx, flag)
+
+    monkeypatch.setattr(obs_trace, "attach", spy_attach)
+    with local_cluster(2, config=_cfg()) as c:
+        client = c.client(0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = np.random.default_rng(4).integers(0, 256, 1 << 20, np.uint8)
+        client.put(h, data)
+        np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+        assert client._ctrl_caps & P.FLAG_CAP_TRACE == 0
+        assert client._dcn_caps[client._owner_addr(h)] == 0
+        client.free(h)
+    assert sent_traced == []  # declined by silence: no prefix ever sent
+
+
+def test_trace_disabled_by_config_never_offers(journaling):
+    with local_cluster(2, config=_cfg(trace=False)) as c:
+        client = c.client(0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        client.put(h, np.zeros(1 << 20, np.uint8))
+        assert client._ctrl_caps == 0
+        assert client._dcn_caps[client._owner_addr(h)] & P.FLAG_CAP_TRACE == 0
+        client.free(h)
+
+
+# -- Prometheus exposition ----------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$"
+)
+
+
+def _validate_prom(text: str) -> dict:
+    """Minimal Prometheus text-format validator: HELP/TYPE pairs precede
+    their family's samples, families are contiguous (never interleaved),
+    no duplicate series, every value parses as a float. Returns
+    {family: [series...]}."""
+    families: dict[str, list[str]] = {}
+    typed: dict[str, str] = {}
+    cur: str | None = None
+    seen_series: set[str] = set()
+    closed: set[str] = set()
+    for line in text.splitlines():
+        assert line.strip() == line and line, f"stray whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            fam = line.split()[2]
+            assert fam not in families, f"duplicate HELP for {fam}"
+            if cur is not None:
+                closed.add(cur)
+            families[fam] = []
+            cur = fam
+        elif line.startswith("# TYPE "):
+            _, _, fam, kind = line.split(None, 3)
+            assert fam == cur, f"TYPE {fam} outside its family block"
+            assert kind in ("counter", "gauge", "histogram", "summary")
+            typed[fam] = kind
+        else:
+            assert _SAMPLE_RE.match(line), f"malformed sample: {line!r}"
+            series, value = line.rsplit(" ", 1)
+            fam = series.split("{", 1)[0]
+            assert fam == cur, f"sample {fam} interleaved into {cur}"
+            assert fam not in closed, f"family {fam} reopened"
+            assert series not in seen_series, f"duplicate series {series}"
+            seen_series.add(series)
+            float(value)  # must parse
+    assert families, "no families rendered"
+    assert set(families) == set(typed), "family missing a TYPE line"
+    return families
+
+
+def test_prom_render_validates():
+    meta = {
+        "rank": 3, "nnodes": 2, "live_allocs": 1,
+        "ops": {
+            "dcn_put_srv": {"count": 4, "p50_us": 10.0, "p99_us": 20.0,
+                            "gbps": 1.5, "total_bytes": 123},
+            "srv_req_alloc": {"count": 1, "p50_us": 5.0, "p99_us": 5.0,
+                              "gbps": 0.0, "total_bytes": 0},
+        },
+        "transfers": [
+            {"op": "put_srv", "gbps": 2.0, "retries": 1, "bytes": 10},
+        ],
+        "host_arena": {"live_bytes": 10, "capacity_bytes": 100},
+        "device_books": [{"live_bytes": 0, "capacity_bytes": 50}],
+        "leases": {"renewals": 7, "reclaims": 2, "expired": 0,
+                   "lease_s": 30.0, "apps": {"11@r0": 1.25}},
+    }
+    fams = _validate_prom(prom.render(meta))
+    assert "ocm_op_total" in fams
+    assert "ocm_lease_renewals_total" in fams
+    assert "ocm_app_heartbeat_age_seconds" in fams
+
+
+def _write_nodefile(tmp_path, entries) -> str:
+    p = tmp_path / "cluster.nodes"
+    p.write_text("".join(f"{e.rank} {e.host} {e.port}\n" for e in entries))
+    return str(p)
+
+
+def test_prom_cli_endpoint_validates(tmp_path, capsys):
+    """Acceptance: `python -m oncilla_tpu.obs --prom <rank>` output
+    passes the Prometheus text-format validator."""
+    with local_cluster(2, config=_cfg()) as c:
+        client = c.client(0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        client.put(h, np.zeros(1 << 20, np.uint8))
+        nodefile = _write_nodefile(tmp_path, c.entries)
+        rc = obs_main(["--nodefile", nodefile, "--prom", str(h.rank)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        fams = _validate_prom(out)
+        assert any(
+            f'op="dcn_put_srv"' in s
+            for s in fams.get("ocm_op_total", [])
+        ) or "ocm_op_total" in fams
+        client.free(h)
+
+
+def test_prom_cli_bad_rank(tmp_path):
+    with local_cluster(1, config=_cfg()) as c:
+        nodefile = _write_nodefile(tmp_path, c.entries)
+        assert obs_main(["--nodefile", nodefile, "--prom", "9"]) == 2
+
+
+# -- cluster CLI table and trace modes -----------------------------------
+
+
+def test_cli_table_renders_every_rank(tmp_path, capsys):
+    with local_cluster(2, config=_cfg(heartbeat_s=0.2)) as c:
+        ctx = c.context(0)
+        h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        ctx.put(h, np.zeros(1 << 20, np.uint8))
+        time.sleep(0.5)  # let a heartbeat land so lease columns move
+        nodefile = _write_nodefile(tmp_path, c.entries)
+        rc = obs_main(["--nodefile", nodefile])
+        out = capsys.readouterr().out
+        assert rc == 0
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        assert len(lines) == 3  # header + 2 ranks
+        assert "leases" in lines[0]
+        ctx.free(h)
+        ctx.tini()
+
+
+def test_cli_trace_merges_cluster_journals(tmp_path, capsys, journaling):
+    with local_cluster(2, config=_cfg()) as c:
+        ctx = c.context(0, heartbeat=False)
+        h = ctx.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        ctx.put(h, np.zeros(1 << 20, np.uint8))
+        ctx.free(h)
+        nodefile = _write_nodefile(tmp_path, c.entries)
+        out_json = tmp_path / "cluster-trace.json"
+        rc = obs_main(["--nodefile", nodefile, "--trace", str(out_json)])
+    assert rc == 0
+    with open(out_json, encoding="utf-8") as fh:
+        trace = json.load(fh)
+    assert export.cross_track_flows(trace) >= 1
+    # The in-process cluster serves every rank's STATUS_EVENTS from ONE
+    # ring: dedup must keep each span exactly once.
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    keys = [(e["args"]["span_id"]) for e in spans]
+    assert len(keys) == len(set(keys))
+
+
+def test_cli_smoke_passes():
+    assert obs_main(["--smoke"]) == 0
+
+
+# -- journal captures the lease lifecycle --------------------------------
+
+
+def test_journal_records_lease_renew_and_reclaim(journaling):
+    cfg = _cfg(lease_s=0.4, heartbeat_s=0.1)
+    with local_cluster(2, config=cfg) as c:
+        client = c.client(0)  # heartbeating
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        time.sleep(0.35)
+        renews = [e for e in journal.events() if e["ev"] == "lease_renew"]
+        assert any(e["track"] == "daemon-r0" for e in renews)
+        client.free(h)
+        # Orphan at rank 1 (distinct app identity) -> reaper reclaim.
+        orphan = c.client(1, heartbeat=False)
+        h2 = orphan.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        owner = c.daemons[h2.rank]
+        deadline = time.time() + 5.0
+        while owner.registry.live_count() and time.time() < deadline:
+            time.sleep(0.1)
+        reclaims = [
+            e for e in journal.events() if e["ev"] == "lease_reclaim"
+        ]
+        assert any(e["alloc_id"] == h2.alloc_id for e in reclaims)
+
+
+# -- slow-op watchdog ----------------------------------------------------
+
+
+def test_slowop_flags_on_close(monkeypatch):
+    monkeypatch.setenv("OCM_SLOWOP_US", "1000")
+    journal.clear()
+    tr = Tracer(track="slowtest")
+    with tr.span("slow_sleep"):
+        time.sleep(0.01)
+    evs = [e for e in journal.events() if e["ev"] == "slow_op"]
+    assert evs and evs[-1]["op"] == "slow_sleep"
+    assert evs[-1]["elapsed_us"] >= 1000
+    assert evs[-1]["track"] == "slowtest"
+    assert evs[-1]["trace_id"]  # full trace context on the event
+    journal.clear()
+
+
+def test_slowop_watchdog_flags_open_span(monkeypatch):
+    monkeypatch.setenv("OCM_SLOWOP_US", "20000")
+    journal.clear()
+    tr = Tracer(track="wdtest")  # registration starts the scan thread
+    release = threading.Event()
+
+    def stuck():
+        with tr.span("wedged_op"):
+            release.wait(5.0)
+
+    t = threading.Thread(target=stuck, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 3.0
+        flagged = []
+        while time.time() < deadline and not flagged:
+            flagged = [
+                e for e in journal.events()
+                if e["ev"] == "slow_op" and e["op"] == "wedged_op"
+            ]
+            time.sleep(0.02)
+        # Flagged while the span was STILL OPEN — the wedged-daemon case.
+        assert flagged, "watchdog never flagged the open span"
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+        journal.clear()
+
+
+def test_open_spans_tracked_only_under_threshold(monkeypatch):
+    monkeypatch.delenv("OCM_SLOWOP_US", raising=False)
+    tr = Tracer()
+    with tr.span("cheap"):
+        assert tr.open_spans() == []  # no registry churn when disabled
