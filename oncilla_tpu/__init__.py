@@ -36,9 +36,11 @@ from oncilla_tpu.core.errors import (
     OcmConnectError,
     OcmError,
     OcmInvalidHandle,
+    OcmNotPrimary,
     OcmOutOfMemory,
     OcmPlacementError,
     OcmProtocolError,
+    OcmReplicaUnavailable,
 )
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import Fabric, OcmKind
@@ -58,9 +60,11 @@ __all__ = [
     "OcmError",
     "OcmInvalidHandle",
     "OcmKind",
+    "OcmNotPrimary",
     "OcmOutOfMemory",
     "OcmPlacementError",
     "OcmProtocolError",
+    "OcmReplicaUnavailable",
     "ocm_alloc",
     "ocm_alloc_kind",
     "ocm_copy",

@@ -43,5 +43,17 @@ class OcmConnectError(OcmError):
     """Could not reach the local daemon or a peer daemon."""
 
 
+class OcmReplicaUnavailable(OcmError):
+    """A replicated write could not reach a replica that is not (yet)
+    declared DEAD — the primary refuses to ack a put it cannot make
+    durable on the chain (wire: ErrCode.REPLICA_UNAVAILABLE, retryable)."""
+
+
+class OcmNotPrimary(OcmError):
+    """A replica holder refused a client data op because it still
+    believes its primary alive (wire: ErrCode.NOT_PRIMARY, retryable —
+    the failover window closes when the death verdict lands)."""
+
+
 class OcmPlacementError(OcmError):
     """The placement policy could not site the allocation."""

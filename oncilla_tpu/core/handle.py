@@ -59,6 +59,11 @@ class OcmAlloc:
     # offset is an address in the DAEMON's arena, and treating it as an
     # app-arena offset reads/writes unrelated memory and fails the free.
     daemon_owned: bool = field(default=False, compare=False)
+    # Replica ranks of a k-way replicated allocation (resilience/): the
+    # client's failover candidates — a transfer that can't reach the
+    # primary retries these in order (the first survivor is, by the
+    # deterministic promotion rule, the new primary). () = single copy.
+    replica_ranks: tuple[int, ...] = field(default=(), compare=False)
 
     @property
     def is_remote(self) -> bool:

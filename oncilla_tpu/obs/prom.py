@@ -112,6 +112,41 @@ def render(meta: dict) -> str:
                    "Seconds since an app's last heartbeat.",
                    age_s, rank=rank, app=app)
 
+    res = meta.get("resilience", {})
+    if res:
+        doc.sample("ocm_cluster_epoch", "gauge",
+                   "Cluster epoch as this daemon knows it (bumped per "
+                   "DEAD verdict).",
+                   res.get("epoch", 0), rank=rank)
+        doc.sample("ocm_fenced", "gauge",
+                   "1 when this daemon is fenced by a newer epoch "
+                   "(refusing writes).",
+                   int(bool(res.get("fenced", False))), rank=rank)
+        for peer, st in sorted(res.get("peers", {}).items()):
+            doc.sample("ocm_peer_state", "gauge",
+                       "Failure-detector verdict per peer "
+                       "(0 ALIVE, 1 SUSPECT, 2 DEAD).",
+                       {"ALIVE": 0, "SUSPECT": 1, "DEAD": 2}.get(st, 0),
+                       rank=rank, peer=peer)
+        fo = res.get("failover", {})
+        doc.sample("ocm_failover_deaths_total", "counter",
+                   "DEAD verdicts issued by this daemon (rank 0 only).",
+                   fo.get("deaths", 0), rank=rank)
+        doc.sample("ocm_failover_promotions_total", "counter",
+                   "Replica entries promoted to primary on this daemon.",
+                   fo.get("promotions", 0), rank=rank)
+        doc.sample("ocm_rereplications_total", "counter",
+                   "Repair copies driven to restore k (rank 0 only).",
+                   fo.get("rereplications", 0), rank=rank)
+        doc.sample("ocm_replica_put_errors_total", "counter",
+                   "Put fan-out legs that failed (put rejected, "
+                   "retryable).",
+                   fo.get("repl_put_errors", 0), rank=rank)
+        doc.sample("ocm_replica_put_skips_total", "counter",
+                   "Put fan-out legs skipped because the replica is "
+                   "DEAD (degraded until re-replication).",
+                   fo.get("repl_put_skips", 0), rank=rank)
+
     # The transfer ring is bounded, so ring-derived figures are gauges
     # over the recent window, never counters.
     transfers = meta.get("transfers", [])

@@ -1,0 +1,34 @@
+"""Resilience: replicated allocations, owner failover, fault injection.
+
+The reference leaves node failure entirely unaddressed — a crashed owner
+daemon silently loses every extent it holds (leases only reclaim a
+crashed *app's* allocations). This package closes that gap with the
+shape FaRM/RAMCloud proved out for disaggregated memory:
+
+- :mod:`detector` — daemon-to-daemon liveness (ALIVE -> SUSPECT -> DEAD)
+  driven from the existing reaper/heartbeat cadence; rank 0 arbitrates
+  verdicts and bumps the cluster epoch.
+- :mod:`failover` — the rank-0 coordinator: fence the dead owner
+  (EPOCH_UPDATE), promote surviving replicas (PROMOTE), re-replicate in
+  the background to restore k (RE_REPLICATE).
+- :mod:`chaos` — a seeded, deterministic fault-injection harness hooked
+  into the connection-pool seam, so ``local_cluster`` tests replay
+  identical failure interleavings from one integer seed.
+
+``python -m oncilla_tpu.resilience --smoke`` runs the
+kill-the-owner-mid-workload scenario end to end, twice, and asserts the
+two runs injected the identical interleaving.
+"""
+
+from oncilla_tpu.resilience.chaos import (  # noqa: F401
+    ChaosController,
+    ChaosSchedule,
+    Fault,
+    corrupt_file,
+)
+from oncilla_tpu.resilience.detector import (  # noqa: F401
+    FailureDetector,
+    PeerState,
+    probe,
+)
+from oncilla_tpu.resilience.failover import FailoverCoordinator  # noqa: F401

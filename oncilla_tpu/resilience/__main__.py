@@ -1,0 +1,197 @@
+"""``python -m oncilla_tpu.resilience`` — chaos harness CLI.
+
+``--smoke`` runs the canonical kill-the-owner scenario end to end,
+TWICE, hardware-free, in-process:
+
+  3-daemon local_cluster, OCM_REPLICAS=2, fast-detection config. A
+  client writes half its data, then a seeded chaos schedule kills the
+  owner daemon mid-workload (plus a couple of connection faults). The
+  run asserts: every subsequent get() is byte-exact via the promoted
+  replica, re-replication restores k=2 on a fresh rank, and — the
+  determinism contract — the second run with the same seed injected the
+  IDENTICAL fault interleaving (op-indexed chaos log compares equal).
+
+``--plan`` prints the generated schedule for a seed without running
+anything (what would be injected where).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from oncilla_tpu.resilience.chaos import ChaosController, ChaosSchedule, Fault
+
+
+def _scenario_schedule(seed: int, owner: int) -> ChaosSchedule:
+    """Kill the owner early in the chaotic phase, with a dropped lease
+    before it and a delayed one after — enough turbulence to exercise
+    the retry ladder without drowning the log."""
+    return ChaosSchedule.kill_at(
+        seed, owner, op=4,
+        extra=(
+            Fault(op=2, action="drop"),
+            Fault(op=7, action="delay", delay_s=0.002),
+        ),
+    )
+
+
+def run_scenario(seed: int, verbose: bool = False) -> dict:
+    """One full kill-owner-mid-workload run; returns the replay record
+    (schedule + fired log + outcome) and raises on any failed check."""
+    import numpy as np
+
+    from oncilla_tpu.core.kinds import OcmKind
+    from oncilla_tpu.runtime.cluster import local_cluster
+    from oncilla_tpu.utils.config import OcmConfig
+
+    cfg = OcmConfig(
+        host_arena_bytes=32 << 20,
+        device_arena_bytes=8 << 20,
+        heartbeat_s=0.05,
+        lease_s=5.0,
+        replicas=2,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        dcn_stripes=2,
+        dcn_stripe_min_bytes=1 << 20,
+        chunk_bytes=256 << 10,
+    )
+    total = 4 << 20
+    half = total // 2
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, total, dtype=np.uint8)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0)
+        h = client.alloc(total, OcmKind.REMOTE_HOST)
+        assert h.replica_ranks, "OCM_REPLICAS=2 placement assigned no replica"
+        owner = h.rank
+        if verbose:
+            print(f"  alloc {h.alloc_id}: primary rank {owner}, "
+                  f"replicas {h.replica_ranks}")
+        client.put(h, data[:half], 0)  # calm half
+
+        schedule = _scenario_schedule(seed, owner)
+        controller = ChaosController(schedule, cl.entries, kill_fn=cl.kill)
+        with controller.inject():
+            # Chaotic half: the kill fires at a fixed logical op index
+            # while these puts (and the cluster's own background traffic)
+            # drive the lease counter.
+            step = 512 << 10
+            for off in range(half, total, step):
+                client.put(h, data[off:off + step], off)
+            got = client.get(h, total)
+        assert bytes(got) == data.tobytes(), (
+            "get after owner kill is not byte-exact"
+        )
+        assert not controller.pending(), (
+            f"workload too short for schedule: {controller.pending()}"
+        )
+        promoted = h.rank
+        assert promoted != owner, "handle never failed over"
+
+        # Re-replication restores k: the promoted primary's chain grows
+        # back to 2 members, none of them the dead rank, and the fresh
+        # copy is byte-exact.
+        deadline = time.monotonic() + 20.0
+        chain = ()
+        while time.monotonic() < deadline:
+            try:
+                e = cl.daemons[promoted].registry.lookup(h.alloc_id)
+            except Exception:  # noqa: BLE001 — registry churn mid-failover
+                time.sleep(0.05)
+                continue
+            chain = e.chain
+            if len(chain) >= 2 and owner not in chain:
+                break
+            time.sleep(0.05)
+        assert len(chain) >= 2 and owner not in chain, (
+            f"re-replication never restored k=2 (chain={chain})"
+        )
+        new_rep = next(r for r in chain if r != promoted)
+        re = cl.daemons[new_rep].registry.lookup(h.alloc_id)
+        rep_bytes = bytes(
+            cl.daemons[new_rep].host_arena.view(re.extent)
+        )[: re.nbytes]
+        assert rep_bytes == data.tobytes(), (
+            "re-replicated copy is not byte-exact"
+        )
+        got2 = client.get(h, total)
+        assert bytes(got2) == data.tobytes()
+        epoch = cl.daemons[0].epoch
+        counters = dict(cl.daemons[0].res_counters)
+    return {
+        "seed": seed,
+        "schedule": schedule,
+        "log": list(controller.log),
+        "owner": owner,
+        "promoted": promoted,
+        "chain": list(chain),
+        "epoch": epoch,
+        "counters": counters,
+    }
+
+
+def smoke(seed: int, verbose: bool = False) -> int:
+    print(f"resilience smoke: seed={seed} run 1/2 ...")
+    r1 = run_scenario(seed, verbose=verbose)
+    print(f"  owner rank {r1['owner']} killed -> promoted rank "
+          f"{r1['promoted']}, chain restored to {r1['chain']}, "
+          f"epoch {r1['epoch']}")
+    print(f"  chaos log: {r1['log']}")
+    print(f"resilience smoke: seed={seed} run 2/2 (replay) ...")
+    r2 = run_scenario(seed, verbose=verbose)
+    print(f"  chaos log: {r2['log']}")
+    if r1["schedule"] != r2["schedule"]:
+        print("resilience smoke: FAIL — schedules differ across runs")
+        return 1
+    if r1["log"] != r2["log"]:
+        print("resilience smoke: FAIL — fault interleavings differ: "
+              f"{r1['log']} vs {r2['log']}")
+        return 1
+    if (r1["owner"], r1["promoted"]) != (r2["owner"], r2["promoted"]):
+        print("resilience smoke: FAIL — failover outcome differs")
+        return 1
+    print("resilience smoke: OK — kill-owner failover byte-exact, k "
+          "restored, identical interleaving replayed")
+    return 0
+
+
+def main(argv=None) -> int:
+    from oncilla_tpu.utils.platform import honor_cpu_env
+
+    honor_cpu_env()
+    ap = argparse.ArgumentParser(
+        prog="python -m oncilla_tpu.resilience",
+        description="chaos/failover harness",
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the kill-owner scenario twice and verify "
+                         "byte-exact failover + deterministic replay")
+    ap.add_argument("--plan", action="store_true",
+                    help="print the generated random schedule for --seed")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--nranks", type=int, default=3)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if args.plan:
+        sched = ChaosSchedule.generate(
+            args.seed, args.nranks,
+            actions=("drop", "delay", "partition", "heal", "kill"),
+        )
+        for f in sched.faults:
+            print(f"op {f.op:>4}: {f.action}"
+                  + (f" rank {f.rank}" if f.rank >= 0 else "")
+                  + (f" ({f.delay_s}s)" if f.action == "delay" else ""))
+        return 0
+    if args.smoke:
+        return smoke(args.seed, verbose=args.verbose)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,9 +40,12 @@ from oncilla_tpu.obs import trace as obs_trace
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime.protocol import (
+    ErrCode,
     FLAG_CAP_COALESCE,
+    FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
     FLAG_MORE,
+    FLAG_REPLICAS,
     FLAG_TRACE_CTX,
     VALID_FLAGS,
     WIRE_KIND,
@@ -264,14 +267,7 @@ class ControlPlaneClient:
         self.tracer = GLOBAL_TRACER
         self._pool = PeerPool()
         me = entries[rank]
-        try:
-            self._ctrl = socket.create_connection(
-                (me.connect_host, me.port), timeout=30.0
-            )
-        except OSError as e:
-            raise OcmConnectError(
-                f"local daemon unreachable at {me.connect_host}:{me.port}: {e}"
-            ) from e
+        self._ctrl = self._connect_ctrl(me.connect_host, me.port)
         self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._ctrl_lock = make_lock("client._ctrl_lock")
         # Which ranks own this app's live remote allocations (rank -> count).
@@ -286,18 +282,28 @@ class ControlPlaneClient:
         self._dcn_caps: dict[tuple[str, int], int] = {}
         self._dcn_tuners: dict[tuple[str, int], _PeerTuner] = {}
         self._dcn_lock = make_lock("client._dcn_lock")
+        # Handle-failover swap guard: concurrent stripes retrying the
+        # same handle must repoint it (and fix owner accounting) exactly
+        # once (resilience/).
+        self._fo_lock = make_lock("client._fo_lock")
         # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132), offering
-        # the trace capability: granted bits gate whether _request may
-        # prefix trace context on this ctrl stream. Must be 0 while the
-        # handshake itself is in flight.
+        # the trace capability — and, when OCM_REPLICAS > 1, the replica
+        # capability (never offered at k=1, so the default wire is
+        # byte-for-byte the pre-replication protocol). Granted bits gate
+        # whether _request may prefix trace context / whether alloc may
+        # request replicated placements on this ctrl stream. Must be 0
+        # while the handshake itself is in flight.
         self._ctrl_caps = 0
+        offer = (FLAG_CAP_TRACE if self.config.trace else 0) | (
+            FLAG_CAP_REPLICA if self.config.replicas > 1 else 0
+        )
         r = self._request(Message(
             MsgType.CONNECT, {"pid": self.pid, "rank": rank},
-            flags=FLAG_CAP_TRACE if self.config.trace else 0,
+            flags=offer,
         ))
         if r.type != MsgType.CONNECT_CONFIRM:
             raise OcmConnectError(f"bad handshake reply {r.type.name}")
-        self._ctrl_caps = r.flags & FLAG_CAP_TRACE
+        self._ctrl_caps = r.flags & (FLAG_CAP_TRACE | FLAG_CAP_REPLICA)
         self.nnodes = r.fields["nnodes"]
         self._plane_server: _PlaneServer | None = None
         if ici_plane is not None and serve_plane:
@@ -318,6 +324,33 @@ class ControlPlaneClient:
             t.start()
 
     # -- plumbing --------------------------------------------------------
+
+    def _connect_ctrl(self, host: str, port: int) -> socket.socket:
+        """Dial the local daemon with capped exponential backoff +
+        jitter: a daemon restarting (snapshot restore, mid-failover
+        replacement) refuses connections for a beat, and a hard error on
+        the very first attempt would surface that routine window to the
+        app. Jitter (uniform in [0.5, 1.0] of the step) keeps a herd of
+        clients from re-dialing a rebinding daemon in lockstep."""
+        import random
+
+        cfg = self.config
+        delay = max(cfg.connect_backoff_s, 1e-3)
+        last: OSError | None = None
+        for attempt in range(cfg.connect_retries + 1):
+            try:
+                return socket.create_connection((host, port), timeout=30.0)
+            except OSError as e:
+                last = e
+                if attempt == cfg.connect_retries:
+                    break
+                step = min(delay, cfg.connect_backoff_cap_s)
+                time.sleep(step * (0.5 + random.random() / 2))
+                delay *= 2
+        raise OcmConnectError(
+            f"local daemon unreachable at {host}:{port} after "
+            f"{cfg.connect_retries + 1} attempts: {last}"
+        ) from last
 
     def _request(self, msg: Message) -> Message:
         # Trace propagation: an ambient span context (Ocm.put/get/alloc
@@ -433,17 +466,28 @@ class ControlPlaneClient:
     # -- RemoteBackend: alloc / free ------------------------------------
 
     def alloc(self, nbytes: int, kind: OcmKind) -> OcmAlloc:
-        r = self._request(
-            Message(
-                MsgType.REQ_ALLOC,
-                {
-                    "orig_rank": self.rank,
-                    "pid": self.pid,
-                    "kind": WIRE_KIND[kind.value],
-                    "nbytes": nbytes,
-                },
-            )
+        req = Message(
+            MsgType.REQ_ALLOC,
+            {
+                "orig_rank": self.rank,
+                "pid": self.pid,
+                "kind": WIRE_KIND[kind.value],
+                "nbytes": nbytes,
+            },
         )
+        # k-way replication: only after the daemon granted
+        # FLAG_CAP_REPLICA at CONNECT, only for host kinds (device bytes
+        # live in the app plane). Un-granted (old daemon, native daemon,
+        # OCM_REPLICAS unset) allocations are single-copy and the frame
+        # is byte-identical to the pre-replication wire.
+        if (
+            self.config.replicas > 1
+            and self._ctrl_caps & FLAG_CAP_REPLICA
+            and kind == OcmKind.REMOTE_HOST
+        ):
+            req.flags |= FLAG_REPLICAS
+            req.data = bytes([self.config.replicas])
+        r = self._request(req)
         f = r.fields
         placed_kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         fabric = (
@@ -463,7 +507,23 @@ class ControlPlaneClient:
         )
         h.owner_addr = (f["owner_host"], f["owner_port"])  # for the DCN path
         h.daemon_owned = True  # even when demoted: the daemon holds the bytes
+        # Replica ranks ride an optional JSON data tail on ALLOC_RESULT
+        # (only present for replicated placements); they are the client's
+        # failover candidates AND extra lease owners — heartbeats and the
+        # DISCONNECT reclamation fan-out must reach every holder.
+        if r.data:
+            import json
+
+            try:
+                reps = json.loads(bytes(r.data)).get("replicas", [])
+                h.replica_ranks = tuple(
+                    int(x) for x in reps if int(x) != h.rank
+                )
+            except (ValueError, TypeError):
+                pass  # tail from a future daemon we don't understand
         self._note_owner(h.rank, +1)
+        for rr in h.replica_ranks:
+            self._note_owner(rr, +1)
         # Device-arm scrub (calloc parity, alloc.c:171): the daemon only
         # BOOKS device extents — the bytes live in the plane's arena. The
         # authoritative scrub is the owner daemon's free-time PLANE_SCRUB
@@ -493,6 +553,8 @@ class ControlPlaneClient:
             )
         )
         self._note_owner(handle.rank, -1)
+        for rr in handle.replica_ranks:
+            self._note_owner(rr, -1)
 
     # -- RemoteBackend: one-sided data ----------------------------------
 
@@ -604,13 +666,22 @@ class ControlPlaneClient:
             entries = self._pool.lease_set(addr[0], addr[1], nstripes)
         except OcmConnectError:
             # Stale cached owner_addr (owner daemon restarted on a new
-            # port): same membership-table fallback the per-stripe retry
-            # uses, applied to the stripe-set lease itself.
-            e = self.entries[handle.rank]
-            handle.owner_addr = addr = (e.connect_host, e.port)
-            printd("leasing stripe set via membership address %s:%d",
-                   e.connect_host, e.port)
-            entries = self._pool.lease_set(addr[0], addr[1], nstripes)
+            # port) or a dead owner: walk the failover candidates — the
+            # membership address for the owner rank, then each replica
+            # rank — the same ladder the per-stripe retry climbs.
+            entries = None
+            for rank_i, cand in self._failover_candidates(handle):
+                try:
+                    entries = self._pool.lease_set(cand[0], cand[1], nstripes)
+                except OcmConnectError:
+                    continue
+                printd("leasing stripe set via rank %d at %s:%d",
+                       rank_i, cand[0], cand[1])
+                self._failover_handle(handle, rank_i, cand)
+                addr = cand
+                break
+            if entries is None:
+                raise
         # Contention shrank the set: re-split so every leased socket
         # still carries a contiguous range of its fair share.
         nstripes = len(entries)
@@ -661,38 +732,116 @@ class ControlPlaneClient:
             raise failures[0]
         return stats
 
+    def _failover_candidates(
+        self, handle: OcmAlloc
+    ) -> list[tuple[int, tuple[str, int]]]:
+        """Retry ladder for a transfer that can't reach (or is refused
+        by) the cached owner: the membership address of the owner rank
+        first (covers restarts on a new port), then each replica rank in
+        chain order — the first survivor is, by the deterministic
+        promotion rule, the new primary."""
+        out = []
+        e = self.entries[handle.rank]
+        out.append((handle.rank, (e.connect_host, e.port)))
+        for rr in handle.replica_ranks:
+            if 0 <= rr < len(self.entries) and rr != handle.rank:
+                e = self.entries[rr]
+                out.append((rr, (e.connect_host, e.port)))
+        return out
+
+    def _failover_handle(
+        self, handle: OcmAlloc, new_rank: int, addr: tuple[str, int]
+    ) -> None:
+        """Repoint a handle at the rank that just served it. Once-only
+        under a lock (concurrent stripes race here): the dead old owner
+        leaves the heartbeat/reclaim owner set exactly once; the promoted
+        rank was already counted as a replica owner at alloc time."""
+        with self._fo_lock:
+            old = handle.rank
+            if old == new_rank:
+                handle.owner_addr = addr
+                return
+            handle.rank = new_rank
+            handle.owner_addr = addr
+            handle.replica_ranks = tuple(
+                r for r in handle.replica_ranks if r != new_rank
+            )
+        obs_journal.record(
+            "client_failover", alloc_id=handle.alloc_id,
+            old_rank=old, new_rank=new_rank,
+        )
+        printd("handle %d failed over: owner rank %d -> %d",
+               handle.alloc_id, old, new_rank)
+        self._note_owner(old, -1)
+
+    # Retryable wire rejections: a fenced stale owner (STALE_EPOCH), a
+    # replica still waiting for its primary's death verdict (NOT_PRIMARY),
+    # and a primary that can't yet honor the replication contract
+    # (REPLICA_UNAVAILABLE). All three are failover-window conditions the
+    # detector resolves within a few probe intervals.
+    _RETRYABLE_CODES = frozenset({
+        int(ErrCode.STALE_EPOCH),
+        int(ErrCode.NOT_PRIMARY),
+        int(ErrCode.REPLICA_UNAVAILABLE),
+    })
+
+    @classmethod
+    def _is_failover_err(cls, err: BaseException) -> bool:
+        """Transport failures and retryable typed rejections mean 'try
+        the next candidate'; every other remote error is an application
+        error and propagates."""
+        if isinstance(err, OcmRemoteError):
+            return err.code in cls._RETRYABLE_CODES
+        return isinstance(err, (OSError, OcmConnectError, OcmProtocolError))
+
     def _stripe_run(
         self, handle: OcmAlloc, start: int, length: int, offset: int,
         put_mv, get_arr, addr, entry, stats: dict, idx: int,
     ) -> None:
         """One stripe with the idempotent-retry contract: DATA_PUT/DATA_GET
-        carry absolute offsets (same bytes, same places), so a transport
-        failure mid-stripe gets one full re-run of THIS stripe — through
-        the membership table's address for the owner rank, covering
-        daemons that restarted (snapshot restore) on a new port with a
-        stale cached owner_addr or a dead pooled connection. A failed
-        stripe only ever rewrites its own byte range, so sibling stripes'
+        carry absolute offsets (same bytes, same places), so a retryable
+        failure mid-stripe gets a full re-run of THIS stripe — first
+        through the membership table's address for the owner rank
+        (daemons that restarted on a new port), then through each replica
+        rank (owner failover: the promoted replica serves the same
+        alloc_id). The ladder is re-walked with a short pause until
+        ``failover_wait_s`` elapses, because the retryable window IS the
+        failure-detection latency: a put that races the owner's death
+        verdict succeeds a few probe intervals later. A failed stripe
+        only ever rewrites its own byte range, so sibling stripes'
         destination views stay intact."""
         try:
             self._stripe_once(handle, start, length, offset, put_mv,
                               get_arr, addr, entry, stats, idx)
             return
-        except (OSError, OcmConnectError, OcmProtocolError) as err:
-            if isinstance(err, OcmRemoteError):
-                raise  # application error: the transfer itself was rejected
-            e = self.entries[handle.rank]
-            handle.owner_addr = (e.connect_host, e.port)
-            stats["retries"][idx] += 1
-            obs_journal.record(
-                "stripe_retry",
-                stripe=idx, alloc_id=handle.alloc_id, owner_rank=handle.rank,
-                nbytes=length, error=f"{type(err).__name__}: {err}",
-            )
-            printd("retrying stripe %d via membership address %s:%d",
-                   idx, e.connect_host, e.port)
-            self._stripe_once(handle, start, length, offset, put_mv,
-                              get_arr, (e.connect_host, e.port), None,
-                              stats, idx)
+        except BaseException as err:
+            if not self._is_failover_err(err):
+                raise
+            last: BaseException = err
+        deadline = time.monotonic() + self.config.failover_wait_s
+        while True:
+            for rank_i, cand in self._failover_candidates(handle):
+                stats["retries"][idx] += 1
+                obs_journal.record(
+                    "stripe_retry",
+                    stripe=idx, alloc_id=handle.alloc_id, owner_rank=rank_i,
+                    nbytes=length, error=f"{type(last).__name__}: {last}",
+                )
+                printd("retrying stripe %d via rank %d at %s:%d",
+                       idx, rank_i, cand[0], cand[1])
+                try:
+                    self._stripe_once(handle, start, length, offset, put_mv,
+                                      get_arr, cand, None, stats, idx)
+                except BaseException as err:
+                    if not self._is_failover_err(err):
+                        raise
+                    last = err
+                    continue
+                self._failover_handle(handle, rank_i, cand)
+                return
+            if time.monotonic() >= deadline:
+                raise last
+            time.sleep(0.05)  # let the detector/promotion window close
 
     def _stripe_once(
         self, handle: OcmAlloc, start: int, length: int, offset: int,

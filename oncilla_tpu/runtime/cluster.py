@@ -60,6 +60,13 @@ class LocalCluster:
         """An Ocm context whose remote arms ride this cluster."""
         return Ocm(config=self.config, remote=self.client(rank, ici_plane=ici_plane, **kw))
 
+    def kill(self, rank: int) -> None:
+        """Hard-kill one daemon (no snapshot, no drain): the crashed-owner
+        scenario the resilience subsystem exists for. The daemon object
+        stays in ``daemons`` so teardown's stop() (idempotent) still
+        runs; chaos schedules use this as their kill_fn."""
+        self.daemons[rank].kill()
+
     def stop(self) -> None:
         with self._lock:
             clients, self.clients = self.clients, []

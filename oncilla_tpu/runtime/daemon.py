@@ -35,8 +35,10 @@ from oncilla_tpu.core.errors import (
     OcmInvalidHandle,
     OcmOutOfMemory,
     OcmPlacementError,
+    OcmNotPrimary,
     OcmProtocolError,
     OcmRemoteError,
+    OcmReplicaUnavailable,
 )
 from oncilla_tpu.core.hostmem import HostArena
 from oncilla_tpu.core.kinds import OcmKind
@@ -49,10 +51,15 @@ from oncilla_tpu.runtime.placement import (
 )
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
+from oncilla_tpu.resilience.detector import FailureDetector, PeerState, probe
+from oncilla_tpu.resilience.failover import FailoverCoordinator
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
+    FLAG_FANOUT,
     FLAG_MORE,
+    FLAG_REPLICAS,
     FLAG_TRACE_CTX,
     VALID_FLAGS,
     WIRE_KIND,
@@ -151,6 +158,35 @@ class Daemon:
         # time (measured ~4x the warm-copy cost); each connection has its
         # own serve thread, so thread-local reuse needs no locking.
         self._get_buf = threading.local()
+        # -- resilience (resilience/) -----------------------------------
+        # Cluster epoch: bumped by rank 0 on every DEAD verdict, gossiped
+        # on PING and adopted max-wins everywhere; a fenced daemon (one
+        # that outlived its own DEAD verdict) refuses writes with
+        # STALE_EPOCH so it can never serve split-brain traffic. The
+        # incarnation is this daemon OBJECT's identity: a restarted
+        # daemon on the same port has a fresh one, so a stale fencing
+        # broadcast can never hit the replacement.
+        self.epoch = 0
+        self._epoch_lock = make_lock("daemon._epoch_lock")
+        self._fenced = False
+        self.incarnation = int.from_bytes(os.urandom(8), "little") or 1
+        self.res_counters = {
+            "deaths": 0,           # DEAD verdicts issued (rank 0 only)
+            "promotions": 0,       # replica entries promoted to primary here
+            "rereplications": 0,   # repair copies driven (rank 0 only)
+            "repl_put_errors": 0,  # put fan-out legs that failed
+            "repl_put_skips": 0,   # fan-out legs skipped (replica DEAD)
+        }
+        self.detector = (
+            FailureDetector(
+                len(entries), rank,
+                suspect_after=self.config.suspect_after,
+                dead_after=self.config.dead_after,
+            )
+            if self.config.detect and len(entries) > 1 else None
+        )
+        self._failover = FailoverCoordinator(self) if rank == 0 else None
+        self._last_probe = time.monotonic()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -227,6 +263,60 @@ class Daemon:
             except OSError:
                 printd("daemon %d: snapshot write failed", self.rank)
         self.peers.close()
+
+    def kill(self) -> None:
+        """Hard-kill (resilience/chaos.py): the crash the failover
+        machinery exists for. No snapshot, no drain, no courtesy to
+        in-flight requests — every socket is torn down NOW, exactly what
+        a SIGKILL'd daemon process looks like to its peers. Idempotent;
+        a later :meth:`stop` (cluster teardown) is a no-op on top."""
+        self._started_ok = False  # a kill must never write a snapshot
+        self._running.clear()
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_mu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.peers.close()
+
+    # -- epoch / fencing (resilience/) -----------------------------------
+
+    def bump_epoch(self) -> int:
+        """Rank-0 only: advance the cluster epoch for a DEAD verdict."""
+        with self._epoch_lock:
+            self.epoch += 1
+            return self.epoch
+
+    def _adopt_epoch(self, epoch: int) -> None:
+        """Max-wins epoch gossip (PING and every resilience message)."""
+        with self._epoch_lock:
+            if epoch > self.epoch:
+                self.epoch = epoch
+
+    def _fence(self, epoch: int) -> None:
+        if not self._fenced:
+            self._fenced = True
+            obs_journal.record(
+                "fenced", track=self.tracer.track,
+                rank=self.rank, epoch=epoch,
+            )
+            printd("daemon %d FENCED at epoch %d: refusing writes",
+                   self.rank, epoch)
 
     # -- checkpoint / resume (SURVEY.md §5.4 upgrade) --------------------
 
@@ -467,6 +557,10 @@ class Daemon:
                         reply = self._dispatch(msg)
                 except OcmOutOfMemory as e:
                     reply = _err(ErrCode.OOM, str(e))
+                except OcmReplicaUnavailable as e:
+                    reply = _err(ErrCode.REPLICA_UNAVAILABLE, str(e))
+                except OcmNotPrimary as e:
+                    reply = _err(ErrCode.NOT_PRIMARY, str(e))
                 except OcmBoundsError as e:
                     reply = _err(ErrCode.BOUNDS, str(e))
                 except OcmInvalidHandle as e:
@@ -531,6 +625,86 @@ class Daemon:
                 )
             if self._plane_unsynced:
                 self._sync_plane_endpoint()
+            try:
+                self._detector_tick()
+            except Exception as e:  # noqa: BLE001 — liveness must never
+                # kill the reaper thread (leases matter more than probes)
+                printd("daemon %d: detector tick failed: %s", self.rank, e)
+
+    # -- failure detection (resilience/detector.py) ----------------------
+
+    def _probe_ranks(self) -> list[int]:
+        """Star topology + one neighbor: rank 0 probes everyone (it is
+        the arbiter); every other rank probes rank 0 plus its next
+        neighbor, so each non-master is watched by a second witness whose
+        SUSPECT report gives rank 0 an early arbitration trigger. Total
+        probe load stays O(n) per interval."""
+        det = self.detector
+        allowed = set(det.probe_targets())
+        if self.rank == 0:
+            return sorted(allowed)
+        n = len(self.entries)
+        targets = [0]
+        r = (self.rank + 1) % n
+        while r in (self.rank, 0):
+            r = (r + 1) % n
+            if r == self.rank:  # 2-node cluster: rank 0 is the only peer
+                break
+        if r not in (self.rank, 0):
+            targets.append(r)
+        return [t for t in targets if t in allowed]
+
+    def _detector_tick(self) -> None:
+        det = self.detector
+        if det is None or self._fenced or not self._running.is_set():
+            return
+        now = time.monotonic()
+        if now - self._last_probe < self.config.detect_interval_s:
+            return
+        self._last_probe = now
+        for r in self._probe_ranks():
+            e = self.entries[r]
+            if e.port == 0:
+                continue  # ephemeral-port test daemon not started yet
+            res = probe(
+                e.connect_host, e.port, self.rank, self.epoch,
+                self.incarnation, timeout=self.config.probe_timeout_s,
+            )
+            if not self._running.is_set():
+                return
+            if res == (-1, -1):
+                # The peer (rank 0) says WE were declared dead: fence.
+                self._fence(self.epoch)
+                return
+            if res is not None:
+                self._adopt_epoch(res[0])
+                prev = det.record_ok(r, res[1])
+                if prev == PeerState.DEAD:
+                    obs_journal.record(
+                        "node_recovered", track=self.tracer.track, rank=r,
+                    )
+                    if self.rank == 0:
+                        self.policy.mark_alive(r)
+                continue
+            st = det.record_fail(r)
+            if st == PeerState.DEAD:
+                # Evict pooled connections NOW: stale sockets to a dead
+                # rank otherwise fail lazily, one costly error per lease.
+                self.peers.evict(e.connect_host, e.port)
+            if st == PeerState.SUSPECT and self.rank != 0:
+                r0 = self.entries[0]
+                try:
+                    self.peers.request(
+                        r0.connect_host, r0.port,
+                        Message(MsgType.SUSPECT_NODE,
+                                {"rank": r, "reporter": self.rank,
+                                 "epoch": self.epoch}),
+                    )
+                except (OSError, OcmError):
+                    printd("daemon %d: SUSPECT report for %d failed",
+                           self.rank, r)
+            elif st == PeerState.DEAD and self.rank == 0:
+                self._failover.node_dead(r)
 
     # -- trace-aware peer forwarding -------------------------------------
 
@@ -587,6 +761,15 @@ class Daemon:
     # -- dispatch --------------------------------------------------------
 
     def _dispatch(self, msg: Message) -> Message:
+        if self._fenced and msg.type in _FENCED_REJECT:
+            # A fenced daemon outlived its own DEAD verdict: its replicas
+            # were promoted under a newer epoch, so serving data or
+            # granting extents here would be split-brain. Clients treat
+            # STALE_EPOCH as a failover signal and retry the chain.
+            return _err(
+                ErrCode.STALE_EPOCH,
+                f"rank {self.rank} fenced at epoch {self.epoch}",
+            )
         h = _HANDLERS.get(msg.type)
         if h is None:
             return _err(ErrCode.BAD_MSG, f"unhandled message {msg.type.name}")
@@ -605,7 +788,8 @@ class Daemon:
                 "nnodes": self.policy.nnodes if self.rank == 0
                 else len(self.entries),
             },
-            flags=msg.flags & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE),
+            flags=msg.flags
+            & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA),
         )
 
     def _on_disconnect(self, msg: Message) -> Message:
@@ -662,6 +846,10 @@ class Daemon:
                 host_arena_bytes=f["host_arena_bytes"],
             )
         )
+        # A (re)joining daemon is a fresh process: clear any DEAD verdict
+        # (revival happens HERE, never via pings — see _on_ping).
+        if self.detector is not None:
+            self.detector.mark_alive(f["rank"])
         # Record the peer's address for forwarding. A nodefile-provided
         # connect address wins over the announced hostname (the announcement
         # carries the daemon's bind host, which may not be routable).
@@ -691,7 +879,19 @@ class Daemon:
             return self._peer_request(r0.connect_host, r0.port, msg)
         kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         nbytes = f["nbytes"]
-        placed = self.policy.place(f["orig_rank"], kind, nbytes)
+        # k-way replication (FLAG_REPLICAS, granted at CONNECT by
+        # FLAG_CAP_REPLICA): the data tail's one u8 is the requested copy
+        # count. Host kinds only — device bytes live in the app plane.
+        k = 1
+        if (
+            msg.flags & FLAG_REPLICAS
+            and len(msg.data) >= 1
+            and kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
+        ):
+            k = max(1, min(int(bytes(msg.data[:1])[0]), 8))
+        placed = self.policy.place(f["orig_rank"], kind, nbytes, replicas=k)
+        if placed.replica_ranks:
+            return self._alloc_replicated(f, placed, nbytes)
         owner = self.entries[placed.rank]
         if placed.rank == self.rank:
             alloc_id, offset = self._do_alloc_local(
@@ -727,6 +927,146 @@ class Daemon:
                 "owner_host": owner.connect_host,
                 "owner_port": owner.port,
             },
+        )
+
+    def _alloc_replicated(self, f: dict, placed, nbytes: int) -> Message:
+        """Provision a k-way replicated allocation (rank 0 only): one
+        alloc_id minted HERE (rank 0's id space is globally unique, so
+        every chain member can register the same id), then DO_REPLICA to
+        each chain member — primary first. The primary must succeed; a
+        replica that fails provisioning just shrinks the chain (degraded,
+        journaled), and the confirmed members are re-sent the corrected
+        chain (DO_REPLICA upserts an existing entry's chain), so every
+        holder agrees on the promotion order."""
+        import json
+
+        chain = (placed.rank, *placed.replica_ranks)
+        alloc_id = self.registry.next_id()
+        csv = ",".join(str(r) for r in chain)
+        confirmed: list[int] = []
+        offset0 = 0
+        for rr in chain:
+            m = Message(
+                MsgType.DO_REPLICA,
+                {
+                    "alloc_id": alloc_id,
+                    "kind": WIRE_KIND[placed.kind.value],
+                    "nbytes": nbytes,
+                    "orig_rank": f["orig_rank"],
+                    "pid": f["pid"],
+                    "chain": csv,
+                    "epoch": self.epoch,
+                },
+            )
+            try:
+                if rr == self.rank:
+                    r = self._on_do_replica(m)
+                else:
+                    e = self.entries[rr]
+                    r = self._peer_request(e.connect_host, e.port, m)
+            except (OSError, OcmError):
+                if rr == placed.rank:
+                    raise  # no primary, no allocation
+                obs_journal.record(
+                    "replica_provision_fail", track=self.tracer.track,
+                    alloc_id=alloc_id, rank=rr,
+                )
+                printd("daemon 0: replica provision on rank %d failed", rr)
+                continue
+            if rr == placed.rank:
+                offset0 = r.fields["offset"]
+            confirmed.append(rr)
+            self.policy.note_alloc(
+                Placement(rank=rr, device_index=0, kind=placed.kind), nbytes
+            )
+        if len(confirmed) < len(chain):
+            fixed = ",".join(str(r) for r in confirmed)
+            m2_fields = {
+                "alloc_id": alloc_id,
+                "kind": WIRE_KIND[placed.kind.value],
+                "nbytes": nbytes,
+                "orig_rank": f["orig_rank"],
+                "pid": f["pid"],
+                "chain": fixed,
+                "epoch": self.epoch,
+            }
+            for rr in confirmed:
+                try:
+                    if rr == self.rank:
+                        self._on_do_replica(
+                            Message(MsgType.DO_REPLICA, dict(m2_fields))
+                        )
+                    else:
+                        e = self.entries[rr]
+                        self._peer_request(
+                            e.connect_host, e.port,
+                            Message(MsgType.DO_REPLICA, dict(m2_fields)),
+                        )
+                except (OSError, OcmError):
+                    printd("daemon 0: chain fixup on rank %d failed", rr)
+        owner = self.entries[placed.rank]
+        return Message(
+            MsgType.ALLOC_RESULT,
+            {
+                "alloc_id": alloc_id,
+                "rank": placed.rank,
+                "device_index": placed.device_index,
+                "kind": WIRE_KIND[placed.kind.value],
+                "offset": offset0,
+                "nbytes": nbytes,
+                "owner_host": owner.connect_host,
+                "owner_port": owner.port,
+            },
+            # Replica ranks ride as a JSON data tail: old clients parse
+            # the fixed fields and ignore trailing data, so the reply
+            # stays v2-compatible.
+            json.dumps({"replicas": confirmed[1:]}).encode(),
+        )
+
+    def _on_do_replica(self, msg: Message) -> Message:
+        """Provision (or chain-update) one member of a replica chain.
+        Idempotent upsert: an existing entry just adopts the new chain —
+        how degraded-chain fixups and re-replication chain extensions
+        reach surviving holders."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        if kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            raise OcmInvalidHandle("only host-kind allocations replicate")
+        chain = tuple(_parse_owners(f["chain"]))
+        try:
+            existing = self.registry.lookup(f["alloc_id"])
+        except OcmInvalidHandle:
+            existing = None
+        if existing is not None:
+            self.registry.set_chain(f["alloc_id"], chain, f["epoch"])
+            return Message(
+                MsgType.DO_REPLICA_OK,
+                {"alloc_id": f["alloc_id"],
+                 "offset": existing.extent.offset},
+            )
+        extent = self.host_arena.alloc(f["nbytes"])
+        self.registry.insert(
+            RegEntry(
+                alloc_id=f["alloc_id"],
+                kind=kind,
+                rank=self.rank,
+                device_index=0,
+                extent=extent,
+                nbytes=f["nbytes"],
+                origin_rank=f["orig_rank"],
+                origin_pid=f["pid"],
+                lease_expiry=self.registry.new_lease_deadline(),
+                chain=chain,
+                epoch=f["epoch"],
+            )
+        )
+        alloctrace.note_alloc(
+            self._trace_scope, f["alloc_id"], f["nbytes"], kind.name
+        )
+        return Message(
+            MsgType.DO_REPLICA_OK,
+            {"alloc_id": f["alloc_id"], "offset": extent.offset},
         )
 
     # DO_ALLOC on the owner: reserve BEFORE replying (race fix).
@@ -820,6 +1160,22 @@ class Daemon:
                     pass
             self.device_books[e.device_index].free(e.extent)
         alloctrace.note_free(self._trace_scope, alloc_id)
+        # Primary of a replica chain: free the replicas too (best-effort —
+        # an unreachable replica's copy falls to its own lease reaper,
+        # since leases stop renewing once the app's handle is gone).
+        for rr in e.replica_ranks(self.rank):
+            if not 0 <= rr < len(self.entries):
+                continue
+            pe = self.entries[rr]
+            try:
+                self._peer_request(
+                    pe.connect_host, pe.port,
+                    Message(MsgType.DO_FREE, {"alloc_id": e.alloc_id}),
+                )
+            except (OSError, OcmError):
+                printd("daemon %d: replica free of %d on rank %d failed "
+                       "(lease reaper is the backstop)",
+                       self.rank, e.alloc_id, rr)
         self._note_free_rank0(e)
 
     def _note_free_rank0(self, e: RegEntry) -> None:
@@ -878,6 +1234,11 @@ class Daemon:
             e = self.registry.lookup(f["alloc_id"])
             if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
                 return None  # device relay needs the payload as a message
+            if not e.is_primary(self.rank) and not msg.flags & FLAG_FANOUT:
+                # Replica holder, client write: the handler may have to
+                # REJECT this (role discipline) — the payload must not
+                # land in the extent before that decision.
+                return None
             check_bounds(
                 Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"]
             )
@@ -886,31 +1247,112 @@ class Daemon:
         view = memoryview(self.host_arena.view(e.extent))
         return view[f["offset"]:f["offset"] + n_data]
 
+    def _believed_dead(self, rank: int) -> bool:
+        """Does THIS daemon consider ``rank`` dead (its own detector
+        verdict, or rank 0's broadcast adopted via mark_dead)? With
+        detection disabled there is no verdict and nothing is dead."""
+        return (
+            self.detector is not None
+            and self.detector.state(rank) == PeerState.DEAD
+        )
+
+    def _check_data_role(self, e: RegEntry, msg: Message) -> None:
+        """Replica-chain role discipline for client data ops: a replica
+        holder serves a CLIENT op only once it believes the primary dead
+        (acting primary, pending promotion); before that, accepting a
+        client write would fork the copies and a read could return bytes
+        the primary has already superseded. Primary-originated fan-out
+        legs (FLAG_FANOUT) always land."""
+        if e.is_primary(self.rank) or msg.flags & FLAG_FANOUT:
+            return
+        primary = e.chain[0]
+        if not self._believed_dead(primary):
+            raise OcmNotPrimary(
+                f"rank {self.rank} holds a replica of alloc {e.alloc_id}; "
+                f"primary rank {primary} is not known dead"
+            )
+
     def _on_data_put(self, msg: Message) -> Message:
         f = msg.fields
         e = self.registry.lookup(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
+        self._check_data_role(e, msg)
         if len(msg.data) != f["nbytes"]:
             raise OcmProtocolError("DATA_PUT length mismatch")
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
-        if getattr(msg, "data_landed", False):
-            # Payload already recv'd straight into the arena extent by
-            # _route_put_payload; the lookup above re-validated the alloc
-            # is still live post-recv.
-            return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
-        import numpy as np
+        if not getattr(msg, "data_landed", False):
+            import numpy as np
 
-        self.host_arena.write(
-            e.extent, np.frombuffer(msg.data, dtype=np.uint8), f["offset"]
-        )
+            self.host_arena.write(
+                e.extent, np.frombuffer(msg.data, dtype=np.uint8),
+                f["offset"],
+            )
+        # else: payload already recv'd straight into the arena extent by
+        # _route_put_payload (which enforced the same role discipline).
+        if not msg.flags & FLAG_FANOUT:
+            self._fan_out_put(e, f["offset"], f["nbytes"], msg.data)
         return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
+
+    def _fan_out_put(self, e: RegEntry, offset: int, nbytes: int,
+                     data) -> None:
+        """Write replication: mirror an applied client DATA_PUT to every
+        other chain member BEFORE acking (synchronous — a byte the
+        client saw acked is on every live replica, so a promoted replica
+        serves it back byte-exact). Chain members the detector holds
+        DEAD are skipped (counted; re-replication repairs them). A
+        member that is NOT known dead but cannot be reached fails the
+        put with retryable REPLICA_UNAVAILABLE after one immediate
+        retry: acking a write the chain doesn't hold would silently
+        break the durability contract the client asked for. Runs on the
+        primary — or on a replica acting as primary once it believes the
+        primary dead (the pre-promotion window)."""
+        if not e.chain:
+            return
+        for rr in e.chain:
+            if rr == self.rank or not 0 <= rr < len(self.entries):
+                continue
+            if self._believed_dead(rr):
+                self.res_counters["repl_put_skips"] += 1
+                continue
+            pe = self.entries[rr]
+            leg = Message(
+                MsgType.DATA_PUT,
+                {"alloc_id": e.alloc_id, "offset": offset,
+                 "nbytes": nbytes},
+                data,
+                flags=FLAG_FANOUT,
+            )
+            err: Exception | None = None
+            for _ in range(2):  # one immediate retry (fresh connection)
+                try:
+                    self.peers.request(pe.connect_host, pe.port, leg)
+                    err = None
+                    break
+                except (OSError, OcmError) as exc:
+                    err = exc
+            if err is None:
+                continue
+            self.res_counters["repl_put_errors"] += 1
+            obs_journal.record(
+                "replica_put_fail", track=self.tracer.track,
+                alloc_id=e.alloc_id, rank=rr,
+                error=f"{type(err).__name__}: {err}",
+            )
+            printd("daemon %d: replica put of %d to rank %d failed",
+                   self.rank, e.alloc_id, rr)
+            raise OcmReplicaUnavailable(
+                f"replica rank {rr} unreachable for alloc {e.alloc_id} "
+                f"({type(err).__name__}: {err}); retry after the "
+                "detector resolves it"
+            )
 
     def _on_data_get(self, msg: Message) -> Message:
         f = msg.fields
         e = self.registry.lookup(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
+        self._check_data_role(e, msg)
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
         # One-copy reply payload: SNAPSHOT the extent bytes at handler
         # time (a live view would keep streaming the arena for the whole
@@ -1053,6 +1495,198 @@ class Daemon:
         """Master hop for owner daemons that don't know the endpoint."""
         return self._forward_to_plane(msg)
 
+    # -- resilience protocol (resilience/) -------------------------------
+
+    def _on_ping(self, msg: Message) -> Message:
+        """Liveness probe + epoch/incarnation gossip. A sender rank 0's
+        detector holds DEAD gets STALE_EPOCH instead of PING_OK: that is
+        how a merely-partitioned owner that heals learns it was declared
+        dead and fences itself (probe() surfaces the verdict as the
+        (-1, -1) sentinel). Revival is only ever via ADD_NODE — a fresh
+        daemon process announcing itself."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        r = f["rank"]
+        det = self.detector
+        if det is not None and 0 <= r < len(self.entries) and r != self.rank:
+            if det.state(r) == PeerState.DEAD:
+                return _err(
+                    ErrCode.STALE_EPOCH,
+                    f"rank {r} was declared dead at epoch {self.epoch}",
+                )
+            det.record_ok(r, f["inc"])
+        return Message(
+            MsgType.PING_OK,
+            {"rank": self.rank, "epoch": self.epoch,
+             "inc": self.incarnation},
+        )
+
+    def _on_suspect(self, msg: Message) -> Message:
+        """A peer's SUSPECT report; rank 0 arbitrates with its OWN probe
+        so a single partitioned reporter can never take a healthy node
+        down. Only the arbiter's consecutive-failure count reaching
+        dead_after produces the DEAD verdict."""
+        if self.rank != 0:
+            return _err(ErrCode.NOT_MASTER, "SUSPECT_NODE sent to non-master")
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        r = f["rank"]
+        det = self.detector
+        state = PeerState.ALIVE
+        if det is not None and 0 <= r < len(self.entries) and r != self.rank:
+            state = det.state(r)
+            if state != PeerState.DEAD:
+                e = self.entries[r]
+                res = probe(
+                    e.connect_host, e.port, self.rank, self.epoch,
+                    self.incarnation,
+                    timeout=self.config.probe_timeout_s,
+                )
+                if res is not None and res != (-1, -1):
+                    self._adopt_epoch(res[0])
+                    det.record_ok(r, res[1])
+                    state = PeerState.ALIVE
+                else:
+                    state = det.record_fail(r)
+                    obs_journal.record(
+                        "suspect_arbitrated", track=self.tracer.track,
+                        rank=r, reporter=f["reporter"], state=state.name,
+                    )
+                    if state == PeerState.DEAD:
+                        self._failover.node_dead(r)
+        return Message(
+            MsgType.SUSPECT_OK,
+            {"epoch": self.epoch, "state": int(state)},
+        )
+
+    def _on_epoch_update(self, msg: Message) -> Message:
+        """Rank 0's fencing broadcast for a DEAD verdict. The incarnation
+        match means the verdict fences exactly the process it was issued
+        against: a replacement daemon that rebound the same port carries
+        a fresh incarnation and ignores a stale broadcast."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        dr = f["dead_rank"]
+        if dr == self.rank:
+            if f["inc"] in (0, self.incarnation):
+                self._fence(f["epoch"])
+        elif 0 <= dr < len(self.entries):
+            if self.detector is not None:
+                self.detector.mark_dead(dr)
+            e = self.entries[dr]
+            self.peers.evict(e.connect_host, e.port)
+        return Message(MsgType.EPOCH_OK, {"epoch": self.epoch})
+
+    def _on_promote(self, msg: Message) -> Message:
+        """Reconcile the dead set against local replica chains: promote
+        where this rank is the first survivor, and report (JSON tail) the
+        allocations this rank is now primary for that lost copies."""
+        import json
+
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        dead = {r for r in _parse_owners(f["dead_ranks"]) if r != self.rank}
+        for dr in dead:
+            if self.detector is not None:
+                self.detector.mark_dead(dr)
+            if 0 <= dr < len(self.entries):
+                e = self.entries[dr]
+                self.peers.evict(e.connect_host, e.port)
+        promoted, repair = self.registry.reconcile_dead(
+            dead, self.rank, f["epoch"]
+        )
+        self.res_counters["promotions"] += len(promoted)
+        for e in promoted:
+            obs_journal.record(
+                "failover_promote", track=self.tracer.track,
+                alloc_id=e.alloc_id, chain=list(e.chain),
+                epoch=f["epoch"],
+            )
+            printd("daemon %d promoted to primary for alloc %d (epoch %d)",
+                   self.rank, e.alloc_id, f["epoch"])
+        return Message(
+            MsgType.PROMOTE_OK,
+            {"count": len(promoted)},
+            json.dumps(repair).encode() if repair else b"",
+        )
+
+    def _on_re_replicate(self, msg: Message) -> Message:
+        """Restore a lost copy: provision the target (DO_REPLICA with the
+        extended chain), stream this primary's bytes over DATA_PUT, then
+        adopt the new chain locally and push it to the surviving
+        replicas (DO_REPLICA upsert)."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        e = self.registry.lookup(f["alloc_id"])
+        if not e.is_primary(self.rank):
+            raise OcmInvalidHandle(
+                f"rank {self.rank} is not primary for alloc {f['alloc_id']}"
+            )
+        target = f["target_rank"]
+        if (
+            not 0 <= target < len(self.entries)
+            or target == self.rank
+            or target in e.chain
+        ):
+            raise OcmInvalidHandle(f"bad re-replication target {target}")
+        base_chain = e.chain or (self.rank,)
+        new_chain = (*base_chain, target)
+        csv = ",".join(str(r) for r in new_chain)
+        prov = {
+            "alloc_id": e.alloc_id,
+            "kind": WIRE_KIND[e.kind.value],
+            "nbytes": e.nbytes,
+            "orig_rank": e.origin_rank,
+            "pid": e.origin_pid,
+            "chain": csv,
+            "epoch": f["epoch"],
+        }
+        te = self.entries[target]
+        self._peer_request(
+            te.connect_host, te.port, Message(MsgType.DO_REPLICA, prov)
+        )
+        # Adopt the chain BEFORE streaming so concurrent client puts
+        # already fan out to the target; the bulk copy then overwrites
+        # (at worst) bytes the fan-out just delivered. A put landing
+        # exactly between a chunk's read and its write can still be
+        # shadowed — docs/RESILIENCE.md records the window.
+        self.registry.set_chain(e.alloc_id, new_chain, f["epoch"])
+        chunk = min(self.config.chunk_bytes, 4 << 20)
+        view = memoryview(self.host_arena.view(e.extent))[: e.nbytes]
+        pos = 0
+        while pos < e.nbytes:
+            n = min(chunk, e.nbytes - pos)
+            self.peers.request(
+                te.connect_host, te.port,
+                Message(
+                    MsgType.DATA_PUT,
+                    {"alloc_id": e.alloc_id, "offset": pos, "nbytes": n},
+                    bytes(view[pos:pos + n]),
+                    flags=FLAG_FANOUT,
+                ),
+            )
+            pos += n
+        for rr in new_chain[1:-1]:
+            if not 0 <= rr < len(self.entries):
+                continue
+            pe = self.entries[rr]
+            try:
+                self._peer_request(
+                    pe.connect_host, pe.port,
+                    Message(MsgType.DO_REPLICA, dict(prov)),
+                )
+            except (OSError, OcmError):
+                printd("daemon %d: chain push to rank %d failed",
+                       self.rank, rr)
+        obs_journal.record(
+            "rereplicated", track=self.tracer.track,
+            alloc_id=e.alloc_id, target=target, chain=list(new_chain),
+        )
+        return Message(
+            MsgType.RE_REPLICATE_OK,
+            {"alloc_id": e.alloc_id, "nbytes": e.nbytes},
+        )
+
     # -- liveness --------------------------------------------------------
 
     def _on_heartbeat(self, msg: Message) -> Message:
@@ -1097,6 +1731,7 @@ class Daemon:
                 "transfers": self.tracer.transfers(last=32),
             },
             "leases": self.registry.lease_stats(),
+            "resilience": self._resilience_meta(),
         }
         return Message(
             MsgType.STATUS_OK,
@@ -1111,6 +1746,16 @@ class Daemon:
             },
             json.dumps(detail, separators=(",", ":")).encode(),
         )
+
+    def _resilience_meta(self) -> dict:
+        """Epoch/fencing/peer-state/failover counters for STATUS and the
+        Prometheus exposition."""
+        return {
+            "epoch": self.epoch,
+            "fenced": self._fenced,
+            "peers": self.detector.states() if self.detector else {},
+            "failover": dict(self.res_counters),
+        }
 
     def _metrics_meta(self) -> dict:
         """Everything the Prometheus endpoint and the cluster CLI render:
@@ -1134,6 +1779,7 @@ class Daemon:
                 for b in self.device_books
             ],
             "leases": self.registry.lease_stats(),
+            "resilience": self._resilience_meta(),
         }
 
     def _on_status_prom(self, msg: Message) -> Message:
@@ -1229,10 +1875,13 @@ def main(argv=None) -> int:
 # prefix is stripped and installed around dispatch before any handler
 # runs), so every traced request type claims it here.
 _FLAGS_HANDLED = {
-    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE,
-    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX,
+    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA,
+    # FLAG_FANOUT: replica-chain role discipline in _check_data_role /
+    # _route_put_payload (fan-out legs land, clients need primary role).
+    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT,
     MsgType.DATA_GET: FLAG_TRACE_CTX,
-    MsgType.REQ_ALLOC: FLAG_TRACE_CTX,
+    # FLAG_REPLICAS: the data tail's u8 copy count, read in _on_req_alloc.
+    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS,
     MsgType.DO_ALLOC: FLAG_TRACE_CTX,
     MsgType.REQ_FREE: FLAG_TRACE_CTX,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
@@ -1244,6 +1893,19 @@ _FLAGS_HANDLED = {
     MsgType.STATUS_PROM: FLAG_TRACE_CTX,
     MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
 }
+
+# Requests a FENCED daemon (one that outlived its own DEAD verdict) must
+# refuse with STALE_EPOCH: anything that grants extents or moves data.
+# Reads are fenced too — after promotion the replica chain is the truth,
+# and a stale primary serving reads would hand back pre-failover bytes.
+_FENCED_REJECT = frozenset({
+    MsgType.REQ_ALLOC,
+    MsgType.DO_ALLOC,
+    MsgType.DO_REPLICA,
+    MsgType.RE_REPLICATE,
+    MsgType.DATA_PUT,
+    MsgType.DATA_GET,
+})
 
 _HANDLERS = {
     MsgType.CONNECT: Daemon._on_connect,
@@ -1266,6 +1928,12 @@ _HANDLERS = {
     MsgType.STATUS: Daemon._on_status,
     MsgType.STATUS_PROM: Daemon._on_status_prom,
     MsgType.STATUS_EVENTS: Daemon._on_status_events,
+    MsgType.PING: Daemon._on_ping,
+    MsgType.SUSPECT_NODE: Daemon._on_suspect,
+    MsgType.EPOCH_UPDATE: Daemon._on_epoch_update,
+    MsgType.DO_REPLICA: Daemon._on_do_replica,
+    MsgType.PROMOTE: Daemon._on_promote,
+    MsgType.RE_REPLICATE: Daemon._on_re_replicate,
 }
 
 if __name__ == "__main__":

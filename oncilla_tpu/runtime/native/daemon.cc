@@ -22,6 +22,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <cstdlib>
@@ -50,6 +51,25 @@ double now_s() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+// CRC32 (IEEE 802.3 polynomial, zlib-compatible) for the snapshot v2
+// integrity trailer — table built once, no zlib link dependency.
+uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
+  static const auto table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
 }
 
 // Per-thread bulk-reply buffer pool (each data connection is served by
@@ -918,7 +938,9 @@ class Daemon {
                    std::strerror(errno));
       return;
     }
+    uint32_t crc = 0;  // v2 trailer accumulates over every written byte
     auto write_all = [&](const uint8_t* p, size_t n) {
+      crc = crc32_update(crc, p, n);
       size_t done = 0;
       while (done < n) {
         ssize_t w = ::write(fd, p + done, n - done);
@@ -937,7 +959,7 @@ class Daemon {
     };
     bool ok = true;
     rec.insert(rec.end(), {'O', 'C', 'M', 'S'});
-    rec.push_back(1);  // snapshot version
+    rec.push_back(2);  // snapshot version (v2: CRC32 trailer)
     put_le(uint64_t(cfg_.rank), 8);
     put_le(registry_.counter(), 8);
     auto entries = registry_.all();
@@ -957,6 +979,15 @@ class Daemon {
       ok = write_all(rec.data(), rec.size());
       if (ok && kind_is_host(e.kind))
         ok = write_all(host_store_.data() + e.extent.offset, e.nbytes);
+    }
+    if (ok) {
+      // Trailer bytes are NOT fed back into the accumulator.
+      uint8_t tail[4] = {uint8_t(crc & 0xff), uint8_t((crc >> 8) & 0xff),
+                         uint8_t((crc >> 16) & 0xff),
+                         uint8_t((crc >> 24) & 0xff)};
+      uint32_t keep = crc;
+      ok = write_all(tail, 4);
+      crc = keep;
     }
     if (!ok) {
       std::fprintf(stderr, "oncillamemd: snapshot write failed: %s\n",
@@ -990,7 +1021,25 @@ class Daemon {
     if (raw.size() < 5 || std::memcmp(raw.data(), "OCMS", 4) != 0)
       throw ProtocolError("bad snapshot magic");
     off = 4;
-    if (get_le(1) != 1) throw ProtocolError("unsupported snapshot version");
+    uint64_t version = get_le(1);
+    if (version != 1 && version != 2)
+      throw ProtocolError("unsupported snapshot version");
+    if (version >= 2) {
+      // Integrity gate BEFORE any entry parsing: refuse a corrupt file
+      // whole rather than half-loading it into a live registry.
+      if (raw.size() < 5 + 4)
+        throw ProtocolError("truncated snapshot (missing CRC)");
+      size_t body = raw.size() - 4;
+      uint32_t want = uint32_t(raw[body]) | uint32_t(raw[body + 1]) << 8 |
+                      uint32_t(raw[body + 2]) << 16 |
+                      uint32_t(raw[body + 3]) << 24;
+      uint32_t got = crc32_update(0, raw.data(), body);
+      if (got != want)
+        throw ProtocolError(
+            "snapshot CRC mismatch: truncated or corrupt — refusing to "
+            "restore");
+      raw.resize(body);
+    }
     int64_t srank = int64_t(get_le(8));
     if (srank != cfg_.rank)
       throw std::runtime_error("snapshot rank mismatch");

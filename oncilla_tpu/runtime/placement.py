@@ -41,6 +41,9 @@ class Placement:
     rank: int
     device_index: int
     kind: OcmKind
+    # k-way replication (resilience/): the k-1 replica ranks chosen
+    # alongside the primary, each on a distinct node. () = single copy.
+    replica_ranks: tuple[int, ...] = ()
 
 
 class PlacementPolicy:
@@ -49,6 +52,7 @@ class PlacementPolicy:
     def __init__(self):
         self._nodes: dict[int, NodeResources] = {}
         self._rr = 0
+        self._dead: set[int] = set()
         self._lock = make_lock("placement._lock")
 
     # -- membership ------------------------------------------------------
@@ -56,6 +60,18 @@ class PlacementPolicy:
     def add_node(self, res: NodeResources) -> None:
         with self._lock:
             self._nodes[res.rank] = res
+            self._dead.discard(res.rank)  # a (re)joining node is alive
+
+    def mark_dead(self, rank: int) -> None:
+        """Stop siting allocations on a rank the detector declared DEAD
+        (its resources stay recorded for when it rejoins via ADD_NODE)."""
+        with self._lock:
+            if rank in self._nodes:
+                self._dead.add(rank)
+
+    def mark_alive(self, rank: int) -> None:
+        with self._lock:
+            self._dead.discard(rank)
 
     @property
     def nnodes(self) -> int:
@@ -84,16 +100,38 @@ class PlacementPolicy:
 
     # -- policy ----------------------------------------------------------
 
-    def place(self, orig_rank: int, kind: OcmKind, nbytes: int) -> Placement:
+    def place(
+        self,
+        orig_rank: int,
+        kind: OcmKind,
+        nbytes: int,
+        replicas: int = 1,
+        exclude: tuple[int, ...] = (),
+    ) -> Placement:
+        """Site an allocation. ``replicas`` > 1 asks for a primary plus
+        ``replicas - 1`` replica ranks on DISTINCT nodes (host kinds; the
+        result's ``replica_ranks`` may be shorter when the cluster has
+        too few eligible nodes — degraded, never an error). ``exclude``
+        bars specific ranks (re-replication must avoid the surviving
+        chain). DEAD-marked ranks are never candidates."""
         raise NotImplementedError
 
 
 class NeighborRoundRobin(PlacementPolicy):
     """Reference-parity policy: remote allocations go to
     ``(orig_rank + 1) % nnodes`` (alloc.c:107,120), single node demotes to
-    local (alloc.c:82-83). Device chosen round-robin within the node."""
+    local (alloc.c:82-83). Device chosen round-robin within the node.
+    Replicas continue the same walk: the next distinct eligible ranks
+    after the primary."""
 
-    def place(self, orig_rank: int, kind: OcmKind, nbytes: int) -> Placement:
+    def place(
+        self,
+        orig_rank: int,
+        kind: OcmKind,
+        nbytes: int,
+        replicas: int = 1,
+        exclude: tuple[int, ...] = (),
+    ) -> Placement:
         with self._lock:
             n = len(self._nodes)
             if n == 0:
@@ -106,15 +144,31 @@ class NeighborRoundRobin(PlacementPolicy):
                     else OcmKind.LOCAL_HOST
                 )
                 return Placement(rank=orig_rank, device_index=0, kind=kind)
+            barred = self._dead | set(exclude)
             rank = (orig_rank + 1) % n
-            node = self._nodes[rank]
+            for _ in range(n):
+                if rank not in barred:
+                    break
+                rank = (rank + 1) % n
+            else:
+                raise OcmPlacementError("no eligible node (all dead/excluded)")
+            reps: list[int] = []
+            if replicas > 1:
+                r = (rank + 1) % n
+                while len(reps) < replicas - 1 and r != rank:
+                    if r not in barred and r != rank:
+                        reps.append(r)
+                    r = (r + 1) % n
             if kind == OcmKind.REMOTE_HOST:
-                return Placement(rank=rank, device_index=0, kind=kind)
+                return Placement(rank=rank, device_index=0, kind=kind,
+                                 replica_ranks=tuple(reps))
+            node = self._nodes[rank]
             self._rr += 1
             return Placement(
                 rank=rank,
                 device_index=self._rr % max(1, node.ndevices),
                 kind=kind,
+                replica_ranks=tuple(reps),
             )
 
 
@@ -122,9 +176,17 @@ class CapacityAware(PlacementPolicy):
     """Pick the (node, device) with the most free bytes that can actually fit
     the request — the accounting the reference commented out
     (alloc.c:87-92) made real. Never places on the origin rank when another
-    node fits (disaggregation intent)."""
+    node fits (disaggregation intent). Replicas take the next-fullest-free
+    DISTINCT nodes after the primary."""
 
-    def place(self, orig_rank: int, kind: OcmKind, nbytes: int) -> Placement:
+    def place(
+        self,
+        orig_rank: int,
+        kind: OcmKind,
+        nbytes: int,
+        replicas: int = 1,
+        exclude: tuple[int, ...] = (),
+    ) -> Placement:
         with self._lock:
             if not self._nodes:
                 raise OcmPlacementError("no nodes registered")
@@ -137,8 +199,11 @@ class CapacityAware(PlacementPolicy):
                 )
                 return Placement(rank=orig_rank, device_index=0, kind=kind)
 
+            barred = self._dead | set(exclude)
             candidates: list[tuple[int, Placement]] = []
             for rank, node in self._nodes.items():
+                if rank in barred:
+                    continue
                 prefer_remote = 0 if rank != orig_rank else -(1 << 62)
                 if kind == OcmKind.REMOTE_HOST:
                     free = node.host_arena_bytes - node.host_used
@@ -157,7 +222,23 @@ class CapacityAware(PlacementPolicy):
                 raise OcmPlacementError(
                     f"no node can fit {nbytes} B of {kind.value}"
                 )
-            return max(candidates, key=lambda c: c[0])[1]
+            candidates.sort(key=lambda c: c[0], reverse=True)
+            primary = candidates[0][1]
+            reps: list[int] = []
+            if replicas > 1:
+                for _, p in candidates[1:]:
+                    if len(reps) >= replicas - 1:
+                        break
+                    if p.rank != primary.rank and p.rank not in reps:
+                        reps.append(p.rank)
+            if not reps:
+                return primary
+            return Placement(
+                rank=primary.rank,
+                device_index=primary.device_index,
+                kind=primary.kind,
+                replica_ranks=tuple(reps),
+            )
 
 
 POLICIES = {

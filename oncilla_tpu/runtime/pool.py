@@ -39,6 +39,20 @@ from oncilla_tpu.core.errors import (
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.runtime.protocol import Message, request
 
+# Chaos seam (resilience/chaos.py): a process-global hook fired once per
+# connection lease, BEFORE the caller touches the socket. The deterministic
+# fault injector uses it to drop (raise OSError), delay, or partition
+# traffic — and to trigger scheduled daemon kills — at a reproducible
+# logical op index. None (the default) costs one global read per lease.
+_chaos_hook = None
+
+
+def set_chaos_hook(fn) -> None:
+    """Install (or clear with None) the process-wide chaos hook, called as
+    ``fn(host, port)`` on every pool lease. Test/harness-only."""
+    global _chaos_hook
+    _chaos_hook = fn
+
 
 class PoolEntry:
     """One pooled connection; ``lock`` is held by whoever leased it."""
@@ -68,6 +82,17 @@ class PeerPool:
         an idle cached one, else a fresh dial — callers doing multi-frame
         pipelining keep the lease for the whole exchange, then
         :meth:`release` (still in sync) or :meth:`discard` (broken)."""
+        hook = _chaos_hook
+        if hook is not None:
+            try:
+                hook(host, port)
+            except OSError as e:
+                # An injected fault wears the pool's normal unreachable-
+                # peer shape, so every caller's retry ladder sees exactly
+                # what a real torn connection would produce.
+                raise OcmConnectError(
+                    f"peer {host}:{port} unreachable: {e}"
+                ) from e
         key = (host, port)
         with self._cond:
             while True:
@@ -202,6 +227,30 @@ class PeerPool:
             raise
         self.release(host, port, entry)
         return reply
+
+    def evict(self, host: str, port: int) -> int:
+        """Drop every cached connection to ONE peer (resilience/: the
+        failure detector's DEAD verdict). Without this, stale sockets to
+        a crashed daemon only fail lazily — each subsequent lease hands
+        out a dead connection that costs a full send/recv error cycle
+        before the caller's retry path engages. Leased (in-flight)
+        entries are marked dead and closed too; their holders hit the
+        error immediately and discard on their own path. Returns the
+        number of entries dropped; the pool stays usable (a restarted
+        daemon on the same port dials fresh)."""
+        key = (host, port)
+        with self._cond:
+            lst = self._conns.pop(key, [])
+            for e in lst:
+                e.dead = True
+                try:
+                    e.sock.close()
+                except OSError:
+                    pass
+            self._cond.notify_all()
+        if lst:
+            obs_journal.record("pool_evict", host=host, port=port, n=len(lst))
+        return len(lst)
 
     def reset(self) -> None:
         """Drop every cached connection but keep the pool usable (e.g. to

@@ -60,6 +60,26 @@ FLAG_CAP_TRACE = 0x0004
 # their serve-side spans / forwarded hops. Replies never carry it (the
 # requester already owns the context).
 FLAG_TRACE_CTX = 0x0008
+# FLAG_CAP_REPLICA on CONNECT offers k-way replicated allocations
+# (resilience/): same offer/echo dance as FLAG_CAP_COALESCE. Only after
+# the daemon echoes it may a client set FLAG_REPLICAS on REQ_ALLOC; a
+# flags=0 reply (un-upgraded v2 daemon, native C++ daemon) declines by
+# silence and every allocation stays single-copy — with OCM_REPLICAS
+# unset/1 the bit is never even offered, so the wire is byte-for-byte
+# the pre-replication protocol.
+FLAG_CAP_REPLICA = 0x0010
+# FLAG_REPLICAS on REQ_ALLOC: the data tail carries one u8 — the
+# requested copy count k (after any trace prefix is stripped). The fixed
+# schema stays untouched so un-flagged frames remain byte-identical and
+# parseable by every v2 peer; chain membership itself rides the new
+# DO_REPLICA message, never a legacy type.
+FLAG_REPLICAS = 0x0020
+# FLAG_FANOUT on DATA_PUT marks a primary->replica replication leg (and
+# re-replication streaming). Replica holders accept ONLY fan-out writes
+# while they believe their primary alive — a client write landing on a
+# replica is rejected NOT_PRIMARY so the copies can never diverge — and
+# never re-fan a fan-out write (no forwarding loops).
+FLAG_FANOUT = 0x0040
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -124,6 +144,23 @@ class MsgType(enum.IntEnum):
     PLANE_PUT = 52
     PLANE_GET = 53
     PLANE_SCRUB = 54
+    # resilience (resilience/): daemon-to-daemon liveness, cluster-epoch
+    # arbitration, k-way replica provisioning and failover repair. All new
+    # types — a v2 peer that predates them never receives one (the client
+    # capability gate is FLAG_CAP_REPLICA; liveness probes treat a typed
+    # BAD_MSG ERROR reply as "alive, capability absent").
+    PING = 60               # liveness probe; carries sender epoch+incarnation
+    PING_OK = 61
+    SUSPECT_NODE = 62       # non-master -> rank 0: I can't reach this rank
+    SUSPECT_OK = 63
+    EPOCH_UPDATE = 64       # rank 0 -> all: epoch bump + DEAD verdict (fence)
+    EPOCH_OK = 65
+    DO_REPLICA = 66         # provision a replica extent under a given id
+    DO_REPLICA_OK = 67
+    PROMOTE = 68            # rank 0 -> survivor: reconcile dead ranks
+    PROMOTE_OK = 69
+    RE_REPLICATE = 70       # rank 0 -> primary: copy an alloc to a new rank
+    RE_REPLICATE_OK = 71
     # failure
     ERROR = 99
 
@@ -139,15 +176,17 @@ WIRE_KIND_INV = {v: k for k, v in WIRE_KIND.items()}
 
 VALID_FLAGS.update({
     # Capability offer/echo bits.
-    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE,
-    MsgType.CONNECT_CONFIRM: FLAG_CAP_COALESCE | FLAG_CAP_TRACE,
+    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA,
+    MsgType.CONNECT_CONFIRM: (
+        FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
+    ),
     # Requests that may carry a trace-context prefix once the peer
     # granted FLAG_CAP_TRACE. DATA_PUT also keeps the coalesced-burst
     # bit; its trace prefix rides the burst-CLOSING chunk only, so the
     # body chunks stay eligible for the zero-copy recv-into-arena path.
-    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX,
+    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT,
     MsgType.DATA_GET: FLAG_TRACE_CTX,
-    MsgType.REQ_ALLOC: FLAG_TRACE_CTX,
+    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS,
     MsgType.DO_ALLOC: FLAG_TRACE_CTX,
     MsgType.REQ_FREE: FLAG_TRACE_CTX,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
@@ -327,6 +366,41 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
         ("ext_offset", "Q"),
         ("ext_nbytes", "Q"),
     ],
+    # Resilience family (resilience/). "inc" is the sender's incarnation —
+    # a random u64 minted per daemon object, so a DEAD verdict can fence
+    # exactly the process it was issued against (a restarted daemon on the
+    # same port carries a fresh incarnation and is never falsely fenced).
+    MsgType.PING: [("rank", "q"), ("epoch", "Q"), ("inc", "Q")],
+    MsgType.PING_OK: [("rank", "q"), ("epoch", "Q"), ("inc", "Q")],
+    MsgType.SUSPECT_NODE: [("rank", "q"), ("reporter", "q"), ("epoch", "Q")],
+    # "state" is the arbiter's PeerState verdict (resilience/detector.py
+    # wire values: 0 ALIVE, 1 SUSPECT, 2 DEAD).
+    MsgType.SUSPECT_OK: [("epoch", "Q"), ("state", "B")],
+    MsgType.EPOCH_UPDATE: [("epoch", "Q"), ("dead_rank", "q"), ("inc", "Q")],
+    MsgType.EPOCH_OK: [("epoch", "Q")],
+    # "chain" is the ordered comma-separated owner chain "primary,r1,...";
+    # every holder of a replicated allocation records it, so promotion on
+    # a DEAD verdict is a deterministic local computation.
+    MsgType.DO_REPLICA: [
+        ("alloc_id", "Q"),
+        ("kind", "B"),
+        ("nbytes", "Q"),
+        ("orig_rank", "q"),
+        ("pid", "q"),
+        ("chain", "s"),
+        ("epoch", "Q"),
+    ],
+    MsgType.DO_REPLICA_OK: [("alloc_id", "Q"), ("offset", "Q")],
+    MsgType.PROMOTE: [("dead_ranks", "s"), ("epoch", "Q")],
+    # PROMOTE_OK carries a JSON data tail listing the allocations this
+    # rank is now primary for that lost copies (re-replication work list).
+    MsgType.PROMOTE_OK: [("count", "Q")],
+    MsgType.RE_REPLICATE: [
+        ("alloc_id", "Q"),
+        ("target_rank", "q"),
+        ("epoch", "Q"),
+    ],
+    MsgType.RE_REPLICATE_OK: [("alloc_id", "Q"), ("nbytes", "Q")],
     MsgType.ERROR: [("code", "I"), ("detail", "s")],
 }
 
@@ -339,6 +413,21 @@ class ErrCode(enum.IntEnum):
     BAD_MSG = 4
     PLACEMENT = 5
     NOT_MASTER = 6
+    # The serving daemon was fenced by a newer cluster epoch (a DEAD
+    # verdict it outlived): it must not serve data or grant extents, and
+    # clients treat this as a failover signal, retrying via the replica
+    # chain instead of surfacing an application error.
+    STALE_EPOCH = 7
+    # A replica refused a CLIENT data op because it still believes its
+    # primary alive (accepting would fork the copies). Retryable: the
+    # client re-walks its failover ladder — by the time the primary's
+    # death verdict lands, the replica starts serving.
+    NOT_PRIMARY = 8
+    # A primary could not reach a replica that is not (yet) declared
+    # DEAD, so it cannot honor the replication contract for this write.
+    # Retryable: the detector resolves the replica's fate within a few
+    # probe intervals, after which the put either fans out or degrades.
+    REPLICA_UNAVAILABLE = 9
 
 
 def _pack_prefix(msg: Message) -> bytes:

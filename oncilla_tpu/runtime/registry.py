@@ -31,6 +31,25 @@ class RegEntry:
     origin_rank: int
     origin_pid: int
     lease_expiry: float  # absolute monotonic deadline; renewed by heartbeat
+    # Replication (resilience/): the ordered owner chain of a k-way
+    # replicated allocation — chain[0] is the primary, the rest hold
+    # replicas. Every holder records the SAME chain, so when rank 0
+    # declares a member DEAD each survivor computes the identical
+    # promotion locally (first alive member becomes primary). () = the
+    # unreplicated common case. ``epoch`` stamps the cluster epoch of the
+    # last chain rewrite (failover fencing evidence).
+    chain: tuple[int, ...] = ()
+    epoch: int = 0
+
+    def is_primary(self, self_rank: int) -> bool:
+        """Primary = unreplicated owner, or first member of the chain."""
+        return not self.chain or self.chain[0] == self_rank
+
+    def replica_ranks(self, self_rank: int) -> tuple[int, ...]:
+        """Ranks this holder must fan writes out to (primary only)."""
+        if self.chain and self.chain[0] == self_rank:
+            return self.chain[1:]
+        return ()
 
 
 class AllocRegistry:
@@ -126,6 +145,56 @@ class AllocRegistry:
                     for (pid, rank), t in self._last_beat.items()
                 },
             }
+
+    def set_chain(self, alloc_id: int, chain: tuple[int, ...],
+                  epoch: int) -> None:
+        """Record (or rewrite) an allocation's replica chain."""
+        with self._lock:
+            e = self._entries.get(alloc_id)
+            if e is None:
+                raise OcmInvalidHandle(f"unknown alloc_id {alloc_id}")
+            e.chain = tuple(chain)
+            e.epoch = epoch
+
+    def reconcile_dead(
+        self, dead: set[int], self_rank: int, epoch: int
+    ) -> tuple[list[RegEntry], list[dict]]:
+        """Drop ``dead`` ranks from every replica chain (resilience/
+        failover.py). Returns (newly promoted entries, re-replication work
+        list): an entry whose chain's first ALIVE member is ``self_rank``
+        is promoted here — registry ownership rewritten under ``epoch`` —
+        and every entry this rank is primary for that now holds fewer
+        copies than it was built with is reported for repair. Each holder
+        of a chain runs the same pure computation, so no coordination
+        beyond the dead-set is needed."""
+        promoted: list[RegEntry] = []
+        repair: list[dict] = []
+        with self._lock:
+            for e in self._entries.values():
+                if not e.chain or not (set(e.chain) & dead):
+                    continue
+                want = len(e.chain)
+                alive = tuple(r for r in e.chain if r not in dead)
+                if not alive:
+                    continue  # unreachable: this holder is alive
+                was_primary = e.chain[0] == self_rank
+                e.chain = alive
+                e.epoch = epoch
+                if alive[0] != self_rank:
+                    continue
+                if not was_primary:
+                    promoted.append(e)
+                if len(alive) < want:
+                    repair.append({
+                        "alloc_id": e.alloc_id,
+                        "kind": e.kind.value,
+                        "nbytes": e.nbytes,
+                        "chain": list(alive),
+                        "want": want,
+                        "origin_rank": e.origin_rank,
+                        "origin_pid": e.origin_pid,
+                    })
+        return promoted, repair
 
     def for_app(self, origin_pid: int, origin_rank: int) -> list[RegEntry]:
         """Every allocation originated by an app — feeds the disconnect-time

@@ -15,20 +15,28 @@ daemons so snapshots are interchangeable:
              nbytes u64 | origin_rank i64 | origin_pid i64 | data_len u64 |
              data (host-kind entries carry their live bytes; device-kind
              entries carry none — HBM contents belong to the app processes)
+  v2 trailer: crc32 u32 over every preceding byte (header + entries)
+
+Version 2 adds the CRC trailer so a torn or bit-flipped snapshot is
+refused WHOLE at load time (magic/version alone only catch header damage;
+a flipped byte inside an entry previously restored garbage silently).
+Version-1 files (no trailer) still load — they predate the guard.
 """
 
 from __future__ import annotations
 
 import os
 import struct
+import zlib
 from dataclasses import dataclass
 
 from oncilla_tpu.core.errors import OcmProtocolError
 
 MAGIC = b"OCMS"
-VERSION = 1
+VERSION = 2  # v2: trailing CRC32 integrity guard
 _HDR = struct.Struct("<4sBqQI")
 _ENTRY = struct.Struct("<QBIQQqqQ")
+_CRC = struct.Struct("<I")
 
 
 @dataclass
@@ -60,6 +68,7 @@ def dump(snap: Snapshot) -> bytes:
             e.origin_rank, e.origin_pid, len(e.data),
         )
         out += e.data
+    out += _CRC.pack(zlib.crc32(out))
     return bytes(out)
 
 
@@ -69,8 +78,21 @@ def load(raw: bytes) -> Snapshot:
     magic, version, rank, counter, n = _HDR.unpack_from(raw, 0)
     if magic != MAGIC:
         raise OcmProtocolError("bad snapshot magic")
-    if version != VERSION:
+    if version not in (1, VERSION):
         raise OcmProtocolError(f"unsupported snapshot version {version}")
+    if version >= 2:
+        # Integrity gate BEFORE any entry parsing: a corrupt snapshot must
+        # be refused whole (never half-loaded into a live registry).
+        if len(raw) < _HDR.size + _CRC.size:
+            raise OcmProtocolError("truncated snapshot (missing CRC)")
+        (want,) = _CRC.unpack_from(raw, len(raw) - _CRC.size)
+        got = zlib.crc32(raw[: len(raw) - _CRC.size])
+        if got != want:
+            raise OcmProtocolError(
+                f"snapshot CRC mismatch (stored {want:#010x}, computed "
+                f"{got:#010x}): truncated or corrupt — refusing to restore"
+            )
+        raw = raw[: len(raw) - _CRC.size]
     off = _HDR.size
     entries = []
     for _ in range(n):
@@ -102,13 +124,21 @@ def write_file_iter(path, rank: int, id_counter: int, nentries: int, entries):
     tmp = path + ".tmp"
     try:
         with open(tmp, "wb") as f:
-            f.write(_HDR.pack(MAGIC, VERSION, rank, id_counter, nentries))
+            # CRC accumulates incrementally over exactly the bytes written,
+            # so streaming keeps its one-entry memory bound.
+            head = _HDR.pack(MAGIC, VERSION, rank, id_counter, nentries)
+            crc = zlib.crc32(head)
+            f.write(head)
             for e in entries:
-                f.write(_ENTRY.pack(
+                rec = _ENTRY.pack(
                     e.alloc_id, e.kind, e.device_index, e.offset, e.nbytes,
                     e.origin_rank, e.origin_pid, len(e.data),
-                ))
+                )
+                crc = zlib.crc32(rec, crc)
+                f.write(rec)
+                crc = zlib.crc32(e.data, crc)
                 f.write(e.data)
+            f.write(_CRC.pack(crc))
             f.flush()
             os.fsync(f.fileno())
     except BaseException:

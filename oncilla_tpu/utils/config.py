@@ -108,6 +108,53 @@ class OcmConfig:
     lease_s: float = 30.0
     heartbeat_s: float = 5.0
 
+    # Resilience (resilience/): k-way replicated allocations. k = total
+    # copies (primary + k-1 replicas on distinct nodes); 1 = today's
+    # single-copy behavior and the pre-replication wire protocol
+    # byte-for-byte (the capability is never offered). Host-kind
+    # allocations only — device bytes live in the app plane's arena and
+    # are not daemon-replicable.
+    replicas: int = field(default_factory=lambda: _env_int("OCM_REPLICAS", 1))
+    # Daemon-to-daemon failure detection (resilience/detector.py), driven
+    # from the reaper loop in a star topology (every rank probes rank 0,
+    # rank 0 probes everyone): OCM_DETECT=0 disables; probes fire at most
+    # every detect_interval_s (floored at heartbeat_s); suspect_after /
+    # dead_after are consecutive probe failures before the SUSPECT report
+    # and the rank-0 DEAD verdict.
+    detect: bool = field(default_factory=lambda: bool(_env_int("OCM_DETECT", 1)))
+    detect_interval_s: float = field(
+        default_factory=lambda: _env_int("OCM_DETECT_INTERVAL_MS", 1000) / 1e3
+    )
+    suspect_after: int = field(
+        default_factory=lambda: _env_int("OCM_SUSPECT_AFTER", 2)
+    )
+    dead_after: int = field(
+        default_factory=lambda: _env_int("OCM_DEAD_AFTER", 5)
+    )
+    probe_timeout_s: float = field(
+        default_factory=lambda: _env_int("OCM_PROBE_TIMEOUT_MS", 1000) / 1e3
+    )
+
+    # Client CONNECT retry: a daemon restarting mid-failover refuses
+    # connections for a beat; the app-side client retries with capped
+    # exponential backoff + jitter instead of surfacing a hard connect
+    # error. 0 retries = the old single-attempt behavior.
+    connect_retries: int = field(
+        default_factory=lambda: _env_int("OCM_CONNECT_RETRIES", 4)
+    )
+    connect_backoff_s: float = field(
+        default_factory=lambda: _env_int("OCM_CONNECT_BACKOFF_MS", 50) / 1e3
+    )
+    connect_backoff_cap_s: float = 2.0
+    # How long a data transfer keeps re-walking its failover ladder
+    # (owner membership address, then each replica) on RETRYABLE
+    # failures — transport errors, STALE_EPOCH, NOT_PRIMARY,
+    # REPLICA_UNAVAILABLE — before surfacing the error. Sized to cover
+    # the detection window (dead_after probes) plus promotion.
+    failover_wait_s: float = field(
+        default_factory=lambda: _env_int("OCM_FAILOVER_WAIT_MS", 10000) / 1e3
+    )
+
     def __post_init__(self) -> None:
         # A 0-byte chunk livelocks every chunked transfer loop
         # (n = min(chunk_bytes, total - pos) never advances pos) and a
@@ -136,4 +183,23 @@ class OcmConfig:
             raise ValueError(
                 "dcn_stripe_min_bytes must be > 0 "
                 f"(got {self.dcn_stripe_min_bytes})"
+            )
+        # The replica count rides the wire as one u8 and a chain must stay
+        # a short csv string; 8 copies is already far past any sane
+        # durability/overhead trade-off.
+        if not 1 <= self.replicas <= 8:
+            raise ValueError(
+                f"replicas must be in [1, 8] (got {self.replicas}); "
+                "1 selects the single-copy path"
+            )
+        if self.suspect_after < 1 or self.dead_after < self.suspect_after:
+            raise ValueError(
+                "need 1 <= suspect_after <= dead_after (got "
+                f"{self.suspect_after}/{self.dead_after}) — a DEAD verdict "
+                "before a SUSPECT report skips arbitration"
+            )
+        if self.connect_retries < 0 or self.connect_backoff_s < 0:
+            raise ValueError(
+                "connect_retries/connect_backoff_s must be >= 0 (got "
+                f"{self.connect_retries}/{self.connect_backoff_s})"
             )

@@ -47,6 +47,13 @@ echo "== dcn smoke =="
 # asserted; runs in seconds and needs no chip.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke || fail=1
 
+echo "== chaos smoke =="
+# Kill-the-owner failover proof: OCM_REPLICAS=2 on a 3-daemon in-process
+# cluster, seeded chaos kills the owner mid-workload; every subsequent
+# get must be byte-exact via the promoted replica, re-replication must
+# restore k, and the same seed must replay the identical interleaving.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --smoke || fail=1
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -214,6 +214,35 @@ def test_native_v2_peer_capability_negotiation(native_cluster, rng):
     client.close()
 
 
+def test_native_replica_capability_declined_by_silence(native_cluster, rng):
+    """OCM_REPLICAS=2 against the unmodified C++ daemon: the CONNECT
+    offer of FLAG_CAP_REPLICA comes back flags=0 (declined by silence),
+    so the client never sets FLAG_REPLICAS, every allocation is
+    single-copy, and the wire is byte-for-byte the pre-replication
+    protocol — transfers stay byte-exact."""
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        replicas=2,
+    )
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    assert client._ctrl_caps & P.FLAG_CAP_REPLICA == 0
+    h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+    assert h.replica_ranks == ()
+    data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+    # Exactly one daemon registered the allocation: single copy.
+    counts = [client.status(rank=r)["live_allocs"] for r in range(2)]
+    assert sorted(counts) == [0, 1]
+    client.free(h)
+    client.close()
+
+
 def test_native_lease_reaping(binary, tmp_path):
     ports = free_ports(2)
     nodefile = tmp_path / "nf"

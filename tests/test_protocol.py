@@ -24,6 +24,10 @@ def test_roundtrip_all_schemas():
         "owner_host": "10.0.0.1", "owner_port": 18000,
         "owners": "1,3,5", "count": 2,
         "relay": 1, "ext_offset": 4096, "ext_nbytes": 65536,
+        # resilience family (PING/SUSPECT/EPOCH/DO_REPLICA/PROMOTE/...)
+        "epoch": 9, "inc": (7 << 40) | 1, "reporter": 1, "state": 2,
+        "chain": "1,2,0", "dead_ranks": "1", "dead_rank": 1,
+        "target_rank": 2,
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})

@@ -1,0 +1,554 @@
+"""Resilience subsystem: k-way replication, owner failover, fencing,
+failure detection, the deterministic chaos harness, and the hardening
+satellites (pool eviction, snapshot CRC, client connect backoff,
+reaper-vs-chaos lease hygiene)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.analysis import alloctrace
+from oncilla_tpu.core.kinds import OcmKind as K
+from oncilla_tpu.resilience.chaos import (
+    ChaosController,
+    ChaosSchedule,
+    Fault,
+    corrupt_file,
+)
+from oncilla_tpu.resilience.detector import FailureDetector, PeerState
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime import snapshot as snap
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import LocalCluster, local_cluster
+from oncilla_tpu.runtime.daemon import Daemon
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.placement import CapacityAware, NodeResources
+from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def fast_cfg(**kw):
+    d = dict(
+        host_arena_bytes=16 << 20,
+        device_arena_bytes=4 << 20,
+        chunk_bytes=128 << 10,
+        heartbeat_s=0.05,
+        lease_s=5.0,
+        replicas=2,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        dcn_stripes=2,
+        dcn_stripe_min_bytes=256 << 10,
+        failover_wait_s=10.0,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+# -- failure detector (unit) ---------------------------------------------
+
+
+def test_detector_state_machine():
+    det = FailureDetector(4, self_rank=0, suspect_after=2, dead_after=4)
+    assert det.state(1) == PeerState.ALIVE
+    assert det.record_fail(1) == PeerState.ALIVE       # 1 strike
+    assert det.record_fail(1) == PeerState.SUSPECT     # 2
+    assert det.record_fail(1) == PeerState.SUSPECT     # 3
+    assert det.record_fail(1) == PeerState.DEAD        # 4
+    assert det.dead_ranks() == {1}
+    # A successful probe revives and resets the counter.
+    assert det.record_ok(1, inc=77) == PeerState.DEAD  # returns PREVIOUS
+    assert det.state(1) == PeerState.ALIVE
+    assert det.incarnation(1) == 77
+    assert det.record_fail(1) == PeerState.ALIVE       # counter restarted
+    # Self and out-of-range ranks are never tracked.
+    assert det.record_fail(0) == PeerState.ALIVE
+    assert det.state(99) == PeerState.ALIVE
+
+
+def test_detector_dead_probe_cadence():
+    det = FailureDetector(2, self_rank=0, suspect_after=1, dead_after=1)
+    det.mark_dead(1)
+    hits = sum(1 in det.probe_targets() for _ in range(16))
+    assert 1 <= hits <= 4  # reduced cadence, never zero (restarts re-admit)
+
+
+# -- placement with replicas ---------------------------------------------
+
+
+def test_capacity_aware_replica_placement_distinct_and_excluded():
+    pol = CapacityAware()
+    for r in range(4):
+        pol.add_node(NodeResources(rank=r, ndevices=1,
+                                   device_arena_bytes=1 << 20,
+                                   host_arena_bytes=8 << 20))
+    p = pol.place(0, K.REMOTE_HOST, 1 << 20, replicas=3)
+    members = (p.rank, *p.replica_ranks)
+    assert len(members) == 3 and len(set(members)) == 3
+    # Excluded ranks never appear (the re-replication contract).
+    p2 = pol.place(0, K.REMOTE_HOST, 1 << 20, exclude=(p.rank,))
+    assert p2.rank != p.rank
+    # A dead rank is no candidate; rejoin re-admits it.
+    pol.mark_dead(1)
+    for _ in range(4):
+        q = pol.place(0, K.REMOTE_HOST, 1 << 20, replicas=4)
+        assert 1 not in (q.rank, *q.replica_ranks)
+    pol.mark_alive(1)
+    q = pol.place(0, K.REMOTE_HOST, 1 << 20, replicas=4)
+    assert 1 in (q.rank, *q.replica_ranks)
+    # More copies than nodes degrades, never errors.
+    q = pol.place(0, K.REMOTE_HOST, 1 << 20, replicas=8)
+    assert len((q.rank, *q.replica_ranks)) == 4
+
+
+# -- satellite: pool eviction --------------------------------------------
+
+
+def test_pool_evict_drops_cached_connections():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    pool = PeerPool()
+    try:
+        entries = pool.lease_set("127.0.0.1", port, 3)
+        for e in entries:
+            pool.release("127.0.0.1", port, e)
+        assert pool.evict("127.0.0.1", port) == len(entries)
+        for e in entries:
+            assert e.dead
+            # closed: fileno() of a closed socket is -1
+            assert e.sock.fileno() == -1
+        # The pool stays usable: a fresh lease dials anew.
+        e2 = pool.lease("127.0.0.1", port)
+        assert not e2.dead
+        pool.release("127.0.0.1", port, e2)
+        assert pool.evict("127.0.0.1", port) == 1
+        assert pool.evict("127.0.0.1", port) == 0  # idempotent
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_dead_verdict_evicts_pooled_connections():
+    """The detector's DEAD verdict must evict pooled connections NOW,
+    not leave them to fail lazily on the next lease."""
+    cfg = fast_cfg(replicas=1)
+    cl = LocalCluster(2, config=cfg)
+    try:
+        d0 = cl.daemons[0]
+        d1 = cl.daemons[1]
+        addr = (cl.entries[1].connect_host, cl.entries[1].port)
+        # Seed a pooled connection d0 -> d1.
+        d0.peers.request(addr[0], addr[1],
+                         P.Message(P.MsgType.STATUS, {}))
+        assert d0.peers._conns.get(addr)
+        cl.kill(1)
+        deadline = time.time() + 10
+        while time.time() < deadline and d0.detector.state(1) != PeerState.DEAD:
+            time.sleep(0.05)
+        assert d0.detector.state(1) == PeerState.DEAD
+        assert not d0.peers._conns.get(addr), (
+            "stale pooled connections to the dead rank were not evicted"
+        )
+        assert d1.res_counters is not None  # killed object still inspectable
+    finally:
+        cl.stop()
+
+
+# -- satellite: snapshot CRC hardening -----------------------------------
+
+
+def test_snapshot_v2_crc_roundtrip_and_corruption(tmp_path):
+    s = snap.Snapshot(
+        rank=0, id_counter=3,
+        entries=[snap.SnapEntry(2, 3, 0, 0, 1024, 0, 42, b"\xab" * 1024)],
+    )
+    raw = snap.dump(s)
+    assert raw[4] == snap.VERSION == 2
+    assert snap.load(raw).entries == s.entries
+    # Any single flipped byte must be refused whole.
+    for off in (5, len(raw) // 2, len(raw) - 1):
+        bad = bytearray(raw)
+        bad[off] ^= 0xFF
+        with pytest.raises(ocm.OcmProtocolError,
+                           match="CRC|magic|version"):
+            snap.load(bytes(bad))
+
+
+def test_snapshot_v1_still_loads():
+    # A pre-CRC (version 1) file loads unchanged: forward compatibility
+    # with snapshots written before this PR.
+    s = snap.Snapshot(
+        rank=1, id_counter=5,
+        entries=[snap.SnapEntry(4, 3, 0, 4096, 16, 1, 7, b"x" * 16)],
+    )
+    raw = bytearray(snap.dump(s)[:-4])  # strip the v2 trailer
+    raw[4] = 1
+    out = snap.load(bytes(raw))
+    assert out.rank == 1 and out.entries == s.entries
+
+
+def test_corrupt_snapshot_restore_refused_cleanly(tmp_path, rng):
+    """Restore must refuse a corrupt snapshot WHOLE — no half-loaded
+    registry, no clobbered on-disk file."""
+    cfg = OcmConfig(host_arena_bytes=4 << 20, device_arena_bytes=1 << 20)
+    path = str(tmp_path / "d0.ocms")
+    d = Daemon(0, [NodeEntry(0, "127.0.0.1", 0)], config=cfg,
+               snapshot_path=path)
+    d.start()
+    entries = [NodeEntry(0, "127.0.0.1", d.port)]
+    client = ControlPlaneClient(entries, 0, heartbeat=False)
+    h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+    client.put(h, rng.integers(0, 256, 256 << 10, dtype=np.uint8))
+    client.close(detach=True)
+    d.stop()
+
+    offset = corrupt_file(path, offset=snap._HDR.size + 9)
+    assert offset == snap._HDR.size + 9
+    before = open(path, "rb").read()
+    d2 = Daemon(0, [NodeEntry(0, "127.0.0.1", 0)], config=cfg,
+                snapshot_path=path)
+    with pytest.raises(ocm.OcmProtocolError, match="CRC"):
+        d2.start()
+    assert d2.registry.live_count() == 0, "half-loaded a corrupt snapshot"
+    d2.stop()
+    assert open(path, "rb").read() == before, (
+        "failed restore clobbered the on-disk snapshot"
+    )
+
+
+# -- satellite: client CONNECT retry -------------------------------------
+
+
+def test_client_connect_retries_daemon_coming_up():
+    """A daemon that binds shortly after the client's first dial (restart
+    mid-failover) must not surface a hard connect error."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    entries = [NodeEntry(0, "127.0.0.1", port)]
+    cfg = OcmConfig(host_arena_bytes=1 << 20, device_arena_bytes=1 << 20,
+                    connect_retries=6, connect_backoff_s=0.05)
+    d = Daemon(0, entries, config=cfg)
+    d.port = port
+
+    def late_start():
+        time.sleep(0.4)
+        d.start()
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        client = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+        assert time.monotonic() - t0 >= 0.2  # it actually waited
+        assert client.status()["rank"] == 0
+        client.close()
+    finally:
+        t.join()
+        d.stop()
+
+
+def test_client_connect_retries_exhausted():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    cfg = OcmConfig(connect_retries=2, connect_backoff_s=0.01)
+    with pytest.raises(ocm.OcmConnectError, match="3 attempts"):
+        ControlPlaneClient([NodeEntry(0, "127.0.0.1", port)], 0,
+                           config=cfg, heartbeat=False)
+
+
+# -- replication end to end ----------------------------------------------
+
+
+def test_replicated_alloc_mirrors_and_frees(rng):
+    with local_cluster(3, config=fast_cfg()) as cl:
+        client = cl.client(0)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        assert h.replica_ranks and h.rank not in h.replica_ranks
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h, data)
+        # Every chain member holds the same id, same chain, same bytes.
+        chain = (h.rank, *h.replica_ranks)
+        for r in chain:
+            e = cl.daemons[r].registry.lookup(h.alloc_id)
+            assert e.chain == chain
+            got = bytes(cl.daemons[r].host_arena.view(e.extent))[:e.nbytes]
+            assert got == data.tobytes()
+        # get() still byte-exact through the normal path.
+        np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+        # free drains every member.
+        client.free(h)
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+            d.registry.live_count() for d in cl.daemons
+        ):
+            time.sleep(0.05)
+        assert [d.registry.live_count() for d in cl.daemons] == [0, 0, 0]
+
+
+def test_replica_rejects_client_write_while_primary_alive(rng):
+    """Role discipline: a client write landing on a replica (primary
+    alive) must be rejected NOT_PRIMARY, or the copies would fork."""
+    with local_cluster(3, config=fast_cfg()) as cl:
+        client = cl.client(0)
+        h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+        rep = h.replica_ranks[0]
+        e = cl.entries[rep]
+        s = socket.create_connection((e.connect_host, e.port), timeout=5)
+        try:
+            with pytest.raises(ocm.OcmError) as ei:
+                P.request(s, P.Message(
+                    P.MsgType.DATA_PUT,
+                    {"alloc_id": h.alloc_id, "offset": 0, "nbytes": 16},
+                    b"\x00" * 16,
+                ))
+            assert ei.value.code == int(P.ErrCode.NOT_PRIMARY)
+        finally:
+            s.close()
+        client.free(h)
+
+
+def test_unreplicated_wire_is_byte_identical():
+    """OCM_REPLICAS unset/1: CONNECT never offers FLAG_CAP_REPLICA and
+    REQ_ALLOC carries no flag and no tail — byte-for-byte the
+    pre-replication frames."""
+    connect = P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0},
+        flags=P.FLAG_CAP_TRACE if OcmConfig().trace else 0,
+    ))
+    assert not P.HEADER.unpack(connect[:P.HEADER.size])[3] & (
+        P.FLAG_CAP_REPLICA | P.FLAG_REPLICAS
+    )
+    req = P.pack(P.Message(
+        P.MsgType.REQ_ALLOC,
+        {"orig_rank": 0, "pid": 7, "kind": 3, "nbytes": 4096},
+    ))
+    magic, ver, mtype, flags, plen = P.HEADER.unpack(req[:P.HEADER.size])
+    assert flags == 0
+    # Payload is exactly the fixed fields: q + q + B + Q = 25 bytes.
+    assert plen == 25 and len(req) == P.HEADER.size + 25
+
+
+# -- failover end to end -------------------------------------------------
+
+
+def test_owner_failover_promotes_rereplicates_and_fences(rng):
+    cfg = fast_cfg()
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0)
+        h = client.alloc(2 << 20, OcmKind.REMOTE_HOST)
+        owner = h.rank
+        data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+        client.put(h, data)
+        cl.kill(owner)
+        # Writes and reads keep working through the failover window.
+        data2 = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+        client.put(h, data2)
+        np.testing.assert_array_equal(client.get(h, 2 << 20), data2)
+        promoted = h.rank
+        assert promoted != owner
+        # Rank 0 arbitrated: epoch bumped, death counted.
+        deadline = time.time() + 15
+        while time.time() < deadline and cl.daemons[0].epoch == 0:
+            time.sleep(0.05)
+        assert cl.daemons[0].epoch >= 1
+        assert cl.daemons[0].res_counters["deaths"] == 1
+        # The promoted daemon rewrote ownership under the new epoch and
+        # re-replication restored k=2 on a fresh rank.
+        chain = ()
+        while time.time() < deadline:
+            e = cl.daemons[promoted].registry.lookup(h.alloc_id)
+            chain = e.chain
+            if len(chain) >= 2 and owner not in chain:
+                break
+            time.sleep(0.05)
+        assert chain[0] == promoted and owner not in chain
+        new_rep = next(r for r in chain if r != promoted)
+        re_ = cl.daemons[new_rep].registry.lookup(h.alloc_id)
+        got = bytes(cl.daemons[new_rep].host_arena.view(re_.extent))
+        assert got[:re_.nbytes] == data2.tobytes()
+        # Prometheus rows surface the story.
+        prom = client.fetch_prom(rank=0)
+        assert "ocm_cluster_epoch" in prom
+        assert "ocm_failover_deaths_total" in prom
+        assert "ocm_rereplications_total" in prom
+
+
+def test_fencing_by_incarnation():
+    cfg = fast_cfg(replicas=1, detect=False)
+    with local_cluster(2, config=cfg) as cl:
+        d1 = cl.daemons[1]
+        e = cl.entries[1]
+        s = socket.create_connection((e.connect_host, e.port), timeout=5)
+        try:
+            # Wrong incarnation: a verdict for a PREVIOUS process on this
+            # port — must be ignored (the replacement-daemon race).
+            P.request(s, P.Message(
+                P.MsgType.EPOCH_UPDATE,
+                {"epoch": 5, "dead_rank": 1,
+                 "inc": (d1.incarnation ^ 1) or 1},
+            ))
+            assert not d1._fenced and d1.epoch == 5  # epoch still adopted
+            # Matching incarnation: fence.
+            P.request(s, P.Message(
+                P.MsgType.EPOCH_UPDATE,
+                {"epoch": 6, "dead_rank": 1, "inc": d1.incarnation},
+            ))
+            assert d1._fenced
+            # A fenced daemon refuses writes with STALE_EPOCH.
+            with pytest.raises(ocm.OcmError) as ei:
+                P.request(s, P.Message(
+                    P.MsgType.DO_ALLOC,
+                    {"orig_rank": 0, "pid": 1, "kind": 3,
+                     "device_index": 0, "nbytes": 4096},
+                ))
+            assert ei.value.code == int(P.ErrCode.STALE_EPOCH)
+        finally:
+            s.close()
+
+
+# -- satellite: lease reaper vs chaos ------------------------------------
+
+
+def test_app_killed_mid_striped_put_leaves_no_orphans(monkeypatch, rng):
+    """An app that dies mid-striped-PUT (detach-close: no DISCONNECT)
+    must leave no orphaned extents on ANY chain member — the lease
+    reaper drains primary and replicas alike, and the alloctrace ledger
+    drains on every rank."""
+    monkeypatch.setenv("OCM_ALLOCTRACE", "1")
+    alloctrace.reset()
+    cfg = fast_cfg(lease_s=0.6, heartbeat_s=0.1)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0, heartbeat=False)  # crashed app: no renewals
+        h = client.alloc(2 << 20, OcmKind.REMOTE_HOST)
+        assert h.replica_ranks
+        data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+
+        killed = threading.Event()
+
+        def mid_put_kill():
+            # Kill the app (detach) while stripes are in flight.
+            time.sleep(0.01)
+            client.close(detach=True)
+            killed.set()
+
+        t = threading.Thread(target=mid_put_kill)
+        t.start()
+        try:
+            client.put(h, data)
+        except ocm.OcmError:
+            pass  # the dying app's put may fail mid-flight: that's the point
+        t.join()
+        assert killed.is_set()
+        cl.clients.remove(client)
+        # Lease expiry reaps every copy on every rank.
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+            d.registry.live_count() for d in cl.daemons
+        ):
+            time.sleep(0.1)
+        assert [d.registry.live_count() for d in cl.daemons] == [0, 0, 0]
+        for d in cl.daemons:
+            assert d.host_arena.allocator.bytes_live == 0
+    leaked = alloctrace.live()
+    assert not leaked, [r.describe() for r in leaked]
+
+
+# -- protocol/lint coverage of the new surface ---------------------------
+
+
+def test_new_flags_declared_and_daemon_handled():
+    """The protocol-exhaustiveness gate must cover the resilience bits:
+    declared on the wire, claimed handled by the daemon, rejected at
+    pack time when undeclared — exactly the PR-3 flag contract."""
+    from oncilla_tpu.analysis.project import check_protocol
+    from oncilla_tpu.runtime import daemon as D
+
+    assert P.VALID_FLAGS[P.MsgType.CONNECT] & P.FLAG_CAP_REPLICA
+    assert P.VALID_FLAGS[P.MsgType.REQ_ALLOC] & P.FLAG_REPLICAS
+    assert P.VALID_FLAGS[P.MsgType.DATA_PUT] & P.FLAG_FANOUT
+    assert D._FLAGS_HANDLED[P.MsgType.CONNECT] & P.FLAG_CAP_REPLICA
+    assert D._FLAGS_HANDLED[P.MsgType.REQ_ALLOC] & P.FLAG_REPLICAS
+    assert D._FLAGS_HANDLED[P.MsgType.DATA_PUT] & P.FLAG_FANOUT
+    # FLAG_FANOUT is DATA_PUT-only: a stray bit on DATA_GET must fail at
+    # the sender.
+    with pytest.raises(ocm.OcmProtocolError, match="flags"):
+        P.pack(P.Message(
+            P.MsgType.DATA_GET,
+            {"alloc_id": 1, "offset": 0, "nbytes": 1},
+            flags=P.FLAG_FANOUT,
+        ))
+    assert check_protocol() == []
+
+
+# -- chaos harness determinism -------------------------------------------
+
+
+def test_chaos_schedule_deterministic():
+    a = ChaosSchedule.generate(99, nranks=4, nfaults=6,
+                               actions=("drop", "delay", "partition",
+                                        "heal", "kill"))
+    b = ChaosSchedule.generate(99, nranks=4, nfaults=6,
+                               actions=("drop", "delay", "partition",
+                                        "heal", "kill"))
+    assert a == b and len(a.faults) == 6
+    assert a != ChaosSchedule.generate(100, nranks=4, nfaults=6)
+    assert all(f.rank != 0 for f in a.faults if f.action == "kill")
+    with pytest.raises(ValueError, match="unknown chaos action"):
+        Fault(op=1, action="meteor")
+
+
+def test_chaos_replay_identical_interleaving(rng):
+    """Same seed, same workload -> the controller fires the identical
+    (op, action, rank) sequence, and injected faults are survived by the
+    retry ladder (byte-exactness holds)."""
+    def run(seed):
+        cfg = fast_cfg(replicas=1, detect=False)
+        with local_cluster(2, config=cfg) as cl:
+            client = cl.client(0)
+            h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+            sched = ChaosSchedule(seed=seed, faults=(
+                Fault(op=2, action="drop"),
+                Fault(op=4, action="delay", delay_s=0.002),
+                Fault(op=6, action="drop"),
+            ))
+            data = np.random.default_rng(seed).integers(
+                0, 256, 1 << 20, dtype=np.uint8
+            )
+            controller = ChaosController(sched, cl.entries,
+                                         kill_fn=cl.kill)
+            with controller.inject():
+                for _ in range(4):
+                    client.put(h, data)
+                    out = client.get(h, 1 << 20)
+            assert bytes(out) == data.tobytes()
+            assert not controller.pending()
+            return list(controller.log)
+
+    assert run(7) == run(7)
+
+
+def test_chaos_partition_blocks_and_heals():
+    sched = ChaosSchedule(seed=1, faults=(
+        Fault(op=1, action="partition", rank=1),
+        Fault(op=3, action="heal", rank=1),
+    ))
+    entries = [NodeEntry(0, "127.0.0.1", 1111), NodeEntry(1, "127.0.0.1", 2222)]
+    c = ChaosController(sched, entries)
+    c("127.0.0.1", 1111)           # op 1: partition armed (dest rank 0 fine)
+    with pytest.raises(OSError, match="partitioned"):
+        c("127.0.0.1", 2222)       # op 2: rank 1 blocked
+    c("127.0.0.1", 2222)           # op 3: heal fires before the check
+    assert [x[1] for x in c.log] == ["partition", "heal"]
