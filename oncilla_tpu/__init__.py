@@ -32,7 +32,9 @@ from oncilla_tpu.core.context import (
     ocm_tini,
 )
 from oncilla_tpu.core.errors import (
+    OcmAdmissionDenied,
     OcmBoundsError,
+    OcmBusy,
     OcmConnectError,
     OcmError,
     OcmInvalidHandle,
@@ -40,6 +42,8 @@ from oncilla_tpu.core.errors import (
     OcmOutOfMemory,
     OcmPlacementError,
     OcmProtocolError,
+    OcmQuotaExceeded,
+    OcmRemoteError,
     OcmReplicaUnavailable,
 )
 from oncilla_tpu.core.handle import OcmAlloc
@@ -53,8 +57,10 @@ __all__ = [
     "Extent",
     "Fabric",
     "Ocm",
+    "OcmAdmissionDenied",
     "OcmAlloc",
     "OcmBoundsError",
+    "OcmBusy",
     "OcmConfig",
     "OcmConnectError",
     "OcmError",
@@ -64,6 +70,8 @@ __all__ = [
     "OcmOutOfMemory",
     "OcmPlacementError",
     "OcmProtocolError",
+    "OcmQuotaExceeded",
+    "OcmRemoteError",
     "OcmReplicaUnavailable",
     "ocm_alloc",
     "ocm_alloc_kind",

@@ -57,3 +57,24 @@ class OcmNotPrimary(OcmError):
 
 class OcmPlacementError(OcmError):
     """The placement policy could not site the allocation."""
+
+
+class OcmQuotaExceeded(OcmError):
+    """The app's byte or handle quota cannot admit this allocation
+    (wire: ErrCode.QUOTA_EXCEEDED, not retryable until the app frees)."""
+
+
+class OcmAdmissionDenied(OcmError):
+    """Admission control refused the app outright — e.g. the daemon's
+    concurrent-app cap is reached (wire: ErrCode.ADMISSION_DENIED)."""
+
+
+class OcmBusy(OcmError):
+    """Back-pressure: the arena(s) crossed the high watermark and the
+    daemon asks the client to retry later (wire: ErrCode.BUSY, retryable;
+    ``retry_after_ms`` is the server-suggested backoff, carried as a u32
+    data tail on the ERROR frame)."""
+
+    def __init__(self, detail: str, retry_after_ms: int = 0):
+        super().__init__(detail)
+        self.retry_after_ms = int(retry_after_ms)

@@ -67,10 +67,37 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}GiB"
 
 
+_PRIO_NAMES = {0: "low", 1: "normal", 2: "high"}
+
+
+def _app_rows(rank: int, st: dict) -> list[list[str]]:
+    """Per-app QoS rows for one rank: app id, priority class, quota use
+    (live/limit bytes + handles), heartbeat age. Quota state comes from
+    the qos tail; heartbeat age from the lease stats (both keyed by the
+    same pid@rank app id)."""
+    apps = (st.get("qos") or {}).get("apps") or {}
+    hb = (st.get("leases") or {}).get("apps") or {}
+    out = []
+    for app, rec in sorted(apps.items()):
+        qb = rec.get("quota_bytes", 0)
+        qh = rec.get("quota_handles", 0)
+        out.append([
+            app,
+            str(rank),
+            _PRIO_NAMES.get(rec.get("priority", 1), "?"),
+            (f"{_fmt_bytes(rec.get('used_bytes', 0))}/"
+             + (_fmt_bytes(qb) if qb else "inf")),
+            (f"{rec.get('handles', 0)}/" + (str(qh) if qh else "inf")),
+            f"{hb[app]:.1f}" if app in hb else "-",
+        ])
+    return out
+
+
 def _table(entries) -> int:
     cols = ["rank", "nodes", "allocs", "live", "ops", "p50_us", "p99_us",
             "gbit/s", "leases r/x/e", "hb_age_s"]
     rows = []
+    app_rows: list[list[str]] = []
     any_ok = False
     for e in entries:
         st = _poll_status(e)
@@ -79,6 +106,7 @@ def _table(entries) -> int:
                          "-", st["error"][:40]])
             continue
         any_ok = True
+        app_rows.extend(_app_rows(e.rank, st))
         ops = (st.get("dcn") or {}).get("ops") or {}
         count = sum(v.get("count", 0) for v in ops.values())
         p50 = max((v.get("p50_us", 0.0) for v in ops.values()), default=0.0)
@@ -108,6 +136,17 @@ def _table(entries) -> int:
     print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
     for r in rows:
         print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    if app_rows:
+        acols = ["app", "rank", "prio", "bytes used/quota",
+                 "handles", "hb_age_s"]
+        awidths = [
+            max(len(c), *(len(r[i]) for r in app_rows))
+            for i, c in enumerate(acols)
+        ]
+        print()
+        print("  ".join(c.ljust(awidths[i]) for i, c in enumerate(acols)))
+        for r in app_rows:
+            print("  ".join(v.ljust(awidths[i]) for i, v in enumerate(r)))
     return 0 if any_ok else 1
 
 

@@ -147,6 +147,52 @@ def render(meta: dict) -> str:
                    "DEAD (degraded until re-replication).",
                    fo.get("repl_put_skips", 0), rank=rank)
 
+    qos = meta.get("qos", {})
+    if qos:
+        qc = qos.get("counters", {})
+        doc.sample("ocm_admission_denied_total", "counter",
+                   "REQ_ALLOC rejections by admission control, "
+                   "by reason.",
+                   qc.get("quota_exceeded", 0),
+                   rank=rank, reason="quota_exceeded")
+        doc.sample("ocm_admission_denied_total", "counter",
+                   "REQ_ALLOC rejections by admission control, "
+                   "by reason.",
+                   qc.get("admission_denied", 0),
+                   rank=rank, reason="max_apps")
+        doc.sample("ocm_backpressure_busy_total", "counter",
+                   "REQ_ALLOC answered retryable BUSY past the "
+                   "high watermark.",
+                   qc.get("busy", 0), rank=rank)
+        for prio, rec in sorted(
+            (qos.get("evictions_by_priority") or {}).items()
+        ):
+            doc.sample("ocm_evictions_by_priority", "counter",
+                       "Pressure evictions by priority class and lease "
+                       "state.",
+                       rec.get("expired", 0),
+                       rank=rank, priority=prio, lease="expired")
+            doc.sample("ocm_evictions_by_priority", "counter",
+                       "Pressure evictions by priority class and lease "
+                       "state.",
+                       rec.get("active", 0),
+                       rank=rank, priority=prio, lease="active")
+        for app, rec in sorted((qos.get("apps") or {}).items()):
+            doc.sample("ocm_quota_bytes_used", "gauge",
+                       "Live admitted bytes per app (origin-daemon "
+                       "view).",
+                       rec.get("used_bytes", 0),
+                       rank=rank, app=app,
+                       priority=rec.get("priority", 1))
+            doc.sample("ocm_quota_handles_used", "gauge",
+                       "Live admitted handles per app.",
+                       rec.get("handles", 0), rank=rank, app=app)
+        for peer, score in sorted((qos.get("load_scores") or {}).items()):
+            doc.sample("ocm_placement_load_score", "gauge",
+                       "Load-aware placement score per rank "
+                       "(0 cold .. ~0.9 hot).",
+                       score, rank=rank, peer=peer)
+
     # The transfer ring is bounded, so ring-derived figures are gauges
     # over the recent window, never counters.
     transfers = meta.get("transfers", [])

@@ -1,0 +1,39 @@
+"""Multi-tenant QoS: quotas, priority leases, back-pressure, placement.
+
+The reference system trusts every application equally — REQ_ALLOC is
+first-come-first-served and rank 0 places blind. This package makes the
+runtime safe to share between thousands of concurrent apps:
+
+- :mod:`policy` — per-app quotas and priority classes (``QosManager``):
+  admission control at REQ_ALLOC (typed ``QUOTA_EXCEEDED`` /
+  ``ADMISSION_DENIED``), optimistic reserve/commit/abort accounting at
+  the app's origin daemon, the ``suggest_backoff_ms`` back-pressure
+  hint, and the eviction counters that pin the
+  no-eviction-of-active-priority invariant.
+- :mod:`loadaware` — ``LoadAware(PlacementPolicy)``: CapacityAware
+  discounted by live per-rank load (live bytes, dcn p99, Gbit/s) fed
+  from the obs subsystem, selected with ``policy="loadaware"``.
+
+Every wire-visible piece rides the capability discipline: FLAG_CAP_QOS
+offered at CONNECT, declined-by-silence by v2 and native peers, and
+with ``OCM_QUOTA_*``/``OCM_PRIORITY`` unset the wire stays byte-for-byte
+the pre-QoS protocol.
+
+``python -m oncilla_tpu.qos --soak`` runs the multi-tenant soak: dozens
+of simulated apps with skewed sizes/priorities against a local_cluster,
+asserting fairness, the eviction invariant, and a drained alloctrace
+ledger — optionally with a chaos-harness daemon kill mid-soak
+(``--smoke`` is the bounded CI variant).
+"""
+
+from oncilla_tpu.qos.loadaware import LoadAware  # noqa: F401
+from oncilla_tpu.qos.policy import (  # noqa: F401
+    PRIO_HIGH,
+    PRIO_LOW,
+    PRIO_NAMES,
+    PRIO_NORMAL,
+    QosManager,
+    pack_profile,
+    suggest_backoff_ms,
+    unpack_profile,
+)

@@ -39,12 +39,15 @@ from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.qos.policy import pack_profile
 from oncilla_tpu.runtime.protocol import (
     ErrCode,
     FLAG_CAP_COALESCE,
+    FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
     FLAG_MORE,
+    FLAG_QOS_TAIL,
     FLAG_REPLICAS,
     FLAG_TRACE_CTX,
     VALID_FLAGS,
@@ -59,6 +62,15 @@ from oncilla_tpu.runtime.protocol import (
 )
 from oncilla_tpu.utils.config import MAX_CHUNK_BYTES, OcmConfig
 from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
+
+
+def backoff_sleep(step_s: float) -> None:
+    """One capped-backoff pause with jitter (uniform in [0.5, 1.0] of the
+    step) — shared by the CONNECT retry ladder and the QoS BUSY retry so
+    a herd of clients never re-dials a saturated daemon in lockstep."""
+    import random
+
+    time.sleep(step_s * (0.5 + random.random() / 2))
 
 
 class _PlaneServer:
@@ -258,11 +270,17 @@ class ControlPlaneClient:
         ici_plane=None,
         heartbeat: bool = True,
         serve_plane: bool = True,
+        app_id: int | None = None,
     ):
         self.entries = entries
         self.rank = rank
         self.config = config or OcmConfig()
-        self.pid = os.getpid()
+        # App identity on the wire. Defaults to the OS pid (one app per
+        # process, as in the reference); ``app_id`` lets a process host
+        # several logical tenants — each with its own leases, QoS
+        # profile and quota — which is how the qos soak simulates dozens
+        # of apps in one harness process.
+        self.pid = os.getpid() if app_id is None else int(app_id)
         self.ici_plane = ici_plane
         self.tracer = GLOBAL_TRACER
         self._pool = PeerPool()
@@ -297,13 +315,27 @@ class ControlPlaneClient:
         offer = (FLAG_CAP_TRACE if self.config.trace else 0) | (
             FLAG_CAP_REPLICA if self.config.replicas > 1 else 0
         )
-        r = self._request(Message(
-            MsgType.CONNECT, {"pid": self.pid, "rank": rank},
-            flags=offer,
-        ))
+        # QoS profile declaration (qos/): only a NON-default profile is
+        # worth a capability offer — priority/quota unset keeps this
+        # frame byte-for-byte the pre-QoS CONNECT. The profile rides the
+        # same frame as a FLAG_QOS_TAIL data tail; decliners (old
+        # daemons, the native C++ daemon) ignore both bit and tail.
+        connect = Message(
+            MsgType.CONNECT, {"pid": self.pid, "rank": rank}, flags=offer
+        )
+        if self.config.qos_offer:
+            connect.flags |= FLAG_CAP_QOS | FLAG_QOS_TAIL
+            connect.data = pack_profile(
+                self.config.priority,
+                self.config.quota_bytes,
+                self.config.quota_handles,
+            )
+        r = self._request(connect)
         if r.type != MsgType.CONNECT_CONFIRM:
             raise OcmConnectError(f"bad handshake reply {r.type.name}")
-        self._ctrl_caps = r.flags & (FLAG_CAP_TRACE | FLAG_CAP_REPLICA)
+        self._ctrl_caps = r.flags & (
+            FLAG_CAP_TRACE | FLAG_CAP_REPLICA | FLAG_CAP_QOS
+        )
         self.nnodes = r.fields["nnodes"]
         self._plane_server: _PlaneServer | None = None
         if ici_plane is not None and serve_plane:
@@ -332,8 +364,6 @@ class ControlPlaneClient:
         the very first attempt would surface that routine window to the
         app. Jitter (uniform in [0.5, 1.0] of the step) keeps a herd of
         clients from re-dialing a rebinding daemon in lockstep."""
-        import random
-
         cfg = self.config
         delay = max(cfg.connect_backoff_s, 1e-3)
         last: OSError | None = None
@@ -344,8 +374,7 @@ class ControlPlaneClient:
                 last = e
                 if attempt == cfg.connect_retries:
                     break
-                step = min(delay, cfg.connect_backoff_cap_s)
-                time.sleep(step * (0.5 + random.random() / 2))
+                backoff_sleep(min(delay, cfg.connect_backoff_cap_s))
                 delay *= 2
         raise OcmConnectError(
             f"local daemon unreachable at {host}:{port} after "
@@ -487,7 +516,7 @@ class ControlPlaneClient:
         ):
             req.flags |= FLAG_REPLICAS
             req.data = bytes([self.config.replicas])
-        r = self._request(req)
+        r = self._alloc_request(req)
         f = r.fields
         placed_kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         fabric = (
@@ -545,16 +574,61 @@ class ControlPlaneClient:
                     scrub(h)
         return h
 
+    def _alloc_request(self, req: Message) -> Message:
+        """REQ_ALLOC with back-pressure compliance (qos/): a retryable
+        BUSY rejection is honored with capped jittered backoff — seeded
+        by the server's suggested delay when the reply carries one —
+        rather than surfaced to the app. Every other error (including
+        QUOTA_EXCEEDED, which only the app freeing can fix) propagates
+        unchanged, as does BUSY once the retry budget is spent."""
+        cfg = self.config
+        delay = max(cfg.busy_backoff_ms, 1) / 1e3
+        for attempt in range(cfg.busy_retries + 1):
+            try:
+                return self._request(req)
+            except OcmRemoteError as e:
+                if (
+                    e.code != int(ErrCode.BUSY)
+                    or attempt == cfg.busy_retries
+                ):
+                    raise
+                hint = getattr(e, "retry_after_ms", 0) / 1e3
+                step = min(
+                    max(delay, hint), cfg.connect_backoff_cap_s
+                )
+                obs_journal.record(
+                    "backpressure_wait", attempt=attempt,
+                    wait_s=round(step, 4),
+                    nbytes=req.fields.get("nbytes", 0),
+                )
+                printd("client rank %d: BUSY, backing off %.0f ms "
+                       "(attempt %d)", self.rank, step * 1e3, attempt + 1)
+                backoff_sleep(step)
+                delay *= 2
+        raise AssertionError("unreachable")  # loop returns or raises
+
     def free(self, handle: OcmAlloc) -> None:
-        self._request(
-            Message(
-                MsgType.REQ_FREE,
-                {"alloc_id": handle.alloc_id, "rank": handle.rank},
-            )
-        )
+        # Leave the owner set BEFORE the round trip (restored on
+        # failure): a heartbeat racing the free would otherwise ship a
+        # stale owners list for the whole free RPC and trigger a relay
+        # for an allocation that no longer exists. During the RPC a beat
+        # that misses the owner only skips renewing a lease that is
+        # being destroyed anyway.
         self._note_owner(handle.rank, -1)
         for rr in handle.replica_ranks:
             self._note_owner(rr, -1)
+        try:
+            self._request(
+                Message(
+                    MsgType.REQ_FREE,
+                    {"alloc_id": handle.alloc_id, "rank": handle.rank},
+                )
+            )
+        except BaseException:
+            self._note_owner(handle.rank, +1)
+            for rr in handle.replica_ranks:
+                self._note_owner(rr, +1)
+            raise
 
     # -- RemoteBackend: one-sided data ----------------------------------
 

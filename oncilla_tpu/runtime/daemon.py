@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 import socket
+import struct
 import threading
 import time
 
@@ -29,7 +30,9 @@ from oncilla_tpu.analysis import alloctrace
 from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 from oncilla_tpu.core.errors import (
+    OcmAdmissionDenied,
     OcmBoundsError,
+    OcmBusy,
     OcmConnectError,
     OcmError,
     OcmInvalidHandle,
@@ -37,6 +40,7 @@ from oncilla_tpu.core.errors import (
     OcmPlacementError,
     OcmNotPrimary,
     OcmProtocolError,
+    OcmQuotaExceeded,
     OcmRemoteError,
     OcmReplicaUnavailable,
 )
@@ -51,14 +55,24 @@ from oncilla_tpu.runtime.placement import (
 )
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
+from oncilla_tpu.qos.policy import (
+    PRIO_HIGH,
+    PRIO_LOW,
+    PRIO_NORMAL,
+    QosManager,
+    suggest_backoff_ms,
+    unpack_profile,
+)
 from oncilla_tpu.resilience.detector import FailureDetector, PeerState, probe
 from oncilla_tpu.resilience.failover import FailoverCoordinator
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
     FLAG_FANOUT,
     FLAG_MORE,
+    FLAG_QOS_TAIL,
     FLAG_REPLICAS,
     FLAG_TRACE_CTX,
     VALID_FLAGS,
@@ -114,9 +128,18 @@ class Daemon:
             ArenaAllocator(self.config.device_arena_bytes, self.config.alignment)
             for _ in range(ndevices)
         ]
-        self.registry = AllocRegistry(rank, self.config.lease_s)
+        self.registry = AllocRegistry(
+            rank, self.config.lease_s,
+            app_stale_leases=self.config.app_stale_leases,
+        )
         self.policy = POLICIES[policy]()
         self.peers = PeerPool()
+        # Multi-tenant QoS (qos/): tenant profiles + admission accounting
+        # for apps whose ORIGIN daemon this is; rank 0 additionally runs
+        # the back-pressure check and, with policy="loadaware", feeds the
+        # placement policy from peer STATUS polls in the reaper loop.
+        self.qos = QosManager(self.config)
+        self._last_load_poll = time.monotonic()
         # Device-plane endpoint (host, port) registered by the SPMD
         # controller's client via PLANE_SERVE; device-kind data ops are
         # relayed there (tuple rebind is atomic under the GIL). The daemon
@@ -557,6 +580,16 @@ class Daemon:
                         reply = self._dispatch(msg)
                 except OcmOutOfMemory as e:
                     reply = _err(ErrCode.OOM, str(e))
+                except OcmQuotaExceeded as e:
+                    reply = _err(ErrCode.QUOTA_EXCEEDED, str(e))
+                except OcmAdmissionDenied as e:
+                    reply = _err(ErrCode.ADMISSION_DENIED, str(e))
+                except OcmBusy as e:
+                    # Retryable back-pressure: the server-suggested
+                    # backoff rides as a u32 (ms) data tail — invisible
+                    # to peers that don't know the code.
+                    reply = _err(ErrCode.BUSY, str(e),
+                                 struct.pack("<I", e.retry_after_ms))
                 except OcmReplicaUnavailable as e:
                     reply = _err(ErrCode.REPLICA_UNAVAILABLE, str(e))
                 except OcmNotPrimary as e:
@@ -567,6 +600,23 @@ class Daemon:
                     reply = _err(ErrCode.BAD_ALLOC_ID, str(e))
                 except OcmPlacementError as e:
                     reply = _err(ErrCode.PLACEMENT, str(e))
+                except OcmRemoteError as e:
+                    # A relayed hop's typed rejection (REQ_ALLOC proxied
+                    # to rank 0, DO_FREE to an owner) keeps its code —
+                    # clients switch on it (BUSY backoff, failover
+                    # ladder), so flattening to UNKNOWN here would break
+                    # them one hop out. BUSY re-carries its backoff tail.
+                    code = (
+                        ErrCode(e.code)
+                        if e.code in ErrCode._value2member_map_
+                        else ErrCode.UNKNOWN
+                    )
+                    reply = _err(
+                        code, e.detail,
+                        struct.pack(
+                            "<I", getattr(e, "retry_after_ms", 0)
+                        ) if code == ErrCode.BUSY else b"",
+                    )
                 except OcmError as e:
                     reply = _err(ErrCode.UNKNOWN, str(e))
                 except Exception as e:  # noqa: BLE001 — always answer with a
@@ -623,6 +673,19 @@ class Daemon:
                     alloc_id=e.alloc_id, nbytes=e.nbytes,
                     origin_pid=e.origin_pid, origin_rank=e.origin_rank,
                 )
+            # QoS (qos/): pressure eviction under the arena watermarks,
+            # stale-tenant pruning, and the load-aware placement feed.
+            # Each guarded — a QoS hiccup must never kill the reaper.
+            try:
+                self._pressure_evict()
+                self.qos.prune_stale()
+            except Exception as e:  # noqa: BLE001 — see above
+                printd("daemon %d: pressure evict failed: %s", self.rank, e)
+            try:
+                self._feed_load_stats()
+            except Exception as e:  # noqa: BLE001 — telemetry feed is
+                # best-effort; placement falls back to capacity order
+                printd("daemon %d: load feed failed: %s", self.rank, e)
             if self._plane_unsynced:
                 self._sync_plane_endpoint()
             try:
@@ -630,6 +693,118 @@ class Daemon:
             except Exception as e:  # noqa: BLE001 — liveness must never
                 # kill the reaper thread (leases matter more than probes)
                 printd("daemon %d: detector tick failed: %s", self.rank, e)
+
+    # -- multi-tenant QoS (qos/) -----------------------------------------
+
+    def _pressure_evict(self) -> None:
+        """Priority eviction under arena pressure (Borg-style tiers):
+        when host occupancy crosses the high watermark, free extents in
+        victim order — expired first, then priority ascending, oldest
+        lease first — until occupancy falls below the LOW watermark
+        (hysteresis) or victims run out. The invariant this PRESERVES:
+        an ACTIVE (lease-current) extent above priority 0 is never
+        evicted; only the low class is preemptible while alive. Runs on
+        the owner, and only over entries this rank is primary for (the
+        chain free fans out), so replica copies never fork."""
+        cap = self.config.host_arena_bytes
+        if cap <= 0:
+            return
+        live = self.host_arena.allocator.bytes_live
+        if live / cap < self.config.arena_high_pct / 100.0:
+            return
+        low_bytes = cap * self.config.arena_low_pct / 100.0
+        now = time.monotonic()
+        for e in self.registry.eviction_candidates(self.rank, now):
+            if self.host_arena.allocator.bytes_live <= low_bytes:
+                break
+            active = e.lease_expiry >= now
+            if active and e.priority > PRIO_LOW:
+                # Victim queue is sorted, but the guard stays explicit:
+                # the invariant must hold even if the ordering changes.
+                continue
+            try:
+                self._do_free_local(e.alloc_id)
+            except OcmInvalidHandle:
+                continue  # raced with an explicit free
+            except (OSError, OcmError) as exc:
+                printd("daemon %d: eviction of %d failed: %s",
+                       self.rank, e.alloc_id, exc)
+                continue
+            self.qos.note_eviction(e.priority, active)
+            self.registry.note_reclaim()
+            obs_journal.record(
+                "qos_evict", track=self.tracer.track,
+                alloc_id=e.alloc_id, priority=e.priority, active=active,
+                nbytes=e.nbytes, origin_pid=e.origin_pid,
+            )
+            printd(
+                "daemon %d evicted alloc %d under pressure "
+                "(priority %d, %s, %d B)",
+                self.rank, e.alloc_id, e.priority,
+                "active" if active else "expired", e.nbytes,
+            )
+
+    def _feed_load_stats(self) -> None:
+        """Rank-0, policy="loadaware" only: refresh the placement
+        policy's per-rank load scores from each daemon's live stats —
+        its own locally, peers via the same STATUS the obs CLI polls."""
+        observe = getattr(self.policy, "observe", None)
+        if self.rank != 0 or observe is None:
+            return
+        now = time.monotonic()
+        if now - self._last_load_poll < self.config.loadaware_poll_s:
+            return
+        self._last_load_poll = now
+        observe(
+            self.rank,
+            live_bytes=self.host_arena.allocator.bytes_live,
+            **self._own_load_sample(),
+        )
+        for e in self.entries:
+            if e.rank == self.rank or e.port == 0:
+                continue
+            if self._believed_dead(e.rank):
+                continue
+            try:
+                r = self.peers.request(
+                    e.connect_host, e.port, Message(MsgType.STATUS, {})
+                )
+            except (OSError, OcmError):
+                continue  # detector owns liveness; skip this round
+            gbps, p99 = 0.0, 0.0
+            if r.data:
+                import json
+
+                try:
+                    tail = json.loads(bytes(r.data))
+                except (ValueError, UnicodeDecodeError):
+                    tail = {}
+                ops = (tail.get("dcn") or {}).get("ops") or {}
+                p99 = max(
+                    (v.get("p99_us", 0.0) for v in ops.values()),
+                    default=0.0,
+                )
+                transfers = (tail.get("dcn") or {}).get("transfers") or []
+                if transfers:
+                    gbps = transfers[-1].get("gbps", 0.0)
+            observe(
+                e.rank,
+                live_bytes=r.fields.get("host_bytes_live", 0),
+                gbps=gbps, p99_us=p99,
+            )
+
+    def _own_load_sample(self) -> dict:
+        ops = {
+            k: v for k, v in self.tracer.snapshot().items()
+            if k.startswith("dcn_")
+        }
+        transfers = self.tracer.transfers(last=1)
+        return {
+            "gbps": transfers[-1].get("gbps", 0.0) if transfers else 0.0,
+            "p99_us": max(
+                (v.get("p99_us", 0.0) for v in ops.values()), default=0.0
+            ),
+        }
 
     # -- failure detection (resilience/detector.py) ----------------------
 
@@ -710,12 +885,13 @@ class Daemon:
 
     def _peer_caps_for(self, host: str, port: int) -> int:
         """Negotiated capability bits for the daemon at (host, port),
-        probed once per address with a CONNECT offering FLAG_CAP_TRACE.
-        Un-upgraded v2 peers and the native C++ daemon echo flags=0 —
-        decline by silence — and this daemon then never prefixes trace
-        context on hops to them. Probe failures are NOT cached (the peer
-        may simply be restarting); the forwarded request itself will
-        surface the real error."""
+        probed once per address with a CONNECT offering FLAG_CAP_TRACE
+        and FLAG_CAP_QOS (one probe covers both relay concerns: trace
+        prefixes and priority tails). Un-upgraded v2 peers and the
+        native C++ daemon echo flags=0 — decline by silence — and this
+        daemon then ships plain frames to them. Probe failures are NOT
+        cached (the peer may simply be restarting); the forwarded
+        request itself will surface the real error."""
         key = (host, port)
         with self._peer_caps_lock:
             caps = self._peer_caps.get(key)
@@ -723,14 +899,15 @@ class Daemon:
             return caps
         import os as _os
 
+        offer = FLAG_CAP_TRACE | FLAG_CAP_QOS
         try:
             r = self.peers.request(host, port, Message(
                 MsgType.CONNECT,
                 {"pid": _os.getpid(), "rank": self.rank},
-                flags=FLAG_CAP_TRACE,
+                flags=offer,
             ))
             caps = (
-                r.flags & FLAG_CAP_TRACE
+                r.flags & offer
                 if r.type == MsgType.CONNECT_CONFIRM else 0
             )
         except (OSError, OcmError):
@@ -778,6 +955,16 @@ class Daemon:
     # CONNECT: app attach (process_msg MSG_CONNECT analogue, main.c:58-103).
     def _on_connect(self, msg: Message) -> Message:
         printd("daemon %d: app pid %d connected", self.rank, msg.fields["pid"])
+        # QoS profile declaration (qos/): a FLAG_CAP_QOS offer may carry
+        # the app's (priority, quota_bytes, quota_handles) as a
+        # FLAG_QOS_TAIL data tail. Registered BEFORE the echo so the
+        # app's very first REQ_ALLOC already runs under its profile.
+        if msg.flags & FLAG_CAP_QOS and msg.flags & FLAG_QOS_TAIL:
+            prof = unpack_profile(msg.data)
+            if prof is not None:
+                self.qos.register(
+                    msg.fields["pid"], msg.fields["rank"], *prof
+                )
         # Capability negotiation: grant exactly the offered bits we
         # implement. Peers that never offer (old clients, the C++ daemon's
         # own dials) get flags=0 and the lockstep protocol unchanged.
@@ -789,7 +976,8 @@ class Daemon:
                 else len(self.entries),
             },
             flags=msg.flags
-            & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA),
+            & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
+               | FLAG_CAP_QOS),
         )
 
     def _on_disconnect(self, msg: Message) -> Message:
@@ -801,6 +989,9 @@ class Daemon:
         DISCONNECT and falls back to the lease reaper."""
         pid = msg.fields["pid"]
         self._reclaim_app_local(pid, self.rank)
+        # The tenant's whole QoS state goes with it — quota give-back for
+        # remote-owned allocations the origin ledger still remembered.
+        self.qos.drop_app(pid, self.rank)
         for r in _parse_owners(msg.fields.get("owners", "")):
             if r == self.rank or not 0 <= r < len(self.entries):
                 continue
@@ -871,48 +1062,146 @@ class Daemon:
     # REQ_ALLOC: non-masters proxy the request to rank 0 (the placement leg,
     # mem.c:128); rank 0 places (alloc_find analogue) then drives the
     # DO_ALLOC leg to the owner and returns the complete handle
-    # (msg_send_req_alloc analogue, mem.c:234-260).
+    # (msg_send_req_alloc analogue, mem.c:234-260). QoS (qos/) wraps the
+    # whole path: size validation first, then quota admission at the
+    # app's ORIGIN daemon (the one that holds its profile), then the
+    # rank-0 back-pressure check inside _place_alloc.
     def _on_req_alloc(self, msg: Message) -> Message:
         f = msg.fields
-        if self.rank != 0:
-            r0 = self.entries[0]
-            return self._peer_request(r0.connect_host, r0.port, msg)
-        kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         nbytes = f["nbytes"]
-        # k-way replication (FLAG_REPLICAS, granted at CONNECT by
-        # FLAG_CAP_REPLICA): the data tail's one u8 is the requested copy
-        # count. Host kinds only — device bytes live in the app plane.
-        k = 1
+        kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        # Daemon-side size validation: a zero-byte request has no valid
+        # extent (it previously surfaced as an untyped ValueError deep in
+        # the owner's arena), and a request above every node's arena can
+        # NEVER be sited — reject both up front, reserving nothing.
+        if nbytes <= 0:
+            raise OcmPlacementError(
+                f"invalid allocation size {nbytes}: must be > 0"
+            )
+        if self.rank == 0:
+            cap = self.policy.max_capacity(kind)
+            if cap and nbytes > cap:
+                raise OcmOutOfMemory(
+                    f"{nbytes} B of {kind.value} exceeds every node's "
+                    f"arena capacity (largest is {cap} B)"
+                )
+        app = (f["pid"], f["orig_rank"])
+        local_app = f["orig_rank"] == self.rank
+        if local_app:
+            # Admission: reserve against the app's quota (raises typed
+            # QUOTA_EXCEEDED / ADMISSION_DENIED); committed to the alloc
+            # id on success, rolled back on any downstream failure.
+            self.qos.admit(app[0], app[1], nbytes)
+        try:
+            if self.rank != 0:
+                r0 = self.entries[0]
+                r = self._peer_request(
+                    r0.connect_host, r0.port,
+                    self._with_priority_tail(
+                        msg, self.qos.priority_of(*app) if local_app
+                        else None,
+                        r0.connect_host, r0.port,
+                    ),
+                )
+            else:
+                r = self._place_alloc(msg, kind, nbytes)
+        except BaseException:
+            if local_app:
+                self.qos.abort(app[0], app[1], nbytes)
+            raise
+        if local_app:
+            self.qos.commit(app[0], app[1], r.fields["alloc_id"], nbytes)
+        return r
+
+    def _with_priority_tail(
+        self, msg: Message, priority: int | None, host: str, port: int
+    ) -> Message:
+        """Append the FLAG_QOS_TAIL priority u8 to a forwarded
+        REQ_ALLOC — only for a non-default class, and only when the peer
+        granted FLAG_CAP_QOS (default-priority traffic ships unchanged
+        frames, preserving wire byte-identity and skipping the
+        capability probe entirely)."""
         if (
-            msg.flags & FLAG_REPLICAS
-            and len(msg.data) >= 1
-            and kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
+            priority is None
+            or priority == PRIO_NORMAL
+            or not self._peer_caps_for(host, port) & FLAG_CAP_QOS
         ):
-            k = max(1, min(int(bytes(msg.data[:1])[0]), 8))
+            return msg
+        return Message(
+            msg.type, msg.fields,
+            bytes(msg.data) + bytes([priority]),
+            msg.flags | FLAG_QOS_TAIL,
+        )
+
+    def _place_alloc(self, msg: Message, kind: OcmKind,
+                     nbytes: int) -> Message:
+        """Rank-0 placement: parse the optional tails, run back-pressure,
+        site the allocation, drive the DO_ALLOC/DO_REPLICA leg(s)."""
+        f = msg.fields
+        # Data-tail layout after the generic trace strip:
+        # [k u8 if FLAG_REPLICAS] [priority u8 if FLAG_QOS_TAIL].
+        data = bytes(msg.data)
+        off = 0
+        k = 1
+        if msg.flags & FLAG_REPLICAS and len(data) > off:
+            if kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                k = max(1, min(data[off], 8))
+            off += 1
+        if msg.flags & FLAG_QOS_TAIL and len(data) > off:
+            prio = min(max(data[off], PRIO_LOW), PRIO_HIGH)
+        elif f["orig_rank"] == self.rank:
+            prio = self.qos.priority_of(f["pid"], f["orig_rank"])
+        else:
+            prio = PRIO_NORMAL
+        # Back-pressure (host kinds): when even the least-loaded alive
+        # rank is past the high watermark, answer retryable BUSY with a
+        # suggested backoff instead of packing arenas to the brim — the
+        # reaper's pressure eviction is busy making room. High-priority
+        # apps bypass it (their work is what the room is being made for).
+        if (
+            kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
+            and prio < PRIO_HIGH
+        ):
+            high = self.config.arena_high_pct / 100.0
+            occ = self.policy.min_host_occupancy()
+            if occ is not None and occ >= high:
+                self.qos.note_busy()
+                obs_journal.record(
+                    "backpressure_busy", track=self.tracer.track,
+                    occupancy=round(occ, 4), nbytes=nbytes,
+                    pid=f["pid"], orig_rank=f["orig_rank"],
+                )
+                raise OcmBusy(
+                    f"host arenas at {occ:.0%} (high watermark "
+                    f"{self.config.arena_high_pct}%): retry later",
+                    retry_after_ms=suggest_backoff_ms(
+                        occ, high, self.config.busy_backoff_ms
+                    ),
+                )
         placed = self.policy.place(f["orig_rank"], kind, nbytes, replicas=k)
         if placed.replica_ranks:
-            return self._alloc_replicated(f, placed, nbytes)
+            return self._alloc_replicated(f, placed, nbytes, priority=prio)
         owner = self.entries[placed.rank]
         if placed.rank == self.rank:
             alloc_id, offset = self._do_alloc_local(
                 placed.kind, placed.device_index, nbytes, f["orig_rank"],
-                f["pid"],
+                f["pid"], priority=prio,
             )
         else:
-            r = self._peer_request(
-                owner.connect_host,
-                owner.port,
-                Message(
-                    MsgType.DO_ALLOC,
-                    {
-                        "orig_rank": f["orig_rank"],
-                        "pid": f["pid"],
-                        "kind": WIRE_KIND[placed.kind.value],
-                        "device_index": placed.device_index,
-                        "nbytes": nbytes,
-                    },
-                ),
+            leg = Message(
+                MsgType.DO_ALLOC,
+                {
+                    "orig_rank": f["orig_rank"],
+                    "pid": f["pid"],
+                    "kind": WIRE_KIND[placed.kind.value],
+                    "device_index": placed.device_index,
+                    "nbytes": nbytes,
+                },
             )
+            leg = self._with_priority_tail(
+                leg, prio, owner.connect_host, owner.port
+            )
+            r = self._peer_request(owner.connect_host, owner.port, leg)
             alloc_id, offset = r.fields["alloc_id"], r.fields["offset"]
         self.policy.note_alloc(placed, nbytes)
         return Message(
@@ -929,7 +1218,8 @@ class Daemon:
             },
         )
 
-    def _alloc_replicated(self, f: dict, placed, nbytes: int) -> Message:
+    def _alloc_replicated(self, f: dict, placed, nbytes: int,
+                          priority: int = PRIO_NORMAL) -> Message:
         """Provision a k-way replicated allocation (rank 0 only): one
         alloc_id minted HERE (rank 0's id space is globally unique, so
         every chain member can register the same id), then DO_REPLICA to
@@ -945,6 +1235,11 @@ class Daemon:
         csv = ",".join(str(r) for r in chain)
         confirmed: list[int] = []
         offset0 = 0
+        # Non-default priority rides every chain leg (FLAG_QOS_TAIL u8)
+        # so a promoted replica inherits the class — eviction discipline
+        # must survive failover.
+        qflags = FLAG_QOS_TAIL if priority != PRIO_NORMAL else 0
+        qtail = bytes([priority]) if qflags else b""
         for rr in chain:
             m = Message(
                 MsgType.DO_REPLICA,
@@ -957,6 +1252,8 @@ class Daemon:
                     "chain": csv,
                     "epoch": self.epoch,
                 },
+                qtail,
+                flags=qflags,
             )
             try:
                 if rr == self.rank:
@@ -1045,6 +1342,9 @@ class Daemon:
                 {"alloc_id": f["alloc_id"],
                  "offset": existing.extent.offset},
             )
+        prio = PRIO_NORMAL
+        if msg.flags & FLAG_QOS_TAIL and len(msg.data) >= 1:
+            prio = min(max(bytes(msg.data[:1])[0], PRIO_LOW), PRIO_HIGH)
         extent = self.host_arena.alloc(f["nbytes"])
         self.registry.insert(
             RegEntry(
@@ -1059,6 +1359,7 @@ class Daemon:
                 lease_expiry=self.registry.new_lease_deadline(),
                 chain=chain,
                 epoch=f["epoch"],
+                priority=prio,
             )
         )
         alloctrace.note_alloc(
@@ -1073,14 +1374,18 @@ class Daemon:
     def _on_do_alloc(self, msg: Message) -> Message:
         f = msg.fields
         kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        prio = PRIO_NORMAL
+        if msg.flags & FLAG_QOS_TAIL and len(msg.data) >= 1:
+            prio = min(max(bytes(msg.data[:1])[0], PRIO_LOW), PRIO_HIGH)
         alloc_id, offset = self._do_alloc_local(
-            kind, f["device_index"], f["nbytes"], f["orig_rank"], f["pid"]
+            kind, f["device_index"], f["nbytes"], f["orig_rank"], f["pid"],
+            priority=prio,
         )
         return Message(MsgType.DO_ALLOC_OK, {"alloc_id": alloc_id, "offset": offset})
 
     def _do_alloc_local(
         self, kind: OcmKind, device_index: int, nbytes: int, orig_rank: int,
-        origin_pid: int = 0,
+        origin_pid: int = 0, priority: int = PRIO_NORMAL,
     ) -> tuple[int, int]:
         """alloc_ate analogue (alloc.c:151-222): reserve the extent in the
         owner's arena and register the allocation."""
@@ -1103,6 +1408,7 @@ class Daemon:
                 origin_rank=orig_rank,
                 origin_pid=origin_pid,
                 lease_expiry=self.registry.new_lease_deadline(),
+                priority=priority,
             )
         )
         alloctrace.note_alloc(self._trace_scope, alloc_id, nbytes, kind.name)
@@ -1124,6 +1430,9 @@ class Daemon:
                 owner.connect_host, owner.port,
                 Message(MsgType.DO_FREE, {"alloc_id": f["alloc_id"]}),
             )
+        # Quota give-back at the ORIGIN daemon (idempotent: the local-
+        # owner branch already released through _do_free_local).
+        self.qos.release(f["alloc_id"])
         return Message(MsgType.FREE_OK, {"alloc_id": f["alloc_id"]})
 
     def _on_do_free(self, msg: Message) -> Message:
@@ -1160,6 +1469,9 @@ class Daemon:
                     pass
             self.device_books[e.device_index].free(e.extent)
         alloctrace.note_free(self._trace_scope, alloc_id)
+        # Quota give-back when this daemon is ALSO the app's origin (the
+        # reaper/eviction/reclaim paths funnel here); no-op otherwise.
+        self.qos.release(alloc_id)
         # Primary of a replica chain: free the replicas too (best-effort —
         # an unreachable replica's copy falls to its own lease reaper,
         # since leases stop renewing once the app's handle is gone).
@@ -1696,6 +2008,7 @@ class Daemon:
         so they are not re-relayed (no forwarding loop)."""
         f = msg.fields
         self.registry.renew_leases(f["pid"], f["rank"])
+        self.qos.touch(f["pid"], f["rank"])
         obs_journal.record(
             "lease_renew", track=self.tracer.track,
             app_pid=f["pid"], app_rank=f["rank"],
@@ -1732,6 +2045,7 @@ class Daemon:
             },
             "leases": self.registry.lease_stats(),
             "resilience": self._resilience_meta(),
+            "qos": self._qos_meta(),
         }
         return Message(
             MsgType.STATUS_OK,
@@ -1757,6 +2071,15 @@ class Daemon:
             "failover": dict(self.res_counters),
         }
 
+    def _qos_meta(self) -> dict:
+        """Tenant/quota/eviction state for STATUS, STATUS_PROM and the
+        obs cluster table's per-app rows."""
+        meta = self.qos.metrics()
+        scores = getattr(self.policy, "load_scores", None)
+        if self.rank == 0 and scores is not None:
+            meta["load_scores"] = scores()
+        return meta
+
     def _metrics_meta(self) -> dict:
         """Everything the Prometheus endpoint and the cluster CLI render:
         op counters, the transfer ring, arena occupancy, lease health."""
@@ -1780,6 +2103,7 @@ class Daemon:
             ],
             "leases": self.registry.lease_stats(),
             "resilience": self._resilience_meta(),
+            "qos": self._qos_meta(),
         }
 
     def _on_status_prom(self, msg: Message) -> Message:
@@ -1799,8 +2123,8 @@ class Daemon:
         )
 
 
-def _err(code: ErrCode, detail: str) -> Message:
-    return Message(MsgType.ERROR, {"code": int(code), "detail": detail})
+def _err(code: ErrCode, detail: str, data: bytes = b"") -> Message:
+    return Message(MsgType.ERROR, {"code": int(code), "detail": detail}, data)
 
 
 def _parse_owners(s: str) -> list[int]:
@@ -1875,14 +2199,21 @@ def main(argv=None) -> int:
 # prefix is stripped and installed around dispatch before any handler
 # runs), so every traced request type claims it here.
 _FLAGS_HANDLED = {
-    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA,
+    # FLAG_CAP_QOS / FLAG_QOS_TAIL: QoS profile declaration parsed in
+    # _on_connect; priority tails parsed in _place_alloc / _on_do_alloc /
+    # _on_do_replica (qos/).
+    MsgType.CONNECT: (
+        FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
+        | FLAG_CAP_QOS | FLAG_QOS_TAIL
+    ),
     # FLAG_FANOUT: replica-chain role discipline in _check_data_role /
     # _route_put_payload (fan-out legs land, clients need primary role).
     MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT,
     MsgType.DATA_GET: FLAG_TRACE_CTX,
-    # FLAG_REPLICAS: the data tail's u8 copy count, read in _on_req_alloc.
-    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS,
-    MsgType.DO_ALLOC: FLAG_TRACE_CTX,
+    # FLAG_REPLICAS: the data tail's u8 copy count, read in _place_alloc.
+    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL,
+    MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
+    MsgType.DO_REPLICA: FLAG_QOS_TAIL,
     MsgType.REQ_FREE: FLAG_TRACE_CTX,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,

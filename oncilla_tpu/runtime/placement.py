@@ -78,6 +78,34 @@ class PlacementPolicy:
         with self._lock:
             return len(self._nodes)
 
+    # -- cluster-wide queries (qos/: validation + back-pressure) ---------
+
+    def max_capacity(self, kind: OcmKind) -> int:
+        """Largest single-arena capacity any non-dead node offers for
+        ``kind`` — a request above it can NEVER be sited, so REQ_ALLOC
+        rejects it up front instead of bouncing through placement/OOM."""
+        with self._lock:
+            caps = [
+                n.host_arena_bytes
+                if kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
+                else n.device_arena_bytes
+                for r, n in self._nodes.items() if r not in self._dead
+            ]
+        return max(caps, default=0)
+
+    def min_host_occupancy(self) -> float | None:
+        """The LEAST-loaded alive node's host-arena occupancy in [0, 1]
+        (None with no alive nodes). This is the back-pressure signal:
+        when even the emptiest rank is past the high watermark, REQ_ALLOC
+        answers BUSY rather than packing arenas to the brim."""
+        with self._lock:
+            occ = [
+                n.host_used / n.host_arena_bytes
+                for r, n in self._nodes.items()
+                if r not in self._dead and n.host_arena_bytes > 0
+            ]
+        return min(occ) if occ else None
+
     # -- accounting ------------------------------------------------------
 
     def note_alloc(self, p: Placement, nbytes: int) -> None:
@@ -179,6 +207,13 @@ class CapacityAware(PlacementPolicy):
     node fits (disaggregation intent). Replicas take the next-fullest-free
     DISTINCT nodes after the primary."""
 
+    def _weight(self, rank: int, free: int) -> int:
+        """Candidate score (higher wins). The base policy ranks by free
+        bytes alone; qos.loadaware.LoadAware overrides this to discount
+        hot ranks using the live obs per-rank stats. Called under
+        self._lock."""
+        return free
+
     def place(
         self,
         orig_rank: int,
@@ -209,14 +244,16 @@ class CapacityAware(PlacementPolicy):
                     free = node.host_arena_bytes - node.host_used
                     if free >= nbytes:
                         candidates.append(
-                            (free + prefer_remote, Placement(rank, 0, kind))
+                            (self._weight(rank, free) + prefer_remote,
+                             Placement(rank, 0, kind))
                         )
                 else:
                     for di in range(node.ndevices):
                         free = node.device_arena_bytes - node.device_used[di]
                         if free >= nbytes:
                             candidates.append(
-                                (free + prefer_remote, Placement(rank, di, kind))
+                                (self._weight(rank, free) + prefer_remote,
+                                 Placement(rank, di, kind))
                             )
             if not candidates:
                 raise OcmPlacementError(
@@ -241,7 +278,18 @@ class CapacityAware(PlacementPolicy):
             )
 
 
+def _make_loadaware():
+    # Lazy factory, not a class reference: qos.loadaware subclasses
+    # CapacityAware from THIS module, so a top-level import here would be
+    # circular. The factory resolves at first use, long after both
+    # modules finished initializing.
+    from oncilla_tpu.qos.loadaware import LoadAware
+
+    return LoadAware()
+
+
 POLICIES = {
     "neighbor": NeighborRoundRobin,
     "capacity": CapacityAware,
+    "loadaware": _make_loadaware,
 }

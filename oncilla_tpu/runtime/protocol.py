@@ -80,6 +80,23 @@ FLAG_REPLICAS = 0x0020
 # replica is rejected NOT_PRIMARY so the copies can never diverge — and
 # never re-fan a fan-out write (no forwarding loops).
 FLAG_FANOUT = 0x0040
+# FLAG_CAP_QOS on CONNECT offers multi-tenant QoS (qos/): per-app
+# quota/priority declaration and the priority tails on the alloc chain.
+# Same offer/echo dance as the other capabilities: a flags=0 reply
+# (un-upgraded v2 daemon, native C++ daemon) declines by silence and the
+# app runs at the server-side defaults. With OCM_QUOTA_*/OCM_PRIORITY
+# unset the bit is never offered, so the wire stays byte-for-byte the
+# pre-QoS protocol.
+FLAG_CAP_QOS = 0x0080
+# FLAG_QOS_TAIL marks a QoS data tail (after any trace prefix is
+# stripped): on CONNECT, the app's declared profile
+# (priority u8 | quota_bytes u64 | quota_handles u32, qos/policy.py
+# PROFILE_TAIL); on REQ_ALLOC / DO_ALLOC / DO_REPLICA, one u8 — the
+# allocation's priority class, appended AFTER the FLAG_REPLICAS u8 when
+# both ride. Only ever set toward a peer that granted FLAG_CAP_QOS;
+# the fixed schemas stay untouched so un-flagged frames remain
+# byte-identical and parseable by every v2 peer.
+FLAG_QOS_TAIL = 0x0100
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -175,10 +192,16 @@ WIRE_KIND = {
 WIRE_KIND_INV = {v: k for k, v in WIRE_KIND.items()}
 
 VALID_FLAGS.update({
-    # Capability offer/echo bits.
-    MsgType.CONNECT: FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA,
+    # Capability offer/echo bits. CONNECT may also carry the QoS profile
+    # tail (FLAG_QOS_TAIL) alongside the FLAG_CAP_QOS offer; decliners
+    # ignore both the bit and the tail.
+    MsgType.CONNECT: (
+        FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
+        | FLAG_CAP_QOS | FLAG_QOS_TAIL
+    ),
     MsgType.CONNECT_CONFIRM: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
+        | FLAG_CAP_QOS
     ),
     # Requests that may carry a trace-context prefix once the peer
     # granted FLAG_CAP_TRACE. DATA_PUT also keeps the coalesced-burst
@@ -186,8 +209,9 @@ VALID_FLAGS.update({
     # body chunks stay eligible for the zero-copy recv-into-arena path.
     MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT,
     MsgType.DATA_GET: FLAG_TRACE_CTX,
-    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS,
-    MsgType.DO_ALLOC: FLAG_TRACE_CTX,
+    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL,
+    MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
+    MsgType.DO_REPLICA: FLAG_QOS_TAIL,
     MsgType.REQ_FREE: FLAG_TRACE_CTX,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
@@ -428,6 +452,19 @@ class ErrCode(enum.IntEnum):
     # Retryable: the detector resolves the replica's fate within a few
     # probe intervals, after which the put either fans out or degrades.
     REPLICA_UNAVAILABLE = 9
+    # QoS admission control (qos/): the app's byte or handle quota
+    # cannot admit this allocation. Not retryable until the app frees —
+    # the quota is the app's own budget, not a transient condition.
+    QUOTA_EXCEEDED = 10
+    # Admission control refused the app outright (e.g. the daemon's
+    # concurrent-app cap is reached). Retrying only helps once other
+    # apps disconnect or go stale.
+    ADMISSION_DENIED = 11
+    # Back-pressure: the arena(s) crossed the high watermark. Retryable;
+    # the ERROR frame's data tail carries a u32 server-suggested backoff
+    # in milliseconds, which request() surfaces as
+    # OcmRemoteError.retry_after_ms.
+    BUSY = 12
 
 
 def _pack_prefix(msg: Message) -> bytes:
@@ -697,8 +734,15 @@ def request(sock: socket.socket, msg: Message) -> Message:
     send_msg(sock, msg)
     reply = recv_msg(sock)
     if reply.type == MsgType.ERROR:
-        raise OcmRemoteError(
+        err = OcmRemoteError(
             reply.fields["code"],
             f"{ErrCode(reply.fields['code']).name}: {reply.fields['detail']}",
         )
+        # A BUSY rejection carries the server-suggested backoff as a u32
+        # (milliseconds) data tail — the retry hint back-pressured
+        # clients honor (qos/). Other codes never carry one; a short or
+        # absent tail just means "no hint".
+        if reply.fields["code"] == int(ErrCode.BUSY) and len(reply.data) >= 4:
+            (err.retry_after_ms,) = struct.unpack_from("<I", reply.data, 0)
+        raise err
     return reply

@@ -40,6 +40,12 @@ class RegEntry:
     # last chain rewrite (failover fencing evidence).
     chain: tuple[int, ...] = ()
     epoch: int = 0
+    # QoS priority class (qos/): 0 low, 1 normal, 2 high. Carried from
+    # the app's CONNECT declaration via the FLAG_QOS_TAIL alloc tails;
+    # the reaper's pressure eviction orders victims by it and never
+    # touches an ACTIVE entry above class 0. Snapshot-restored entries
+    # come back at the default (the snapshot format predates priorities).
+    priority: int = 1
 
     def is_primary(self, self_rank: int) -> bool:
         """Primary = unreplicated owner, or first member of the chain."""
@@ -57,9 +63,14 @@ class AllocRegistry:
     unique per daemon: ``id = rank * 2^32 + counter*2`` (apps use odd local
     ids, so the spaces never collide)."""
 
-    def __init__(self, rank: int, lease_s: float = 30.0):
+    def __init__(self, rank: int, lease_s: float = 30.0,
+                 app_stale_leases: float = 10.0):
         self._rank = rank
         self._lease_s = lease_s
+        # Heartbeat-silence threshold (in lease periods) before an app's
+        # row is pruned from the per-app view (config.app_stale_leases;
+        # previously a hardcoded 10).
+        self._app_stale_leases = app_stale_leases
         self._counter = 0
         self._entries: dict[int, RegEntry] = {}
         self._lock = make_lock("registry._lock")
@@ -121,14 +132,15 @@ class AllocRegistry:
     def lease_stats(self, now: float | None = None) -> dict:
         """Lease/heartbeat health: renewal + reaper-reclaim totals, how
         many live entries are past their lease right now, and seconds
-        since each app's last heartbeat. Apps silent for 10 lease periods
-        are pruned from the per-app view (the dict must not grow with
-        every app that ever attached)."""
+        since each app's last heartbeat. Apps silent for
+        ``app_stale_leases`` lease periods are pruned from the per-app
+        view (the dict must not grow with every app that ever
+        attached)."""
         now = time.monotonic() if now is None else now
         with self._lock:
             stale = [
                 k for k, t in self._last_beat.items()
-                if now - t > 10 * self._lease_s
+                if now - t > self._app_stale_leases * self._lease_s
             ]
             for k in stale:
                 del self._last_beat[k]
@@ -210,6 +222,27 @@ class AllocRegistry:
         now = time.monotonic() if now is None else now
         with self._lock:
             return [e for e in self._entries.values() if e.lease_expiry < now]
+
+    def eviction_candidates(
+        self, self_rank: int, now: float | None = None
+    ) -> list[RegEntry]:
+        """Victim order for the reaper's pressure eviction (qos/):
+        host-kind entries this rank is PRIMARY for (evicting a replica
+        copy out from under its chain would silently degrade k), sorted
+        expired-first, then priority ascending, then oldest lease. The
+        caller enforces the class invariant — an ACTIVE entry above
+        priority 0 is never evicted — this just supplies the queue."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            cands = [
+                e for e in self._entries.values()
+                if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
+                and e.is_primary(self_rank)
+            ]
+        cands.sort(
+            key=lambda e: (e.lease_expiry >= now, e.priority, e.lease_expiry)
+        )
+        return cands
 
     def new_lease_deadline(self) -> float:
         return time.monotonic() + self._lease_s

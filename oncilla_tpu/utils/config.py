@@ -107,6 +107,57 @@ class OcmConfig:
     # /root/reference/src/main.c:6-7).
     lease_s: float = 30.0
     heartbeat_s: float = 5.0
+    # How many lease periods of heartbeat silence before an app is
+    # considered stale: its row is pruned from lease_stats' per-app view
+    # and its QoS tenant state is dropped (the maps must not grow with
+    # every app that ever attached).
+    app_stale_leases: float = field(
+        default_factory=lambda: float(_env_int("OCM_APP_STALE_LEASES", 10))
+    )
+
+    # Multi-tenant QoS (qos/). Server side, these are the DEFAULT per-app
+    # caps a daemon enforces at REQ_ALLOC admission (0 = unlimited); an
+    # app may declare its own profile at CONNECT behind FLAG_CAP_QOS.
+    # Client side, a non-default profile (priority != 1 or a quota set)
+    # is what triggers the capability offer — all unset keeps the wire
+    # byte-for-byte the pre-QoS protocol.
+    quota_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_QUOTA_BYTES", 0)
+    )
+    quota_handles: int = field(
+        default_factory=lambda: _env_int("OCM_QUOTA_HANDLES", 0)
+    )
+    # Priority class: 0 low (evictable under arena pressure), 1 normal
+    # (default), 2 high (also exempt from back-pressure BUSY).
+    priority: int = field(default_factory=lambda: _env_int("OCM_PRIORITY", 1))
+    # Concurrent-app admission cap per daemon (0 = unlimited): the
+    # "thousands of apps per daemon" guard — past it, REQ_ALLOC from a
+    # NEW app answers ADMISSION_DENIED until others disconnect/go stale.
+    max_apps: int = field(default_factory=lambda: _env_int("OCM_MAX_APPS", 0))
+    # Back-pressure watermarks, percent of host-arena capacity. Crossing
+    # high makes REQ_ALLOC answer retryable BUSY (rank 0, host kinds,
+    # priority < high) and arms the reaper's pressure eviction, which
+    # frees low-priority extents until occupancy falls below low.
+    arena_high_pct: int = field(
+        default_factory=lambda: _env_int("OCM_ARENA_HIGH_PCT", 90)
+    )
+    arena_low_pct: int = field(
+        default_factory=lambda: _env_int("OCM_ARENA_LOW_PCT", 75)
+    )
+    # Client retry budget for BUSY rejections: capped exponential backoff
+    # with jitter (the CONNECT-retry helper), seeded by the server's
+    # suggested delay when one rides the reply.
+    busy_retries: int = field(
+        default_factory=lambda: _env_int("OCM_BUSY_RETRIES", 4)
+    )
+    busy_backoff_ms: int = field(
+        default_factory=lambda: _env_int("OCM_BUSY_BACKOFF_MS", 50)
+    )
+    # Load-aware placement (policy="loadaware"): how often rank 0 polls
+    # peer STATUS to refresh the per-rank load scores.
+    loadaware_poll_s: float = field(
+        default_factory=lambda: _env_int("OCM_LOADAWARE_POLL_MS", 2000) / 1e3
+    )
 
     # Resilience (resilience/): k-way replicated allocations. k = total
     # copies (primary + k-1 replicas on distinct nodes); 1 = today's
@@ -203,3 +254,39 @@ class OcmConfig:
                 "connect_retries/connect_backoff_s must be >= 0 (got "
                 f"{self.connect_retries}/{self.connect_backoff_s})"
             )
+        if not 0 <= self.priority <= 2:
+            raise ValueError(
+                f"priority must be 0 (low), 1 (normal) or 2 (high) "
+                f"(got {self.priority})"
+            )
+        if (self.quota_bytes < 0 or self.quota_handles < 0
+                or self.max_apps < 0):
+            raise ValueError(
+                "quota_bytes/quota_handles/max_apps must be >= 0 "
+                "(0 = unlimited)"
+            )
+        if not 0 < self.arena_low_pct <= self.arena_high_pct <= 100:
+            raise ValueError(
+                "need 0 < arena_low_pct <= arena_high_pct <= 100 (got "
+                f"{self.arena_low_pct}/{self.arena_high_pct}) — eviction "
+                "hysteresis must sit at or below the BUSY threshold"
+            )
+        if self.busy_retries < 0 or self.busy_backoff_ms < 0:
+            raise ValueError(
+                "busy_retries/busy_backoff_ms must be >= 0"
+            )
+        if self.app_stale_leases <= 0:
+            raise ValueError(
+                f"app_stale_leases must be > 0 (got {self.app_stale_leases})"
+            )
+
+    @property
+    def qos_offer(self) -> bool:
+        """Whether a client has a non-default QoS profile to declare —
+        the gate on offering FLAG_CAP_QOS at CONNECT. All-default keeps
+        the CONNECT frame byte-for-byte the pre-QoS wire."""
+        return (
+            self.priority != 1
+            or self.quota_bytes > 0
+            or self.quota_handles > 0
+        )

@@ -47,6 +47,13 @@ echo "== dcn smoke =="
 # asserted; runs in seconds and needs no chip.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke || fail=1
 
+echo "== qos smoke =="
+# Multi-tenant QoS proof: simulated tenants with skewed sizes/priorities
+# against an in-process cluster — quota enforcement, back-pressure BUSY,
+# low-priority eviction under pressure (never an active higher class),
+# a chaos daemon kill mid-soak, and a drained alloctrace ledger.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.qos --soak --smoke || fail=1
+
 echo "== chaos smoke =="
 # Kill-the-owner failover proof: OCM_REPLICAS=2 on a 3-daemon in-process
 # cluster, seeded chaos kills the owner mid-workload; every subsequent

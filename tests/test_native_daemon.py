@@ -243,6 +243,37 @@ def test_native_replica_capability_declined_by_silence(native_cluster, rng):
     client.close()
 
 
+def test_native_qos_capability_declined_by_silence(native_cluster, rng):
+    """A non-default QoS profile against the unmodified C++ daemon: the
+    CONNECT offer of FLAG_CAP_QOS arrives WITH the profile data tail in
+    the same frame, and the native codec must tolerate both — echoing
+    flags=0 (declined by silence) and ignoring the tail — after which
+    the client runs at server defaults, allocations are admitted
+    unquota'd, and transfers stay byte-exact (mirror of
+    test_native_replica_capability_declined_by_silence)."""
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        priority=2,
+        quota_bytes=512 << 10,
+    )
+    assert cfg2.qos_offer
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    assert client._ctrl_caps & P.FLAG_CAP_QOS == 0
+    # The declared 512 KiB quota is NOT enforced by the declining
+    # daemon: a larger allocation is admitted (server-default behavior).
+    h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+    client.free(h)
+    client.close()
+
+
 def test_native_lease_reaping(binary, tmp_path):
     ports = free_ports(2)
     nodefile = tmp_path / "nf"

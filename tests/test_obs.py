@@ -428,9 +428,16 @@ def test_cli_table_renders_every_rank(tmp_path, capsys):
         rc = obs_main(["--nodefile", nodefile])
         out = capsys.readouterr().out
         assert rc == 0
-        lines = [ln for ln in out.splitlines() if ln.strip()]
-        assert len(lines) == 3  # header + 2 ranks
-        assert "leases" in lines[0]
+        # Rank table: header + 2 ranks, then a blank line and the per-app
+        # QoS section (qos/) for the one attached app.
+        sections = out.split("\n\n")
+        rank_lines = [ln for ln in sections[0].splitlines() if ln.strip()]
+        assert len(rank_lines) == 3  # header + 2 ranks
+        assert "leases" in rank_lines[0]
+        assert len(sections) == 2
+        app_lines = [ln for ln in sections[1].splitlines() if ln.strip()]
+        assert "prio" in app_lines[0] and "quota" in app_lines[0]
+        assert any("@r0" in ln for ln in app_lines[1:])
         ctx.free(h)
         ctx.tini()
 

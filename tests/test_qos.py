@@ -1,0 +1,380 @@
+"""Multi-tenant QoS (qos/): quotas, priority leases, back-pressure,
+load-aware placement — plus the wire-compat discipline: with
+OCM_QUOTA_*/OCM_PRIORITY unset the frames stay byte-for-byte the
+pre-QoS protocol."""
+
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.errors import OcmAdmissionDenied, OcmQuotaExceeded
+from oncilla_tpu.qos import (
+    PRIO_HIGH,
+    PRIO_LOW,
+    PRIO_NORMAL,
+    LoadAware,
+    QosManager,
+    pack_profile,
+    suggest_backoff_ms,
+    unpack_profile,
+)
+from oncilla_tpu.runtime import daemon as D
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.runtime.placement import NodeResources, Placement
+from oncilla_tpu.runtime.registry import AllocRegistry
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def qcfg(**kw):
+    d = dict(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=4 << 20,
+        chunk_bytes=64 << 10,
+        heartbeat_s=0.1,
+        lease_s=30.0,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+# -- QosManager unit -----------------------------------------------------
+
+
+def test_quota_admit_commit_release():
+    q = QosManager(qcfg(quota_bytes=1 << 20, quota_handles=2))
+    q.admit(1, 0, 512 << 10)
+    q.commit(1, 0, 100, 512 << 10)
+    # Byte quota: a second half-MiB fits, a third does not.
+    q.admit(1, 0, 512 << 10)
+    q.commit(1, 0, 102, 512 << 10)
+    with pytest.raises(OcmQuotaExceeded, match="byte quota"):
+        q.admit(1, 0, 1)
+    # Release gives the bytes back; idempotent on a raced double free.
+    q.release(100)
+    q.release(100)
+    q.admit(1, 0, 256 << 10)
+    q.abort(1, 0, 256 << 10)  # failed placement rolls back
+    q.admit(1, 0, 256 << 10)
+    q.commit(1, 0, 104, 256 << 10)
+    # Handle quota: two live handles is the cap.
+    with pytest.raises(OcmQuotaExceeded, match="handle quota"):
+        q.admit(1, 0, 1)
+
+
+def test_max_apps_admission_denied_and_stale_prune():
+    cfg = qcfg(max_apps=2, lease_s=0.05, app_stale_leases=1.0)
+    q = QosManager(cfg)
+    q.admit(1, 0, 1)
+    q.commit(1, 0, 100, 1)
+    q.admit(2, 0, 1)
+    q.commit(2, 0, 102, 1)
+    with pytest.raises(OcmAdmissionDenied, match="OCM_MAX_APPS"):
+        q.admit(3, 0, 1)
+    # Stale tenants are pruned (crashed apps give their slots back).
+    time.sleep(0.12)
+    assert q.prune_stale() == 2
+    q.admit(3, 0, 1)
+
+
+def test_profile_tail_roundtrip_and_backoff_hint():
+    assert unpack_profile(pack_profile(PRIO_HIGH, 5 << 20, 7)) == (
+        PRIO_HIGH, 5 << 20, 7
+    )
+    assert unpack_profile(b"") is None
+    # Deeper past the watermark => longer suggested backoff.
+    a = suggest_backoff_ms(0.90, 0.90, 50)
+    b = suggest_backoff_ms(0.99, 0.90, 50)
+    assert 0 < a < b
+
+
+# -- satellite: configurable app-staleness threshold ---------------------
+
+
+def test_lease_stats_staleness_configurable():
+    reg = AllocRegistry(0, lease_s=0.05, app_stale_leases=2.0)
+    reg.renew_leases(7, 0)
+    assert "7@r0" in reg.lease_stats()["apps"]
+    time.sleep(0.15)  # > 2 * 0.05
+    assert "7@r0" not in reg.lease_stats()["apps"]
+    # A larger threshold keeps the row alive across the same silence.
+    reg2 = AllocRegistry(0, lease_s=0.05, app_stale_leases=100.0)
+    reg2.renew_leases(7, 0)
+    time.sleep(0.15)
+    assert "7@r0" in reg2.lease_stats()["apps"]
+
+
+# -- wire identity + flag coverage ---------------------------------------
+
+
+def test_qos_unset_wire_is_byte_identical():
+    """Default config: CONNECT never offers FLAG_CAP_QOS and carries no
+    tail; REQ_ALLOC is exactly the 25-byte fixed payload — the pre-QoS
+    frames, byte for byte (the PR-5 replica-identity pin, extended)."""
+    cfg = OcmConfig()
+    assert not cfg.qos_offer
+    connect = P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0},
+        flags=P.FLAG_CAP_TRACE if cfg.trace else 0,
+    ))
+    magic, ver, mtype, flags, plen = P.HEADER.unpack(connect[:P.HEADER.size])
+    assert not flags & (P.FLAG_CAP_QOS | P.FLAG_QOS_TAIL)
+    assert plen == 16  # pid q + rank q, no profile tail
+    req = P.pack(P.Message(
+        P.MsgType.REQ_ALLOC,
+        {"orig_rank": 0, "pid": 7, "kind": 3, "nbytes": 4096},
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(req[:P.HEADER.size])
+    assert flags == 0 and plen == 25
+
+
+def test_qos_flags_declared_and_daemon_handled():
+    """Protocol-exhaustiveness coverage of the QoS bits, pinned the way
+    PR 5 pinned the replica bits: declared on the wire, claimed handled
+    by the daemon, rejected at pack time where undeclared."""
+    assert P.VALID_FLAGS[P.MsgType.CONNECT] & P.FLAG_CAP_QOS
+    assert P.VALID_FLAGS[P.MsgType.CONNECT] & P.FLAG_QOS_TAIL
+    assert P.VALID_FLAGS[P.MsgType.CONNECT_CONFIRM] & P.FLAG_CAP_QOS
+    for t in (P.MsgType.REQ_ALLOC, P.MsgType.DO_ALLOC, P.MsgType.DO_REPLICA):
+        assert P.VALID_FLAGS[t] & P.FLAG_QOS_TAIL
+        assert D._FLAGS_HANDLED[t] & P.FLAG_QOS_TAIL
+    assert D._FLAGS_HANDLED[P.MsgType.CONNECT] & (
+        P.FLAG_CAP_QOS | P.FLAG_QOS_TAIL
+    )
+    # FLAG_QOS_TAIL is not a data-plane bit: a stray one on DATA_GET
+    # must fail at the sender.
+    with pytest.raises(ocm.OcmProtocolError, match="invalid"):
+        P.pack(P.Message(
+            P.MsgType.DATA_GET,
+            {"alloc_id": 1, "offset": 0, "nbytes": 1},
+            flags=P.FLAG_QOS_TAIL,
+        ))
+
+
+# -- satellite: REQ_ALLOC size validation --------------------------------
+
+
+def test_req_alloc_size_validation_typed_errors():
+    """Size 0 and size > every arena: typed ERROR, no reservation, no
+    hang — and the books stay balanced afterwards."""
+    with local_cluster(2, config=qcfg()) as c:
+        client = c.client(0)
+        with pytest.raises(ocm.OcmError, match="must be > 0") as ei:
+            client.alloc(0, OcmKind.REMOTE_HOST)
+        assert ei.value.code == int(P.ErrCode.PLACEMENT)
+        with pytest.raises(ocm.OcmError, match="exceeds every node") as ei:
+            client.alloc(1 << 30, OcmKind.REMOTE_HOST)
+        assert ei.value.code == int(P.ErrCode.OOM)
+        assert all(d.registry.live_count() == 0 for d in c.daemons)
+        assert all(
+            d.host_arena.allocator.bytes_live == 0 for d in c.daemons
+        )
+        # The connection is still in sync: a normal alloc works after.
+        h = client.alloc(4096, OcmKind.REMOTE_HOST)
+        client.free(h)
+
+
+# -- quotas and priority end to end --------------------------------------
+
+
+def test_quota_enforced_end_to_end_and_freed_quota_returns():
+    cfg = qcfg(quota_bytes=1 << 20)
+    with local_cluster(2, config=qcfg()) as c:
+        client = ControlPlaneClient(c.entries, 0, config=cfg)
+        c.clients.append(client)
+        assert client._ctrl_caps & P.FLAG_CAP_QOS
+        h = client.alloc(768 << 10, OcmKind.REMOTE_HOST)
+        with pytest.raises(ocm.OcmError, match="byte quota") as ei:
+            client.alloc(768 << 10, OcmKind.REMOTE_HOST)
+        assert ei.value.code == int(P.ErrCode.QUOTA_EXCEEDED)
+        client.free(h)
+        h2 = client.alloc(768 << 10, OcmKind.REMOTE_HOST)
+        client.free(h2)
+
+
+def test_priority_rides_to_owner_registry():
+    """The CONNECT-declared class must land on the OWNER's RegEntry,
+    including across the origin->rank0->owner relay (the FLAG_QOS_TAIL
+    u8 tails)."""
+    with local_cluster(3, config=qcfg()) as c:
+        client = ControlPlaneClient(
+            c.entries, 1, config=qcfg(priority=PRIO_HIGH), app_id=501
+        )
+        c.clients.append(client)
+        h = client.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        e = c.daemons[h.rank].registry.lookup(h.alloc_id)
+        assert e.priority == PRIO_HIGH
+        # A distinct default-priority tenant carries no tail and lands
+        # at normal (app identity is (app_id, rank) — sharing the pid
+        # would share the declared profile).
+        plain = ControlPlaneClient(c.entries, 1, config=qcfg(), app_id=502)
+        c.clients.append(plain)
+        h2 = plain.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        assert c.daemons[h2.rank].registry.lookup(h2.alloc_id).priority \
+            == PRIO_NORMAL
+        client.free(h)
+        plain.free(h2)
+
+
+# -- back-pressure -------------------------------------------------------
+
+
+def test_busy_backpressure_with_hint_and_high_priority_bypass():
+    """Past the high watermark REQ_ALLOC answers BUSY (retryable, with a
+    server-suggested backoff that survives the origin-daemon relay);
+    high-priority apps bypass it."""
+    cfg = qcfg(arena_high_pct=50, arena_low_pct=40, heartbeat_s=5.0)
+    with local_cluster(2, config=cfg) as c:
+        # Placement prefers the NON-origin rank, so one filler per rank
+        # pushes BOTH 8 MiB arenas past 50% (BUSY keys off the
+        # least-loaded rank).
+        fillers = [
+            ControlPlaneClient(
+                c.entries, r, config=qcfg(busy_retries=0, heartbeat_s=5.0)
+            )
+            for r in range(2)
+        ]
+        c.clients.extend(fillers)
+        held = [
+            (f, f.alloc(2 << 20, OcmKind.REMOTE_HOST))
+            for f in fillers for _ in range(2)
+        ]
+        filler = fillers[1]  # rank-1 client: BUSY arrives via the relay
+        with pytest.raises(ocm.OcmRemoteError, match="watermark") as ei:
+            filler.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        assert ei.value.code == int(P.ErrCode.BUSY)
+        assert getattr(ei.value, "retry_after_ms", 0) > 0
+        assert c.daemons[0].qos.counters["busy"] >= 1
+        # High priority is exempt: same cluster state, same size, admitted.
+        vip = ControlPlaneClient(
+            c.entries, 1, config=qcfg(priority=PRIO_HIGH, heartbeat_s=5.0)
+        )
+        c.clients.append(vip)
+        hv = vip.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        vip.free(hv)
+        for f, h in held:
+            f.free(h)
+
+
+# -- priority eviction under pressure ------------------------------------
+
+
+def test_reaper_evicts_active_low_priority_never_active_normal():
+    cfg = qcfg(
+        arena_high_pct=50, arena_low_pct=30,
+        heartbeat_s=0.05, lease_s=30.0,
+    )
+    with local_cluster(1, config=cfg) as c:
+        # Distinct app_id: the keeper shares this process's pid, and a
+        # shared (pid, rank) identity would share the LOW profile too.
+        low = ControlPlaneClient(
+            c.entries, 0, config=qcfg(priority=PRIO_LOW, busy_retries=0,
+                                      arena_high_pct=50, arena_low_pct=30),
+            app_id=601,
+        )
+        c.clients.append(low)
+        keeper = c.client(0)  # default (normal) priority
+        hk = keeper.alloc(512 << 10, OcmKind.REMOTE_HOST)
+        keeper.put(hk, np.full(512 << 10, 0xAB, np.uint8))
+        # Low-priority ballast past the 50% watermark (leases ACTIVE —
+        # both clients heartbeat).
+        ballast = []
+        for _ in range(4):
+            try:
+                ballast.append(low.alloc(1 << 20, OcmKind.REMOTE_HOST))
+            except ocm.OcmError:
+                break  # BUSY once pressure is reached: enough ballast
+        d = c.daemons[0]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sum(d.qos.evictions[PRIO_LOW]) > 0:
+                break
+            time.sleep(0.05)
+        assert sum(d.qos.evictions[PRIO_LOW]) > 0, "no low eviction"
+        # The invariant: no ACTIVE normal/high eviction, ever.
+        assert d.qos.evictions[PRIO_NORMAL][1] == 0
+        assert d.qos.evictions[PRIO_HIGH][1] == 0
+        # The keeper's active normal-priority bytes survived the purge.
+        got = np.asarray(keeper.get(hk, 512 << 10))
+        assert (got == 0xAB).all()
+        keeper.free(hk)
+        for h in ballast:
+            try:
+                low.free(h)
+            except ocm.OcmError:
+                pass  # evicted underneath us: the expected outcome
+
+
+# -- load-aware placement ------------------------------------------------
+
+
+def test_loadaware_prefers_cold_rank():
+    p = LoadAware()
+    for r in range(2):
+        p.add_node(NodeResources(
+            rank=r, ndevices=1,
+            device_arena_bytes=1 << 20, host_arena_bytes=64 << 20,
+        ))
+    # Capacity alone would pick rank 1 (more free bytes)...
+    p.note_alloc(
+        Placement(rank=0, device_index=0, kind=OcmKind.REMOTE_HOST),
+        8 << 20,
+    )
+    assert p.place(2, OcmKind.REMOTE_HOST, 1 << 20).rank == 1
+    # ...but a hot rank 1 (high p99 + saturated NIC) loses to rank 0.
+    p.observe(1, live_bytes=0, gbps=10.0, p99_us=100_000.0)
+    p.observe(0, live_bytes=8 << 20)
+    assert p.place(2, OcmKind.REMOTE_HOST, 1 << 20).rank == 0
+
+
+def test_loadaware_policy_registered_and_fed():
+    from oncilla_tpu.runtime.placement import POLICIES
+
+    assert "loadaware" in POLICIES
+    cfg = qcfg(loadaware_poll_s=0.05, heartbeat_s=0.05)
+    with local_cluster(2, config=cfg, policy="loadaware") as c:
+        assert isinstance(c.daemons[0].policy, LoadAware)
+        client = c.client(0)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if c.daemons[0].policy.load_scores():
+                break
+            time.sleep(0.05)
+        scores = c.daemons[0].policy.load_scores()
+        assert scores, "rank 0 never fed the load-aware policy"
+        # The feed is surfaced through STATUS for the obs table.
+        st = client.status()
+        assert "load_scores" in st.get("qos", {})
+        client.free(h)
+
+
+# -- observability -------------------------------------------------------
+
+
+def test_prom_renders_qos_families():
+    from oncilla_tpu.obs import prom
+
+    with local_cluster(2, config=qcfg()) as c:
+        client = ControlPlaneClient(
+            c.entries, 0, config=qcfg(quota_bytes=1 << 20)
+        )
+        c.clients.append(client)
+        h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+        with pytest.raises(ocm.OcmError):
+            client.alloc(1 << 20, OcmKind.REMOTE_HOST)  # quota trip
+        text = client.fetch_prom(rank=0)
+        for family in (
+            "ocm_admission_denied_total",
+            "ocm_backpressure_busy_total",
+            "ocm_evictions_by_priority",
+            "ocm_quota_bytes_used",
+        ):
+            assert f"# TYPE {family}" in text, family
+        # The quota trip is visible as a counted rejection.
+        assert 'reason="quota_exceeded"' in text
+        client.free(h)
