@@ -29,10 +29,12 @@ from oncilla_tpu.utils.config import OcmConfig
 
 
 @contextlib.contextmanager
-def _daemon_pair(cfg: OcmConfig, native: bool):
+def _daemon_pair(cfg: OcmConfig, native: bool, extra_env: dict | None = None):
     """Two REAL daemon processes on loopback (the C++ twin when built,
     else python subprocesses) — in-process daemon threads would share the
-    client's GIL and understate the data plane by ~2x."""
+    client's GIL and understate the data plane by ~2x. ``extra_env``
+    reaches the python daemons only (the fabric sweep sets OCM_FABRIC=shm
+    there; the C++ twin serves no fabrics and would silently ignore it)."""
     import os
     import socket
     import subprocess
@@ -64,7 +66,7 @@ def _daemon_pair(cfg: OcmConfig, native: bool):
                     heartbeat_s=5.0, lease_s=120.0,
                 ))
         else:
-            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
             for r in range(2):
                 procs.append(subprocess.Popen(
                     [sys.executable, "-m", "oncilla_tpu.runtime.daemon",
@@ -97,7 +99,7 @@ def _daemon_pair(cfg: OcmConfig, native: bool):
 
 def _make_cfg(
     nbytes: int, chunk_bytes: int, inflight: int, stripes: int,
-    adaptive: bool,
+    adaptive: bool, fabric: str = "tcp",
 ) -> OcmConfig:
     return OcmConfig(
         host_arena_bytes=nbytes + chunk_bytes,
@@ -107,6 +109,7 @@ def _make_cfg(
         dcn_stripes=stripes,
         dcn_adaptive=adaptive,
         heartbeat_s=5.0,
+        fabric=fabric,
     )
 
 
@@ -232,6 +235,53 @@ def dcn_stripe_sweep(
     }
 
 
+def dcn_fabric_sweep(
+    sizes: tuple = (4 << 20, 64 << 20, 256 << 20),
+    iters: int = 3,
+    chunk_bytes: int = 16 << 20,
+) -> dict:
+    """Fabric × size sweep (fabric/): the framed-TCP engine against the
+    same-host shared-memory fabric over python daemon PROCESSES. Three
+    cells per size —
+
+    - ``tcp_s1``: single-stream lockstep tcp, the pre-stripe baseline the
+      shm speedup is judged against;
+    - ``tcp``: the striped/coalesced engine at its default width;
+    - ``shm``: the one-sided memcpy path (daemons spawned with
+      OCM_FABRIC=shm, so their arenas are segment-backed).
+
+    The shm number is the CO-LOCATED ceiling: both endpoints share DRAM,
+    so it measures memcpy + one control round-trip, not a network. The
+    C++ twin serves no fabrics, so every cell runs python daemons — the
+    tcp cells here are therefore comparable to each other and to ``shm``,
+    but NOT to the native-daemon numbers in ``dcn_stripe_sweep``."""
+    out_cells: dict[str, dict] = {}
+    for nbytes in sizes:
+        data = _bench_data(nbytes)
+        for cell, stripes, fabric in (
+            ("tcp_s1", 1, "tcp"),
+            ("tcp", 4, "tcp"),
+            ("shm", 1, "shm"),
+        ):
+            cfg = _make_cfg(nbytes, chunk_bytes, 2, stripes, False, fabric)
+            extra = {"OCM_FABRIC": fabric} if fabric != "tcp" else None
+            with _daemon_pair(cfg, native=False, extra_env=extra) as entries:
+                r = _timed_roundtrip(entries, cfg, nbytes, iters, data)
+            out_cells[f"{cell}_{nbytes >> 20}m"] = {
+                "put_gbps": round(r["put_gbps"], 3),
+                "get_gbps": round(r["get_gbps"], 3),
+                "verified": r["verified"],
+            }
+    return {
+        "sizes": list(sizes),
+        "iters": iters,
+        "unit": "Gbit/s",
+        "native_daemons": False,
+        "cells": out_cells,
+        "verified": all(v["verified"] for v in out_cells.values()),
+    }
+
+
 def smoke(nbytes: int = 4 << 20) -> dict:
     """Seconds-scale loopback DCN smoke for CI (scripts/check.sh): a tiny
     striped put/get roundtrip through an in-process 2-daemon cluster,
@@ -241,7 +291,10 @@ def smoke(nbytes: int = 4 << 20) -> dict:
 
     out = {}
     data = _bench_data(nbytes)
-    for stripes in (4, 1):
+    # (stripes, fabric): both tcp protocol variants (coalesced/striped
+    # and lockstep) plus the shm fabric cell — which must actually ride
+    # shm, asserted via the transfer ring's per-fabric tag.
+    for stripes, fab in ((4, "tcp"), (1, "tcp"), (1, "shm")):
         cfg = OcmConfig(
             host_arena_bytes=nbytes + (1 << 20),
             device_arena_bytes=1 << 20,
@@ -249,6 +302,8 @@ def smoke(nbytes: int = 4 << 20) -> dict:
             inflight_ops=2,
             dcn_stripes=stripes,
             dcn_stripe_min_bytes=256 << 10,
+            fabric=fab,
+            fabric_shm_min_bytes=4 << 10,
         )
         with local_cluster(2, config=cfg) as cluster:
             client = cluster.client(0, heartbeat=False)
@@ -260,11 +315,19 @@ def smoke(nbytes: int = 4 << 20) -> dict:
                 dt = time.perf_counter() - t0
                 if not np.array_equal(got, data):
                     raise AssertionError(
-                        f"DCN smoke roundtrip mismatch at stripes={stripes}"
+                        f"DCN smoke roundtrip mismatch at "
+                        f"stripes={stripes} fabric={fab}"
                     )
+                if fab == "shm":
+                    rec = client.tracer.transfers()[-2:]
+                    if [r.get("fabric") for r in rec] != ["shm", "shm"]:
+                        raise AssertionError(
+                            f"smoke shm cell rode {rec}: negotiation "
+                            "failed on the one host where it never should"
+                        )
             finally:
                 client.free(h)
-            out[f"stripes{stripes}_roundtrip_s"] = round(dt, 3)
+            out[f"{fab}_stripes{stripes}_roundtrip_s"] = round(dt, 3)
     out["verified"] = True
     return out
 
@@ -280,6 +343,8 @@ def main(argv=None) -> int:
                     help="tiny in-process striped roundtrip (seconds)")
     ap.add_argument("--sweep", action="store_true",
                     help="stripe x window sweep against daemon processes")
+    ap.add_argument("--fabrics", action="store_true",
+                    help="tcp vs shm fabric x size sweep (fabric/)")
     ap.add_argument("--nbytes", type=int, default=None)
     ap.add_argument("--python-daemons", action="store_true",
                     help="skip the C++ twin even if it builds")
@@ -294,8 +359,19 @@ def main(argv=None) -> int:
             )
         except Exception:  # noqa: BLE001 — C++ twin unavailable
             out = dcn_stripe_sweep(args.nbytes or (256 << 20), native=False)
+    elif args.fabrics:
+        out = dcn_fabric_sweep(
+            sizes=(args.nbytes,) if args.nbytes else (4 << 20, 64 << 20,
+                                                      256 << 20)
+        )
     else:
         out = dcn_loopback_bench(args.nbytes or (256 << 20))
+        # The default invocation carries the fabric cells too: the shm
+        # column is the co-located ceiling the tcp engine is judged
+        # against on a single-host container.
+        out["fabric"] = dcn_fabric_sweep(
+            sizes=(args.nbytes or (256 << 20),)
+        )
     print(json.dumps(out, indent=2, sort_keys=True))
     return 0
 

@@ -15,15 +15,38 @@ from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 
 
 class HostArena:
-    """A byte arena in host DRAM with offset-addressed read/write."""
+    """A byte arena in host DRAM with offset-addressed read/write.
 
-    def __init__(self, capacity: int, alignment: int = 512):
+    ``backing`` lets a fabric provide the storage itself — the
+    registered-region idiom (fabric/shm.py backs the arena with a named
+    shared-memory segment so same-host peers put/get by memcpy; the
+    reference registers the server buffer with the NIC the same way,
+    alloc.c:171-176). It must be a writable C-contiguous uint8 array of
+    at least ``capacity`` bytes, already zero-filled (the scrub-on-free
+    contract assumes bytes start clean)."""
+
+    def __init__(self, capacity: int, alignment: int = 512,
+                 backing: np.ndarray | None = None):
         self.allocator = ArenaAllocator(capacity, alignment)
-        self._buf = np.zeros(capacity, dtype=np.uint8)
+        if backing is not None:
+            if backing.dtype != np.uint8 or backing.nbytes < capacity:
+                raise ValueError(
+                    "backing must be a uint8 array of >= capacity bytes "
+                    f"(got {backing.dtype}, {backing.nbytes} B)"
+                )
+            self._buf = backing[:capacity]
+        else:
+            self._buf = np.zeros(capacity, dtype=np.uint8)
 
     @property
     def capacity(self) -> int:
         return self.allocator.capacity
+
+    @property
+    def buffer(self) -> np.ndarray:
+        """The registerable backing buffer — what a fabric advertises
+        (and what :meth:`view` windows into)."""
+        return self._buf
 
     def alloc(self, nbytes: int) -> Extent:
         return self.allocator.alloc(nbytes)

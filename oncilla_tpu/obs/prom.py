@@ -193,6 +193,36 @@ def render(meta: dict) -> str:
                        "(0 cold .. ~0.9 hot).",
                        score, rank=rank, peer=peer)
 
+    fab = meta.get("fabric", {})
+    if fab:
+        for name in fab.get("served", []):
+            doc.sample("ocm_fabric_served", "gauge",
+                       "1 for each one-sided fabric this daemon "
+                       "registered and advertises at CONNECT.",
+                       1, rank=rank, fabric=name)
+        fc = fab.get("counters", {})
+        doc.sample("ocm_fabric_selected_total", "counter",
+                   "CONNECT fabric negotiations by outcome (shm = "
+                   "descriptor granted; tcp = declined, framed-TCP "
+                   "fallback).",
+                   fc.get("selected_shm", 0), rank=rank, fabric="shm")
+        doc.sample("ocm_fabric_selected_total", "counter",
+                   "CONNECT fabric negotiations by outcome (shm = "
+                   "descriptor granted; tcp = declined, framed-TCP "
+                   "fallback).",
+                   fc.get("selected_tcp", 0), rank=rank, fabric="tcp")
+        for op in ("put", "get"):
+            doc.sample("ocm_fabric_ops_total", "counter",
+                       "One-sided ops validated per fabric and "
+                       "direction.",
+                       fc.get(f"shm_{op}s", 0),
+                       rank=rank, fabric="shm", op=op)
+            doc.sample("ocm_fabric_bytes_total", "counter",
+                       "Bytes moved through one-sided fabric ops per "
+                       "direction.",
+                       fc.get(f"shm_{op}_bytes", 0),
+                       rank=rank, fabric="shm", op=op)
+
     # The transfer ring is bounded, so ring-derived figures are gauges
     # over the recent window, never counters.
     transfers = meta.get("transfers", [])

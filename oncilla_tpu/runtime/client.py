@@ -35,6 +35,8 @@ from oncilla_tpu.core.errors import (
 )
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import Fabric, OcmKind
+from oncilla_tpu.fabric import attach_peer
+from oncilla_tpu.fabric import tcp as tcp_fabric
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
 from oncilla_tpu.runtime.membership import NodeEntry
@@ -43,10 +45,10 @@ from oncilla_tpu.qos.policy import pack_profile
 from oncilla_tpu.runtime.protocol import (
     ErrCode,
     FLAG_CAP_COALESCE,
+    FLAG_CAP_FABRIC,
     FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
-    FLAG_MORE,
     FLAG_QOS_TAIL,
     FLAG_REPLICAS,
     FLAG_TRACE_CTX,
@@ -55,12 +57,11 @@ from oncilla_tpu.runtime.protocol import (
     WIRE_KIND_INV,
     Message,
     MsgType,
-    RecvScratch,
     recv_msg,
     request,
     send_msg,
 )
-from oncilla_tpu.utils.config import MAX_CHUNK_BYTES, OcmConfig
+from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
 
 
@@ -193,63 +194,10 @@ class _PlaneServer:
             pass
 
 
-class _PeerTuner:
-    """Adaptive windowing for one owner daemon: autotunes the pipelined
-    window depth and chunk size from observed per-chunk RTT instead of
-    pinning the hardcoded ``inflight_ops`` × ``chunk_bytes``.
-
-    Two rules, both damped to one step per completed transfer so a single
-    noisy measurement cannot swing the plan:
-
-    - **window** targets pipe-fill: enough chunks in flight to cover one
-      observed RTT at the achieved rate (+1 for the send leg), clamped to
-      [2, 8] — beyond that the extra requests only queue at the daemon.
-    - **chunk** amortizes per-op overhead: p50 RTT under ~20 ms means the
-      frame overhead is a visible fraction (double the chunk, up to the
-      wire cap); over ~250 ms means one chunk monopolizes the stream and
-      retry/error latency balloons (halve, floor 1 MiB).
-
-    Shared across concurrent stripes to the same peer; all state moves
-    under one leaf lock.
-    """
-
-    MIN_WINDOW, MAX_WINDOW = 2, 8
-    MIN_CHUNK = 1 << 20
-
-    def __init__(self, config: OcmConfig):
-        self.adaptive = config.dcn_adaptive
-        self._window = max(1, config.inflight_ops)
-        self._chunk = config.chunk_bytes
-        self._lock = make_lock("client._tuner_lock")
-
-    def plan(self) -> tuple[int, int]:
-        """Current (chunk_bytes, window) to run a stripe with."""
-        with self._lock:
-            return self._chunk, self._window
-
-    def observe(self, rtt_p50_s: float, achieved_bps: float) -> None:
-        """Feed one completed stripe's p50 chunk RTT + achieved bytes/s."""
-        if not self.adaptive or rtt_p50_s <= 0:
-            return
-        with self._lock:
-            prev = (self._window, self._chunk)
-            if achieved_bps > 0:
-                per_chunk_s = self._chunk / achieved_bps
-                want = round(rtt_p50_s / per_chunk_s) + 1
-                want = min(self.MAX_WINDOW, max(self.MIN_WINDOW, want))
-                self._window += (want > self._window) - (want < self._window)
-            if rtt_p50_s < 0.02 and self._chunk * 2 <= MAX_CHUNK_BYTES:
-                self._chunk *= 2
-            elif rtt_p50_s > 0.25 and self._chunk // 2 >= self.MIN_CHUNK:
-                self._chunk //= 2
-            cur = (self._window, self._chunk)
-        if cur != prev:
-            obs_journal.record(
-                "tuner_window",
-                window=cur[0], chunk_bytes=cur[1],
-                prev_window=prev[0], prev_chunk_bytes=prev[1],
-                rtt_p50_us=round(rtt_p50_s * 1e6, 1),
-            )
+# The striped TCP engine was re-homed into the fabric layer (PR 7):
+# the tuner and stripe loops live in oncilla_tpu/fabric/tcp.py now;
+# this alias keeps the long-standing import path working.
+_PeerTuner = tcp_fabric.PeerTuner
 
 
 class ControlPlaneClient:
@@ -295,10 +243,13 @@ class ControlPlaneClient:
         self._owner_ranks: dict[int, int] = {}
         self._owner_lock = make_lock("client._owner_lock")
         # DCN data-plane state per owner daemon addr: negotiated capability
-        # bits (None until probed on the first leased data socket) and the
-        # adaptive window/chunk tuner. One leaf lock covers both maps.
+        # bits (None until probed on the first leased data socket), the
+        # adaptive window/chunk tuner, and the negotiated one-sided fabric
+        # (fabric/: a PeerFabric once attached, None = this pair runs
+        # tcp). One leaf lock covers all three maps.
         self._dcn_caps: dict[tuple[str, int], int] = {}
         self._dcn_tuners: dict[tuple[str, int], _PeerTuner] = {}
+        self._dcn_fabrics: dict[tuple[str, int], object] = {}
         self._dcn_lock = make_lock("client._dcn_lock")
         # Handle-failover swap guard: concurrent stripes retrying the
         # same handle must repoint it (and fix owner accounting) exactly
@@ -485,6 +436,14 @@ class ControlPlaneClient:
                 finally:
                     self._ctrl_lock.release()
         self._pool.close()
+        # Detach negotiated fabrics (shm: unmap the peer segments).
+        with self._dcn_lock:
+            fabs, self._dcn_fabrics = list(self._dcn_fabrics.values()), {}
+        for fab in fabs:
+            try:
+                fab.close()
+            except OcmError:
+                pass
         if self._plane_server is not None:
             self._plane_server.close()
         try:
@@ -629,6 +588,12 @@ class ControlPlaneClient:
             for rr in handle.replica_ranks:
                 self._note_owner(rr, +1)
             raise
+        # Drop any cached fabric region keys for this alloc: a recycled
+        # alloc_id must re-resolve its extent, never inherit a stale map.
+        with self._dcn_lock:
+            fabs = list(self._dcn_fabrics.values())
+        for fab in fabs:
+            fab.forget(handle.alloc_id)
 
     # -- RemoteBackend: one-sided data ----------------------------------
 
@@ -672,18 +637,23 @@ class ControlPlaneClient:
         """Negotiated capability bits for the daemon at ``addr``, probed
         once per address on the first leased data socket: a CONNECT
         offering FLAG_CAP_COALESCE and/or FLAG_CAP_TRACE (each gated by
-        config); the reply's echoed bits are what the peer grants. Old
-        Python daemons and the unmodified C++ daemon reply with flags=0 —
-        the probe is how the new client discovers it must stay on the
-        lockstep one-ACK-per-chunk protocol and ship plain untraced
-        frames."""
+        config) — plus FLAG_CAP_FABRIC when this config negotiates data
+        fabrics (fabric/). The reply's echoed bits are what the peer
+        grants; a granted fabric offer additionally carries the daemon's
+        fabric descriptor tail, which this probe resolves to an ATTACHED
+        PeerFabric (or None when unreachable — cross-host pairs fail the
+        attach and run tcp). Old Python daemons and the unmodified C++
+        daemon reply with flags=0 — the probe is how the new client
+        discovers it must stay on the lockstep one-ACK-per-chunk
+        protocol and ship plain untraced frames."""
         with self._dcn_lock:
             caps = self._dcn_caps.get(addr)
         if caps is not None:
             return caps
         offer = (FLAG_CAP_COALESCE if self.config.dcn_coalesce else 0) | (
             FLAG_CAP_TRACE if self.config.trace else 0
-        )
+        ) | (FLAG_CAP_FABRIC if self.config.fabric_offer else 0)
+        fab = None
         if not offer:
             caps = 0  # nothing to negotiate: lockstep by configuration
         else:
@@ -695,8 +665,27 @@ class ControlPlaneClient:
                 r.flags & offer
                 if r.type == MsgType.CONNECT_CONFIRM else 0
             )
+            if caps & FLAG_CAP_FABRIC and r.data:
+                fab = attach_peer(
+                    bytes(r.data), self._fabric_control(addr)
+                )
+                obs_journal.record(
+                    "fabric_selected", host=addr[0], port=addr[1],
+                    fabric=fab.name if fab is not None else "tcp",
+                )
+        loser = None
         with self._dcn_lock:
             self._dcn_caps[addr] = caps
+            if fab is not None:
+                if addr in self._dcn_fabrics:
+                    # Concurrent stripes both probed this address; the
+                    # first store wins and the duplicate attachment must
+                    # be unmapped, not orphaned to a noisy GC.
+                    loser = fab
+                else:
+                    self._dcn_fabrics[addr] = fab
+        if loser is not None:
+            loser.close()
         return caps
 
     def _tuner_for(self, addr: tuple[str, int]) -> _PeerTuner:
@@ -707,12 +696,89 @@ class ControlPlaneClient:
             return t
 
     def _plan_stripes(self, total: int) -> int:
-        """How many stripes a ``total``-byte transfer is worth: capped by
-        config, and shrunk so each stripe moves at least
-        ``dcn_stripe_min_bytes`` (a thread + socket per few hundred KiB
-        would cost more than the parallelism buys)."""
-        per = max(1, self.config.dcn_stripe_min_bytes)
-        return max(1, min(self.config.dcn_stripes, total // per))
+        """Stripe count for a ``total``-byte transfer (fabric/tcp.py)."""
+        return tcp_fabric.plan_stripes(self.config, total)
+
+    # -- fabric selection (fabric/) --------------------------------------
+
+    def _fabric_control(self, addr: tuple[str, int]):
+        """The control-leg callable a PeerFabric validates through: one
+        framed request/reply to the owner daemon over the pool. Typed
+        rejections (STALE_EPOCH, NOT_PRIMARY, BAD_ALLOC_ID) surface as
+        OcmRemoteError; a dead daemon as OcmConnectError — both feed
+        the caller's failover ladder unchanged."""
+        def control(mtype: MsgType, fields: dict) -> Message:
+            return self._pool.request(addr[0], addr[1], Message(mtype, fields))
+
+        return control
+
+    def _fabric_for(self, addr: tuple[str, int], total: int):
+        """The negotiated one-sided fabric for ``addr``, or None (tcp).
+        Forces the capability probe if this address was never probed —
+        the fabric decision must exist BEFORE the transfer plans its
+        stripes. Small transfers stay on tcp: below the shm threshold
+        the control round-trip is the whole cost either way."""
+        if (
+            not self.config.fabric_offer
+            or total < self.config.fabric_shm_min_bytes
+        ):
+            return None
+        with self._dcn_lock:
+            if addr in self._dcn_caps:
+                return self._dcn_fabrics.get(addr)
+        try:
+            entry = self._pool.lease(addr[0], addr[1])
+        except OcmConnectError:
+            return None  # the transfer path's ladder owns this failure
+        try:
+            self._dcn_caps_for(addr, entry.sock)
+        except BaseException:
+            self._pool.discard(addr[0], addr[1], entry)
+            return None  # probe failed: run tcp, let the engine retry
+        self._pool.release(addr[0], addr[1], entry)
+        with self._dcn_lock:
+            return self._dcn_fabrics.get(addr)
+
+    def _invalidate_fabric(self, addr: tuple[str, int]) -> None:
+        """Drop a peer's negotiated fabric AND its capability cache so
+        the next transfer re-negotiates from scratch — the re-resolution
+        step of failover (a promoted primary advertises its own segment;
+        a restarted daemon a fresh one)."""
+        with self._dcn_lock:
+            fab = self._dcn_fabrics.pop(addr, None)
+            self._dcn_caps.pop(addr, None)
+        if fab is not None:
+            obs_journal.record(
+                "fabric_invalidated", host=addr[0], port=addr[1],
+                fabric=fab.name,
+            )
+            try:
+                fab.close()
+            except OcmError:
+                pass
+
+    def _fabric_transfer(
+        self, fab, handle: OcmAlloc, total: int, offset: int,
+        put_mv, get_arr,
+    ) -> dict:
+        """One whole transfer over a negotiated one-sided fabric: resolve
+        the region key (cached per alloc), then a single put/get — the
+        memcpy is the data plane; the fabric's control legs carry the
+        validation. Stats mirror the tcp engine's shape so telemetry and
+        STATUS render uniformly."""
+        key = fab.map(handle.alloc_id)
+        if put_mv is not None:
+            fab.put(key, offset, put_mv)
+        else:
+            fab.get(key, offset, memoryview(get_arr))
+        return {
+            "stripes": 1,
+            "retries": [0],
+            "window": [0],
+            "chunk": [total],
+            "coalesced": [False],
+            "fabric": fab.name,
+        }
 
     def _dcn_transfer(
         self, handle: OcmAlloc, total: int, offset: int,
@@ -723,8 +789,31 @@ class ControlPlaneClient:
         engine behind put (``put_mv`` = source view) and get (``get_arr``
         = destination array, stripes land in disjoint views of it).
         Returns the transfer stats for telemetry."""
-        nstripes = self._plan_stripes(total)
         addr = self._owner_addr(handle)
+        # Fabric dispatch (fabric/): a negotiated one-sided fabric serves
+        # the whole transfer in one mapped-region op. Retryable failures
+        # (owner died, fenced, demoted) drop the pair back to tcp for
+        # THIS transfer — the engine's failover ladder below repoints the
+        # handle, and the next transfer re-negotiates against the new
+        # owner (fabric re-resolution). Full-range re-runs are idempotent,
+        # so a half-landed fabric put is safely rewritten.
+        fab = self._fabric_for(addr, total)
+        if fab is not None:
+            try:
+                return self._fabric_transfer(
+                    fab, handle, total, offset, put_mv, get_arr
+                )
+            except BaseException as err:
+                if not self._is_failover_err(err):
+                    raise
+                self._invalidate_fabric(addr)
+                obs_journal.record(
+                    "fabric_fallback", alloc_id=handle.alloc_id,
+                    host=addr[0], port=addr[1],
+                    error=f"{type(err).__name__}: {err}",
+                )
+                printd("fabric op failed (%s); falling back to tcp", err)
+        nstripes = self._plan_stripes(total)
         stats: dict = {
             "retries": [0] * nstripes,
             "window": [0] * nstripes,
@@ -832,6 +921,7 @@ class ControlPlaneClient:
         rank was already counted as a replica owner at alloc time."""
         with self._fo_lock:
             old = handle.rank
+            old_addr = handle.owner_addr
             if old == new_rank:
                 handle.owner_addr = addr
                 return
@@ -840,6 +930,13 @@ class ControlPlaneClient:
             handle.replica_ranks = tuple(
                 r for r in handle.replica_ranks if r != new_rank
             )
+        # Fabric re-resolution (fabric/): the owner this handle left is
+        # dead or demoted, so its negotiated one-sided fabric — and the
+        # capability cache that would hand it back — must go with it.
+        # The promoted owner's fabric negotiates fresh on the next
+        # transfer that clears the size threshold.
+        if old_addr is not None and old_addr != addr:
+            self._invalidate_fabric(tuple(old_addr))
         obs_journal.record(
             "client_failover", alloc_id=handle.alloc_id,
             old_rank=old, new_rank=new_rank,
@@ -949,11 +1046,11 @@ class ControlPlaneClient:
         rtts: list[float] = []
         try:
             if coalesce:
-                self._stripe_put_coalesced(
+                tcp_fabric.stripe_put_coalesced(
                     s, handle, start, length, offset, put_mv, chunk, tctx
                 )
             else:
-                self._stripe_windowed(
+                tcp_fabric.stripe_windowed(
                     s, handle, start, length, offset, put_mv, get_arr,
                     chunk, window, rtts, tctx,
                 )
@@ -974,145 +1071,8 @@ class ControlPlaneClient:
             rtt_p50 = sorted(rtts)[len(rtts) // 2] if rtts else dt
             tuner.observe(rtt_p50, length / dt)
 
-    def _stripe_put_coalesced(
-        self, s, handle, start, length, offset, put_mv, chunk, tctx=None,
-    ) -> None:
-        """ACK-coalesced put burst: every chunk but the last carries
-        FLAG_MORE, the daemon applies them silently and answers ONCE at
-        the final chunk — the stripe streams at TCP speed instead of
-        lockstepping a reply per chunk. One reply per burst also means
-        the error path stays in sync: a burst ERROR arrives exactly where
-        the single ACK would.
-
-        Trace context (``tctx``) rides the burst-CLOSING chunk only: a
-        prefix on every chunk would disqualify each one from the daemon's
-        zero-copy recv-into-arena landing, and one stitched hop per burst
-        is all the exported trace needs."""
-        end = start + length
-        pos = start
-        while pos < end:
-            n = min(chunk, end - pos)
-            last = pos + n >= end
-            req = Message(
-                MsgType.DATA_PUT,
-                {
-                    "alloc_id": handle.alloc_id,
-                    "offset": offset + pos,
-                    "nbytes": n,
-                },
-                put_mv[pos:pos + n],
-                flags=0 if last else FLAG_MORE,
-            )
-            if last and tctx is not None:
-                obs_trace.attach(req, tctx, FLAG_TRACE_CTX)
-            send_msg(s, req)
-            pos += n
-        r = recv_msg(s)
-        if r.type == MsgType.ERROR:
-            raise OcmRemoteError(r.fields["code"], r.fields["detail"])
-        if r.type != MsgType.DATA_PUT_OK or r.fields["nbytes"] != length:
-            raise OcmProtocolError(
-                f"coalesced burst ack mismatch: {r.type.name} "
-                f"{r.fields.get('nbytes')} != {length}"
-            )
-
-    def _stripe_windowed(
-        self, s, handle, start, length, offset, put_mv, get_arr,
-        chunk, window, rtts: list[float], tctx=None,
-    ) -> None:
-        """The lockstep-compatible pipelined window over one stripe's
-        range [start, start+length): up to ``window`` requests in flight,
-        one reply consumed per chunk in FIFO order. Runs against ANY v2
-        daemon (it is the pre-capability protocol unchanged) and doubles
-        as the get path everywhere — get replies carry the data, so there
-        is nothing to coalesce.
-
-        Trace context: every DATA_GET carries it (the request has no
-        payload, so the 16-byte prefix costs nothing); DATA_PUT carries
-        it on the stripe's FINAL chunk only, preserving the body chunks'
-        zero-copy recv-into-arena eligibility at the daemon."""
-        window = max(1, window)
-        is_put = put_mv is not None
-        get_mv = memoryview(get_arr) if get_arr is not None else None
-        end = start + length
-        inflight: list[tuple[int, int, float]] = []  # (pos, nbytes, t_send)
-        pos = start
-        failure: OcmRemoteError | None = None
-        # Reusable reply buffer: each DATA_GET_OK chunk is consumed
-        # before the next recv, the RecvScratch contract (per stripe,
-        # because the scratch is per socket).
-        scratch = RecvScratch()
-        while pos < end or inflight:
-            while pos < end and len(inflight) < window and failure is None:
-                n = min(chunk, end - pos)
-                if is_put:
-                    req = Message(
-                        MsgType.DATA_PUT,
-                        {
-                            "alloc_id": handle.alloc_id,
-                            "offset": offset + pos,
-                            "nbytes": n,
-                        },
-                        put_mv[pos:pos + n],
-                    )
-                    if tctx is not None and pos + n >= end:
-                        obs_trace.attach(req, tctx, FLAG_TRACE_CTX)
-                else:
-                    req = Message(
-                        MsgType.DATA_GET,
-                        {
-                            "alloc_id": handle.alloc_id,
-                            "offset": offset + pos,
-                            "nbytes": n,
-                        },
-                    )
-                    if tctx is not None:
-                        obs_trace.attach(req, tctx, FLAG_TRACE_CTX)
-                send_msg(s, req)
-                inflight.append((pos, n, time.perf_counter()))
-                pos += n
-            if not inflight:
-                break
-            # Replies are FIFO, so the expected chunk's destination is
-            # known BEFORE the recv: a matching fixed-field reply
-            # (DATA_GET_OK) lands its payload straight in the disjoint
-            # destination view — no scratch hop, no copy. An ERROR reply
-            # (strings) or a length mismatch ignores the sink and takes
-            # the normal path below.
-            sink = (
-                get_mv[inflight[0][0]:inflight[0][0] + inflight[0][1]]
-                if get_mv is not None and failure is None else None
-            )
-            r = recv_msg(s, scratch, data_into=sink)
-            c_pos, n, t_send = inflight.pop(0)
-            rtts.append(time.perf_counter() - t_send)
-            if r.type == MsgType.ERROR:
-                # Remember the first failure; keep draining replies
-                # for chunks already on the wire.
-                if failure is None:
-                    failure = OcmRemoteError(
-                        r.fields["code"], r.fields["detail"]
-                    )
-            elif failure is None:
-                if sink is not None and r.data is sink:
-                    continue  # payload already landed in place
-                if not is_put and get_arr is not None:
-                    try:
-                        get_arr[c_pos:c_pos + n] = np.frombuffer(
-                            r.data, dtype=np.uint8
-                        )
-                    except (OSError, OcmProtocolError):
-                        raise
-                    except Exception as exc:
-                        # A reply that parses as a frame but whose payload
-                        # doesn't decode (wrong length for np.frombuffer,
-                        # bad field types) means the stream is desynced:
-                        # a transport failure, not an application error.
-                        raise OcmProtocolError(
-                            f"malformed {r.type.name} reply payload: {exc}"
-                        ) from exc
-        if failure is not None:
-            raise failure
+    # (stripe_put_coalesced / stripe_windowed moved to fabric/tcp.py —
+    # the tcp backend of the fabric layer; see _stripe_once.)
 
     def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
         mv = memoryview(raw)  # stripes/chunks stay zero-copy views;
@@ -1162,6 +1122,7 @@ class ControlPlaneClient:
             chunk_bytes=max(stats["chunk"]) if stats["chunk"] else 0,
             retries=sum(stats["retries"]),
             coalesced=any(stats["coalesced"]),
+            fabric=stats.get("fabric", "tcp"),
         )
 
     def _owner_addr(self, handle: OcmAlloc) -> tuple[str, int]:

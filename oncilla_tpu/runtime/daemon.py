@@ -44,6 +44,7 @@ from oncilla_tpu.core.errors import (
     OcmRemoteError,
     OcmReplicaUnavailable,
 )
+from oncilla_tpu import fabric as fabric_mod
 from oncilla_tpu.core.hostmem import HostArena
 from oncilla_tpu.core.kinds import OcmKind
 from oncilla_tpu.runtime.membership import NodeEntry
@@ -67,6 +68,7 @@ from oncilla_tpu.resilience.detector import FailureDetector, PeerState, probe
 from oncilla_tpu.resilience.failover import FailoverCoordinator
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_FABRIC,
     FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
@@ -117,9 +119,31 @@ class Daemon:
             host = os.environ.get("OCM_BIND_HOST", "127.0.0.1")
         self.host = host
         self.port = entries[rank].port
+        # One-sided fabrics this daemon serves (fabric/): with
+        # OCM_FABRIC=shm/auto the host arena is BACKED by a named
+        # shared-memory segment, advertised at CONNECT behind
+        # FLAG_CAP_FABRIC so same-host clients put/get by memcpy. A
+        # failed registration (tiny /dev/shm) degrades to tcp-only.
+        self.fabrics = fabric_mod.server_fabrics(self.config)
+        backing = (
+            self.fabrics["shm"].buffer() if "shm" in self.fabrics else None
+        )
+        # Counters for the per-fabric transfer metrics (STATUS tail +
+        # ocm_fabric_* prom families): CONNECT negotiations by outcome
+        # and served one-sided ops/bytes. Plain int bumps under the GIL,
+        # same discipline as res_counters.
+        self.fabric_counters = {
+            "selected_shm": 0,   # CONNECT offers granted with a descriptor
+            "selected_tcp": 0,   # offers declined (nothing to advertise)
+            "shm_puts": 0,
+            "shm_gets": 0,
+            "shm_put_bytes": 0,
+            "shm_get_bytes": 0,
+        }
         # Daemon-owned storage for the REMOTE_HOST arm (DCN fabric).
         self.host_arena = HostArena(
-            self.config.host_arena_bytes, self.config.alignment
+            self.config.host_arena_bytes, self.config.alignment,
+            backing=backing,
         )
         # Bookkeeping-only allocators for this host's device arenas: the HBM
         # bytes live in the SPMD app processes (the ICI fabric); the daemon
@@ -286,6 +310,10 @@ class Daemon:
             except OSError:
                 printd("daemon %d: snapshot write failed", self.rank)
         self.peers.close()
+        # Unregister fabrics LAST: the snapshot above reads the arena,
+        # which an shm fabric backs. Idempotent (kill() may have run).
+        for f in self.fabrics.values():
+            f.teardown()
 
     def kill(self) -> None:
         """Hard-kill (resilience/chaos.py): the crash the failover
@@ -316,6 +344,12 @@ class Daemon:
             except OSError:
                 pass
         self.peers.close()
+        # A killed daemon must not leak its segment name in /dev/shm:
+        # unlink NOW (attached peers' mappings stay valid; only the name
+        # dies — exactly a SIGKILL'd process whose parent reaps the
+        # segment). The chaos-harness kill path asserts this.
+        for f in self.fabrics.values():
+            f.teardown()
 
     # -- epoch / fencing (resilience/) -----------------------------------
 
@@ -968,7 +1002,7 @@ class Daemon:
         # Capability negotiation: grant exactly the offered bits we
         # implement. Peers that never offer (old clients, the C++ daemon's
         # own dials) get flags=0 and the lockstep protocol unchanged.
-        return Message(
+        reply = Message(
             MsgType.CONNECT_CONFIRM,
             {
                 "rank": self.rank,
@@ -979,6 +1013,25 @@ class Daemon:
             & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
                | FLAG_CAP_QOS),
         )
+        # Fabric negotiation (fabric/): an offered FLAG_CAP_FABRIC is
+        # granted only when this daemon actually registered a fabric —
+        # the grant carries the descriptor tail the client needs to
+        # prove reachability (attach the segment). Un-offered CONNECTs
+        # ship the reply unchanged, so the default wire stays
+        # byte-for-byte pre-fabric.
+        if msg.flags & FLAG_CAP_FABRIC:
+            desc = {n: f.descriptor() for n, f in self.fabrics.items()}
+            if desc:
+                import json
+
+                reply.flags |= FLAG_CAP_FABRIC
+                reply.data = json.dumps(
+                    desc, separators=(",", ":")
+                ).encode()
+                self.fabric_counters["selected_shm"] += 1
+            else:
+                self.fabric_counters["selected_tcp"] += 1
+        return reply
 
     def _on_disconnect(self, msg: Message) -> Message:
         """Immediate reclamation on app disconnect instead of waiting out the
@@ -1687,6 +1740,91 @@ class Daemon:
         ]
         return Message(MsgType.DATA_GET_OK, {"nbytes": n}, sink)
 
+    # -- shm fabric control plane (fabric/shm.py) -------------------------
+    #
+    # The data moved by memcpy through the shared arena segment; these
+    # legs carry everything that must stay authoritative on the owner:
+    # registry lookup, extent identity, bounds, replica role, epoch
+    # fencing (all three types are in _FENCED_REJECT) — and the replica
+    # fan-out for puts, which rides TCP exactly like a framed put's.
+
+    def _shm_entry(self, msg: Message) -> RegEntry:
+        """Shared validation for the shm control legs: the entry must be
+        host-kind (device bytes live in the app plane, not this arena),
+        honor replica role discipline, and — for PUT/GET — match the
+        extent the client's cached mapping used (a freed-and-recycled
+        extent answers BAD_ALLOC_ID, so a stale mapping can never be
+        blessed) and stay in bounds."""
+        f = msg.fields
+        # Segment identity first: a restarted daemon on the same
+        # host:port serves the SAME alloc_ids (snapshot restore) out of
+        # a FRESH segment — acking a client whose memcpy landed in the
+        # dead daemon's orphaned mapping would silently lose the bytes.
+        # STALE_EPOCH is the failover signal: the client drops its
+        # cached fabric and re-negotiates.
+        served = self.fabrics.get("shm")
+        if served is None or f["seg"] != served.descriptor()["seg"]:
+            raise OcmRemoteError(
+                int(ErrCode.STALE_EPOCH),
+                f"rank {self.rank} does not serve segment {f['seg']!r} "
+                "(daemon restarted?) — re-negotiate the fabric",
+            )
+        e = self.registry.lookup(f["alloc_id"])
+        if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            raise OcmInvalidHandle(
+                "shm fabric serves host-kind allocations only"
+            )
+        self._check_data_role(e, msg)
+        if "ext_offset" in f:
+            if f["ext_offset"] != e.extent.offset:
+                raise OcmInvalidHandle(
+                    f"stale fabric mapping for alloc {f['alloc_id']}: "
+                    f"mapped extent {f['ext_offset']}, live extent "
+                    f"{e.extent.offset} — re-map"
+                )
+            check_bounds(
+                Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"]
+            )
+        return e
+
+    def _on_shm_map(self, msg: Message) -> Message:
+        e = self._shm_entry(msg)
+        return Message(
+            MsgType.SHM_MAP_OK,
+            {"alloc_id": e.alloc_id, "ext_offset": e.extent.offset,
+             "ext_nbytes": e.nbytes},
+        )
+
+    def _on_shm_put(self, msg: Message) -> Message:
+        f = msg.fields
+        e = self._shm_entry(msg)
+        self.fabric_counters["shm_puts"] += 1
+        self.fabric_counters["shm_put_bytes"] += f["nbytes"]
+        self.tracer.note_transfer(
+            "shm_put_srv", f["nbytes"], 0.0, coalesced=False, fabric="shm",
+        )
+        # Replica fan-out stays on TCP: mirror the just-landed segment
+        # bytes to every live chain member BEFORE acking, the same
+        # durability contract as a framed put (a byte the client saw
+        # acked is on every live replica). Snapshot the extent window —
+        # the client may already be memcpying the next transfer.
+        if e.chain and not msg.flags & FLAG_FANOUT:
+            view = memoryview(self.host_arena.view(e.extent))
+            data = bytes(view[f["offset"]:f["offset"] + f["nbytes"]])
+            self._fan_out_put(e, f["offset"], f["nbytes"], data)
+        return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
+
+    def _on_shm_get(self, msg: Message) -> Message:
+        f = msg.fields
+        self._shm_entry(msg)
+        self.fabric_counters["shm_gets"] += 1
+        self.fabric_counters["shm_get_bytes"] += f["nbytes"]
+        self.tracer.note_transfer(
+            "shm_get_srv", f["nbytes"], 0.0, coalesced=False, fabric="shm",
+        )
+        # The ack IS the reply; the client copies from the segment after.
+        return Message(MsgType.DATA_GET_OK, {"nbytes": f["nbytes"]})
+
     # -- cross-process device plane (PLANE_SERVE / PLANE_PUT / PLANE_GET) --
     #
     # Device bytes live in the SPMD controller's plane arena (the daemon
@@ -2046,6 +2184,7 @@ class Daemon:
             "leases": self.registry.lease_stats(),
             "resilience": self._resilience_meta(),
             "qos": self._qos_meta(),
+            "fabric": self._fabric_meta(),
         }
         return Message(
             MsgType.STATUS_OK,
@@ -2080,6 +2219,14 @@ class Daemon:
             meta["load_scores"] = scores()
         return meta
 
+    def _fabric_meta(self) -> dict:
+        """Which fabrics this daemon serves + per-fabric transfer
+        counters, for STATUS and the ocm_fabric_* prom families."""
+        return {
+            "served": sorted(self.fabrics),
+            "counters": dict(self.fabric_counters),
+        }
+
     def _metrics_meta(self) -> dict:
         """Everything the Prometheus endpoint and the cluster CLI render:
         op counters, the transfer ring, arena occupancy, lease health."""
@@ -2104,6 +2251,7 @@ class Daemon:
             "leases": self.registry.lease_stats(),
             "resilience": self._resilience_meta(),
             "qos": self._qos_meta(),
+            "fabric": self._fabric_meta(),
         }
 
     def _on_status_prom(self, msg: Message) -> Message:
@@ -2204,7 +2352,7 @@ _FLAGS_HANDLED = {
     # _on_do_replica (qos/).
     MsgType.CONNECT: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
-        | FLAG_CAP_QOS | FLAG_QOS_TAIL
+        | FLAG_CAP_QOS | FLAG_QOS_TAIL | FLAG_CAP_FABRIC
     ),
     # FLAG_FANOUT: replica-chain role discipline in _check_data_role /
     # _route_put_payload (fan-out legs land, clients need primary role).
@@ -2223,6 +2371,12 @@ _FLAGS_HANDLED = {
     MsgType.STATUS: FLAG_TRACE_CTX,
     MsgType.STATUS_PROM: FLAG_TRACE_CTX,
     MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
+    # shm fabric control legs (fabric/): validated in _shm_entry; the
+    # FLAG_CAP_FABRIC offer itself is handled in _on_connect (echo +
+    # descriptor tail).
+    MsgType.SHM_MAP: FLAG_TRACE_CTX,
+    MsgType.SHM_PUT: FLAG_TRACE_CTX,
+    MsgType.SHM_GET: FLAG_TRACE_CTX,
 }
 
 # Requests a FENCED daemon (one that outlived its own DEAD verdict) must
@@ -2236,6 +2390,13 @@ _FENCED_REJECT = frozenset({
     MsgType.RE_REPLICATE,
     MsgType.DATA_PUT,
     MsgType.DATA_GET,
+    # The shm fabric's control legs are data ops: a fenced daemon must
+    # refuse to bless a segment write OR hand out a mapping — the
+    # STALE_EPOCH reply is what sends the client down its failover
+    # ladder to the promoted replica (fabric re-resolution).
+    MsgType.SHM_MAP,
+    MsgType.SHM_PUT,
+    MsgType.SHM_GET,
 })
 
 _HANDLERS = {
@@ -2251,6 +2412,9 @@ _HANDLERS = {
     MsgType.NOTE_ALLOC: Daemon._on_note_alloc,
     MsgType.DATA_PUT: Daemon._on_data_put,
     MsgType.DATA_GET: Daemon._on_data_get,
+    MsgType.SHM_MAP: Daemon._on_shm_map,
+    MsgType.SHM_PUT: Daemon._on_shm_put,
+    MsgType.SHM_GET: Daemon._on_shm_get,
     MsgType.PLANE_SERVE: Daemon._on_plane_serve,
     MsgType.PLANE_PUT: Daemon._on_plane_relay,
     MsgType.PLANE_GET: Daemon._on_plane_relay,

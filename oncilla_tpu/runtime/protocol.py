@@ -97,6 +97,19 @@ FLAG_CAP_QOS = 0x0080
 # the fixed schemas stay untouched so un-flagged frames remain
 # byte-identical and parseable by every v2 peer.
 FLAG_QOS_TAIL = 0x0100
+# FLAG_CAP_FABRIC on CONNECT offers data-fabric negotiation (fabric/):
+# the client asks which one-sided fabrics the daemon serves besides the
+# framed-TCP engine this protocol itself rides on. A daemon that serves
+# one echoes the bit on CONNECT_CONFIRM and appends a JSON descriptor
+# data tail (e.g. {"shm": {"seg": <segment name>, "size": <bytes>}});
+# the CLIENT then proves reachability (for shm: by actually attaching
+# the named segment — same-host detection is attachability, never a
+# hostname comparison). Decline-by-silence as ever: a flags=0 reply
+# (un-upgraded v2 daemon, native C++ daemon) or an unattachable
+# descriptor (cross-host pair) keeps the peer pair on tcp. With
+# OCM_FABRIC unset/"tcp" the bit is never offered, so the default wire
+# is byte-for-byte the pre-fabric protocol.
+FLAG_CAP_FABRIC = 0x0200
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -178,6 +191,18 @@ class MsgType(enum.IntEnum):
     PROMOTE_OK = 69
     RE_REPLICATE = 70       # rank 0 -> primary: copy an alloc to a new rank
     RE_REPLICATE_OK = 71
+    # shm fabric control plane (fabric/shm.py). The DATA itself never
+    # rides these frames — it is a one-sided memcpy through the peer's
+    # mapped arena segment; these carry the registration lookup and the
+    # validate/ack legs (role discipline, epoch fencing, bounds, replica
+    # fan-out all stay on TCP, exactly the reference's split between the
+    # allocation protocol and the per-fabric one-sided put/get). All new
+    # types: only ever sent to a peer that granted FLAG_CAP_FABRIC, so a
+    # v2/native peer never receives one.
+    SHM_MAP = 72            # client -> owner: where does alloc_id live?
+    SHM_MAP_OK = 73         # owner -> client: (ext_offset, ext_nbytes)
+    SHM_PUT = 74            # "I wrote [off,off+n) via the segment": validate+ack
+    SHM_GET = 75            # "may I read [off,off+n)?": validate before copy
     # failure
     ERROR = 99
 
@@ -197,11 +222,11 @@ VALID_FLAGS.update({
     # ignore both the bit and the tail.
     MsgType.CONNECT: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
-        | FLAG_CAP_QOS | FLAG_QOS_TAIL
+        | FLAG_CAP_QOS | FLAG_QOS_TAIL | FLAG_CAP_FABRIC
     ),
     MsgType.CONNECT_CONFIRM: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
-        | FLAG_CAP_QOS
+        | FLAG_CAP_QOS | FLAG_CAP_FABRIC
     ),
     # Requests that may carry a trace-context prefix once the peer
     # granted FLAG_CAP_TRACE. DATA_PUT also keeps the coalesced-burst
@@ -221,6 +246,12 @@ VALID_FLAGS.update({
     MsgType.STATUS: FLAG_TRACE_CTX,
     MsgType.STATUS_PROM: FLAG_TRACE_CTX,
     MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
+    # shm fabric control legs are ordinary traceable requests: the
+    # exported trace shows the validate/ack hop where a DATA_* span
+    # would have been.
+    MsgType.SHM_MAP: FLAG_TRACE_CTX,
+    MsgType.SHM_PUT: FLAG_TRACE_CTX,
+    MsgType.SHM_GET: FLAG_TRACE_CTX,
 })
 
 
@@ -425,6 +456,41 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
         ("epoch", "Q"),
     ],
     MsgType.RE_REPLICATE_OK: [("alloc_id", "Q"), ("nbytes", "Q")],
+    # shm fabric control (fabric/shm.py). Every leg names the SEGMENT
+    # the client attached ("seg"): a daemon that restarted on the same
+    # host:port serves a fresh segment under the same alloc_ids
+    # (snapshot restore), and without the identity check it would bless
+    # a memcpy that landed in the dead daemon's orphaned mapping. A
+    # mismatch answers STALE_EPOCH — the failover signal — so the
+    # client re-negotiates instead of trusting the stale region.
+    # SHM_PUT/SHM_GET additionally carry the ext_offset the client's
+    # cached mapping used, so the owner can refuse a STALE mapping
+    # (extent freed and recycled since SHM_MAP) with BAD_ALLOC_ID
+    # instead of blessing a write that landed on the wrong tenant's
+    # bytes. "offset" is handle-relative, as on DATA_*. Replies:
+    # SHM_PUT -> DATA_PUT_OK, SHM_GET -> DATA_GET_OK (the get reply
+    # carries NO payload — the client copies from the segment after
+    # the validation lands).
+    MsgType.SHM_MAP: [("alloc_id", "Q"), ("seg", "s")],
+    MsgType.SHM_MAP_OK: [
+        ("alloc_id", "Q"),
+        ("ext_offset", "Q"),
+        ("ext_nbytes", "Q"),
+    ],
+    MsgType.SHM_PUT: [
+        ("alloc_id", "Q"),
+        ("ext_offset", "Q"),
+        ("offset", "Q"),
+        ("nbytes", "Q"),
+        ("seg", "s"),
+    ],
+    MsgType.SHM_GET: [
+        ("alloc_id", "Q"),
+        ("ext_offset", "Q"),
+        ("offset", "Q"),
+        ("nbytes", "Q"),
+        ("seg", "s"),
+    ],
     MsgType.ERROR: [("code", "I"), ("detail", "s")],
 }
 

@@ -93,6 +93,25 @@ class OcmConfig:
         default_factory=lambda: bool(_env_int("OCM_DCN_COALESCE", 1))
     )
 
+    # Data-plane fabric selection (fabric/). "tcp" (and OCM_FABRIC
+    # unset) is the framed-TCP engine with NO negotiation — the wire is
+    # byte-for-byte the pre-fabric protocol. "shm" offers FLAG_CAP_FABRIC
+    # at the data-plane CONNECT probe and, when the peer daemon serves a
+    # shared-memory segment THIS process can attach (same host, verified
+    # by attaching — never by hostname comparison), large put/get becomes
+    # a bounds-checked memcpy into the peer's mapped arena; every pair
+    # that can't (old daemons, the native C++ daemon, cross-host peers)
+    # falls back to tcp per pair. "auto" is an alias for "shm".
+    fabric: str = field(
+        default_factory=lambda: os.environ.get("OCM_FABRIC") or "tcp"
+    )
+    # Transfers below this ride tcp even when shm is negotiated: the
+    # mapped-segment path costs a TCP control round-trip per transfer
+    # either way, and tiny ops gain nothing from the memcpy.
+    fabric_shm_min_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_FABRIC_SHM_MIN_BYTES", 64 << 10)
+    )
+
     # Distributed tracing (obs/): offer FLAG_CAP_TRACE at CONNECT and
     # prefix requests with a 16-byte trace context once granted, so one
     # trace_id stitches client → local daemon → peer daemon spans.
@@ -279,6 +298,26 @@ class OcmConfig:
             raise ValueError(
                 f"app_stale_leases must be > 0 (got {self.app_stale_leases})"
             )
+        if self.fabric not in ("tcp", "shm", "auto"):
+            raise ValueError(
+                f"fabric must be 'tcp', 'shm' or 'auto' (got "
+                f"{self.fabric!r}); 'tcp' is the framed-TCP engine with "
+                "no negotiation, 'shm'/'auto' negotiate per peer pair"
+            )
+        if self.fabric_shm_min_bytes < 0:
+            raise ValueError(
+                "fabric_shm_min_bytes must be >= 0 "
+                f"(got {self.fabric_shm_min_bytes})"
+            )
+
+    @property
+    def fabric_offer(self) -> bool:
+        """Whether this process negotiates fabrics at all — the gate on
+        offering FLAG_CAP_FABRIC at the data-plane CONNECT probe (client
+        side) and on creating a shared-memory-backed arena (daemon
+        side). OCM_FABRIC unset/"tcp" keeps the wire byte-for-byte the
+        pre-fabric protocol."""
+        return self.fabric in ("shm", "auto")
 
     @property
     def qos_offer(self) -> bool:

@@ -199,6 +199,7 @@ class Tracer:
         chunk_bytes: int = 0,
         retries: int = 0,
         coalesced: bool = False,
+        fabric: str = "tcp",
     ) -> None:
         """Record one completed data-plane transfer in the ring buffer."""
         rec = {
@@ -211,6 +212,7 @@ class Tracer:
             "chunk_bytes": int(chunk_bytes),
             "retries": int(retries),
             "coalesced": bool(coalesced),
+            "fabric": str(fabric),
         }
         with self._lock:
             self._transfers.append(rec)

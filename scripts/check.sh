@@ -47,6 +47,12 @@ echo "== dcn smoke =="
 # asserted; runs in seconds and needs no chip.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke || fail=1
 
+echo "== fabric smoke =="
+# One-sided fabric proof: shm put/get roundtrip on a 2-daemon local
+# cluster — must actually ride shm (transfer-ring fabric tag), come back
+# byte-exact, drain the alloctrace ledger, and leave /dev/shm clean.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.fabric --smoke || fail=1
+
 echo "== qos smoke =="
 # Multi-tenant QoS proof: simulated tenants with skewed sizes/priorities
 # against an in-process cluster — quota enforcement, back-pressure BUSY,

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from oncilla_tpu import OcmKind
-from oncilla_tpu.runtime import client as client_mod
+from oncilla_tpu.fabric import tcp as tcp_mod
 from oncilla_tpu.runtime import protocol as P
 from oncilla_tpu.runtime.client import _PeerTuner
 from oncilla_tpu.runtime.cluster import local_cluster
@@ -207,7 +207,9 @@ def test_mid_stripe_socket_kill_retries(rng, monkeypatch, stripes, direction):
         if direction == "get":
             client.put(h, data)  # stage content before the faulty get
 
-        real_send = client_mod.send_msg
+        # The stripe loops live in fabric/tcp.py (the engine's PR-7
+        # re-homing); the fault must be injected at that seam.
+        real_send = tcp_mod.send_msg
         fired = []
         lock = threading.Lock()
 
@@ -223,13 +225,13 @@ def test_mid_stripe_socket_kill_retries(rng, monkeypatch, stripes, direction):
                     sock.shutdown(socket.SHUT_RDWR)
             return real_send(sock, msg)
 
-        monkeypatch.setattr(client_mod, "send_msg", flaky)
+        monkeypatch.setattr(tcp_mod, "send_msg", flaky)
         if direction == "put":
             client.put(h, data)
             got = client.get(h, nbytes)
         else:
             got = client.get(h, nbytes)
-        monkeypatch.setattr(client_mod, "send_msg", real_send)
+        monkeypatch.setattr(tcp_mod, "send_msg", real_send)
         assert fired, "fault was never injected"
         np.testing.assert_array_equal(got, data)
         # The retry is visible in the transfer record.
@@ -248,7 +250,7 @@ def test_failed_stripe_does_not_corrupt_siblings(rng, monkeypatch):
         data = rng.integers(0, 256, nbytes, dtype=np.uint8)
         client.put(h, data)
 
-        real_recv = client_mod.recv_msg
+        real_recv = tcp_mod.recv_msg
         state = {"n": 0}
         lock = threading.Lock()
 
@@ -261,9 +263,9 @@ def test_failed_stripe_does_not_corrupt_siblings(rng, monkeypatch):
                 sock.shutdown(socket.SHUT_RDWR)
             return real_recv(sock, *a, **kw)
 
-        monkeypatch.setattr(client_mod, "recv_msg", flaky_recv)
+        monkeypatch.setattr(tcp_mod, "recv_msg", flaky_recv)
         got = client.get(h, nbytes)
-        monkeypatch.setattr(client_mod, "recv_msg", real_recv)
+        monkeypatch.setattr(tcp_mod, "recv_msg", real_recv)
         np.testing.assert_array_equal(got, data)
         client.free(h)
 

@@ -1,0 +1,329 @@
+"""Pluggable one-sided fabric layer (fabric/): negotiation, the shm
+backend's lifecycle edges, wire byte-identity with fabrics unset, and
+fallback-to-tcp in every pair that cannot prove attachability."""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu import fabric as F
+from oncilla_tpu.core.errors import OcmBoundsError
+from oncilla_tpu.fabric import shm as fshm
+from oncilla_tpu.fabric.base import FabricKey
+from oncilla_tpu.runtime import daemon as D
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def fcfg(**kw):
+    """Shm-fabric config small enough that every test transfer clears
+    the shm size threshold and runs in milliseconds."""
+    d = dict(
+        host_arena_bytes=16 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=256 << 10,
+        inflight_ops=2,
+        dcn_stripes=2,
+        dcn_stripe_min_bytes=256 << 10,
+        heartbeat_s=5.0,
+        fabric="shm",
+        fabric_shm_min_bytes=4 << 10,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+# -- config + key units ---------------------------------------------------
+
+
+def test_fabric_config_validated():
+    with pytest.raises(ValueError, match="fabric"):
+        OcmConfig(fabric="rdma")
+    with pytest.raises(ValueError, match="fabric_shm_min_bytes"):
+        OcmConfig(fabric_shm_min_bytes=-1)
+    assert not OcmConfig().fabric_offer          # default: tcp, no offer
+    assert OcmConfig(fabric="shm").fabric_offer
+    assert OcmConfig(fabric="auto").fabric_offer
+
+
+def test_fabric_key_bounds_checked_before_any_byte_moves():
+    key = FabricKey(alloc_id=7, offset=4096, nbytes=1024)
+    key.check(0, 1024)
+    key.check(1023, 1)
+    for off, n in ((0, 1025), (1024, 1), (-1, 4), (4, -1)):
+        with pytest.raises(OcmBoundsError):
+            key.check(off, n)
+
+
+def test_attach_peer_declines_garbage_and_unreachable():
+    """Malformed tails and unattachable descriptors are a clean decline
+    (-> tcp), never an error — the cross-host case IS an unattachable
+    descriptor: the segment name does not exist in this host's
+    /dev/shm."""
+    control = None  # never reached on a declined attach
+    assert F.attach_peer(b"not json", control) is None
+    assert F.attach_peer(b"[1,2]", control) is None
+    assert F.attach_peer(b"{}", control) is None
+    # Wrong prefix: a future daemon's descriptor we don't understand.
+    assert F.attach_peer(
+        b'{"shm": {"seg": "other-prefix-1", "size": 4096}}', control
+    ) is None
+    # Well-formed but nonexistent segment — what a cross-host client
+    # (or one racing a dead daemon) actually sees.
+    assert F.attach_peer(
+        b'{"shm": {"seg": "ocm-fab-feed-0123456789abcdef", '
+        b'"size": 4096}}', control
+    ) is None
+
+
+# -- wire byte-identity with fabrics unset (the satellite pin) ------------
+
+
+def test_fabric_unset_wire_is_byte_identical():
+    """OCM_FABRIC unset/tcp: the data-plane CONNECT probe never offers
+    FLAG_CAP_FABRIC and ships the exact pre-fabric frame (the QoS/replica
+    byte-identity pin, extended to the fabric bit)."""
+    cfg = OcmConfig()
+    assert not cfg.fabric_offer
+    offer = (P.FLAG_CAP_COALESCE if cfg.dcn_coalesce else 0) | (
+        P.FLAG_CAP_TRACE if cfg.trace else 0
+    )
+    connect = P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0}, flags=offer,
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(connect[:P.HEADER.size])
+    assert not flags & P.FLAG_CAP_FABRIC
+    assert plen == 16  # pid q + rank q, no tail
+    # An explicit OCM_FABRIC=tcp is the same non-offer.
+    assert not OcmConfig(fabric="tcp").fabric_offer
+
+
+def test_fabric_flag_declared_and_daemon_handled():
+    """Protocol-exhaustiveness coverage of the fabric bit and the shm
+    control legs, pinned the way the replica/QoS bits were."""
+    assert P.VALID_FLAGS[P.MsgType.CONNECT] & P.FLAG_CAP_FABRIC
+    assert P.VALID_FLAGS[P.MsgType.CONNECT_CONFIRM] & P.FLAG_CAP_FABRIC
+    assert D._FLAGS_HANDLED[P.MsgType.CONNECT] & P.FLAG_CAP_FABRIC
+    for t in (P.MsgType.SHM_MAP, P.MsgType.SHM_PUT, P.MsgType.SHM_GET):
+        assert t in D._HANDLERS
+        assert t in D._FENCED_REJECT  # data ops: a fenced owner refuses
+    # The fabric bit is CONNECT-only: a stray one on DATA_GET must fail
+    # at the sender.
+    with pytest.raises(ocm.OcmProtocolError, match="invalid"):
+        P.pack(P.Message(
+            P.MsgType.DATA_GET,
+            {"alloc_id": 1, "offset": 0, "nbytes": 1},
+            flags=P.FLAG_CAP_FABRIC,
+        ))
+
+
+# -- negotiation and transfer through live clusters -----------------------
+
+
+def _roundtrip(client, nbytes, rng, h=None):
+    if h is None:
+        h = client.alloc(nbytes, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+    client.put(h, data)
+    got = client.get(h, nbytes)
+    np.testing.assert_array_equal(got, data)
+    return h, data
+
+
+def test_shm_roundtrip_counters_and_prom(rng):
+    with local_cluster(2, config=fcfg()) as cl:
+        client = cl.client(0, heartbeat=False)
+        h, _ = _roundtrip(client, 2 << 20, rng)
+        rec = client.tracer.transfers()[-2:]
+        assert [r["op"] for r in rec] == ["put", "get"]
+        assert [r["fabric"] for r in rec] == ["shm", "shm"]
+        owner = cl.daemons[h.rank]
+        fc = owner.fabric_counters
+        assert fc["selected_shm"] >= 1
+        assert fc["shm_puts"] >= 1 and fc["shm_gets"] >= 1
+        assert fc["shm_put_bytes"] >= 2 << 20
+        # STATUS carries the fabric meta; prom renders the families.
+        st = client.status(rank=h.rank)
+        assert st["fabric"]["served"] == ["shm"]
+        prom = client.fetch_prom(rank=h.rank)
+        assert 'ocm_fabric_served{' in prom
+        assert 'ocm_fabric_selected_total{' in prom
+        assert 'ocm_fabric_ops_total{' in prom
+        client.free(h)
+
+
+def test_small_transfers_stay_on_tcp(rng):
+    """Below fabric_shm_min_bytes the control round-trip IS the cost
+    either way: the pair keeps the framed engine."""
+    with local_cluster(2, config=fcfg(fabric_shm_min_bytes=1 << 20)) as cl:
+        client = cl.client(0, heartbeat=False)
+        h, _ = _roundtrip(client, 64 << 10, rng)
+        rec = client.tracer.transfers()[-2:]
+        assert [r["fabric"] for r in rec] == ["tcp", "tcp"]
+        client.free(h)
+
+
+def test_v2_daemon_declines_by_silence(rng):
+    """Daemons that serve no fabrics (OCM_FABRIC unset) answer the
+    client's FLAG_CAP_FABRIC offer with silence: no echo, no descriptor,
+    and the pair runs the framed engine byte-exact."""
+    tcp_only = fcfg(fabric="tcp")
+    with local_cluster(2, config=tcp_only) as cl:
+        client = ControlPlaneClient(
+            cl.entries, 0, config=fcfg(), heartbeat=False,
+        )
+        try:
+            h, _ = _roundtrip(client, 2 << 20, rng)
+            addr = client._owner_addr(h)
+            assert not client._dcn_caps[addr] & P.FLAG_CAP_FABRIC
+            assert addr not in client._dcn_fabrics
+            rec = client.tracer.transfers()[-2:]
+            assert [r["fabric"] for r in rec] == ["tcp", "tcp"]
+            assert cl.daemons[h.rank].fabric_counters["selected_tcp"] >= 1
+            client.free(h)
+        finally:
+            client.close()
+
+
+def test_cross_host_pair_never_selects_shm(rng, monkeypatch):
+    """Same-host detection is ATTACHABILITY: a client that cannot map
+    the advertised segment (exactly what a cross-host peer sees —
+    FileNotFoundError from a name that is not in its /dev/shm) falls
+    back to tcp and the transfer still completes byte-exact."""
+    def no_attach(seg):
+        raise FileNotFoundError(f"/dev/shm/{seg} (cross-host)")
+
+    monkeypatch.setattr(fshm, "_attach_untracked", no_attach)
+    with local_cluster(2, config=fcfg()) as cl:
+        client = cl.client(0, heartbeat=False)
+        h, _ = _roundtrip(client, 2 << 20, rng)
+        addr = client._owner_addr(h)
+        # The daemon granted the offer (it DOES serve shm) but the
+        # attach failed, so the pair negotiated down to tcp.
+        assert client._dcn_caps[addr] & P.FLAG_CAP_FABRIC
+        assert addr not in client._dcn_fabrics
+        rec = client.tracer.transfers()[-2:]
+        assert [r["fabric"] for r in rec] == ["tcp", "tcp"]
+        client.free(h)
+
+
+# -- shm lifecycle edges --------------------------------------------------
+
+
+def test_kill_and_stop_unlink_segments_no_dev_shm_leak(rng):
+    """A crashed daemon must not leak its segment name: kill() unlinks
+    immediately (the chaos-harness contract), stop() unlinks the rest."""
+    cl_names = []
+    with local_cluster(2, config=fcfg()) as cl:
+        for d in cl.daemons:
+            assert "shm" in d.fabrics
+            cl_names.append(d.fabrics["shm"]._shm.name)
+        for n in cl_names:
+            assert os.path.exists(f"/dev/shm/{n}")
+        client = cl.client(0, heartbeat=False)
+        _roundtrip(client, 1 << 20, rng)
+        cl.kill(1)
+        assert not os.path.exists(f"/dev/shm/{cl_names[1]}")
+        assert os.path.exists(f"/dev/shm/{cl_names[0]}")  # rank 0 alive
+    for n in cl_names:
+        assert not os.path.exists(f"/dev/shm/{n}")
+
+
+def test_stale_segment_and_stale_mapping_rejected():
+    """The restarted-daemon hole: SHM legs naming a segment this daemon
+    does not serve answer STALE_EPOCH (failover signal -> re-negotiate);
+    a stale extent mapping for a live segment answers BAD_ALLOC_ID."""
+    with local_cluster(2, config=fcfg()) as cl:
+        client = cl.client(0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        e = cl.entries[h.rank]
+        s = socket.create_connection((e.connect_host, e.port), timeout=5)
+        try:
+            with pytest.raises(ocm.OcmError) as ei:
+                P.request(s, P.Message(
+                    P.MsgType.SHM_MAP,
+                    {"alloc_id": h.alloc_id,
+                     "seg": "ocm-fab-dead-beef"},
+                ))
+            assert ei.value.code == int(P.ErrCode.STALE_EPOCH)
+            live_seg = cl.daemons[h.rank].fabrics["shm"]._shm.name
+            r = P.request(s, P.Message(
+                P.MsgType.SHM_MAP,
+                {"alloc_id": h.alloc_id, "seg": live_seg},
+            ))
+            ext_off = r.fields["ext_offset"]
+            # A put claiming a DIFFERENT extent than the registry's is a
+            # recycled-extent write: refused before it is blessed.
+            with pytest.raises(ocm.OcmError) as ei:
+                P.request(s, P.Message(
+                    P.MsgType.SHM_PUT,
+                    {"alloc_id": h.alloc_id, "ext_offset": ext_off + 512,
+                     "offset": 0, "nbytes": 64, "seg": live_seg},
+                ))
+            assert ei.value.code == int(P.ErrCode.BAD_ALLOC_ID)
+        finally:
+            s.close()
+        client.free(h)
+
+
+def test_fabric_renegotiated_after_owner_failover(rng):
+    """The failover ladder's fabric re-resolution: mid-life owner death
+    repoints the handle, the dead pair's fabric (and capability cache)
+    is dropped, and the NEXT qualifying transfer negotiates shm against
+    the promoted owner — gets stay byte-exact throughout."""
+    cfg = fcfg(
+        replicas=2,
+        heartbeat_s=0.05,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        failover_wait_s=10.0,
+    )
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0)
+        h = client.alloc(2 << 20, OcmKind.REMOTE_HOST)
+        owner = h.rank
+        old_addr = tuple(h.owner_addr)
+        h, data = _roundtrip(client, 2 << 20, rng, h=h)
+        assert client.tracer.transfers()[-1]["fabric"] == "shm"
+        cl.kill(owner)
+        # Through the failover window: the shm put against the dead
+        # owner fails, the ladder repoints, bytes stay exact.
+        data2 = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+        client.put(h, data2)
+        np.testing.assert_array_equal(client.get(h, 2 << 20), data2)
+        assert h.rank != owner
+        assert old_addr not in client._dcn_fabrics  # re-resolution
+        # A fresh transfer negotiates shm against the promoted owner.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            client.put(h, data2)
+            if client.tracer.transfers()[-1]["fabric"] == "shm":
+                break
+            time.sleep(0.1)
+        assert client.tracer.transfers()[-1]["fabric"] == "shm"
+        np.testing.assert_array_equal(client.get(h, 2 << 20), data2)
+        client.free(h)
+
+
+def test_free_forgets_cached_key_and_close_releases_mappings(rng):
+    with local_cluster(2, config=fcfg()) as cl:
+        client = cl.client(0, heartbeat=False)
+        h, _ = _roundtrip(client, 1 << 20, rng)
+        addr = client._owner_addr(h)
+        fab = client._dcn_fabrics[addr]
+        assert h.alloc_id in fab._keys
+        client.free(h)
+        # A recycled alloc_id must re-resolve its extent, never inherit
+        # the freed handle's mapping.
+        assert h.alloc_id not in fab._keys
+        client.close()
+        assert client._dcn_fabrics == {}

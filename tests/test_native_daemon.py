@@ -274,6 +274,37 @@ def test_native_qos_capability_declined_by_silence(native_cluster, rng):
     client.close()
 
 
+def test_native_fabric_capability_declined_by_silence(native_cluster, rng):
+    """OCM_FABRIC=shm against the unmodified C++ daemon: the data-plane
+    CONNECT offer of FLAG_CAP_FABRIC comes back flags=0 (the native
+    codec always packs zero flags), no descriptor tail is ever parsed,
+    the pair runs the framed-TCP engine, and transfers stay byte-exact
+    — the fabric analogue of the replica/QoS silence tests."""
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        fabric="shm",
+        fabric_shm_min_bytes=4 << 10,
+    )
+    assert cfg2.fabric_offer
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+    addr = client._owner_addr(h)
+    assert not client._dcn_caps[addr] & P.FLAG_CAP_FABRIC
+    assert addr not in client._dcn_fabrics
+    rec = [r for r in client.tracer.transfers() if r["op"] == "put"][-1]
+    assert rec["fabric"] == "tcp"
+    client.free(h)
+    client.close()
+
+
 def test_native_lease_reaping(binary, tmp_path):
     ports = free_ports(2)
     nodefile = tmp_path / "nf"

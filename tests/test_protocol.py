@@ -28,6 +28,8 @@ def test_roundtrip_all_schemas():
         "epoch": 9, "inc": (7 << 40) | 1, "reporter": 1, "state": 2,
         "chain": "1,2,0", "dead_ranks": "1", "dead_rank": 1,
         "target_rank": 2,
+        # fabric family (SHM_MAP/SHM_PUT/SHM_GET)
+        "seg": "ocm-fab-1a2b-00112233aabbccdd",
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})
