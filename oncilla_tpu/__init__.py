@@ -38,6 +38,7 @@ from oncilla_tpu.core.errors import (
     OcmConnectError,
     OcmError,
     OcmInvalidHandle,
+    OcmMoved,
     OcmNotPrimary,
     OcmOutOfMemory,
     OcmPlacementError,
@@ -66,6 +67,7 @@ __all__ = [
     "OcmError",
     "OcmInvalidHandle",
     "OcmKind",
+    "OcmMoved",
     "OcmNotPrimary",
     "OcmOutOfMemory",
     "OcmPlacementError",

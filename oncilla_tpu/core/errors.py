@@ -69,6 +69,17 @@ class OcmAdmissionDenied(OcmError):
     concurrent-app cap is reached (wire: ErrCode.ADMISSION_DENIED)."""
 
 
+class OcmMoved(OcmError):
+    """The allocation was live-migrated off this rank (elastic/): the
+    source holds a forwarding tombstone naming the new owner (wire:
+    ErrCode.MOVED, retryable; ``rank`` rides as an i64 data tail on the
+    ERROR frame and clients repoint their handle at it)."""
+
+    def __init__(self, detail: str, rank: int):
+        super().__init__(detail)
+        self.rank = int(rank)
+
+
 class OcmBusy(OcmError):
     """Back-pressure: the arena(s) crossed the high watermark and the
     daemon asks the client to retry later (wire: ErrCode.BUSY, retryable;

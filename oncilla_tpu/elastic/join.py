@@ -1,0 +1,161 @@
+"""Joiner/leaver side of the elastic membership protocol.
+
+:func:`join_cluster` is what a fresh daemon process runs instead of the
+boot-time nodefile path: bind a listener FIRST (peers dialing the freshly
+announced rank queue in the backlog instead of bouncing off a closed
+port), dial rank 0 with REQ_JOIN, and build the daemon from the JOIN_OK
+grant — assigned rank, cluster epoch, and the full member table. The
+request retries with capped backoff: a dropped REQ_JOIN or a lost
+JOIN_OK re-sends idempotently, and rank 0 dedups the (host, port)
+announcement onto the original rank, so a retried join can never leak a
+half-member slot.
+
+:func:`leave_cluster` is the graceful departure: REQ_LEAVE asks rank 0
+to drain everything the leaver holds (migrate primaries out, re-home
+replica copies), and only a COMPLETE drain lets the member depart —
+rank 0 bumps the epoch, broadcasts the shrunk view, and the leaver stops
+serving. A refused drain leaves the member in place; dying instead is
+the *unclean* path and degrades to the DEAD-verdict failover ladder.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+from oncilla_tpu.core.errors import OcmConnectError, OcmError, OcmRemoteError
+from oncilla_tpu.runtime.membership import ClusterView, NodeEntry
+from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.runtime.protocol import Message, MsgType
+from oncilla_tpu.utils.config import OcmConfig
+from oncilla_tpu.utils.debug import printd
+
+
+def join_cluster(
+    rank0_host: str,
+    rank0_port: int,
+    config: OcmConfig | None = None,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    advertise_host: str | None = None,
+    policy: str = "capacity",
+    ndevices: int = 1,
+    snapshot_path: str | None = None,
+    retries: int = 20,
+):
+    """Join a running cluster and return the STARTED joiner daemon.
+
+    The listener binds (and listens) before REQ_JOIN goes out, so the
+    instant rank 0 broadcasts the new member, peer dials land in the
+    backlog and are served the moment :meth:`Daemon.start` runs the
+    accept loop. ``advertise_host`` is the address peers should dial
+    (defaults to the bind host — pass it when binding a wildcard).
+    """
+    from oncilla_tpu.runtime.daemon import Daemon  # cycle: daemon imports elastic
+
+    config = config or OcmConfig()
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        listener.bind((host, port))
+        listener.listen(64)
+        port = listener.getsockname()[1]
+        inc = int.from_bytes(os.urandom(8), "little") or 1
+        req = Message(
+            MsgType.REQ_JOIN,
+            {
+                "host": advertise_host or host,
+                "port": port,
+                "ndevices": ndevices,
+                "device_arena_bytes": config.device_arena_bytes,
+                "host_arena_bytes": config.host_arena_bytes,
+                "inc": inc,
+            },
+        )
+        # A short-lived pool (not a bare socket) so the chaos harness's
+        # lease seam covers the JOIN leg too — a partitioned or dropped
+        # REQ_JOIN retries idempotently, which IS the protocol claim the
+        # smoke proves.
+        pool = PeerPool()
+        try:
+            reply = None
+            for i in range(retries):
+                try:
+                    reply = pool.request(rank0_host, rank0_port, req)
+                    break
+                except (OSError, OcmConnectError) as e:
+                    printd("join: REQ_JOIN attempt %d failed: %s", i, e)
+                    time.sleep(min(0.05 * 2 ** i, 2.0))
+            if reply is None:
+                raise OcmConnectError(
+                    f"rank 0 unreachable at {rank0_host}:{rank0_port} "
+                    f"after {retries} REQ_JOIN attempts"
+                )
+        finally:
+            pool.close()
+        rank = reply.fields["rank"]
+        epoch = reply.fields["epoch"]
+        view = ClusterView([])
+        if not reply.data:
+            raise OcmError("JOIN_OK carried no member table")
+        view.adopt(epoch, bytes(reply.data))
+        if not (0 <= rank < len(view)):
+            raise OcmError(
+                f"JOIN_OK rank {rank} not in the granted member table"
+            )
+        d = Daemon(
+            rank, view, config=config, policy=policy, ndevices=ndevices,
+            host=host, snapshot_path=snapshot_path,
+            incarnation=inc, listener=listener,
+        )
+        listener = None  # owned by the daemon now
+        d._adopt_epoch(epoch)
+        d.start()
+        # The granted view may name members a boot-time constructor never
+        # saw (and departed ones it must not probe).
+        d._reconcile_detector()
+        printd("join: rank %d serving at %s:%d (epoch %d, %d members)",
+               rank, host, port, epoch, view.alive_count())
+        return d
+    finally:
+        if listener is not None:
+            listener.close()
+
+
+def leave_cluster(daemon, retries: int = 3) -> dict:
+    """Gracefully depart: drain-then-drop via rank 0, then stop serving.
+
+    Returns ``{"epoch": ..., "moved": ...}`` from LEAVE_OK. Raises (and
+    leaves the daemon RUNNING) if rank 0 refuses — e.g. the drain could
+    not complete, or this daemon's incarnation no longer matches the
+    member table (a restarted daemon at the same address must re-join
+    before it may leave).
+    """
+    if daemon.rank == 0:
+        raise OcmError("rank 0 (the placement master) cannot leave")
+    r0 = daemon.entries[0]
+    req = Message(
+        MsgType.REQ_LEAVE,
+        {"rank": daemon.rank, "inc": daemon.incarnation},
+    )
+    last: Exception | None = None
+    for i in range(retries):
+        try:
+            reply = daemon.peers.request(r0.connect_host, r0.port, req)
+            break
+        except (OSError, OcmConnectError) as e:
+            # Transport-only retry: a typed refusal (drain incomplete,
+            # stale incarnation) is the caller's problem, not noise.
+            last = e
+            time.sleep(min(0.05 * 2 ** i, 1.0))
+    else:
+        raise OcmRemoteError(
+            0, f"rank 0 unreachable for REQ_LEAVE: {last}"
+        )
+    out = {"epoch": reply.fields["epoch"], "moved": reply.fields["moved"]}
+    printd("leave: rank %d departed at epoch %d (%d extents moved)",
+           daemon.rank, out["epoch"], out["moved"])
+    daemon.stop()
+    return out

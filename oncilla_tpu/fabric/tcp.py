@@ -37,6 +37,7 @@ from oncilla_tpu.runtime.protocol import (
     MsgType,
     RecvScratch,
     recv_msg,
+    remote_error,
     send_msg,
 )
 from oncilla_tpu.utils.config import MAX_CHUNK_BYTES, OcmConfig
@@ -145,7 +146,7 @@ def stripe_put_coalesced(
         pos += n
     r = recv_msg(s)
     if r.type == MsgType.ERROR:
-        raise OcmRemoteError(r.fields["code"], r.fields["detail"])
+        raise remote_error(r)
     if r.type != MsgType.DATA_PUT_OK or r.fields["nbytes"] != length:
         raise OcmProtocolError(
             f"coalesced burst ack mismatch: {r.type.name} "
@@ -227,9 +228,9 @@ def stripe_windowed(
             # Remember the first failure; keep draining replies
             # for chunks already on the wire.
             if failure is None:
-                failure = OcmRemoteError(
-                    r.fields["code"], r.fields["detail"]
-                )
+                # remote_error, not a bare code+detail: a MOVED reply's
+                # rank tail is the redirect the failover ladder follows.
+                failure = remote_error(r)
         elif failure is None:
             if sink is not None and r.data is sink:
                 continue  # payload already landed in place

@@ -94,8 +94,8 @@ def _app_rows(rank: int, st: dict) -> list[list[str]]:
 
 
 def _table(entries) -> int:
-    cols = ["rank", "nodes", "allocs", "live", "ops", "p50_us", "p99_us",
-            "gbit/s", "leases r/x/e", "hb_age_s"]
+    cols = ["rank", "nodes", "members", "allocs", "live", "ops", "p50_us",
+            "p99_us", "gbit/s", "leases r/x/e", "migr ok/ab", "hb_age_s"]
     rows = []
     app_rows: list[list[str]] = []
     any_ok = False
@@ -103,7 +103,7 @@ def _table(entries) -> int:
         st = _poll_status(e)
         if "error" in st:
             rows.append([str(e.rank), "-", "-", "-", "-", "-", "-", "-",
-                         "-", st["error"][:40]])
+                         "-", "-", "-", st["error"][:40]])
             continue
         any_ok = True
         app_rows.extend(_app_rows(e.rank, st))
@@ -115,9 +115,12 @@ def _table(entries) -> int:
         gbps = transfers[-1].get("gbps", 0.0) if transfers else 0.0
         leases = st.get("leases") or {}
         apps = leases.get("apps") or {}
+        ela = st.get("elastic") or {}
+        ec = ela.get("counters") or {}
         rows.append([
             str(st.get("rank", e.rank)),
             str(st.get("nnodes", "-")),
+            str(ela.get("members", "-")),
             str(st.get("live_allocs", 0)),
             _fmt_bytes(st.get("host_bytes_live", 0)
                        + st.get("device_bytes_live", 0)),
@@ -127,6 +130,8 @@ def _table(entries) -> int:
             f"{gbps:.2f}",
             (f"{leases.get('renewals', 0)}/{leases.get('reclaims', 0)}"
              f"/{leases.get('expired', 0)}"),
+            (f"{ec.get('migrations_completed', 0)}"
+             f"/{ec.get('migrations_aborted', 0)}"),
             f"{max(apps.values()):.1f}" if apps else "-",
         ])
     widths = [

@@ -223,6 +223,36 @@ def render(meta: dict) -> str:
                        fc.get(f"shm_{op}_bytes", 0),
                        rank=rank, fabric="shm", op=op)
 
+    ela = meta.get("elastic", {})
+    if ela:
+        doc.sample("ocm_cluster_members", "gauge",
+                   "Members of the cluster view not marked left "
+                   "(elastic membership).",
+                   ela.get("members", 0), rank=rank)
+        ec = ela.get("counters", {})
+        doc.sample("ocm_member_joins_total", "counter",
+                   "REQ_JOIN admissions granted (rank 0 only).",
+                   ec.get("joins", 0), rank=rank)
+        doc.sample("ocm_member_leaves_total", "counter",
+                   "Graceful REQ_LEAVE departures (rank 0 only).",
+                   ec.get("leaves", 0), rank=rank)
+        for outcome in ("completed", "aborted"):
+            doc.sample("ocm_migrations_total", "counter",
+                       "Live extent migrations by outcome, counted at "
+                       "the migration source (aborts are also counted "
+                       "at a target dropping a quarantined copy).",
+                       ec.get(f"migrations_{outcome}", 0),
+                       rank=rank, outcome=outcome)
+        doc.sample("ocm_migration_bytes_total", "counter",
+                   "Bytes whose ownership flipped through completed "
+                   "live migrations.",
+                   ec.get("migration_bytes", 0), rank=rank)
+        doc.sample("ocm_migration_tombstones", "gauge",
+                   "Forwarding tombstones held for live-migrated "
+                   "allocations (pruned once the owning app goes "
+                   "stale).",
+                   ela.get("tombstones", 0), rank=rank)
+
     # The transfer ring is bounded, so ring-derived figures are gauges
     # over the recent window, never counters.
     transfers = meta.get("transfers", [])

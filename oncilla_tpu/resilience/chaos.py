@@ -19,6 +19,15 @@ Fault vocabulary:
                 target rank raises (one-way partition at the seam).
 - ``corrupt_snapshot`` — flip one byte of the target rank's snapshot
                 file (exercises the CRC refusal path on restart).
+- ``join``/``leave``/``migrate`` — elastic-membership fault points
+                (elastic/): fire the harness-bound ``join_fn`` /
+                ``leave_fn(rank)`` / ``migrate_fn`` at a deterministic
+                op index, so a JOIN can land mid-workload, a LEAVE can
+                race live puts, and a migration can start exactly N
+                leases before the kill that aborts it. The callables
+                run inline on the leasing thread (that is what keys
+                them deterministically) and must not require the lease
+                that triggered them.
 
 Faults that need cluster knowledge (kill, partition's rank→port mapping,
 snapshot paths) resolve through the membership ``entries`` list and an
@@ -36,7 +45,8 @@ from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.runtime import pool as _pool
 
-ACTIONS = ("kill", "drop", "delay", "partition", "heal", "corrupt_snapshot")
+ACTIONS = ("kill", "drop", "delay", "partition", "heal", "corrupt_snapshot",
+           "join", "leave", "migrate")
 
 
 @dataclass(frozen=True)
@@ -105,11 +115,18 @@ class ChaosController:
     replay-identity assertion."""
 
     def __init__(self, schedule: ChaosSchedule, entries,
-                 kill_fn=None, snapshot_paths: dict[int, str] | None = None):
+                 kill_fn=None, snapshot_paths: dict[int, str] | None = None,
+                 join_fn=None, leave_fn=None, migrate_fn=None):
         self.schedule = schedule
         self.entries = entries  # live membership list (ports resolve late)
         self.kill_fn = kill_fn
         self.snapshot_paths = snapshot_paths or {}
+        # Elastic-membership fault points (elastic/): bound by the
+        # harness; a schedule naming them without a binding is a no-op
+        # fault (still logged, so replay identity holds either way).
+        self.join_fn = join_fn
+        self.leave_fn = leave_fn
+        self.migrate_fn = migrate_fn
         self.log: list[tuple[int, str, int]] = []
         self._by_op: dict[int, list[Fault]] = {}
         for f in schedule.faults:
@@ -155,6 +172,15 @@ class ChaosController:
                 path = self.snapshot_paths.get(f.rank)
                 if path:
                     corrupt_file(path, seed=self.schedule.seed)
+            elif f.action == "join":
+                if self.join_fn is not None:
+                    self.join_fn()
+            elif f.action == "leave":
+                if self.leave_fn is not None:
+                    self.leave_fn(f.rank)
+            elif f.action == "migrate":
+                if self.migrate_fn is not None:
+                    self.migrate_fn()
         if drop:
             raise OSError(f"chaos: dropped lease to {host}:{port} (op {n})")
         if blocked:

@@ -153,6 +153,25 @@ class FailureDetector:
                 self._states[rank] = PeerState.ALIVE
                 self._fails[rank] = 0
 
+    # -- elastic membership (elastic/) -----------------------------------
+
+    def add_rank(self, rank: int) -> None:
+        """A member JOINed post-boot: start watching it (idempotent —
+        an existing row keeps its state)."""
+        with self._lock:
+            if rank != self.self_rank and rank not in self._states:
+                self._states[rank] = PeerState.ALIVE
+                self._fails[rank] = 0
+
+    def forget(self, rank: int) -> None:
+        """A member LEFT cleanly: stop probing it entirely. Unlike
+        mark_dead, no verdict is implied — a clean departure is not a
+        death and must not be journaled or repaired as one."""
+        with self._lock:
+            self._states.pop(rank, None)
+            self._fails.pop(rank, None)
+            self._incs.pop(rank, None)
+
     # -- queries ---------------------------------------------------------
 
     def state(self, rank: int) -> PeerState:

@@ -86,6 +86,10 @@ class FailoverCoordinator:
         dead = d.detector.dead_ranks() if d.detector else {dead_rank}
         dead.add(dead_rank)
         repair: list[dict] = []
+        # Quarantined inbound migration copies from the dead rank are
+        # dropped BEFORE reconciliation (elastic/): a half-streamed copy
+        # must never be promoted into a chain.
+        d._abort_migrations(dead, epoch)
         promoted, items = d.registry.reconcile_dead(dead, d.rank, epoch)
         d.res_counters["promotions"] += len(promoted)
         for e in promoted:

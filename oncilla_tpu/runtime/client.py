@@ -895,21 +895,78 @@ class ControlPlaneClient:
             raise failures[0]
         return stats
 
+    def _rank_addr(self, rank: int) -> tuple[str, int] | None:
+        """Membership address of ``rank`` — None when the rank postdates
+        this client's view (a member that JOINed after boot; REQ_LOCATE
+        names its address explicitly)."""
+        if 0 <= rank < len(self.entries):
+            e = self.entries[rank]
+            if e.port:
+                return (e.connect_host, e.port)
+        return None
+
     def _failover_candidates(
-        self, handle: OcmAlloc
+        self, handle: OcmAlloc, last_err: BaseException | None = None
     ) -> list[tuple[int, tuple[str, int]]]:
         """Retry ladder for a transfer that can't reach (or is refused
-        by) the cached owner: the membership address of the owner rank
-        first (covers restarts on a new port), then each replica rank in
-        chain order — the first survivor is, by the deterministic
-        promotion rule, the new primary."""
+        by) the cached owner: a live-migration MOVED redirect first (the
+        rejection NAMES the new owner — walking anywhere else is wasted
+        round trips), then the membership address of the owner rank
+        (covers restarts on a new port), then each replica rank in chain
+        order — the first survivor is, by the deterministic promotion
+        rule, the new primary."""
         out = []
-        e = self.entries[handle.rank]
-        out.append((handle.rank, (e.connect_host, e.port)))
+        moved = getattr(last_err, "moved_to_rank", None)
+        if moved is not None:
+            addr = self._rank_addr(moved)
+            if addr is not None:
+                out.append((moved, addr))
+        addr = self._rank_addr(handle.rank)
+        if addr is not None and (handle.rank, addr) not in out:
+            out.append((handle.rank, addr))
         for rr in handle.replica_ranks:
-            if 0 <= rr < len(self.entries) and rr != handle.rank:
-                e = self.entries[rr]
-                out.append((rr, (e.connect_host, e.port)))
+            if rr == handle.rank:
+                continue
+            addr = self._rank_addr(rr)
+            if addr is not None and (rr, addr) not in out:
+                out.append((rr, addr))
+        return out
+
+    def _locate_at(
+        self, addr: tuple[str, int] | None, handle: OcmAlloc
+    ) -> tuple[int, tuple[str, int]] | None:
+        """One REQ_LOCATE against ``addr``: the reply names the current
+        primary's rank AND address explicitly — the only way to reach an
+        owner whose rank postdates this client's boot membership
+        (elastic/)."""
+        if addr is None:
+            return None
+        try:
+            r = self._pool.request(
+                addr[0], addr[1],
+                Message(MsgType.REQ_LOCATE, {"alloc_id": handle.alloc_id}),
+            )
+        except (OSError, OcmError):
+            return None
+        return (r.fields["rank"], (r.fields["host"], r.fields["port"]))
+
+    def _locate_candidates(
+        self, handle: OcmAlloc, last_err: BaseException | None
+    ) -> list[tuple[int, tuple[str, int]]]:
+        """The ladder's locate backstops, in preference order: the
+        daemon that just answered MOVED (its tombstone knows the target,
+        and its live view knows the target's address — essential when
+        the redirect names a rank beyond this client's boot view), then
+        rank 0 (the rebalancer records every flip it drives)."""
+        out = []
+        moved = getattr(last_err, "moved_to_rank", None)
+        if moved is not None and self._rank_addr(moved) is None:
+            loc = self._locate_at(self._owner_addr(handle), handle)
+            if loc is not None:
+                out.append(loc)
+        loc = self._locate_at(self._rank_addr(0), handle)
+        if loc is not None and loc not in out:
+            out.append(loc)
         return out
 
     def _failover_handle(
@@ -925,11 +982,19 @@ class ControlPlaneClient:
             if old == new_rank:
                 handle.owner_addr = addr
                 return
+            was_known = new_rank in handle.replica_ranks
             handle.rank = new_rank
             handle.owner_addr = addr
             handle.replica_ranks = tuple(
                 r for r in handle.replica_ranks if r != new_rank
             )
+        if not was_known:
+            # Live-migration repoint (elastic/): the new owner was never
+            # in the replica chain, so unlike a promoted replica it was
+            # never counted into the heartbeat owner set — count it now
+            # or the migrated copy's lease lapses once the source's
+            # forwarding tombstone goes stale.
+            self._note_owner(new_rank, +1)
         # Fabric re-resolution (fabric/): the owner this handle left is
         # dead or demoted, so its negotiated one-sided fabric — and the
         # capability cache that would hand it back — must go with it.
@@ -947,13 +1012,17 @@ class ControlPlaneClient:
 
     # Retryable wire rejections: a fenced stale owner (STALE_EPOCH), a
     # replica still waiting for its primary's death verdict (NOT_PRIMARY),
-    # and a primary that can't yet honor the replication contract
-    # (REPLICA_UNAVAILABLE). All three are failover-window conditions the
-    # detector resolves within a few probe intervals.
+    # a primary that can't yet honor the replication contract
+    # (REPLICA_UNAVAILABLE), and a live-migration redirect (MOVED — the
+    # error's rank tail names the new owner, which the ladder tries
+    # first). The first three are failover-window conditions the
+    # detector resolves within a few probe intervals; MOVED resolves on
+    # the very next attempt.
     _RETRYABLE_CODES = frozenset({
         int(ErrCode.STALE_EPOCH),
         int(ErrCode.NOT_PRIMARY),
         int(ErrCode.REPLICA_UNAVAILABLE),
+        int(ErrCode.MOVED),
     })
 
     @classmethod
@@ -991,7 +1060,11 @@ class ControlPlaneClient:
             last: BaseException = err
         deadline = time.monotonic() + self.config.failover_wait_s
         while True:
-            for rank_i, cand in self._failover_candidates(handle):
+            cands = self._failover_candidates(handle, last)
+            for loc in self._locate_candidates(handle, last):
+                if loc not in cands:
+                    cands.append(loc)
+            for rank_i, cand in cands:
                 stats["retries"][idx] += 1
                 obs_journal.record(
                     "stripe_retry",

@@ -36,6 +36,7 @@ from oncilla_tpu.core.errors import (
     OcmConnectError,
     OcmError,
     OcmInvalidHandle,
+    OcmMoved,
     OcmOutOfMemory,
     OcmPlacementError,
     OcmNotPrimary,
@@ -47,7 +48,8 @@ from oncilla_tpu.core.errors import (
 from oncilla_tpu import fabric as fabric_mod
 from oncilla_tpu.core.hostmem import HostArena
 from oncilla_tpu.core.kinds import OcmKind
-from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.elastic.rebalance import Rebalancer
+from oncilla_tpu.runtime.membership import NodeEntry, as_view
 from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime.placement import (
     POLICIES,
@@ -74,6 +76,7 @@ from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_TRACE,
     FLAG_FANOUT,
     FLAG_MORE,
+    FLAG_HB_FWD,
     FLAG_QOS_TAIL,
     FLAG_REPLICAS,
     FLAG_TRACE_CTX,
@@ -105,10 +108,17 @@ class Daemon:
         ndevices: int = 1,
         host: str | None = None,
         snapshot_path: str | None = None,
+        incarnation: int | None = None,
+        listener: socket.socket | None = None,
     ):
         self.snapshot_path = snapshot_path
         self.rank = rank
-        self.entries = entries
+        # Membership is a LIVE epoch-stamped table (elastic/): a plain
+        # nodefile list is wrapped, an existing ClusterView is shared
+        # as-is (the LocalCluster idiom — every in-process daemon sees
+        # one table, exactly like the reference's global nodefile, but
+        # mutable under the JOIN/LEAVE protocol).
+        self.entries = as_view(entries)
         self.config = config or OcmConfig()
         self.ndevices = ndevices
         # The control/data plane is unauthenticated (like the reference's,
@@ -216,7 +226,44 @@ class Daemon:
         self.epoch = 0
         self._epoch_lock = make_lock("daemon._epoch_lock")
         self._fenced = False
-        self.incarnation = int.from_bytes(os.urandom(8), "little") or 1
+        self.incarnation = (
+            incarnation or int.from_bytes(os.urandom(8), "little") or 1
+        )
+        # Pre-bound listener (elastic/join_cluster): the joiner binds
+        # and LISTENS before REQ_JOIN so peers reaching for the new rank
+        # queue in the backlog instead of bouncing off a closed port.
+        self._prebound = listener
+        # -- elastic membership (elastic/) -------------------------------
+        # Forwarding tombstones for live-migrated allocations:
+        # alloc_id -> (new owner rank, origin_pid, origin_rank, stamp).
+        # Data ops on a tombstoned id answer typed MOVED (the client
+        # repoints its handle); DO_FREE forwards; heartbeats from the
+        # owning app are forwarded so the migrated copy's lease stays
+        # renewed until the client repoints. Pruned by the reaper once
+        # the app goes stale.
+        self._moved: dict[int, tuple[int, int, int, float]] = {}
+        self._moved_lock = make_lock("daemon._moved_lock")
+        # In-flight outbound migrations (source side): alloc_id ->
+        # {"dirty": [(offset, nbytes)...], "fence": bool}. Client puts
+        # landing mid-stream are recorded for the pre-copy dirty passes;
+        # once fenced, they answer retryable NOT_PRIMARY and the ladder
+        # re-lands them on the target after the flip.
+        self._migrations: dict[int, dict] = {}
+        self._mig_lock = make_lock("daemon._mig_lock")
+        # MEMBER_UPDATE broadcast retry set (rank 0): peers that have
+        # not confirmed the current member table yet; the reaper loop
+        # re-pushes until every live member converges (the
+        # _plane_unsynced pattern).
+        self._member_unsynced: set[int] = set()
+        self._member_sync_lock = make_lock("daemon._member_sync_lock")
+        self.ela_counters = {
+            "joins": 0,                  # rank 0: REQ_JOIN admissions
+            "leaves": 0,                 # rank 0: graceful departures
+            "migrations_started": 0,     # source side
+            "migrations_completed": 0,
+            "migrations_aborted": 0,
+            "migration_bytes": 0,        # bytes whose ownership flipped
+        }
         self.res_counters = {
             "deaths": 0,           # DEAD verdicts issued (rank 0 only)
             "promotions": 0,       # replica entries promoted to primary here
@@ -233,24 +280,34 @@ class Daemon:
             if self.config.detect and len(entries) > 1 else None
         )
         self._failover = FailoverCoordinator(self) if rank == 0 else None
+        self._rebalancer = Rebalancer(self) if rank == 0 else None
         self._last_probe = time.monotonic()
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        # Loopback by default (see __init__); multi-host deployments pass the
-        # nodefile hostname or opt into the wildcard explicitly. Peers dial
-        # the nodefile's addr column, which need not match what the local
-        # resolver maps our own hostname to.
-        self._listener.bind((self.host, self.port))
+        if self._prebound is not None:
+            # elastic join: the socket was bound AND listening before
+            # REQ_JOIN, so peers dialing the freshly announced rank
+            # queue in the backlog until the accept loop drains them.
+            self._listener, self._prebound = self._prebound, None
+        else:
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
+            # Loopback by default (see __init__); multi-host deployments
+            # pass the nodefile hostname or opt into the wildcard
+            # explicitly. Peers dial the nodefile's addr column, which
+            # need not match what the local resolver maps our own
+            # hostname to.
+            self._listener.bind((self.host, self.port))
+            self._listener.listen(64)
         if self.port == 0:  # ephemeral port (tests)
             self.port = self._listener.getsockname()[1]
             self.entries[self.rank] = NodeEntry(
                 self.rank, self.host, self.port, self.entries[self.rank].addr
             )
-        self._listener.listen(64)
         self._running.set()
         # Join the cluster (ADD_NODE resets rank-0 accounting for this node)
         # and restore the snapshot (NOTE_ALLOC resyncs it) BEFORE serving:
@@ -628,6 +685,11 @@ class Daemon:
                     reply = _err(ErrCode.REPLICA_UNAVAILABLE, str(e))
                 except OcmNotPrimary as e:
                     reply = _err(ErrCode.NOT_PRIMARY, str(e))
+                except OcmMoved as e:
+                    # Live-migration redirect: the new owner rank rides
+                    # as an i64 data tail (invisible to old peers).
+                    reply = _err(ErrCode.MOVED, str(e),
+                                 struct.pack("<q", e.rank))
                 except OcmBoundsError as e:
                     reply = _err(ErrCode.BOUNDS, str(e))
                 except OcmInvalidHandle as e:
@@ -645,12 +707,19 @@ class Daemon:
                         if e.code in ErrCode._value2member_map_
                         else ErrCode.UNKNOWN
                     )
-                    reply = _err(
-                        code, e.detail,
-                        struct.pack(
+                    if code == ErrCode.BUSY:
+                        tail = struct.pack(
                             "<I", getattr(e, "retry_after_ms", 0)
-                        ) if code == ErrCode.BUSY else b"",
-                    )
+                        )
+                    elif code == ErrCode.MOVED and hasattr(
+                        e, "moved_to_rank"
+                    ):
+                        # Relayed migration redirects keep their rank
+                        # tail — the redirect is useless without it.
+                        tail = struct.pack("<q", e.moved_to_rank)
+                    else:
+                        tail = b""
+                    reply = _err(code, e.detail, tail)
                 except OcmError as e:
                     reply = _err(ErrCode.UNKNOWN, str(e))
                 except Exception as e:  # noqa: BLE001 — always answer with a
@@ -722,6 +791,13 @@ class Daemon:
                 printd("daemon %d: load feed failed: %s", self.rank, e)
             if self._plane_unsynced:
                 self._sync_plane_endpoint()
+            if self._member_unsynced:
+                try:
+                    self._sync_members()
+                except Exception as e:  # noqa: BLE001 — gossip must never
+                    # kill the reaper; unsynced peers retry next tick
+                    printd("daemon %d: member sync failed: %s", self.rank, e)
+            self._prune_tombstones()
             try:
                 self._detector_tick()
             except Exception as e:  # noqa: BLE001 — liveness must never
@@ -1494,7 +1570,31 @@ class Daemon:
 
     def _do_free_local(self, alloc_id: int) -> None:
         """dealloc_ate analogue (alloc.c:231-282)."""
-        e = self.registry.remove(alloc_id)
+        try:
+            e = self.registry.remove(alloc_id)
+        except OcmInvalidHandle:
+            # Live-migrated away (elastic/): forward the free to the new
+            # owner so a client whose handle never repointed can still
+            # release — and give the ORIGIN quota back here, since the
+            # migration deliberately kept it reserved.
+            with self._moved_lock:
+                rec = self._moved.pop(alloc_id, None)
+            if rec is None:
+                raise
+            target = rec[0]
+            if 0 <= target < len(self.entries):
+                pe = self.entries[target]
+                try:
+                    self._peer_request(
+                        pe.connect_host, pe.port,
+                        Message(MsgType.DO_FREE, {"alloc_id": alloc_id}),
+                    )
+                except (OSError, OcmError):
+                    printd("daemon %d: forwarded free of migrated alloc "
+                           "%d to rank %d failed (lease reaper is the "
+                           "backstop)", self.rank, alloc_id, target)
+            self.qos.release(alloc_id)
+            return
         if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             self.host_arena.free(e.extent)
         else:
@@ -1522,6 +1622,11 @@ class Daemon:
                     pass
             self.device_books[e.device_index].free(e.extent)
         alloctrace.note_free(self._trace_scope, alloc_id)
+        if e.migrating:
+            # Dropping a quarantined migration copy (stream abort): its
+            # bytes were never counted at rank 0 and the tenant's quota
+            # still covers the SOURCE copy — no accounting to move.
+            return
         # Quota give-back when this daemon is ALSO the app's origin (the
         # reaper/eviction/reclaim paths funnel here); no-op otherwise.
         self.qos.release(alloc_id)
@@ -1599,10 +1704,13 @@ class Daemon:
             e = self.registry.lookup(f["alloc_id"])
             if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
                 return None  # device relay needs the payload as a message
-            if not e.is_primary(self.rank) and not msg.flags & FLAG_FANOUT:
-                # Replica holder, client write: the handler may have to
-                # REJECT this (role discipline) — the payload must not
-                # land in the extent before that decision.
+            if (
+                not e.is_primary(self.rank) or e.migrating
+            ) and not msg.flags & FLAG_FANOUT:
+                # Replica holder or quarantined migration copy, client
+                # write: the handler may have to REJECT this (role
+                # discipline) — the payload must not land in the extent
+                # before that decision.
                 return None
             check_bounds(
                 Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"]
@@ -1628,7 +1736,17 @@ class Daemon:
         client write would fork the copies and a read could return bytes
         the primary has already superseded. Primary-originated fan-out
         legs (FLAG_FANOUT) always land."""
-        if e.is_primary(self.rank) or msg.flags & FLAG_FANOUT:
+        if msg.flags & FLAG_FANOUT:
+            return
+        if e.migrating:
+            # Quarantined migration copy (elastic/): only the source's
+            # stream and mirror writes may land until the flip — serving
+            # a client from half-streamed bytes would break exactness.
+            raise OcmNotPrimary(
+                f"rank {self.rank} holds an in-flight migration copy of "
+                f"alloc {e.alloc_id}; retry"
+            )
+        if e.is_primary(self.rank):
             return
         primary = e.chain[0]
         if not self._believed_dead(primary):
@@ -1639,7 +1757,7 @@ class Daemon:
 
     def _on_data_put(self, msg: Message) -> Message:
         f = msg.fields
-        e = self.registry.lookup(f["alloc_id"])
+        e = self._lookup_serving(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
         self._check_data_role(e, msg)
@@ -1656,6 +1774,12 @@ class Daemon:
         # else: payload already recv'd straight into the arena extent by
         # _route_put_payload (which enforced the same role discipline).
         if not msg.flags & FLAG_FANOUT:
+            # Outbound-migration bookkeeping (elastic/), AFTER the local
+            # write so a concurrent dirty flush can never stream stale
+            # bytes and still clear the marker: record the dirty range
+            # for the pre-copy re-stream, or bounce retryably (write
+            # landed but UNACKED) once the flip fence is up.
+            self._note_migration_write(e.alloc_id, f["offset"], f["nbytes"])
             self._fan_out_put(e, f["offset"], f["nbytes"], msg.data)
         return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
 
@@ -1714,7 +1838,7 @@ class Daemon:
 
     def _on_data_get(self, msg: Message) -> Message:
         f = msg.fields
-        e = self.registry.lookup(f["alloc_id"])
+        e = self._lookup_serving(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
         self._check_data_role(e, msg)
@@ -1769,7 +1893,7 @@ class Daemon:
                 f"rank {self.rank} does not serve segment {f['seg']!r} "
                 "(daemon restarted?) — re-negotiate the fabric",
             )
-        e = self.registry.lookup(f["alloc_id"])
+        e = self._lookup_serving(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             raise OcmInvalidHandle(
                 "shm fabric serves host-kind allocations only"
@@ -1798,6 +1922,10 @@ class Daemon:
     def _on_shm_put(self, msg: Message) -> Message:
         f = msg.fields
         e = self._shm_entry(msg)
+        if not msg.flags & FLAG_FANOUT:
+            # Same migration bookkeeping as a framed put: the memcpy
+            # already landed in the segment (unacked if fenced).
+            self._note_migration_write(e.alloc_id, f["offset"], f["nbytes"])
         self.fabric_counters["shm_puts"] += 1
         self.fabric_counters["shm_put_bytes"] += f["nbytes"]
         self.tracer.note_transfer(
@@ -2042,6 +2170,10 @@ class Daemon:
             if 0 <= dr < len(self.entries):
                 e = self.entries[dr]
                 self.peers.evict(e.connect_host, e.port)
+        # Quarantined inbound migration copies whose source just died
+        # are dropped BEFORE reconciliation — a half-streamed copy must
+        # never be promoted into (or repaired onto) a chain.
+        self._abort_migrations(dead, f["epoch"])
         promoted, repair = self.registry.reconcile_dead(
             dead, self.rank, f["epoch"]
         )
@@ -2091,9 +2223,15 @@ class Daemon:
             "chain": csv,
             "epoch": f["epoch"],
         }
+        # The restored copy must inherit the allocation's QoS class —
+        # eviction discipline has to survive repair exactly as it
+        # survives failover (qos/; a default-priority tail is omitted so
+        # default traffic ships unchanged frames).
+        qflags, qtail = _priority_tail(e.priority)
         te = self.entries[target]
         self._peer_request(
-            te.connect_host, te.port, Message(MsgType.DO_REPLICA, prov)
+            te.connect_host, te.port,
+            Message(MsgType.DO_REPLICA, prov, qtail, flags=qflags),
         )
         # Adopt the chain BEFORE streaming so concurrent client puts
         # already fan out to the target; the bulk copy then overwrites
@@ -2137,6 +2275,609 @@ class Daemon:
             {"alloc_id": e.alloc_id, "nbytes": e.nbytes},
         )
 
+    # -- elastic membership + live migration (elastic/) -------------------
+
+    def _ensure_detector(self) -> FailureDetector | None:
+        """Create the failure detector lazily when membership GROWS past
+        one node (a solo seed daemon others join post-boot was built
+        without one — len(entries) was 1 at construction)."""
+        if (
+            self.detector is None
+            and self.config.detect
+            and len(self.entries) > 1
+        ):
+            self.detector = FailureDetector(
+                len(self.entries), self.rank,
+                suspect_after=self.config.suspect_after,
+                dead_after=self.config.dead_after,
+            )
+            for r in self.entries.left_ranks():
+                self.detector.forget(r)
+        return self.detector
+
+    def _reconcile_detector(self) -> None:
+        """Make the detector's watch set match the member table — called
+        after any view adoption. Idempotent, so a shared in-process view
+        that was already mutated by rank 0 still grows THIS daemon's
+        detector."""
+        det = self._ensure_detector()
+        if det is None:
+            return
+        left = self.entries.left_ranks()
+        for e in self.entries:
+            if e.rank == self.rank:
+                continue
+            if e.rank in left:
+                det.forget(e.rank)
+            else:
+                det.add_rank(e.rank)
+
+    def _queue_member_sync(self, defer: tuple[int, ...] = ()) -> None:
+        """Rank 0: (re)arm the member-table broadcast toward every live
+        peer and push once inline; the reaper retries stragglers.
+        ``defer`` skips the INLINE push only (the brand-new joiner is not
+        serving yet — it gets the table in JOIN_OK and the reaper's
+        retry confirms it once its accept loop runs)."""
+        with self._member_sync_lock:
+            self._member_unsynced = {
+                e.rank for e in self.entries
+                if e.rank != self.rank
+                and e.port
+                and not self.entries.has_left(e.rank)
+            }
+        self._sync_members(skip=defer)
+
+    def _sync_members(self, skip: tuple[int, ...] = ()) -> None:
+        with self._member_sync_lock:
+            pending = sorted(self._member_unsynced - set(skip))
+        for r in pending:
+            if self.entries.has_left(r) or self._believed_dead(r):
+                with self._member_sync_lock:
+                    self._member_unsynced.discard(r)
+                continue
+            e = self.entries[r]
+            try:
+                self.peers.request(
+                    e.connect_host, e.port,
+                    Message(
+                        MsgType.MEMBER_UPDATE,
+                        {"epoch": self.entries.epoch},
+                        self.entries.to_wire(),
+                    ),
+                )
+                with self._member_sync_lock:
+                    self._member_unsynced.discard(r)
+            except (OSError, OcmError):
+                pass  # retried on the next reaper tick
+
+    def _on_req_join(self, msg: Message) -> Message:
+        """Admit a fresh daemon (rank 0 only): assign the next rank —
+        or the SAME rank when the address was seen before, so a joiner
+        whose JOIN_OK was lost retries idempotently instead of leaking a
+        half-member slot — bump the epoch, adopt it everywhere."""
+        if self.rank != 0:
+            return _err(ErrCode.NOT_MASTER, "REQ_JOIN sent to non-master")
+        f = msg.fields
+        view = self.entries
+        existing = view.find(f["host"], f["port"])
+        rank = existing if existing is not None else len(view)
+        epoch = self.bump_epoch()
+        view.upsert(NodeEntry(rank, f["host"], f["port"]), epoch=epoch)
+        self.policy.add_node(
+            NodeResources(
+                rank=rank,
+                ndevices=f["ndevices"],
+                device_arena_bytes=f["device_arena_bytes"],
+                host_arena_bytes=f["host_arena_bytes"],
+            )
+        )
+        det = self._ensure_detector()
+        if det is not None:
+            det.add_rank(rank)
+            det.mark_alive(rank)
+            if f["inc"]:
+                det.record_ok(rank, f["inc"])
+        self.ela_counters["joins"] += 1
+        obs_journal.record(
+            "member_join", track=self.tracer.track,
+            rank=rank, host=f["host"], port=f["port"], epoch=epoch,
+            rejoin=existing is not None,
+        )
+        printd("daemon 0: rank %d joined at %s:%d (epoch %d)",
+               rank, f["host"], f["port"], epoch)
+        self._queue_member_sync(defer=(rank,))
+        if self.config.rebalance and self._rebalancer is not None:
+            threading.Thread(
+                target=self._rebalancer.rebalance_safe,
+                kwargs={"settle_s": self.config.heartbeat_s},
+                daemon=True, name=f"ocm-rebalance-e{epoch}",
+            ).start()
+        return Message(
+            MsgType.JOIN_OK,
+            {"rank": rank, "epoch": epoch, "nnodes": self.policy.nnodes},
+            view.to_wire(),
+        )
+
+    def _on_req_leave(self, msg: Message) -> Message:
+        """Graceful departure (rank 0 only): migrate everything off the
+        leaver, THEN bump the epoch and drop it from the view. A drain
+        that cannot complete fails the leave — the member stays, because
+        departing with data aboard is just a slow crash (the unclean
+        path is simply dying, which the DEAD-verdict failover handles)."""
+        if self.rank != 0:
+            return _err(ErrCode.NOT_MASTER, "REQ_LEAVE sent to non-master")
+        f = msg.fields
+        rank = f["rank"]
+        view = self.entries
+        if rank == 0:
+            raise OcmInvalidHandle("rank 0 (the placement master) cannot leave")
+        if not 0 <= rank < len(view) or view.has_left(rank):
+            raise OcmInvalidHandle(f"rank {rank} is not a member")
+        det = self.detector
+        if f["inc"] and det is not None:
+            known = det.incarnation(rank)
+            if known and known != f["inc"]:
+                raise OcmRemoteError(
+                    int(ErrCode.STALE_EPOCH),
+                    f"REQ_LEAVE incarnation {f['inc']:#x} does not match "
+                    f"the serving daemon at rank {rank} ({known:#x})",
+                )
+        # Fence NEW placements off the leaver before moving data, else
+        # the drain chases a moving target.
+        self.policy.mark_dead(rank)
+        try:
+            moved, remaining = (
+                self._rebalancer.drain(rank)
+                if self._rebalancer is not None else (0, 0)
+            )
+            if remaining:
+                raise OcmError(
+                    f"drain of rank {rank} incomplete: {remaining} extents "
+                    "still held — leave refused, member retained"
+                )
+        except BaseException:
+            self.policy.mark_alive(rank)  # leave failed: still a member
+            raise
+        epoch = self.bump_epoch()
+        view.mark_left(rank, epoch=epoch)
+        self.policy.remove_node(rank)
+        if det is not None:
+            det.forget(rank)
+        de = view[rank]
+        self.peers.evict(de.connect_host, de.port)
+        self.ela_counters["leaves"] += 1
+        obs_journal.record(
+            "member_leave", track=self.tracer.track,
+            rank=rank, epoch=epoch, moved=moved,
+        )
+        printd("daemon 0: rank %d left (epoch %d, %d extents moved)",
+               rank, epoch, moved)
+        self._queue_member_sync()
+        return Message(MsgType.LEAVE_OK, {"epoch": epoch, "moved": moved})
+
+    def _on_member_update(self, msg: Message) -> Message:
+        """Adopt rank 0's member-table broadcast (epoch-fenced: stale
+        tables are dropped by ClusterView.adopt)."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        if msg.data:
+            self.entries.adopt(f["epoch"], bytes(msg.data))
+        self._reconcile_detector()
+        return Message(MsgType.MEMBER_OK, {"epoch": self.epoch})
+
+    def _lookup_serving(self, alloc_id: int) -> RegEntry:
+        """Registry lookup for data ops: a live-migrated id answers the
+        typed MOVED redirect (new owner rank rides the error tail)
+        instead of BAD_ALLOC_ID, so clients repoint instead of failing."""
+        try:
+            return self.registry.lookup(alloc_id)
+        except OcmInvalidHandle:
+            with self._moved_lock:
+                rec = self._moved.get(alloc_id)
+            if rec is not None:
+                raise OcmMoved(
+                    f"alloc {alloc_id} was migrated to rank {rec[0]}",
+                    rec[0],
+                ) from None
+            raise
+
+    def _note_moved(self, alloc_id: int, target: int, origin_pid: int,
+                    origin_rank: int) -> None:
+        with self._moved_lock:
+            self._moved[alloc_id] = (
+                target, origin_pid, origin_rank, time.monotonic()
+            )
+
+    def _prune_tombstones(self) -> None:
+        """Drop forwarding tombstones whose app went heartbeat-stale —
+        a live app's beats keep refreshing the stamp (and by then its
+        client has long repointed via MOVED/REQ_LOCATE)."""
+        horizon = self.config.app_stale_leases * self.config.lease_s
+        now = time.monotonic()
+        with self._moved_lock:
+            stale = [
+                a for a, rec in self._moved.items()
+                if now - rec[3] > horizon
+            ]
+            for a in stale:
+                del self._moved[a]
+
+    def _note_migration_write(self, alloc_id: int, offset: int,
+                              nbytes: int) -> None:
+        """Client-write hook while THIS daemon streams the allocation
+        out: record the dirty range for the pre-copy passes, or — once
+        the flip fence is up — refuse retryably so the ladder re-lands
+        the write on the new primary."""
+        with self._mig_lock:
+            st = self._migrations.get(alloc_id)
+            if st is None:
+                return
+            if st["fence"]:
+                raise OcmNotPrimary(
+                    f"alloc {alloc_id} is mid-migration flip on rank "
+                    f"{self.rank}; retry"
+                )
+            st["dirty"].append((offset, nbytes))
+
+    def _on_migrate(self, msg: Message) -> Message:
+        """Move one allocation to ``target_rank`` with zero acked-write
+        loss (the source stays primary throughout the copy):
+
+        1. provision — MIGRATE_BEGIN registers a QUARANTINED copy on the
+           target (refuses client ops; dropped if this daemon dies).
+        2. stream — local-only chain adoption makes every racing client
+           put fan out to the target, then the extent streams over
+           FLAG_FANOUT chunks; dirty ranges written mid-pass re-stream
+           (bounded pre-copy), the residue flushes under a brief fence
+           that bounces writers NOT_PRIMARY (retryable).
+        3. flip — the target (then every surviving replica) adopts the
+           chain with the target primary and the source gone.
+        4. drop-source — the local entry dies; a forwarding tombstone
+           answers MOVED so stale handles repoint.
+
+        Every other holder keeps the OLD chain until the flip, so a
+        source death mid-stream promotes among FULL copies only and the
+        target's quarantined partial is aborted — a chain can never
+        fork onto half-streamed bytes."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        e = self._lookup_serving(f["alloc_id"])
+        if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            raise OcmInvalidHandle("only host-kind allocations migrate")
+        if not e.is_primary(self.rank):
+            raise OcmInvalidHandle(
+                f"rank {self.rank} is not primary for alloc {f['alloc_id']}"
+            )
+        if f["epoch"] < e.epoch:
+            raise OcmRemoteError(
+                int(ErrCode.STALE_EPOCH),
+                f"migration epoch {f['epoch']} predates chain epoch "
+                f"{e.epoch} for alloc {f['alloc_id']}",
+            )
+        target = f["target_rank"]
+        if (
+            not 0 <= target < len(self.entries)
+            or target == self.rank
+            or target in e.chain
+            or self.entries.has_left(target)
+        ):
+            raise OcmInvalidHandle(f"bad migration target {target}")
+        orig_chain = e.chain
+        stream_chain = (*(orig_chain or (self.rank,)), target)
+        epoch = max(e.epoch, f["epoch"])
+        self.ela_counters["migrations_started"] += 1
+        obs_journal.record(
+            "migrate_start", track=self.tracer.track,
+            alloc_id=e.alloc_id, src=self.rank, target=target,
+            nbytes=e.nbytes, epoch=epoch,
+        )
+        te = self.entries[target]
+        qflags, qtail = _priority_tail(e.priority)
+        begin = Message(
+            MsgType.MIGRATE_BEGIN,
+            {
+                "alloc_id": e.alloc_id,
+                "kind": WIRE_KIND[e.kind.value],
+                "nbytes": e.nbytes,
+                "orig_rank": e.origin_rank,
+                "pid": e.origin_pid,
+                "chain": ",".join(str(r) for r in stream_chain),
+                "src_rank": self.rank,
+                "epoch": epoch,
+            },
+            qtail,
+            flags=qflags,
+        )
+        try:
+            self._peer_request(te.connect_host, te.port, begin)
+        except (OSError, OcmError) as exc:
+            self._migrate_abort(e.alloc_id, target, "provision", exc)
+            raise
+        with self._mig_lock:
+            self._migrations[e.alloc_id] = {"dirty": [], "fence": False}
+        try:
+            # Local-only chain adoption: racing puts now fan out to the
+            # target too; every OTHER holder keeps the old chain.
+            self.registry.set_chain(e.alloc_id, stream_chain, epoch)
+            self._migrate_stream(e, te, 0, e.nbytes)
+            # Bounded pre-copy: re-stream ranges dirtied mid-pass.
+            st = self._migrations[e.alloc_id]
+            for _ in range(8):
+                with self._mig_lock:
+                    dirty, st["dirty"] = st["dirty"], []
+                if not dirty:
+                    break
+                for off, n in dirty:
+                    self._migrate_stream(e, te, off, n)
+            # Fence the residue: late writers bounce retryable and land
+            # on the target after the flip.
+            with self._mig_lock:
+                st["fence"] = True
+                dirty = list(st["dirty"])
+            for off, n in dirty:
+                self._migrate_stream(e, te, off, n)
+            # Flip: the target must adopt primaryship; survivors follow.
+            new_chain = (
+                target, *[r for r in orig_chain if r != self.rank]
+            )
+            flip = {
+                "alloc_id": e.alloc_id,
+                "kind": WIRE_KIND[e.kind.value],
+                "nbytes": e.nbytes,
+                "orig_rank": e.origin_rank,
+                "pid": e.origin_pid,
+                "chain": ",".join(str(r) for r in new_chain),
+                "epoch": epoch,
+            }
+            self._peer_request(
+                te.connect_host, te.port,
+                Message(MsgType.DO_REPLICA, dict(flip)),
+            )
+        except (OSError, OcmError) as exc:
+            # Abort: the source stays the (sole) primary under its
+            # ORIGINAL chain; the target's quarantined copy is dropped
+            # best-effort (its quarantine also dies with us).
+            try:
+                self.registry.set_chain(e.alloc_id, orig_chain, epoch)
+            except OcmInvalidHandle:
+                pass  # freed underneath us: nothing to restore
+            with self._mig_lock:
+                self._migrations.pop(e.alloc_id, None)
+            try:
+                self.peers.request(
+                    te.connect_host, te.port,
+                    Message(MsgType.DO_FREE, {"alloc_id": e.alloc_id}),
+                )
+            except (OSError, OcmError):
+                pass
+            self._migrate_abort(e.alloc_id, target, "stream", exc)
+            raise
+        for rr in new_chain[1:]:
+            if rr == self.rank or not 0 <= rr < len(self.entries):
+                continue
+            pe = self.entries[rr]
+            try:
+                self._peer_request(
+                    pe.connect_host, pe.port,
+                    Message(MsgType.DO_REPLICA, dict(flip)),
+                )
+            except (OSError, OcmError):
+                printd("daemon %d: migrate chain push to rank %d failed",
+                       self.rank, rr)
+        # Drop-source + tombstone. Deliberately NOT _do_free_local: the
+        # tenant's quota stays reserved at its origin ledger (the bytes
+        # still exist — they just moved), and placement accounting moves
+        # atomically for both ends at the rank-0 rebalancer. The
+        # tombstone lands BEFORE the registry entry dies so a racing
+        # data op always sees either the live entry or the MOVED
+        # redirect — never a bare BAD_ALLOC_ID window.
+        self._note_moved(e.alloc_id, target, e.origin_pid, e.origin_rank)
+        e2 = self.registry.remove(e.alloc_id)
+        with self._mig_lock:
+            self._migrations.pop(e.alloc_id, None)
+        self.host_arena.free(e2.extent)
+        alloctrace.note_free(self._trace_scope, e.alloc_id)
+        self.ela_counters["migrations_completed"] += 1
+        self.ela_counters["migration_bytes"] += e2.nbytes
+        obs_journal.record(
+            "migrate_flip", track=self.tracer.track,
+            alloc_id=e.alloc_id, src=self.rank, target=target,
+            nbytes=e2.nbytes, chain=list(new_chain), epoch=epoch,
+        )
+        printd("daemon %d: alloc %d migrated to rank %d (%d B)",
+               self.rank, e.alloc_id, target, e2.nbytes)
+        return Message(
+            MsgType.MIGRATE_OK,
+            {"alloc_id": e.alloc_id, "nbytes": e2.nbytes},
+        )
+
+    def _migrate_stream(self, e: RegEntry, te: NodeEntry, offset: int,
+                        nbytes: int) -> None:
+        """Stream [offset, offset+nbytes) of the extent to the target as
+        FLAG_FANOUT chunks (idempotent absolute-offset writes)."""
+        chunk = min(self.config.migrate_chunk_bytes, self.config.chunk_bytes)
+        end = min(offset + nbytes, e.nbytes)
+        view = memoryview(self.host_arena.view(e.extent))
+        pos = offset
+        while pos < end:
+            n = min(chunk, end - pos)
+            self.peers.request(
+                te.connect_host, te.port,
+                Message(
+                    MsgType.DATA_PUT,
+                    {"alloc_id": e.alloc_id, "offset": pos, "nbytes": n},
+                    bytes(view[pos:pos + n]),
+                    flags=FLAG_FANOUT,
+                ),
+            )
+            pos += n
+
+    def _migrate_abort(self, alloc_id: int, target: int, stage: str,
+                       exc: BaseException) -> None:
+        self.ela_counters["migrations_aborted"] += 1
+        obs_journal.record(
+            "migrate_abort", track=self.tracer.track,
+            alloc_id=alloc_id, src=self.rank, target=target, stage=stage,
+            error=f"{type(exc).__name__}: {exc}",
+        )
+        printd("daemon %d: migration of %d to rank %d aborted at %s: %s",
+               self.rank, alloc_id, target, stage, exc)
+
+    def _on_migrate_begin(self, msg: Message) -> Message:
+        """Target side of a migration: provision (or re-adopt) the copy
+        QUARANTINED — only FLAG_FANOUT stream/mirror writes land until
+        the flip's chain rewrite, and the copy is dropped (never
+        promoted) if the source dies mid-stream."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+        if kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            raise OcmInvalidHandle("only host-kind allocations migrate")
+        chain = tuple(_parse_owners(f["chain"]))
+        prio = PRIO_NORMAL
+        if msg.flags & FLAG_QOS_TAIL and len(msg.data) >= 1:
+            prio = min(max(bytes(msg.data[:1])[0], PRIO_LOW), PRIO_HIGH)
+        try:
+            existing = self.registry.lookup(f["alloc_id"])
+        except OcmInvalidHandle:
+            existing = None
+        if existing is not None:
+            if f["epoch"] < existing.epoch:
+                raise OcmRemoteError(
+                    int(ErrCode.STALE_EPOCH),
+                    f"MIGRATE_BEGIN epoch {f['epoch']} predates chain "
+                    f"epoch {existing.epoch}",
+                )
+            self.registry.mark_migrating(
+                f["alloc_id"], chain, f["epoch"], f["src_rank"]
+            )
+            return Message(
+                MsgType.DO_REPLICA_OK,
+                {"alloc_id": f["alloc_id"],
+                 "offset": existing.extent.offset},
+            )
+        extent = self.host_arena.alloc(f["nbytes"])
+        self.registry.insert(
+            RegEntry(
+                alloc_id=f["alloc_id"],
+                kind=kind,
+                rank=self.rank,
+                device_index=0,
+                extent=extent,
+                nbytes=f["nbytes"],
+                origin_rank=f["orig_rank"],
+                origin_pid=f["pid"],
+                lease_expiry=self.registry.new_lease_deadline(),
+                chain=chain,
+                epoch=f["epoch"],
+                priority=prio,
+                migrating=True,
+                migrate_src=f["src_rank"],
+            )
+        )
+        # This rank holds the allocation again: any old forwarding
+        # tombstone (migrated away and now coming back) is obsolete.
+        with self._moved_lock:
+            self._moved.pop(f["alloc_id"], None)
+        alloctrace.note_alloc(
+            self._trace_scope, f["alloc_id"], f["nbytes"], kind.name
+        )
+        return Message(
+            MsgType.DO_REPLICA_OK,
+            {"alloc_id": f["alloc_id"], "offset": extent.offset},
+        )
+
+    def _abort_migrations(self, dead: set[int], epoch: int) -> None:
+        """Drop quarantined inbound copies whose SOURCE died mid-stream
+        (called before reconcile_dead wherever a dead set lands): a
+        half-streamed copy must never be promoted or repaired into a
+        chain. Outbound migrations simply fail their stream and abort
+        at the source's own state machine."""
+        for e in self.registry.abort_migrations(dead):
+            self.host_arena.free(e.extent)
+            alloctrace.note_free(self._trace_scope, e.alloc_id)
+            self.ela_counters["migrations_aborted"] += 1
+            obs_journal.record(
+                "migrate_abort", track=self.tracer.track,
+                alloc_id=e.alloc_id, src=e.migrate_src, target=self.rank,
+                stage="source-died", epoch=epoch,
+            )
+            printd("daemon %d: dropped quarantined migration copy %d "
+                   "(source rank %d died)", self.rank, e.alloc_id,
+                   e.migrate_src)
+
+    def _extent_rows(self) -> list[dict]:
+        """Host-kind inventory for the rebalancer (REQ_EXTENTS)."""
+        rows = []
+        for e in self.registry.snapshot():
+            if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+                continue
+            rows.append({
+                "id": e.alloc_id,
+                "kind": WIRE_KIND[e.kind.value],
+                "nbytes": e.nbytes,
+                "chain": list(e.chain),
+                "primary": e.is_primary(self.rank),
+                "prio": e.priority,
+                "origin_rank": e.origin_rank,
+                "origin_pid": e.origin_pid,
+                "migrating": e.migrating,
+            })
+        rows.sort(key=lambda r: r["id"])
+        return rows
+
+    def _on_req_extents(self, msg: Message) -> Message:
+        import json
+
+        rows = self._extent_rows()
+        return Message(
+            MsgType.EXTENTS_OK,
+            {"rank": self.rank, "count": len(rows)},
+            json.dumps(rows, separators=(",", ":")).encode(),
+        )
+
+    def _on_req_locate(self, msg: Message) -> Message:
+        """Where does this allocation live NOW? Answered from the local
+        registry (chain head) or the forwarding tombstones — at rank 0
+        the rebalancer records every flip, so this is the client
+        ladder's backstop once a migration source departed entirely."""
+        aid = msg.fields["alloc_id"]
+        rank = None
+        chain: tuple[int, ...] = ()
+        try:
+            e = self.registry.lookup(aid)
+            rank = e.chain[0] if e.chain else self.rank
+            chain = e.chain
+        except OcmInvalidHandle:
+            with self._moved_lock:
+                rec = self._moved.get(aid)
+            if rec is not None:
+                rank = rec[0]
+        if rank is None or not 0 <= rank < len(self.entries):
+            raise OcmInvalidHandle(f"unknown alloc_id {aid}")
+        e2 = self.entries[rank]
+        return Message(
+            MsgType.LOCATE_OK,
+            {
+                "alloc_id": aid,
+                "rank": rank,
+                "host": e2.connect_host,
+                "port": e2.port,
+                "chain": ",".join(str(r) for r in chain),
+            },
+        )
+
+    def _elastic_meta(self) -> dict:
+        """Membership/migration state for STATUS, STATUS_PROM and the
+        obs cluster table."""
+        return {
+            "members": self.entries.alive_count(),
+            "left": sorted(self.entries.left_ranks()),
+            "view_epoch": self.entries.epoch,
+            "counters": dict(self.ela_counters),
+            "tombstones": len(self._moved),
+        }
+
     # -- liveness --------------------------------------------------------
 
     def _on_heartbeat(self, msg: Message) -> Message:
@@ -2152,18 +2893,55 @@ class Daemon:
             app_pid=f["pid"], app_rank=f["rank"],
             relayed=f["rank"] != self.rank,
         )
+        if msg.flags & FLAG_HB_FWD:
+            # A tombstone-forwarded beat is TERMINAL: renew (done above)
+            # and stop. Re-relaying it would loop — the origin's relay
+            # branch fires on f["rank"] == its own rank no matter how
+            # the beat got there, and two swapped migrations would
+            # ping-pong a forward between their sources forever.
+            return Message(
+                MsgType.HEARTBEAT_OK, {"lease_s": self.registry.lease_s}
+            )
+        relayed_to: set[int] = set()
         if f["rank"] == self.rank:
             # Relay only to the ranks the app says own its allocations —
             # O(owners) per beat, not an O(nnodes) broadcast per app.
             for r in _parse_owners(f.get("owners", "")):
                 if r == self.rank or not 0 <= r < len(self.entries):
                     continue
+                relayed_to.add(r)
                 e = self.entries[r]
                 try:
                     self._peer_request(e.connect_host, e.port, msg)
                 except (OSError, OcmConnectError):
                     printd("daemon %d: heartbeat relay to %d failed",
                            self.rank, e.rank)
+        # Forward the beat along live-migration tombstones (elastic/):
+        # until the app's client repoints its handle, its owners list
+        # still names THIS rank — the migrated copy's lease would lapse
+        # without the forward. Touching the stamp keeps the tombstone
+        # alive exactly as long as the app is. Never toward the app's
+        # ORIGIN rank (it renews from the app's direct beats), and the
+        # forward is flagged so the receiver cannot relay it onward.
+        fwd: set[int] = set()
+        now = time.monotonic()
+        with self._moved_lock:
+            for aid, rec in self._moved.items():
+                if (rec[1], rec[2]) == (f["pid"], f["rank"]):
+                    self._moved[aid] = (rec[0], rec[1], rec[2], now)
+                    fwd.add(rec[0])
+        for r in fwd - relayed_to - {self.rank, f["rank"]}:
+            if not 0 <= r < len(self.entries):
+                continue
+            e = self.entries[r]
+            try:
+                self._peer_request(
+                    e.connect_host, e.port,
+                    Message(MsgType.HEARTBEAT, dict(f), flags=FLAG_HB_FWD),
+                )
+            except (OSError, OcmConnectError):
+                printd("daemon %d: migrated-lease heartbeat forward to %d "
+                       "failed", self.rank, r)
         return Message(MsgType.HEARTBEAT_OK, {"lease_s": self.registry.lease_s})
 
     def _on_status(self, msg: Message) -> Message:
@@ -2185,6 +2963,7 @@ class Daemon:
             "resilience": self._resilience_meta(),
             "qos": self._qos_meta(),
             "fabric": self._fabric_meta(),
+            "elastic": self._elastic_meta(),
         }
         return Message(
             MsgType.STATUS_OK,
@@ -2252,6 +3031,7 @@ class Daemon:
             "resilience": self._resilience_meta(),
             "qos": self._qos_meta(),
             "fabric": self._fabric_meta(),
+            "elastic": self._elastic_meta(),
         }
 
     def _on_status_prom(self, msg: Message) -> Message:
@@ -2273,6 +3053,15 @@ class Daemon:
 
 def _err(code: ErrCode, detail: str, data: bytes = b"") -> Message:
     return Message(MsgType.ERROR, {"code": int(code), "detail": detail}, data)
+
+
+def _priority_tail(priority: int) -> tuple[int, bytes]:
+    """(flags, data tail) carrying a NON-default QoS priority on a
+    provision leg (DO_REPLICA / MIGRATE_BEGIN); default-class traffic
+    ships unchanged frames so the unreplicated wire stays byte-exact."""
+    if priority == PRIO_NORMAL:
+        return 0, b""
+    return FLAG_QOS_TAIL, bytes([priority])
 
 
 def _parse_owners(s: str) -> list[int]:
@@ -2362,12 +3151,17 @@ _FLAGS_HANDLED = {
     MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL,
     MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
     MsgType.DO_REPLICA: FLAG_QOS_TAIL,
+    # FLAG_QOS_TAIL: the migrated copy inherits the allocation's QoS
+    # class — parsed in _on_migrate_begin (elastic/).
+    MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL,
     MsgType.REQ_FREE: FLAG_TRACE_CTX,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
     MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
     MsgType.NOTE_FREE: FLAG_TRACE_CTX,
-    MsgType.HEARTBEAT: FLAG_TRACE_CTX,
+    # FLAG_HB_FWD: a tombstone-forwarded beat is renewed but never
+    # re-relayed (elastic/; the loop-prevention contract).
+    MsgType.HEARTBEAT: FLAG_TRACE_CTX | FLAG_HB_FWD,
     MsgType.STATUS: FLAG_TRACE_CTX,
     MsgType.STATUS_PROM: FLAG_TRACE_CTX,
     MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
@@ -2390,6 +3184,12 @@ _FENCED_REJECT = frozenset({
     MsgType.RE_REPLICATE,
     MsgType.DATA_PUT,
     MsgType.DATA_GET,
+    # A fenced daemon must neither drive membership nor move extents:
+    # its verdicts were superseded by a newer epoch (elastic/).
+    MsgType.REQ_JOIN,
+    MsgType.REQ_LEAVE,
+    MsgType.MIGRATE,
+    MsgType.MIGRATE_BEGIN,
     # The shm fabric's control legs are data ops: a fenced daemon must
     # refuse to bless a segment write OR hand out a mapping — the
     # STALE_EPOCH reply is what sends the client down its failover
@@ -2429,6 +3229,13 @@ _HANDLERS = {
     MsgType.DO_REPLICA: Daemon._on_do_replica,
     MsgType.PROMOTE: Daemon._on_promote,
     MsgType.RE_REPLICATE: Daemon._on_re_replicate,
+    MsgType.REQ_JOIN: Daemon._on_req_join,
+    MsgType.REQ_LEAVE: Daemon._on_req_leave,
+    MsgType.MEMBER_UPDATE: Daemon._on_member_update,
+    MsgType.MIGRATE: Daemon._on_migrate,
+    MsgType.MIGRATE_BEGIN: Daemon._on_migrate_begin,
+    MsgType.REQ_LOCATE: Daemon._on_req_locate,
+    MsgType.REQ_EXTENTS: Daemon._on_req_extents,
 }
 
 if __name__ == "__main__":

@@ -633,6 +633,17 @@ class Daemon {
       Message msg;
       try {
         msg = recv_msg(fd, &scratch);
+      } catch (const UnknownMsgError& e) {
+        // A type this build predates (elastic membership & co): the
+        // frame was fully consumed, the stream is in sync — decline
+        // the family with a typed BAD_MSG and keep serving, exactly
+        // how an un-upgraded v2 Python peer answers.
+        try {
+          send_msg(fd, err(ErrCode::BAD_MSG, e.what()));
+        } catch (const ProtocolError&) {
+          break;
+        }
+        continue;
       } catch (const ProtocolError& e) {
         // Clean close at a frame boundary is normal; anything else —
         // malformed wire input, truncation, a reset from a crashed peer —

@@ -103,7 +103,9 @@ uint64_t get_le(const uint8_t* p, int nbytes) {
 
 const std::vector<Field>& schema(MsgType t) {
   auto it = schemas().find(t);
-  if (it == schemas().end()) throw ProtocolError("no schema for message type");
+  if (it == schemas().end())
+    throw UnknownMsgError("no schema for message type " +
+                          std::to_string(unsigned(t)));
   return it->second;
 }
 

@@ -87,6 +87,15 @@ struct ProtocolError : std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
+// A well-framed message of a TYPE this build predates (e.g. the elastic
+// membership family): the payload was fully consumed, so the stream is
+// still in sync — the serve loop answers a typed BAD_MSG and keeps the
+// connection, which is how this daemon declines whole message families
+// by silence.
+struct UnknownMsgError : ProtocolError {
+  using ProtocolError::ProtocolError;
+};
+
 // A field value: integers (stored as u64 two's complement), doubles, strings.
 struct Value {
   enum class Tag { I64, U64, F64, STR } tag = Tag::U64;

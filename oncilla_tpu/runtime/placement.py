@@ -73,6 +73,31 @@ class PlacementPolicy:
         with self._lock:
             self._dead.discard(rank)
 
+    def remove_node(self, rank: int) -> None:
+        """A member LEFT cleanly (elastic/): drop its resources from the
+        table entirely — unlike mark_dead, a departed rank must not
+        count toward capacity queries or ever rejoin implicitly."""
+        with self._lock:
+            self._nodes.pop(rank, None)
+            self._dead.discard(rank)
+
+    def host_free(self) -> dict[int, int]:
+        """Free host-arena bytes per alive rank — what the rebalancer's
+        capacity-weighted planner sites migrations against."""
+        with self._lock:
+            return {
+                r: n.host_arena_bytes - n.host_used
+                for r, n in self._nodes.items() if r not in self._dead
+            }
+
+    def host_capacities(self) -> dict[int, int]:
+        """Host-arena capacity per alive rank (the rebalance weights)."""
+        with self._lock:
+            return {
+                r: n.host_arena_bytes
+                for r, n in self._nodes.items() if r not in self._dead
+            }
+
     @property
     def nnodes(self) -> int:
         with self._lock:
@@ -160,6 +185,8 @@ class NeighborRoundRobin(PlacementPolicy):
         replicas: int = 1,
         exclude: tuple[int, ...] = (),
     ) -> Placement:
+        import bisect
+
         with self._lock:
             n = len(self._nodes)
             if n == 0:
@@ -173,20 +200,23 @@ class NeighborRoundRobin(PlacementPolicy):
                 )
                 return Placement(rank=orig_rank, device_index=0, kind=kind)
             barred = self._dead | set(exclude)
-            rank = (orig_rank + 1) % n
-            for _ in range(n):
-                if rank not in barred:
-                    break
-                rank = (rank + 1) % n
-            else:
+            # Walk the LIVE rank set cyclically from the neighbor slot.
+            # Ranks need not be contiguous once members JOIN/LEAVE
+            # post-boot (elastic/): a departed rank keeps its number but
+            # leaves the table, so the reference's (orig+1) % nnodes
+            # arithmetic generalizes to "next registered rank after
+            # orig_rank, wrapping" — identical on a contiguous table.
+            ranks = sorted(self._nodes)
+            start = (orig_rank + 1) % (max(ranks) + 1)
+            i0 = bisect.bisect_left(ranks, start) % n
+            order = ranks[i0:] + ranks[:i0]
+            cands = [r for r in order if r not in barred]
+            if not cands:
                 raise OcmPlacementError("no eligible node (all dead/excluded)")
+            rank = cands[0]
             reps: list[int] = []
             if replicas > 1:
-                r = (rank + 1) % n
-                while len(reps) < replicas - 1 and r != rank:
-                    if r not in barred and r != rank:
-                        reps.append(r)
-                    r = (r + 1) % n
+                reps = cands[1:replicas]
             if kind == OcmKind.REMOTE_HOST:
                 return Placement(rank=rank, device_index=0, kind=kind,
                                  replica_ranks=tuple(reps))

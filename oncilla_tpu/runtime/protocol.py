@@ -110,6 +110,14 @@ FLAG_QOS_TAIL = 0x0100
 # OCM_FABRIC unset/"tcp" the bit is never offered, so the default wire
 # is byte-for-byte the pre-fabric protocol.
 FLAG_CAP_FABRIC = 0x0200
+# FLAG_HB_FWD marks a HEARTBEAT forwarded along a live-migration
+# tombstone (elastic/): the receiver renews leases but must NEVER
+# relay or re-forward it — the origin's relay branch triggering on a
+# forwarded beat would loop (origin -> owner -> tombstone-forward ->
+# origin -> ...), and two swapped migrations would ping-pong forever.
+# With no migrations there are no tombstones and the bit never rides,
+# so the static-membership heartbeat stays byte-identical.
+FLAG_HB_FWD = 0x0400
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -203,6 +211,25 @@ class MsgType(enum.IntEnum):
     SHM_MAP_OK = 73         # owner -> client: (ext_offset, ext_nbytes)
     SHM_PUT = 74            # "I wrote [off,off+n) via the segment": validate+ack
     SHM_GET = 75            # "may I read [off,off+n)?": validate before copy
+    # elastic membership + live migration (elastic/). All new types: a
+    # v2 peer that predates them answers a typed BAD_MSG ERROR (how the
+    # native C++ daemon declines the whole family by silence), and with
+    # no JOIN/LEAVE traffic none of them ever rides the wire — the
+    # static-membership protocol stays byte-for-byte PR-7.
+    REQ_JOIN = 76           # fresh daemon -> rank 0: admit me (addr+capacity)
+    JOIN_OK = 77            # rank 0 -> joiner: (rank, epoch) + member table
+    REQ_LEAVE = 78          # member -> rank 0: drain me, then drop me
+    LEAVE_OK = 79           # rank 0 -> leaver: (epoch, extents moved off)
+    MEMBER_UPDATE = 80      # rank 0 -> all: epoch bump + full member table
+    MEMBER_OK = 81
+    MIGRATE = 82            # rank 0 -> source primary: move alloc to target
+    MIGRATE_OK = 83
+    MIGRATE_BEGIN = 84      # source -> target: provision a QUARANTINED copy
+    #                       (reply: DO_REPLICA_OK — same provision contract)
+    REQ_LOCATE = 85         # client -> rank 0: where does alloc_id live NOW?
+    LOCATE_OK = 86
+    REQ_EXTENTS = 87        # rank 0 -> member: your host-kind inventory
+    EXTENTS_OK = 88
     # failure
     ERROR = 99
 
@@ -237,12 +264,16 @@ VALID_FLAGS.update({
     MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL,
     MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
     MsgType.DO_REPLICA: FLAG_QOS_TAIL,
+    # A migration-provisioned copy inherits the allocation's QoS class
+    # (elastic/): non-default priorities ride the same u8 tail DO_REPLICA
+    # carries; default-class migrations ship unchanged frames.
+    MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL,
     MsgType.REQ_FREE: FLAG_TRACE_CTX,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
     MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
     MsgType.NOTE_FREE: FLAG_TRACE_CTX,
-    MsgType.HEARTBEAT: FLAG_TRACE_CTX,
+    MsgType.HEARTBEAT: FLAG_TRACE_CTX | FLAG_HB_FWD,
     MsgType.STATUS: FLAG_TRACE_CTX,
     MsgType.STATUS_PROM: FLAG_TRACE_CTX,
     MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
@@ -491,6 +522,65 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
         ("nbytes", "Q"),
         ("seg", "s"),
     ],
+    # Elastic membership (elastic/). REQ_JOIN announces the joiner's
+    # peer-reachable address, capacities and incarnation (the same
+    # triple ADD_NODE carries, plus "inc" so rank 0 can tell a restarted
+    # daemon on a reused address from a duplicate). JOIN_OK and
+    # MEMBER_UPDATE carry the full epoch-stamped member table as a JSON
+    # data tail (membership.ClusterView.to_wire) — a table, not fixed
+    # fields, because the row count changes by definition.
+    MsgType.REQ_JOIN: [
+        ("host", "s"),
+        ("port", "I"),
+        ("ndevices", "I"),
+        ("device_arena_bytes", "Q"),
+        ("host_arena_bytes", "Q"),
+        ("inc", "Q"),
+    ],
+    MsgType.JOIN_OK: [("rank", "q"), ("epoch", "Q"), ("nnodes", "q")],
+    MsgType.REQ_LEAVE: [("rank", "q"), ("inc", "Q")],
+    MsgType.LEAVE_OK: [("epoch", "Q"), ("moved", "Q")],
+    MsgType.MEMBER_UPDATE: [("epoch", "Q")],
+    MsgType.MEMBER_OK: [("epoch", "Q")],
+    # Live migration: rank 0's rebalancer drives MIGRATE at the source
+    # primary, which runs the provision -> stream -> flip -> drop-source
+    # state machine (daemon._on_migrate). MIGRATE_BEGIN provisions the
+    # target's copy QUARANTINED (refuses client ops, aborted if the
+    # source dies mid-stream) under the source's chain + itself;
+    # "src_rank" is the abort key. Replies with DO_REPLICA_OK.
+    MsgType.MIGRATE: [
+        ("alloc_id", "Q"),
+        ("target_rank", "q"),
+        ("epoch", "Q"),
+    ],
+    MsgType.MIGRATE_OK: [("alloc_id", "Q"), ("nbytes", "Q")],
+    MsgType.MIGRATE_BEGIN: [
+        ("alloc_id", "Q"),
+        ("kind", "B"),
+        ("nbytes", "Q"),
+        ("orig_rank", "q"),
+        ("pid", "q"),
+        ("chain", "s"),
+        ("src_rank", "q"),
+        ("epoch", "Q"),
+    ],
+    # Handle re-resolution: a client whose ladder dead-ends (owner
+    # migrated away, maybe departed entirely) asks rank 0 where the
+    # allocation lives now. The reply names the primary's address
+    # explicitly — the rank may postdate the client's boot membership.
+    MsgType.REQ_LOCATE: [("alloc_id", "Q")],
+    MsgType.LOCATE_OK: [
+        ("alloc_id", "Q"),
+        ("rank", "q"),
+        ("host", "s"),
+        ("port", "I"),
+        ("chain", "s"),
+    ],
+    # Rebalancer inventory: the member's host-kind registry entries as a
+    # JSON data tail (id, nbytes, chain, priority, origin) — what the
+    # capacity-weighted planner and the LEAVE drain walk.
+    MsgType.REQ_EXTENTS: [],
+    MsgType.EXTENTS_OK: [("rank", "q"), ("count", "Q")],
     MsgType.ERROR: [("code", "I"), ("detail", "s")],
 }
 
@@ -531,6 +621,12 @@ class ErrCode(enum.IntEnum):
     # in milliseconds, which request() surfaces as
     # OcmRemoteError.retry_after_ms.
     BUSY = 12
+    # Live migration (elastic/): the allocation was migrated off this
+    # rank; the data tail carries the new owner rank as an i64, which
+    # request() surfaces as OcmRemoteError.moved_to_rank. Retryable by
+    # definition — the client repoints its handle at the named rank and
+    # re-runs, exactly the failover-ladder contract.
+    MOVED = 13
 
 
 def _pack_prefix(msg: Message) -> bytes:
@@ -793,6 +889,30 @@ def recv_msg(
     return unpack(header, payload)
 
 
+def remote_error(reply: Message) -> OcmRemoteError:
+    """Build the typed OcmRemoteError for an ERROR reply, including the
+    code-specific data tails — a BUSY retry hint (u32 ms) and a MOVED
+    live-migration redirect (i64 new owner rank). EVERY path that turns
+    an ERROR frame into an exception must come through here: an error
+    built from code+detail alone silently drops the redirect, and the
+    client ladder then spins on the old owner instead of following it."""
+    code = reply.fields["code"]
+    detail = reply.fields["detail"]
+    if code in ErrCode._value2member_map_:
+        detail = f"{ErrCode(code).name}: {detail}"
+    err = OcmRemoteError(code, detail)
+    # A BUSY rejection carries the server-suggested backoff as a u32
+    # (milliseconds) data tail — the retry hint back-pressured clients
+    # honor (qos/). A MOVED rejection names the new owner rank as an
+    # i64 tail (elastic/). Other codes never carry one; a short or
+    # absent tail just means "no hint".
+    if code == int(ErrCode.BUSY) and len(reply.data) >= 4:
+        (err.retry_after_ms,) = struct.unpack_from("<I", reply.data, 0)
+    if code == int(ErrCode.MOVED) and len(reply.data) >= 8:
+        (err.moved_to_rank,) = struct.unpack_from("<q", reply.data, 0)
+    return err
+
+
 def request(sock: socket.socket, msg: Message) -> Message:
     """Send and await the reply (``send_recv_msg`` analogue, mem.c:63-88).
     An ERROR reply raises :class:`OcmRemoteError` — the connection stays in
@@ -800,15 +920,5 @@ def request(sock: socket.socket, msg: Message) -> Message:
     send_msg(sock, msg)
     reply = recv_msg(sock)
     if reply.type == MsgType.ERROR:
-        err = OcmRemoteError(
-            reply.fields["code"],
-            f"{ErrCode(reply.fields['code']).name}: {reply.fields['detail']}",
-        )
-        # A BUSY rejection carries the server-suggested backoff as a u32
-        # (milliseconds) data tail — the retry hint back-pressured
-        # clients honor (qos/). Other codes never carry one; a short or
-        # absent tail just means "no hint".
-        if reply.fields["code"] == int(ErrCode.BUSY) and len(reply.data) >= 4:
-            (err.retry_after_ms,) = struct.unpack_from("<I", reply.data, 0)
-        raise err
+        raise remote_error(reply)
     return reply

@@ -46,6 +46,13 @@ class RegEntry:
     # touches an ACTIVE entry above class 0. Snapshot-restored entries
     # come back at the default (the snapshot format predates priorities).
     priority: int = 1
+    # Live migration quarantine (elastic/): a MIGRATE_BEGIN-provisioned
+    # copy is ``migrating`` until the flip's chain rewrite lands — it
+    # refuses client ops (only FLAG_FANOUT stream/mirror writes land)
+    # and is DROPPED, not promoted, if ``migrate_src`` dies mid-stream:
+    # a half-streamed copy must never serve or fork a chain.
+    migrating: bool = False
+    migrate_src: int = -1
 
     def is_primary(self, self_rank: int) -> bool:
         """Primary = unreplicated owner, or first member of the chain."""
@@ -160,13 +167,48 @@ class AllocRegistry:
 
     def set_chain(self, alloc_id: int, chain: tuple[int, ...],
                   epoch: int) -> None:
-        """Record (or rewrite) an allocation's replica chain."""
+        """Record (or rewrite) an allocation's replica chain. A chain
+        rewrite clears migration quarantine: the flip's DO_REPLICA push
+        is the only rewrite a quarantined copy ever sees while its
+        source lives (a dead source goes through abort_migrations
+        BEFORE any reconcile touches chains)."""
         with self._lock:
             e = self._entries.get(alloc_id)
             if e is None:
                 raise OcmInvalidHandle(f"unknown alloc_id {alloc_id}")
             e.chain = tuple(chain)
             e.epoch = epoch
+            e.migrating = False
+            e.migrate_src = -1
+
+    def mark_migrating(self, alloc_id: int, chain: tuple[int, ...],
+                       epoch: int, src: int) -> None:
+        """Re-quarantine an existing entry as an in-flight migration
+        copy (a retried MIGRATE_BEGIN after a lost reply): chain, epoch
+        and quarantine state set under one lock."""
+        with self._lock:
+            e = self._entries.get(alloc_id)
+            if e is None:
+                raise OcmInvalidHandle(f"unknown alloc_id {alloc_id}")
+            e.chain = tuple(chain)
+            e.epoch = epoch
+            e.migrating = True
+            e.migrate_src = src
+
+    def abort_migrations(self, dead: set[int]) -> list[RegEntry]:
+        """Drop quarantined migration copies whose source rank died
+        mid-stream (elastic/): a half-streamed copy must never be
+        promoted or repaired into a chain. Returns the removed entries
+        so the daemon can free their arena extents and journal the
+        aborts. MUST run before reconcile_dead for the same dead set."""
+        with self._lock:
+            doomed = [
+                e for e in self._entries.values()
+                if e.migrating and e.migrate_src in dead
+            ]
+            for e in doomed:
+                del self._entries[e.alloc_id]
+        return doomed
 
     def reconcile_dead(
         self, dead: set[int], self_rank: int, epoch: int
@@ -193,6 +235,11 @@ class AllocRegistry:
                 e.chain = alive
                 e.epoch = epoch
                 if alive[0] != self_rank:
+                    continue
+                if e.migrating:
+                    # A quarantined migration copy is never promoted —
+                    # abort_migrations (run first) drops it when its
+                    # source died; this guard covers any other ordering.
                     continue
                 if not was_primary:
                     promoted.append(e)

@@ -205,6 +205,23 @@ class OcmConfig:
         default_factory=lambda: _env_int("OCM_PROBE_TIMEOUT_MS", 1000) / 1e3
     )
 
+    # Elastic membership (elastic/): OCM_REBALANCE=1 makes rank 0 kick a
+    # background capacity-weighted rebalance after every JOIN (LEAVE
+    # always drains regardless — a graceful departure without moving the
+    # data would just be a slow crash). Off by default: moving tenant
+    # bytes on membership change is an operator policy, not a given.
+    rebalance: bool = field(
+        default_factory=lambda: bool(_env_int("OCM_REBALANCE", 0))
+    )
+    # Chunk size of the migration stream (provision -> FLAG_FANOUT chunk
+    # stream -> flip). Smaller than the DCN transfer chunk by default:
+    # migration shares the source daemon's serve capacity with live
+    # traffic, and finer chunks keep the racing-put fencing windows
+    # short.
+    migrate_chunk_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_MIGRATE_CHUNK", 1 << 20)
+    )
+
     # Client CONNECT retry: a daemon restarting mid-failover refuses
     # connections for a beat; the app-side client retries with capped
     # exponential backoff + jitter instead of surfacing a hard connect
@@ -303,6 +320,12 @@ class OcmConfig:
                 f"fabric must be 'tcp', 'shm' or 'auto' (got "
                 f"{self.fabric!r}); 'tcp' is the framed-TCP engine with "
                 "no negotiation, 'shm'/'auto' negotiate per peer pair"
+            )
+        if not 0 < self.migrate_chunk_bytes <= MAX_CHUNK_BYTES:
+            raise ValueError(
+                f"migrate_chunk_bytes must be in (0, {MAX_CHUNK_BYTES}] "
+                f"(got {self.migrate_chunk_bytes}) — same wire-frame bound "
+                "as chunk_bytes"
             )
         if self.fabric_shm_min_bytes < 0:
             raise ValueError(

@@ -60,6 +60,14 @@ echo "== qos smoke =="
 # a chaos daemon kill mid-soak, and a drained alloctrace ledger.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.qos --soak --smoke || fail=1
 
+echo "== elastic smoke =="
+# Elastic membership proof, seeded so the chaos interleavings replay
+# identically in CI: kill-owner-mid-migration (never forks a chain),
+# joiner partitioned mid-JOIN (converges, no half-member), and a full
+# join -> rebalance -> leave cycle with byte-exact gets and a drained
+# alloctrace ledger on every rank.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.elastic --smoke || fail=1
+
 echo "== chaos smoke =="
 # Kill-the-owner failover proof: OCM_REPLICAS=2 on a 3-daemon in-process
 # cluster, seeded chaos kills the owner mid-workload; every subsequent

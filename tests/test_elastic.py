@@ -1,0 +1,519 @@
+"""Elastic membership (elastic/): epoch-fenced JOIN/LEAVE, live extent
+migration, the capacity-weighted rebalancer — plus the wire-compat
+discipline: with no JOIN/LEAVE traffic the protocol stays byte-for-byte
+the PR-7 static-membership wire."""
+
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.errors import OcmError, OcmMoved, OcmRemoteError
+from oncilla_tpu.elastic.join import join_cluster, leave_cluster
+from oncilla_tpu.elastic.rebalance import Rebalancer
+from oncilla_tpu.runtime import daemon as D
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.runtime.membership import ClusterView, NodeEntry, as_view
+from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def ecfg(**kw):
+    d = dict(
+        host_arena_bytes=16 << 20,
+        device_arena_bytes=4 << 20,
+        chunk_bytes=64 << 10,
+        migrate_chunk_bytes=64 << 10,
+        heartbeat_s=0.1,
+        lease_s=30.0,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+# -- ClusterView unit ----------------------------------------------------
+
+
+def test_clusterview_is_list_dropin_and_shares_rows():
+    rows = [NodeEntry(0, "a", 1), NodeEntry(1, "b", 2)]
+    v1, v2 = ClusterView(rows), ClusterView(rows)
+    assert len(v1) == 2 and v1[1].host == "b"
+    # Row storage is shared by reference: the LocalCluster idiom where
+    # every daemon sees rank 0's ephemeral-port update and JOIN appends.
+    v1[1] = NodeEntry(1, "b", 99)
+    assert rows[1].port == 99 and v2[1].port == 99
+    v1.upsert(NodeEntry(2, "c", 3))
+    assert len(rows) == 3 and v2[2].host == "c"
+    # Epoch/left state is per view — each daemon adopts for itself.
+    v1.mark_left(2, epoch=5)
+    assert v1.has_left(2) and not v2.has_left(2)
+    assert v1.epoch == 5 and v2.epoch == 0
+    assert v1.alive_count() == 2 and v2.alive_count() == 3
+    # as_view passes an existing view through (shared, not re-wrapped),
+    # and wraps a plain list.
+    assert as_view(v1) is v1
+    assert isinstance(as_view(rows), ClusterView)
+
+
+def test_clusterview_adopt_is_epoch_fenced_and_idempotent():
+    v = ClusterView([NodeEntry(0, "a", 1)])
+    w = ClusterView([NodeEntry(0, "a", 1), NodeEntry(1, "b", 2)], epoch=3)
+    w.mark_left(1)
+    wire = w.to_wire()
+    assert v.adopt(3, wire)
+    assert len(v) == 2 and v.has_left(1) and v.epoch == 3
+    # A stale broadcast (older epoch) is dropped whole.
+    stale = ClusterView([NodeEntry(0, "a", 1)], epoch=2).to_wire()
+    assert not v.adopt(2, stale)
+    assert len(v) == 2 and v.epoch == 3
+    # Replay of the same table is harmless (rank-keyed upserts).
+    assert v.adopt(3, wire)
+    assert len(v) == 2
+    with pytest.raises(OcmError, match="malformed"):
+        v.adopt(9, b"{not json")
+
+
+def test_clusterview_find_includes_left_ranks():
+    """REQ_JOIN dedup: a retried/restarted joiner resolves to its
+    original rank — even one marked left — instead of leaking slots."""
+    v = ClusterView([NodeEntry(0, "a", 1), NodeEntry(1, "b", 2)])
+    v.mark_left(1)
+    assert v.find("b", 2) == 1
+    assert v.find("nope", 2) is None
+
+
+# -- rebalancer plan unit ------------------------------------------------
+
+
+def _rows(rank, sizes, chain=()):
+    return [
+        {"id": rank * 100 + i, "kind": 3, "nbytes": s,
+         "chain": list(chain), "primary": True, "prio": 1,
+         "origin_rank": 0, "origin_pid": 1, "migrating": False}
+        for i, s in enumerate(sizes)
+    ]
+
+
+def test_plan_moves_toward_capacity_share_deterministically():
+    rb = Rebalancer(daemon=None)
+    inv = {0: _rows(0, [4 << 20, 2 << 20, 1 << 20, 1 << 20]), 1: [], 2: []}
+    caps = {0: 16 << 20, 1: 16 << 20, 2: 16 << 20}
+    moves = rb.plan(inv, caps)
+    assert moves, "an 8 MiB / 0 / 0 skew must produce moves"
+    assert rb.plan(inv, caps) == moves  # pure + deterministic
+    # Every move leaves an over rank toward an under rank and never
+    # targets a chain member.
+    for row, src, dst in moves:
+        assert src == 0 and dst in (1, 2)
+        assert dst not in row["chain"]
+    # Post-plan loads sit within tolerance of the uniform share.
+    load = {0: 8 << 20, 1: 0, 2: 0}
+    for row, src, dst in moves:
+        load[src] -= row["nbytes"]
+        load[dst] += row["nbytes"]
+    total = 8 << 20
+    assert max(load.values()) - total / 3 <= 0.10 * total + (4 << 20)
+
+
+def test_plan_balanced_or_degenerate_inputs_produce_no_moves():
+    rb = Rebalancer(daemon=None)
+    even = {r: _rows(r, [1 << 20]) for r in range(3)}
+    caps = {r: 8 << 20 for r in range(3)}
+    assert rb.plan(even, caps) == []
+    assert rb.plan({0: _rows(0, [1 << 20])}, {0: 8 << 20}) == []  # 1 rank
+    assert rb.plan({0: [], 1: []}, caps) == []  # nothing to move
+    # Quarantined (mid-migration) copies and replicas never move.
+    inv = {0: _rows(0, [4 << 20]), 1: [], 2: []}
+    inv[0][0]["migrating"] = True
+    assert rb.plan(inv, caps) == []
+    inv[0][0]["migrating"] = False
+    inv[0][0]["primary"] = False
+    assert rb.plan(inv, caps) == []
+
+
+# -- protocol surface pin (the PR-5/7 exhaustiveness precedent) ----------
+
+
+def test_elastic_msgtypes_registered_and_dispatched():
+    """Every elastic MsgType has a schema (auto-covered by the protocol
+    roundtrip + exhaustiveness lint) and a daemon dispatch entry; the
+    membership/migration drivers are fenced; MIGRATE_BEGIN declares the
+    QoS-priority tail it carries."""
+    new = (
+        P.MsgType.REQ_JOIN, P.MsgType.JOIN_OK, P.MsgType.REQ_LEAVE,
+        P.MsgType.LEAVE_OK, P.MsgType.MEMBER_UPDATE, P.MsgType.MEMBER_OK,
+        P.MsgType.MIGRATE, P.MsgType.MIGRATE_OK, P.MsgType.MIGRATE_BEGIN,
+        P.MsgType.REQ_LOCATE, P.MsgType.LOCATE_OK,
+        P.MsgType.REQ_EXTENTS, P.MsgType.EXTENTS_OK,
+    )
+    for t in new:
+        assert t in P._SCHEMAS, f"{t.name} missing a schema"
+    for t in (P.MsgType.REQ_JOIN, P.MsgType.REQ_LEAVE,
+              P.MsgType.MEMBER_UPDATE, P.MsgType.MIGRATE,
+              P.MsgType.MIGRATE_BEGIN, P.MsgType.REQ_LOCATE,
+              P.MsgType.REQ_EXTENTS):
+        assert t in D._HANDLERS, f"{t.name} not dispatched"
+    for t in (P.MsgType.REQ_JOIN, P.MsgType.REQ_LEAVE,
+              P.MsgType.MIGRATE, P.MsgType.MIGRATE_BEGIN):
+        assert t in D._FENCED_REJECT, f"{t.name} not fenced"
+    assert P.VALID_FLAGS[P.MsgType.MIGRATE_BEGIN] & P.FLAG_QOS_TAIL
+    assert D._FLAGS_HANDLED[P.MsgType.MIGRATE_BEGIN] & P.FLAG_QOS_TAIL
+    # Tombstone-forwarded heartbeats carry the terminal FLAG_HB_FWD.
+    assert P.VALID_FLAGS[P.MsgType.HEARTBEAT] & P.FLAG_HB_FWD
+    assert D._FLAGS_HANDLED[P.MsgType.HEARTBEAT] & P.FLAG_HB_FWD
+    # MOVED is a typed, retryable ErrCode whose i64 tail names the new
+    # owner; the client ladder treats it as a redirect.
+    assert int(P.ErrCode.MOVED) in ControlPlaneClient._RETRYABLE_CODES
+    # EVERY error-reply path must parse the redirect tail — the windowed
+    # transfer pipeline included (a bare code+detail error silently
+    # drops the rank and the ladder spins on the old owner).
+    import struct
+
+    reply = P.Message(
+        P.MsgType.ERROR,
+        {"code": int(P.ErrCode.MOVED), "detail": "moved"},
+        struct.pack("<q", 5),
+    )
+    assert P.remote_error(reply).moved_to_rank == 5
+
+
+def test_static_view_wire_is_byte_identical():
+    """With no JOIN/LEAVE traffic, the frames every workload sends are
+    byte-for-byte the PR-7 wire: no new flags, no new tails (the
+    qos/replica byte-identity pins, extended to elastic)."""
+    cfg = OcmConfig()
+    connect = P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0},
+        flags=P.FLAG_CAP_TRACE if cfg.trace else 0,
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(connect[:P.HEADER.size])
+    assert plen == 16  # pid q + rank q, nothing else
+    req = P.pack(P.Message(
+        P.MsgType.REQ_ALLOC,
+        {"orig_rank": 0, "pid": 7, "kind": 3, "nbytes": 4096},
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(req[:P.HEADER.size])
+    assert flags == 0 and plen == 25
+    put = P.pack(P.Message(
+        P.MsgType.DATA_PUT, {"alloc_id": 1, "offset": 0, "nbytes": 4},
+        b"abcd",
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(put[:P.HEADER.size])
+    assert flags == 0 and plen == 24 + 4
+
+
+# -- JOIN / LEAVE integration --------------------------------------------
+
+
+def test_req_join_assigns_next_rank_and_dedups_retries():
+    with local_cluster(2, config=ecfg()) as cl:
+        r0 = cl.entries[0]
+        pool = PeerPool()
+        try:
+            req = P.Message(P.MsgType.REQ_JOIN, {
+                "host": "127.0.0.1", "port": 59999, "ndevices": 1,
+                "device_arena_bytes": 1 << 20,
+                "host_arena_bytes": 8 << 20, "inc": 42,
+            })
+            r1 = pool.request(r0.connect_host, r0.port, req)
+            assert r1.fields["rank"] == 2 and r1.fields["nnodes"] == 3
+            assert r1.data, "JOIN_OK must carry the member table"
+            epoch1 = r1.fields["epoch"]
+            # A retried REQ_JOIN (lost JOIN_OK) lands on the SAME rank —
+            # never a fresh half-member slot.
+            r2 = pool.request(r0.connect_host, r0.port, req)
+            assert r2.fields["rank"] == 2
+            assert r2.fields["nnodes"] == 3
+            assert r2.fields["epoch"] > epoch1  # each admission re-fences
+            assert cl.daemons[0].policy.nnodes == 3
+            # REQ_LEAVE sanity: rank 0 and non-members are refused.
+            with pytest.raises(OcmRemoteError, match="cannot leave"):
+                pool.request(r0.connect_host, r0.port, P.Message(
+                    P.MsgType.REQ_LEAVE, {"rank": 0, "inc": 0}))
+            with pytest.raises(OcmRemoteError, match="not a member"):
+                pool.request(r0.connect_host, r0.port, P.Message(
+                    P.MsgType.REQ_LEAVE, {"rank": 9, "inc": 0}))
+            # Non-masters refuse to drive membership.
+            e1 = cl.entries[1]
+            with pytest.raises(OcmRemoteError, match="non-master"):
+                pool.request(e1.connect_host, e1.port, req)
+        finally:
+            pool.close()
+
+
+def test_join_cluster_serves_and_leave_drains(rng):
+    cfg = ecfg()
+    with local_cluster(2, config=cfg) as cl:
+        r0 = cl.entries[0]
+        d3 = join_cluster(r0.connect_host, r0.port, cfg)
+        try:
+            assert d3.rank == 2
+            # The shared view grew everywhere; rank 0 accounts 3 nodes.
+            assert len(cl.daemons[0].entries) == 3
+            assert cl.daemons[0].policy.nnodes == 3
+            # Capacity placement spreads fresh allocations onto the
+            # joiner; data through it is byte-exact.
+            client = cl.client(0)
+            data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+            hs = [client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+                  for _ in range(6)]
+            assert any(h.rank == 2 for h in hs), "joiner never placed"
+            for h in hs:
+                client.put(h, data)
+                np.testing.assert_array_equal(
+                    client.get(h, data.nbytes), data)
+        except BaseException:
+            d3.stop()
+            raise
+        res = leave_cluster(d3)
+        # Everything the leaver held moved off; the data still reads
+        # byte-exact through the survivors (handles repoint via MOVED).
+        assert res["moved"] == sum(1 for h in hs if h.rank == 2)
+        for h in hs:
+            np.testing.assert_array_equal(client.get(h, data.nbytes), data)
+            assert h.rank != 2
+            client.free(h)
+        assert cl.daemons[0].entries.has_left(2)
+        assert cl.daemons[0].policy.nnodes == 2
+        assert d3.registry.live_count() == 0
+
+
+# -- live migration ------------------------------------------------------
+
+
+def test_live_migration_moved_redirect_put_get_free(rng):
+    with local_cluster(3, config=ecfg()) as cl:
+        client = cl.client(0)
+        data = rng.integers(0, 256, 512 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data)
+        src = h.rank
+        dst = next(r for r in range(3) if r != src)
+        rb = cl.daemons[0]._rebalancer
+        row = next(r for r in cl.daemons[src]._extent_rows()
+                   if r["id"] == h.alloc_id)
+        assert rb.migrate(row, src, dst)
+        # The source holds only a forwarding tombstone now…
+        with pytest.raises(OcmMoved):
+            cl.daemons[src]._lookup_serving(h.alloc_id)
+        # …REQ_LOCATE at rank 0 names the new primary…
+        loc = cl.daemons[0]._on_req_locate(P.Message(
+            P.MsgType.REQ_LOCATE, {"alloc_id": h.alloc_id}))
+        assert loc.fields["rank"] == dst
+        # …and the stale client handle repoints through the MOVED
+        # redirect: get, then put, then get, all byte-exact on the new
+        # owner.
+        np.testing.assert_array_equal(client.get(h, data.nbytes), data)
+        assert h.rank == dst
+        data2 = data[::-1].copy()
+        client.put(h, data2)
+        np.testing.assert_array_equal(client.get(h, data.nbytes), data2)
+        client.free(h)
+        assert cl.daemons[dst].registry.live_count() == 0
+        assert all(d.host_arena.allocator.bytes_live == 0
+                   for d in cl.daemons)
+
+
+def test_migrate_rejects_bad_targets_and_non_primary(rng):
+    with local_cluster(3, config=ecfg(replicas=2)) as cl:
+        client = cl.client(0)
+        data = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data)
+        src, rep = h.rank, h.replica_ranks[0]
+        srcd = next(d for d in cl.daemons if d.rank == src)
+        repd = next(d for d in cl.daemons if d.rank == rep)
+        for bad in (src, rep, 99):
+            with pytest.raises(ocm.OcmError, match="bad migration target"):
+                srcd._on_migrate(P.Message(P.MsgType.MIGRATE, {
+                    "alloc_id": h.alloc_id, "target_rank": bad,
+                    "epoch": srcd.epoch,
+                }))
+        # A replica holder refuses to drive a migration it doesn't own.
+        with pytest.raises(ocm.OcmError, match="not primary"):
+            repd._on_migrate(P.Message(P.MsgType.MIGRATE, {
+                "alloc_id": h.alloc_id,
+                "target_rank": next(r for r in range(3)
+                                    if r not in (src, rep)),
+                "epoch": repd.epoch,
+            }))
+        client.free(h)
+
+
+def test_migration_with_replicas_moves_primary_keeps_chain(rng):
+    """Migrating a replicated allocation: the target becomes primary,
+    the surviving replica keeps its copy, the source drops out of the
+    chain, and reads stay byte-exact."""
+    with local_cluster(4, config=ecfg(replicas=2)) as cl:
+        client = cl.client(0)
+        data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data)
+        src, rep = h.rank, h.replica_ranks[0]
+        dst = next(r for r in range(4) if r not in (src, rep))
+        rb = cl.daemons[0]._rebalancer
+        row = next(r for r in cl.daemons[src]._extent_rows()
+                   if r["id"] == h.alloc_id)
+        assert rb.migrate(row, src, dst)
+        te = cl.daemons[dst].registry.lookup(h.alloc_id)
+        assert te.chain[0] == dst and src not in te.chain
+        assert rep in te.chain
+        assert not te.migrating, "flip must clear quarantine"
+        re_ = cl.daemons[rep].registry.lookup(h.alloc_id)
+        assert re_.chain == te.chain, "survivor never adopted the flip"
+        np.testing.assert_array_equal(client.get(h, data.nbytes), data)
+        assert h.rank == dst
+        client.free(h)
+
+
+def test_heartbeat_tombstone_forward_cannot_loop():
+    """Swapped migrations (an alloc moved 1->2 and another 2->1) must
+    not ping-pong heartbeat forwards between the sources, and a forward
+    toward the app's ORIGIN rank must not re-trigger its relay branch —
+    the amplification storm that exhausts the pool in seconds.
+    Regression: a beat through the swap topology completes promptly and
+    a FLAG_HB_FWD beat is terminal."""
+    with local_cluster(3, config=ecfg()) as cl:
+        d0, d1, d2 = cl.daemons
+        pid = 4242
+        # Swap topology + a tombstone pointing back at the origin.
+        d1._note_moved(101, 2, pid, 0)
+        d2._note_moved(102, 1, pid, 0)
+        d0._note_moved(103, 1, pid, 0)
+        beat = P.Message(P.MsgType.HEARTBEAT, {
+            "pid": pid, "rank": 0, "owners": "1,2",
+        })
+        pool = PeerPool()
+        try:
+            t0 = time.monotonic()
+            r = pool.request(cl.entries[0].connect_host,
+                             cl.entries[0].port, beat)
+            assert r.type == P.MsgType.HEARTBEAT_OK
+            # With the loop, this round-trip blocks until the 30s pool
+            # timeout; without it, it is a few local hops.
+            assert time.monotonic() - t0 < 5.0
+            # A forwarded beat is terminal: handling it relays nowhere
+            # (no exception, prompt OK) even though this daemon holds a
+            # matching tombstone.
+            r2 = pool.request(
+                cl.entries[1].connect_host, cl.entries[1].port,
+                P.Message(P.MsgType.HEARTBEAT,
+                          {"pid": pid, "rank": 0, "owners": ""},
+                          flags=P.FLAG_HB_FWD),
+            )
+            assert r2.type == P.MsgType.HEARTBEAT_OK
+        finally:
+            pool.close()
+
+
+# -- QoS interaction (satellite) -----------------------------------------
+
+
+def test_migration_carries_priority_and_quota_stays_charged(rng):
+    """A migrated extent keeps its RegEntry.priority on the target, and
+    the tenant's byte quota stays charged at the ORIGIN ledger — the
+    bytes moved, they did not escape the quota."""
+    cfg = ecfg()
+    with local_cluster(3, config=cfg) as cl:
+        tenant_cfg = ecfg(priority=2, quota_bytes=768 << 10)
+        client = ControlPlaneClient(cl.entries, 0, config=tenant_cfg)
+        try:
+            data = rng.integers(0, 256, 512 << 10, dtype=np.uint8)
+            h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+            client.put(h, data)
+            src = h.rank
+            assert cl.daemons[src].registry.lookup(h.alloc_id).priority == 2
+            dst = next(r for r in range(3) if r != src)
+            rb = cl.daemons[0]._rebalancer
+            row = next(r for r in cl.daemons[src]._extent_rows()
+                       if r["id"] == h.alloc_id)
+            assert rb.migrate(row, src, dst)
+            # Priority class survived the move.
+            assert cl.daemons[dst].registry.lookup(h.alloc_id).priority == 2
+            # Quota still charged: the same tenant is refused a second
+            # allocation that would overshoot, exactly as pre-migration.
+            with pytest.raises(ocm.OcmError, match="byte quota") as ei:
+                client.alloc(512 << 10, OcmKind.REMOTE_HOST)
+            assert ei.value.code == int(P.ErrCode.QUOTA_EXCEEDED)
+            np.testing.assert_array_equal(client.get(h, data.nbytes), data)
+            # Free gives the quota back (through the post-migration
+            # owner) and the tenant can allocate again.
+            client.free(h)
+            h2 = client.alloc(512 << 10, OcmKind.REMOTE_HOST)
+            client.free(h2)
+        finally:
+            client.close()
+
+
+# -- rebalancer end to end ----------------------------------------------
+
+
+def test_rebalance_spreads_onto_joiner_and_ledger_drains(rng):
+    cfg = ecfg()
+    with local_cluster(2, config=cfg) as cl:
+        client = cl.client(0)
+        payloads = []
+        for _ in range(8):
+            data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+            h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+            client.put(h, data)
+            payloads.append((h, data))
+        r0 = cl.entries[0]
+        d3 = join_cluster(r0.connect_host, r0.port, cfg)
+        try:
+            out = cl.daemons[0]._rebalancer.rebalance()
+            assert out["moved"] > 0
+            ids = {h.alloc_id for h, _ in payloads}
+            assert any(
+                r["id"] in ids for r in d3._extent_rows()
+            ), "rebalance never landed an extent on the joiner"
+            for h, data in payloads:
+                np.testing.assert_array_equal(
+                    client.get(h, data.nbytes), data)
+                client.free(h)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and (
+                d3.registry.live_count()
+                or any(d.registry.live_count() for d in cl.daemons)
+            ):
+                time.sleep(0.05)
+            assert d3.registry.live_count() == 0
+            assert d3.host_arena.allocator.bytes_live == 0
+        finally:
+            d3.stop()
+
+
+def test_join_auto_rebalance_config_knob(rng):
+    """OCM_REBALANCE=1 (config.rebalance) kicks a background round after
+    a JOIN; extents spread without an operator driving it."""
+    cfg = ecfg(rebalance=True, heartbeat_s=0.05)
+    with local_cluster(2, config=cfg) as cl:
+        client = cl.client(0)
+        payloads = []
+        for _ in range(8):
+            data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+            h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+            client.put(h, data)
+            payloads.append((h, data))
+        r0 = cl.entries[0]
+        d3 = join_cluster(r0.connect_host, r0.port, cfg)
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and not d3._extent_rows():
+                time.sleep(0.1)
+            assert d3._extent_rows(), "auto-rebalance never moved extents"
+            for h, data in payloads:
+                np.testing.assert_array_equal(
+                    client.get(h, data.nbytes), data)
+                client.free(h)
+        finally:
+            d3.stop()

@@ -305,6 +305,49 @@ def test_native_fabric_capability_declined_by_silence(native_cluster, rng):
     client.close()
 
 
+def test_native_elastic_family_declined_by_silence(native_cluster, rng):
+    """The elastic MsgType family against the unmodified C++ daemon:
+    REQ_JOIN/REQ_LEAVE/MIGRATE land in its dispatch default arm as a
+    typed BAD_MSG ERROR (the whole family declined by silence), the
+    daemon stays in frame-sync, and ordinary traffic afterwards is
+    byte-exact — the native mirror of the static-view byte-identity pin
+    in tests/test_elastic.py."""
+    from oncilla_tpu.core.errors import OcmRemoteError
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    s = socket.create_connection(
+        (entries[0].host, entries[0].port), timeout=5.0
+    )
+    try:
+        for msg in (
+            P.Message(P.MsgType.REQ_JOIN, {
+                "host": "127.0.0.1", "port": 1, "ndevices": 1,
+                "device_arena_bytes": 1 << 20,
+                "host_arena_bytes": 1 << 20, "inc": 7,
+            }),
+            P.Message(P.MsgType.REQ_LEAVE, {"rank": 1, "inc": 0}),
+            P.Message(P.MsgType.MIGRATE, {
+                "alloc_id": 1, "target_rank": 1, "epoch": 0,
+            }),
+            P.Message(P.MsgType.REQ_LOCATE, {"alloc_id": 1}),
+        ):
+            with pytest.raises(OcmRemoteError) as ei:
+                P.request(s, msg)
+            assert ei.value.code == int(P.ErrCode.BAD_MSG)
+    finally:
+        s.close()
+    # The connection-level rejections left the daemon healthy: a plain
+    # client still allocates and moves bytes exactly.
+    client = ControlPlaneClient(entries, 0, config=cfg)
+    h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 256 << 10), data)
+    client.free(h)
+    client.close()
+
+
 def test_native_lease_reaping(binary, tmp_path):
     ports = free_ports(2)
     nodefile = tmp_path / "nf"

@@ -30,6 +30,8 @@ def test_roundtrip_all_schemas():
         "target_rank": 2,
         # fabric family (SHM_MAP/SHM_PUT/SHM_GET)
         "seg": "ocm-fab-1a2b-00112233aabbccdd",
+        # elastic family (REQ_JOIN/LEAVE_OK/MIGRATE_BEGIN/...)
+        "moved": 3, "src_rank": 1,
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})
