@@ -346,11 +346,22 @@ SCENARIOS = (
 
 
 def smoke(seed: int, verbose: bool = False) -> int:
+    # Each run records into its own flight-recorder timeline and must
+    # pass the cross-rank invariant audit (obs/audit.py): migration
+    # begin/flip/abort pairing, epoch monotonicity, fan-out-before-ack —
+    # the event TIMELINE is the oracle, not just the end state.
+    from oncilla_tpu.obs import audit as obs_audit
+
     for name, fn in SCENARIOS:
-        print(f"elastic smoke [{name}]: seed={seed} run 1/2 ...")
-        r1 = fn(seed, verbose=verbose)
-        print(f"elastic smoke [{name}]: seed={seed} run 2/2 (replay) ...")
-        r2 = fn(seed, verbose=verbose)
+        results = []
+        for run in (1, 2):
+            tag = "replay" if run == 2 else "..."
+            print(f"elastic smoke [{name}]: seed={seed} run {run}/2 "
+                  f"{tag}".rstrip())
+            with obs_audit.recorded(f"elastic-{name}-run{run}") as rec:
+                results.append(fn(seed, verbose=verbose))
+            print(f"  flight recorder: {rec.summary()}")
+        r1, r2 = results
         if r1 != r2:
             print(f"elastic smoke: FAIL — [{name}] runs diverge:\n"
                   f"  run1: {r1}\n  run2: {r2}")
@@ -358,7 +369,7 @@ def smoke(seed: int, verbose: bool = False) -> int:
         print(f"elastic smoke [{name}]: OK {r1}")
     print("elastic smoke: OK — migration never forks, partitioned join "
           "converges, cycle drains every ledger, interleavings replay "
-          "identically")
+          "identically, invariant audit clean on every timeline")
     return 0
 
 

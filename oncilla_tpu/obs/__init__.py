@@ -12,6 +12,15 @@ always-on trace-context propagation:
 - :mod:`~.journal` — bounded per-process JSONL event ring
   (``OCM_EVENTS=1``): spans, lease renewals/reclaims, stripe retries,
   tuner window changes, slow-op flags.
+- :mod:`~.flightrec` — the ring's crash-safe twin
+  (``OCM_FLIGHTREC=dir``): every event also streams into bounded
+  CRC-framed segment files, and kill paths flush the ring, so a dead
+  daemon leaves its black box on disk.
+- :mod:`~.audit` — the post-mortem correctness oracle: merges segments
+  cluster-wide and runs cross-rank invariant checks (epoch
+  monotonicity, migration pairing, fan-out-before-ack, lease
+  termination, eviction priority, fenced silence) with typed findings
+  and a nonzero CLI exit (``python -m oncilla_tpu.obs audit <dir>``).
 - :mod:`~.export` — merge client + daemon journals into one
   Perfetto/Chrome-trace JSON (pid track per process/daemon, trace_id
   stitched as flow events across tracks).

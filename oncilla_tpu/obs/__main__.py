@@ -14,7 +14,14 @@ no extra listener) and renders:
   with cross-process flows stitched by trace_id;
 - ``--smoke``: a self-contained end-to-end proof on an in-process
   cluster (put/get under journaling, export, validate ≥1 cross-track
-  flow) — the CI stage in scripts/check.sh.
+  flow) — the CI stage in scripts/check.sh;
+- ``--watch N``: live mode — redraw the cluster table every N seconds
+  until Ctrl-C (``--watch-count K`` bounds the iterations for
+  non-interactive use);
+- ``audit <dir>``: the post-mortem subcommand — merge the flight
+  recorder's segments (``OCM_FLIGHTREC``) and run the cross-rank
+  invariant checks of :mod:`~oncilla_tpu.obs.audit` over the timeline,
+  exiting nonzero on any finding.
 
 Membership comes from ``--nodefile`` or ``$OCM_NODEFILE`` (the same file
 the daemons were started with).
@@ -27,6 +34,7 @@ import json
 import os
 import socket
 import sys
+import time
 
 from oncilla_tpu.obs import export
 
@@ -69,6 +77,29 @@ def _fmt_bytes(n: float) -> str:
 
 _PRIO_NAMES = {0: "low", 1: "normal", 2: "high"}
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _hist_spark(ops: dict) -> str:
+    """Latency histogram summary for one rank: the per-op cumulative
+    bucket counts (Tracer hist) summed across its dcn serve ops and
+    rendered as a fixed-width sparkline, fastest bucket on the left."""
+    total: list[int] = []
+    for st in ops.values():
+        counts = (st.get("hist") or {}).get("counts") or []
+        if len(counts) > len(total):
+            total.extend([0] * (len(counts) - len(total)))
+        for i, c in enumerate(counts):
+            total[i] += c
+    if not total or not any(total):
+        return "-"
+    peak = max(total)
+    return "".join(
+        _SPARK[min((c * (len(_SPARK) - 1) + peak - 1) // peak,
+                   len(_SPARK) - 1)] if c else "."
+        for c in total
+    )
+
 
 def _app_rows(rank: int, st: dict) -> list[list[str]]:
     """Per-app QoS rows for one rank: app id, priority class, quota use
@@ -95,7 +126,8 @@ def _app_rows(rank: int, st: dict) -> list[list[str]]:
 
 def _table(entries) -> int:
     cols = ["rank", "nodes", "members", "allocs", "live", "ops", "p50_us",
-            "p99_us", "gbit/s", "leases r/x/e", "migr ok/ab", "hb_age_s"]
+            "p99_us", "lat_hist", "gbit/s", "leases r/x/e", "migr ok/ab",
+            "hb_age_s"]
     rows = []
     app_rows: list[list[str]] = []
     any_ok = False
@@ -103,7 +135,7 @@ def _table(entries) -> int:
         st = _poll_status(e)
         if "error" in st:
             rows.append([str(e.rank), "-", "-", "-", "-", "-", "-", "-",
-                         "-", "-", "-", st["error"][:40]])
+                         "-", "-", "-", "-", st["error"][:40]])
             continue
         any_ok = True
         app_rows.extend(_app_rows(e.rank, st))
@@ -127,6 +159,7 @@ def _table(entries) -> int:
             str(count),
             f"{p50:.0f}",
             f"{p99:.0f}",
+            _hist_spark(ops),
             f"{gbps:.2f}",
             (f"{leases.get('renewals', 0)}/{leases.get('reclaims', 0)}"
              f"/{leases.get('expired', 0)}"),
@@ -255,7 +288,65 @@ def _smoke() -> int:
     return 0 if ok else 1
 
 
+def _audit_cmd(argv: list[str]) -> int:
+    """``python -m oncilla_tpu.obs audit <dir>`` — merge the flight
+    recorder's segments and run every invariant check. Sibling
+    recording subdirectories are audited as independent timelines."""
+    from oncilla_tpu.obs import audit
+
+    ap = argparse.ArgumentParser(
+        prog="python -m oncilla_tpu.obs audit",
+        description="cross-rank invariant audit of flight-recorder "
+                    "segments",
+    )
+    ap.add_argument("dir", help="flight-recorder directory "
+                                "(what OCM_FLIGHTREC pointed at)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        print(f"audit: {args.dir} is not a directory", file=sys.stderr)
+        return 2
+    results = audit.audit_tree(args.dir)
+    if not results:
+        print(f"audit: no flight-recorder segments under {args.dir}",
+              file=sys.stderr)
+        return 2
+    total = 0
+    if args.as_json:
+        json.dump(
+            [
+                {"timeline": d, "stats": stats,
+                 "findings": [f.__dict__ for f in findings]}
+                for d, findings, stats in results
+            ],
+            sys.stdout, indent=2, default=str,
+        )
+        print()
+    for d, findings, stats in results:
+        total += len(findings)
+        if args.as_json:
+            continue
+        for f in findings:
+            print(f"{d}: {f.render()}")
+        print(f"audit: {d}: {stats['events']} events, "
+              f"{stats['processes']} process(es), ranks {stats['ranks']}, "
+              f"{stats['truncated_segments']} torn tail(s) -> "
+              + (f"{len(findings)} finding(s)" if findings else "clean"))
+    if not args.as_json:
+        nruns = len(results)
+        if total:
+            print(f"audit: {total} finding(s) across {nruns} timeline(s)")
+        else:
+            print(f"audit: clean ({nruns} timeline(s), "
+                  f"{len(audit.CHECKS)} invariant(s))")
+    return 1 if total else 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "audit":
+        return _audit_cmd(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m oncilla_tpu.obs",
         description="oncilla-tpu cluster observability",
@@ -273,6 +364,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="self-contained end-to-end validation "
                          "(in-process cluster; ignores --nodefile)")
+    ap.add_argument("--watch", type=float, metavar="N", default=None,
+                    help="redraw the cluster table every N seconds "
+                         "(Ctrl-C exits cleanly)")
+    ap.add_argument("--watch-count", type=int, metavar="K", default=0,
+                    help="with --watch: stop after K redraws "
+                         "(0 = until Ctrl-C; non-interactive runs/CI)")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -288,6 +385,24 @@ def main(argv: list[str] | None = None) -> int:
         return _prom(entries, args.prom)
     if args.trace is not None:
         return _trace(entries, args.trace, args.journal)
+    if args.watch is not None:
+        interval = max(args.watch, 0.1)
+        drawn = 0
+        rc = 0
+        try:
+            while True:
+                if sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                print(f"every {interval:g}s  "
+                      f"{time.strftime('%H:%M:%S')}  (Ctrl-C to exit)")
+                rc = _table(entries)
+                drawn += 1
+                if args.watch_count and drawn >= args.watch_count:
+                    return rc
+                time.sleep(interval)
+        except KeyboardInterrupt:
+            print()
+            return rc
     return _table(entries)
 
 

@@ -1,0 +1,415 @@
+"""Cross-rank post-mortem auditor: the journals as a correctness oracle.
+
+The chaos smokes assert end-STATE byte-exactness; this module audits the
+end-to-end event TIMELINE the flight recorder (:mod:`~.flightrec`)
+persisted. Segments from every rank — dead ones included — are merged
+cluster-wide (rank-tagged via each event's ``track``, wall-clock ordered
+with a per-process (jid, seq) tiebreak, tolerant of clock skew because
+every ORDER-sensitive check walks a single process's seq order, never
+the cross-process wall clock) and a registry of invariant checks runs
+over the result:
+
+====================  ==================================================
+rule                  violated when
+====================  ==================================================
+``segment-corrupt``   a segment frame fails its CRC (or decodes to
+                      non-JSON / bad magic) — evidence tampering or disk
+                      rot, reported never skipped
+``journal-gap``       a process's spilled (jid, seq) stream has holes —
+                      events were recorded but never reached the disk
+``epoch-monotonic``   a daemon emits a cluster epoch lower than one it
+                      already emitted (epochs only ever advance)
+``migrate-pairing``   a ``migrate_start`` never reaches a terminal, or
+                      reaches BOTH ``migrate_flip`` and
+                      ``migrate_abort``, or a terminal has no start
+``replica-ack``       a client DATA_PUT ack on a k>1 chain precedes its
+                      replica fan-out (durability contract: a byte the
+                      client saw acked is on every live replica)
+``lease-chain``       an app renewed leases but the timeline never
+                      terminates them (no disconnect / free / reclaim /
+                      eviction for that app)
+``eviction-priority`` a pressure eviction fired on an ACTIVE lease above
+                      the low priority class
+``fenced-silence``    a fenced daemon emitted a post-fence client ack or
+                      replica fan-out (split-brain writes)
+====================  ==================================================
+
+Findings follow the ``analysis``-family style: typed rule, rank, event
+refs, nonzero process exit (``python -m oncilla_tpu.obs audit <dir>``).
+
+Stdlib-only by the obs-package contract.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from oncilla_tpu.obs import flightrec
+
+# Events whose ``epoch`` field reports the emitting daemon's CURRENT
+# epoch at record time. migrate_flip/migrate_abort deliberately carry
+# the migration's BEGIN epoch (the fencing identity of that migration)
+# and are excluded — they may lag a bump that landed mid-stream.
+EPOCH_EVENTS = frozenset({
+    "fenced", "member_join", "member_leave", "node_dead",
+    "failover_promote", "rereplicate", "migrate_start",
+})
+
+# The low priority class (qos/policy.py PRIO_LOW); the reaper may evict
+# ACTIVE leases of this class only. Mirrored here (not imported) to keep
+# the module stdlib-only.
+_PRIO_LOW = 0
+
+_TRACK_RANK = re.compile(r"^daemon-r(\d+)$")
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    """One invariant violation, in the analysis-family Finding style."""
+
+    rule: str
+    message: str
+    rank: int = -1  # emitting daemon rank, -1 when not rank-specific
+    events: tuple[str, ...] = ()  # "jid:seq" refs into the timeline
+
+    def render(self) -> str:
+        where = f" rank={self.rank}" if self.rank >= 0 else ""
+        refs = f" (events: {', '.join(self.events)})" if self.events else ""
+        return f"[{self.rule}]{where} {self.message}{refs}"
+
+
+def _ref(e: dict) -> str:
+    return f"{e.get('jid', '?')}:{e.get('seq', '?')}"
+
+
+def _rank_of(e: dict) -> int:
+    m = _TRACK_RANK.match(str(e.get("track", "")))
+    return int(m.group(1)) if m else -1
+
+
+def _order_key(e: dict):
+    # Wall clock first (the only cross-process clock), then (jid, seq):
+    # same-millisecond events from one process can never interleave out
+    # of their true order, and skewed clocks only ever reorder ACROSS
+    # processes — which no order-sensitive check relies on.
+    return (e.get("ts", 0.0), str(e.get("jid", "")), e.get("seq", 0))
+
+
+class Timeline:
+    """A merged, ordered cluster timeline plus per-process streams."""
+
+    def __init__(self, events: list[dict], problems: list[dict] | None = None,
+                 source: str = ""):
+        self.events = sorted(events, key=_order_key)
+        self.problems = list(problems or ())
+        self.source = source
+        self.streams: dict[str, list[dict]] = defaultdict(list)
+        for e in self.events:
+            jid = e.get("jid")
+            if jid is not None:
+                self.streams[str(jid)].append(e)
+        for evs in self.streams.values():
+            evs.sort(key=lambda e: e.get("seq", 0))
+
+    def stats(self) -> dict:
+        ranks = sorted({r for e in self.events
+                        if (r := _rank_of(e)) >= 0})
+        return {
+            "events": len(self.events),
+            "processes": len(self.streams),
+            "ranks": ranks,
+            "kinds": len({e.get("ev") for e in self.events}),
+            "truncated_segments": sum(
+                1 for p in self.problems if p["kind"] == "truncated"
+            ),
+        }
+
+
+# -- the invariant registry ---------------------------------------------
+
+CHECKS: list[tuple[str, object]] = []
+
+
+def invariant(rule: str):
+    def deco(fn):
+        CHECKS.append((rule, fn))
+        return fn
+    return deco
+
+
+@invariant("segment-corrupt")
+def _check_integrity(tl: Timeline) -> list[AuditFinding]:
+    out = []
+    for p in tl.problems:
+        if p["kind"] in ("crc", "decode", "header"):
+            out.append(AuditFinding(
+                rule="segment-corrupt",
+                message=f"{os.path.basename(p['path'])} @{p['offset']}: "
+                        f"{p['detail']}",
+            ))
+    return out
+
+
+@invariant("journal-gap")
+def _check_continuity(tl: Timeline) -> list[AuditFinding]:
+    out = []
+    for jid, evs in tl.streams.items():
+        seqs = sorted({e.get("seq", 0) for e in evs})
+        if len(seqs) < 2:
+            continue
+        missing = (seqs[-1] - seqs[0] + 1) - len(seqs)
+        if missing:
+            out.append(AuditFinding(
+                rule="journal-gap",
+                message=f"process {jid}: {missing} event(s) missing from "
+                        f"the spilled stream (seq {seqs[0]}..{seqs[-1]} "
+                        f"holds {len(seqs)})",
+            ))
+    return out
+
+
+@invariant("epoch-monotonic")
+def _check_epochs(tl: Timeline) -> list[AuditFinding]:
+    out = []
+    # Per (process, daemon track): one daemon's own epoch never regresses.
+    for jid, evs in tl.streams.items():
+        high: dict[str, tuple[int, dict]] = {}
+        for e in evs:
+            if e.get("ev") not in EPOCH_EVENTS or "epoch" not in e:
+                continue
+            track = str(e.get("track", ""))
+            if not _TRACK_RANK.match(track):
+                continue
+            epoch = int(e["epoch"])
+            prev = high.get(track)
+            if prev is not None and epoch < prev[0]:
+                out.append(AuditFinding(
+                    rule="epoch-monotonic",
+                    rank=_rank_of(e),
+                    message=f"epoch regressed {prev[0]} -> {epoch} "
+                            f"({prev[1].get('ev')} then {e.get('ev')})",
+                    events=(_ref(prev[1]), _ref(e)),
+                ))
+            if prev is None or epoch > prev[0]:
+                high[track] = (epoch, e)
+    return out
+
+
+@invariant("migrate-pairing")
+def _check_migrations(tl: Timeline) -> list[AuditFinding]:
+    groups: dict[tuple, dict[str, list[dict]]] = defaultdict(
+        lambda: {"start": [], "flip": [], "abort": []}
+    )
+    for e in tl.events:
+        ev = e.get("ev")
+        if ev in ("migrate_start", "migrate_flip", "migrate_abort"):
+            key = (e.get("alloc_id"), e.get("src"), e.get("target"))
+            groups[key][ev.split("_", 1)[1]].append(e)
+    out = []
+    for (alloc_id, src, target), g in sorted(
+        groups.items(), key=lambda kv: str(kv[0])
+    ):
+        label = f"alloc {alloc_id} migration rank {src} -> {target}"
+        refs = tuple(_ref(e) for v in g.values() for e in v)
+        if not g["start"]:
+            out.append(AuditFinding(
+                rule="migrate-pairing", rank=src if src is not None else -1,
+                message=f"{label}: terminal without a migrate_start",
+                events=refs,
+            ))
+        elif g["flip"] and g["abort"]:
+            out.append(AuditFinding(
+                rule="migrate-pairing", rank=src if src is not None else -1,
+                message=f"{label}: BOTH flipped and aborted (copies "
+                        "may have forked)",
+                events=refs,
+            ))
+        elif len(g["flip"]) > 1:
+            out.append(AuditFinding(
+                rule="migrate-pairing", rank=src if src is not None else -1,
+                message=f"{label}: {len(g['flip'])} flips for "
+                        f"{len(g['start'])} start(s)",
+                events=refs,
+            ))
+        elif not g["flip"] and not g["abort"]:
+            out.append(AuditFinding(
+                rule="migrate-pairing", rank=src if src is not None else -1,
+                message=f"{label}: migrate_start never reached "
+                        "migrate_flip or migrate_abort",
+                events=refs,
+            ))
+    return out
+
+
+@invariant("replica-ack")
+def _check_replica_acks(tl: Timeline) -> list[AuditFinding]:
+    out = []
+    for jid, evs in tl.streams.items():
+        # Pending fan-outs per (daemon track, alloc, offset, nbytes):
+        # within one process the seq order IS program order per thread,
+        # and the serving thread records its fan-out strictly before its
+        # ack.
+        pending: dict[tuple, int] = defaultdict(int)
+        for e in evs:
+            ev = e.get("ev")
+            if ev == "replica_fanout":
+                key = (e.get("track"), e.get("alloc_id"),
+                       e.get("offset"), e.get("nbytes"))
+                pending[key] += 1
+            elif ev == "put_ack" and e.get("chain", 0) > 1:
+                key = (e.get("track"), e.get("alloc_id"),
+                       e.get("offset"), e.get("nbytes"))
+                if pending[key] <= 0:
+                    out.append(AuditFinding(
+                        rule="replica-ack", rank=_rank_of(e),
+                        message=f"DATA_PUT ack for alloc "
+                                f"{e.get('alloc_id')} "
+                                f"[{e.get('offset')}+{e.get('nbytes')}] on "
+                                f"a {e.get('chain')}-member chain precedes "
+                                "its replica fan-out",
+                        events=(_ref(e),),
+                    ))
+                else:
+                    pending[key] -= 1
+    return out
+
+
+@invariant("lease-chain")
+def _check_lease_chains(tl: Timeline) -> list[AuditFinding]:
+    renewing: dict[object, dict] = {}
+    terminated: set = set()
+    for e in tl.events:
+        ev = e.get("ev")
+        if ev == "lease_renew":
+            renewing.setdefault(e.get("app_pid"), e)
+        elif ev in ("app_disconnect", "app_close"):
+            # Daemon-side reclamation, or the app's own clean close —
+            # DISCONNECT is fire-and-forget, so a stopping daemon may
+            # legitimately never record the former (the lease reaper is
+            # the runtime's backstop); the client-side event is the
+            # deliberate-termination evidence either way.
+            terminated.add(e.get("pid"))
+        elif ev in ("lease_reclaim", "qos_evict", "free_local"):
+            terminated.add(e.get("origin_pid"))
+    out = []
+    for pid, first in sorted(renewing.items(), key=lambda kv: str(kv[0])):
+        if pid not in terminated:
+            out.append(AuditFinding(
+                rule="lease-chain",
+                message=f"app {pid} renewed leases but the timeline has "
+                        "no disconnect / free / reclaim / eviction for "
+                        "it (leaked lease chain)",
+                events=(_ref(first),),
+            ))
+    return out
+
+
+@invariant("eviction-priority")
+def _check_evictions(tl: Timeline) -> list[AuditFinding]:
+    out = []
+    for e in tl.events:
+        if (e.get("ev") == "qos_evict" and e.get("active")
+                and int(e.get("priority", _PRIO_LOW)) > _PRIO_LOW):
+            out.append(AuditFinding(
+                rule="eviction-priority", rank=_rank_of(e),
+                message=f"pressure eviction fired on ACTIVE priority-"
+                        f"{e.get('priority')} alloc {e.get('alloc_id')}",
+                events=(_ref(e),),
+            ))
+    return out
+
+
+@invariant("fenced-silence")
+def _check_fenced(tl: Timeline) -> list[AuditFinding]:
+    out = []
+    for jid, evs in tl.streams.items():
+        fenced_at: dict[str, dict] = {}
+        for e in evs:
+            track = str(e.get("track", ""))
+            ev = e.get("ev")
+            if ev == "fenced" and _TRACK_RANK.match(track):
+                fenced_at.setdefault(track, e)
+            elif ev in ("put_ack", "replica_fanout") and track in fenced_at:
+                out.append(AuditFinding(
+                    rule="fenced-silence", rank=_rank_of(e),
+                    message=f"{ev} for alloc {e.get('alloc_id')} emitted "
+                            "AFTER this daemon was fenced (split-brain "
+                            "write)",
+                    events=(_ref(fenced_at[track]), _ref(e)),
+                ))
+    return out
+
+
+# -- entry points --------------------------------------------------------
+
+
+def audit_events(events: list[dict], problems: list[dict] | None = None,
+                 source: str = "") -> tuple[list[AuditFinding], dict]:
+    tl = Timeline(events, problems, source=source)
+    findings: list[AuditFinding] = []
+    for _rule, fn in CHECKS:
+        findings.extend(fn(tl))
+    return findings, tl.stats()
+
+
+def audit_dir(path: str) -> tuple[list[AuditFinding], dict]:
+    """Audit ONE timeline directory (segments directly inside it)."""
+    events, problems = flightrec.read_dir(path)
+    return audit_events(events, problems, source=path)
+
+
+def audit_tree(path: str) -> list[tuple[str, list[AuditFinding], dict]]:
+    """Audit every timeline under ``path`` independently. Sibling
+    recordings (a smoke's run 1 vs its replay) must never be conflated:
+    their alloc-id and epoch spaces restart per cluster, so each leaf
+    directory of segments is its own oracle."""
+    return [(d, *audit_dir(d)) for d in flightrec.timeline_dirs(path)]
+
+
+@dataclass
+class RecordedRun:
+    """Handle yielded by :func:`recorded`; filled in on clean exit."""
+
+    path: str
+    findings: list[AuditFinding] = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+
+    def summary(self) -> str:
+        st = self.stats or {}
+        return (f"audited {st.get('events', 0)} events from "
+                f"{st.get('processes', 0)} process(es), ranks "
+                f"{st.get('ranks', [])}: "
+                + (f"{len(self.findings)} finding(s)" if self.findings
+                   else "clean"))
+
+
+@contextmanager
+def recorded(label: str, *, strict: bool = True):
+    """Run a block under the flight recorder, then audit its timeline::
+
+        with audit.recorded("resilience-run1") as rec:
+            run_scenario(seed)
+        print(rec.summary())          # findings raise by default
+
+    Spills into ``$OCM_FLIGHTREC/<label>`` (or a temp dir), audits on
+    clean exit, and — when ``strict`` — raises ``AssertionError``
+    listing every finding. The black box is always left on disk; on any
+    failure its path is in the exception message.
+    """
+    base = os.environ.get(flightrec.ENV_DIR)
+    path = os.path.join(base, label) if base else None
+    rec = RecordedRun(path="")
+    with flightrec.recording(path) as d:
+        rec.path = d
+        yield rec
+    rec.findings, rec.stats = audit_dir(rec.path)
+    if strict and rec.findings:
+        lines = "\n".join(f.render() for f in rec.findings)
+        raise AssertionError(
+            f"invariant audit of {rec.path} found "
+            f"{len(rec.findings)} violation(s):\n{lines}"
+        )

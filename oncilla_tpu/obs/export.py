@@ -27,8 +27,11 @@ import json
 
 def merge(*event_lists: list[dict]) -> list[dict]:
     """Concatenate event streams, dropping (jid, seq) duplicates, ordered
-    by wall clock (the only clock shared across processes). Events from
-    pre-journal sources (no jid) are kept as-is."""
+    by wall clock (the only clock shared across processes) with a
+    (jid, seq) tiebreak: two events one process recorded in the same
+    wall-clock millisecond keep their true program order instead of the
+    arbitrary interleaving a ts-only sort gave them. Events from
+    pre-journal sources (no jid) sort on bare ts as before."""
     seen: set[tuple] = set()
     out: list[dict] = []
     for evts in event_lists:
@@ -40,7 +43,9 @@ def merge(*event_lists: list[dict]) -> list[dict]:
                     continue
                 seen.add(key)
             out.append(e)
-    out.sort(key=lambda e: e.get("ts", 0.0))
+    out.sort(key=lambda e: (
+        e.get("ts", 0.0), str(e.get("jid", "")), e.get("seq", 0)
+    ))
     return out
 
 

@@ -9,6 +9,12 @@ latency math should use), plus the recording thread. The ring is capped
 never grow a long-lived daemon without bound: old events fall off, which
 for a flight recorder is the point.
 
+With the flight recorder armed (``OCM_FLIGHTREC=dir`` or
+``flightrec.set_dir``), every recorded event is ALSO streamed into
+crash-safe CRC-framed segment files on disk, so the bounded ring stays
+the hot in-memory view while the disk keeps the full stream for the
+post-mortem auditor (``obs/audit.py``).
+
 Events never leave the process on their own; exporters pull them — the
 ``python -m oncilla_tpu.obs`` CLI over the STATUS_EVENTS protocol
 request, or :func:`dump_jsonl` to a file for offline merging.
@@ -26,7 +32,14 @@ import threading
 import time
 from collections import deque
 
-_ENABLED = os.environ.get("OCM_EVENTS", "") not in ("", "0")
+from oncilla_tpu.obs import flightrec as _flightrec
+
+# OCM_FLIGHTREC alone is a complete opt-in: a flight recorder that also
+# required OCM_EVENTS=1 would silently record nothing.
+_ENABLED = (
+    os.environ.get("OCM_EVENTS", "") not in ("", "0")
+    or bool(os.environ.get(_flightrec.ENV_DIR))
+)
 _CAP = int(os.environ.get("OCM_EVENTS_CAP", "") or 8192)
 
 # Journal identity: exporters merging event streams from several sources
@@ -72,12 +85,39 @@ def record(ev: str, *, force: bool = False, **fields) -> None:
         rec["jid"] = _JID
         rec["seq"] = _seq
         _ring.append(rec)
+    # Spill OUTSIDE the ring lock: the recorder has its own lock, and a
+    # slow disk must never serialize hot-path record() callers.
+    _flightrec.append(rec)
+
+
+def set_cap(n: int) -> None:
+    """Test hook / programmatic ring bound (the env var is read at
+    import). Keeps the newest ``n`` events."""
+    global _CAP, _ring
+    with _lock:
+        _CAP = int(n)
+        _ring = deque(_ring, maxlen=_CAP)
+
+
+def jid() -> str:
+    """This process's journal identity (segment naming, dedup)."""
+    return _JID
 
 
 def events() -> list[dict]:
     """Snapshot copy of the ring (oldest first)."""
     with _lock:
         return list(_ring)
+
+
+def spill_ring(label: str = "ringdump") -> str | None:
+    """Flush the CURRENT in-memory ring to the flight-recorder dir as a
+    labelled segment (no-op when the recorder is off). The kill path's
+    black-box flush: events the stream already spilled dedup away on
+    merge, so calling this is always safe and never loses evidence."""
+    if not _flightrec.configured():
+        return None
+    return _flightrec.dump_events(events(), label=label)
 
 
 def clear() -> None:

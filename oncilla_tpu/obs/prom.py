@@ -40,11 +40,20 @@ class _Doc:
         self._fams: dict[str, tuple[str, str, list[str]]] = {}
 
     def sample(self, family: str, kind: str, help_: str,
-               value: float, **labels: object) -> None:
+               value: float, *, name: str | None = None,
+               exemplar: str = "", **labels: object) -> None:
+        """``name`` overrides the sample's metric name while keeping it
+        grouped (and HELP/TYPE'd) under ``family`` — how a histogram's
+        ``_bucket``/``_sum``/``_count`` samples ride their base family.
+        ``exemplar`` is an OpenMetrics-style ``# {...} value ts`` tail
+        appended verbatim (scrapers that predate exemplars ignore
+        everything after the ``#``)."""
         fam = self._fams.get(family)
         if fam is None:
             fam = self._fams[family] = (kind, help_, [])
-        fam[2].append(f"{family}{_label(**labels)} {_num(value)}")
+        fam[2].append(
+            f"{name or family}{_label(**labels)} {_num(value)}{exemplar}"
+        )
 
     def text(self) -> str:
         lines: list[str] = []
@@ -80,6 +89,35 @@ def render(meta: dict) -> str:
         doc.sample("ocm_op_gigabits_per_second", "gauge",
                    "Lifetime mean throughput per op (gigabits/s).",
                    st.get("gbps", 0.0), rank=rank, op=op)
+        hist = st.get("hist")
+        if hist:
+            # Real cumulative histogram (lifetime counters, unlike the
+            # ring-windowed p50/p99 gauges) with trace-id exemplars in
+            # the OpenMetrics style on the bucket that holds the most
+            # recent traced span.
+            fam = "ocm_op_latency_seconds"
+            help_ = ("Span latency histogram per op (cumulative "
+                     "lifetime counts; exemplars carry trace ids).")
+            cum = 0
+            exemplars = hist.get("exemplars") or {}
+            for i, le in enumerate(hist.get("le", [])):
+                cum += hist["counts"][i]
+                ex = exemplars.get(str(i))
+                tail = (
+                    f' # {{trace_id="{ex["trace_id"]}"}} '
+                    f'{_num(ex["value"])} {_num(ex["ts"])}'
+                    if ex else ""
+                )
+                doc.sample(fam, "histogram", help_, cum,
+                           name=fam + "_bucket", exemplar=tail,
+                           rank=rank, op=op, le=_num(le))
+            cum += hist["counts"][-1] if hist.get("counts") else 0
+            doc.sample(fam, "histogram", help_, cum,
+                       name=fam + "_bucket", rank=rank, op=op, le="+Inf")
+            doc.sample(fam, "histogram", help_, hist.get("sum_s", 0.0),
+                       name=fam + "_sum", rank=rank, op=op)
+            doc.sample(fam, "histogram", help_, cum,
+                       name=fam + "_count", rank=rank, op=op)
 
     arena = meta.get("host_arena", {})
     doc.sample("ocm_arena_live_bytes", "gauge",

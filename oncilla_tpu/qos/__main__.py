@@ -361,8 +361,18 @@ def main(argv=None) -> int:
           f"rounds={rounds} chaos={not args.no_chaos} ...")
     t0 = time.monotonic()
     try:
-        outcome = run_soak(args.seed, tenants, rounds,
-                           chaos=not args.no_chaos, verbose=args.verbose)
+        # The soak records under the flight recorder and its timeline
+        # must pass the cross-rank invariant audit (obs/audit.py) —
+        # eviction priority, fan-out-before-ack, lease termination —
+        # on top of the end-state assertions below. Audit findings
+        # raise AssertionError with the black-box path.
+        from oncilla_tpu.obs import audit as obs_audit
+
+        with obs_audit.recorded(f"qos-{label}") as rec:
+            outcome = run_soak(args.seed, tenants, rounds,
+                               chaos=not args.no_chaos,
+                               verbose=args.verbose)
+        print(f"  flight recorder: {rec.summary()}")
     except AssertionError as e:
         print(f"qos {label}: FAIL — {e}", file=sys.stderr)
         return 1

@@ -136,14 +136,24 @@ def run_scenario(seed: int, verbose: bool = False) -> dict:
 
 
 def smoke(seed: int, verbose: bool = False) -> int:
+    # Every run records under the flight recorder and must pass the
+    # cross-rank invariant audit (obs/audit.py) — the timeline is
+    # checked end to end, not just the end state. A finding raises with
+    # the black-box path in the message.
+    from oncilla_tpu.obs import audit as obs_audit
+
     print(f"resilience smoke: seed={seed} run 1/2 ...")
-    r1 = run_scenario(seed, verbose=verbose)
+    with obs_audit.recorded("resilience-run1") as rec1:
+        r1 = run_scenario(seed, verbose=verbose)
+    print(f"  flight recorder: {rec1.summary()}")
     print(f"  owner rank {r1['owner']} killed -> promoted rank "
           f"{r1['promoted']}, chain restored to {r1['chain']}, "
           f"epoch {r1['epoch']}")
     print(f"  chaos log: {r1['log']}")
     print(f"resilience smoke: seed={seed} run 2/2 (replay) ...")
-    r2 = run_scenario(seed, verbose=verbose)
+    with obs_audit.recorded("resilience-run2") as rec2:
+        r2 = run_scenario(seed, verbose=verbose)
+    print(f"  flight recorder: {rec2.summary()}")
     print(f"  chaos log: {r2['log']}")
     if r1["schedule"] != r2["schedule"]:
         print("resilience smoke: FAIL — schedules differ across runs")
@@ -156,7 +166,8 @@ def smoke(seed: int, verbose: bool = False) -> int:
         print("resilience smoke: FAIL — failover outcome differs")
         return 1
     print("resilience smoke: OK — kill-owner failover byte-exact, k "
-          "restored, identical interleaving replayed")
+          "restored, identical interleaving replayed, invariant audit "
+          "clean on both timelines")
     return 0
 
 

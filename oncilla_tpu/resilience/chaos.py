@@ -128,6 +128,11 @@ class ChaosController:
         self.leave_fn = leave_fn
         self.migrate_fn = migrate_fn
         self.log: list[tuple[int, str, int]] = []
+        # Kill-time journal snapshots (rank -> event list): the victim's
+        # evidence captured BEFORE the kill tears it down, also spilled
+        # to the flight recorder when one is armed. Post-mortem tests
+        # and the auditor read these even for ranks that died.
+        self.victim_rings: dict[int, list[dict]] = {}
         self._by_op: dict[int, list[Fault]] = {}
         for f in schedule.faults:
             self._by_op.setdefault(f.op, []).append(f)
@@ -162,6 +167,11 @@ class ChaosController:
                 "chaos_fault", op=n, action=f.action, rank=f.rank
             )
             if f.action == "kill":
+                # Snapshot the victim's ring AT kill time (and spill it
+                # when the flight recorder is armed): the kill is the
+                # one fault that used to destroy its own evidence.
+                self.victim_rings[f.rank] = obs_journal.events()
+                obs_journal.spill_ring(label=f"chaos-kill-r{f.rank}")
                 if self.kill_fn is not None:
                     self.kill_fn(f.rank)
             elif f.action == "delay":

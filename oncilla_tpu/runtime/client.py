@@ -418,6 +418,11 @@ class ControlPlaneClient:
             except (OSError, OcmError):
                 pass
         if not detach:
+            # Clean-close terminal for the audit timeline: DISCONNECT is
+            # fire-and-forget (a stopping daemon may never read it — the
+            # lease reaper is the backstop), so the client's own journal
+            # records that this app's lease chain ended deliberately.
+            obs_journal.record("app_close", pid=self.pid, rank=self.rank)
             # Bounded lock (mirrors libocm.cc's try_lock teardown): a beat
             # already inside _request holds _ctrl_lock mid send/recv, and an
             # unlocked send here would interleave frames and corrupt the

@@ -379,6 +379,15 @@ class Daemon:
         a SIGKILL'd daemon process looks like to its peers. Idempotent;
         a later :meth:`stop` (cluster teardown) is a no-op on top."""
         self._started_ok = False  # a kill must never write a snapshot
+        # Black-box flush FIRST: the journal ring is the evidence the
+        # post-mortem auditor needs, and a hard kill used to discard it.
+        # With the flight recorder armed (OCM_FLIGHTREC) the ring is
+        # dumped to a labelled segment; streamed duplicates dedup away
+        # at merge time, so this can only ADD evidence.
+        obs_journal.record(
+            "daemon_kill", track=self.tracer.track, rank=self.rank,
+        )
+        obs_journal.spill_ring(label=f"kill-r{self.rank}")
         self._running.clear()
         if self._listener is not None:
             try:
@@ -1117,6 +1126,11 @@ class Daemon:
         handles live), so the fan-out is O(owners); a crashed app never sends
         DISCONNECT and falls back to the lease reaper."""
         pid = msg.fields["pid"]
+        # Terminal event for the app's lease-renewal chain: the auditor
+        # requires every renewing app to end in disconnect/free/reclaim.
+        obs_journal.record(
+            "app_disconnect", track=self.tracer.track, pid=pid,
+        )
         self._reclaim_app_local(pid, self.rank)
         # The tenant's whole QoS state goes with it — quota give-back for
         # remote-owned allocations the origin ledger still remembered.
@@ -1622,6 +1636,11 @@ class Daemon:
                     pass
             self.device_books[e.device_index].free(e.extent)
         alloctrace.note_free(self._trace_scope, alloc_id)
+        obs_journal.record(
+            "free_local", track=self.tracer.track, alloc_id=alloc_id,
+            nbytes=e.nbytes, origin_pid=e.origin_pid,
+            origin_rank=e.origin_rank, migrating=bool(e.migrating),
+        )
         if e.migrating:
             # Dropping a quarantined migration copy (stream abort): its
             # bytes were never counted at rank 0 and the tenant's quota
@@ -1781,6 +1800,15 @@ class Daemon:
             # landed but UNACKED) once the flip fence is up.
             self._note_migration_write(e.alloc_id, f["offset"], f["nbytes"])
             self._fan_out_put(e, f["offset"], f["nbytes"], msg.data)
+            # Client-facing ack (never the fan-out legs themselves): the
+            # auditor pairs this against the replica_fanout recorded
+            # above — an ack with chain>1 and no prior fan-out is a
+            # durability violation.
+            obs_journal.record(
+                "put_ack", track=self.tracer.track,
+                alloc_id=e.alloc_id, offset=f["offset"],
+                nbytes=f["nbytes"], chain=len(e.chain),
+            )
         return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
 
     def _fan_out_put(self, e: RegEntry, offset: int, nbytes: int,
@@ -1834,6 +1862,18 @@ class Daemon:
                 f"replica rank {rr} unreachable for alloc {e.alloc_id} "
                 f"({type(err).__name__}: {err}); retry after the "
                 "detector resolves it"
+            )
+        if len(e.chain) > 1:
+            # Every live leg landed (dead members skipped + counted):
+            # recorded BEFORE the caller acks, which is exactly the
+            # order the audit invariant checks.
+            obs_journal.record(
+                "replica_fanout", track=self.tracer.track,
+                alloc_id=e.alloc_id, offset=offset, nbytes=nbytes,
+                legs=sum(1 for rr in e.chain
+                         if rr != self.rank and not self._believed_dead(rr)),
+                skips=sum(1 for rr in e.chain
+                          if rr != self.rank and self._believed_dead(rr)),
             )
 
     def _on_data_get(self, msg: Message) -> Message:
@@ -1940,6 +1980,12 @@ class Daemon:
             view = memoryview(self.host_arena.view(e.extent))
             data = bytes(view[f["offset"]:f["offset"] + f["nbytes"]])
             self._fan_out_put(e, f["offset"], f["nbytes"], data)
+        if not msg.flags & FLAG_FANOUT:
+            obs_journal.record(
+                "put_ack", track=self.tracer.track,
+                alloc_id=e.alloc_id, offset=f["offset"],
+                nbytes=f["nbytes"], chain=len(e.chain),
+            )
         return Message(MsgType.DATA_PUT_OK, {"nbytes": f["nbytes"]})
 
     def _on_shm_get(self, msg: Message) -> Message:

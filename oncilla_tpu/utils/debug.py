@@ -8,6 +8,7 @@ but adds what SURVEY.md §5.1 calls for: per-op latency/bandwidth counters.
 
 from __future__ import annotations
 
+import bisect
 import logging
 import os
 import threading
@@ -37,6 +38,16 @@ def printd(msg: str, *args) -> None:
     _logger.debug(msg, *args)
 
 
+# Fixed log-spaced latency histogram bounds (seconds), +Inf implicit.
+# Unlike the p50/p99 gauges (computed over the bounded sample ring, so
+# they forget), the bucket counts are true CUMULATIVE counters over the
+# op's lifetime — what a Prometheus scraper can rate() and quantile over
+# (ocm_op_latency_seconds_bucket in obs/prom.py).
+LATENCY_BUCKETS_S: tuple[float, ...] = (
+    50e-6, 200e-6, 1e-3, 5e-3, 20e-3, 100e-3, 500e-3, 2.0,
+)
+
+
 @dataclass
 class OpStats:
     count: int = 0
@@ -46,6 +57,17 @@ class OpStats:
     # latencies (a capped list kept only the oldest and froze p50 at the
     # warm-up distribution, and could overshoot the cap under races).
     samples_s: "deque[float]" = field(default_factory=deque)
+    # Lifetime histogram: bucket_counts[i] = spans with latency <=
+    # LATENCY_BUCKETS_S[i] (last slot = +Inf overflow). exemplars maps a
+    # bucket index to the (trace_id, latency_s, wall_ts) of the most
+    # recent traced span that landed there — the scrape-side hook from a
+    # latency bucket back into the distributed trace.
+    bucket_counts: list[int] = field(
+        default_factory=lambda: [0] * (len(LATENCY_BUCKETS_S) + 1)
+    )
+    exemplars: dict[int, tuple[int, float, float]] = field(
+        default_factory=dict
+    )
 
     def _quantile(self, q: float) -> float:
         if not self.samples_s:
@@ -165,6 +187,10 @@ class Tracer:
                 st.total_s += dt
                 st.total_bytes += nbytes
                 st.samples_s.append(dt)  # deque(maxlen) evicts the oldest
+                bi = bisect.bisect_left(LATENCY_BUCKETS_S, dt)
+                st.bucket_counts[bi] += 1
+                if ctx is not None and ctx.trace_id:
+                    st.exemplars[bi] = (ctx.trace_id, dt, time.time())
             if journal_on:
                 _journal.record(
                     "span", op=op, track=self.track, nbytes=nbytes,
@@ -186,6 +212,8 @@ class Tracer:
                 total_s=st.total_s,
                 total_bytes=st.total_bytes,
                 samples_s=deque(st.samples_s),
+                bucket_counts=list(st.bucket_counts),
+                exemplars=dict(st.exemplars),
             )
 
     def note_transfer(
@@ -234,6 +262,21 @@ class Tracer:
                     "p99_us": v.p99_s * 1e6,
                     "gbps": v.gbps,
                     "total_bytes": v.total_bytes,
+                    # Lifetime latency histogram + trace exemplars
+                    # (JSON-safe: rides the STATUS data tail).
+                    "hist": {
+                        "le": list(LATENCY_BUCKETS_S),
+                        "counts": list(v.bucket_counts),
+                        "sum_s": v.total_s,
+                        "exemplars": {
+                            str(i): {
+                                "trace_id": f"{tid:016x}",
+                                "value": val,
+                                "ts": ts,
+                            }
+                            for i, (tid, val, ts) in v.exemplars.items()
+                        },
+                    },
                 }
                 for k, v in self._stats.items()
             }

@@ -75,6 +75,23 @@ echo "== chaos smoke =="
 # restore k, and the same seed must replay the identical interleaving.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --smoke || fail=1
 
+echo "== obs audit smoke =="
+# Flight recorder + cross-rank invariant auditor, end to end through
+# the CLI: re-run the kill-owner chaos scenario with OCM_FLIGHTREC
+# armed so every rank's journal (the killed owner's included) spills to
+# CRC-framed segments, then audit the on-disk timelines cluster-wide —
+# epoch monotonicity, migration pairing, fan-out-before-ack, lease
+# termination — asserting zero findings. A failure keeps the black box.
+frdir=$(mktemp -d)
+if JAX_PLATFORMS=cpu OCM_FLIGHTREC="$frdir" \
+        python -m oncilla_tpu.resilience --smoke >/dev/null \
+    && JAX_PLATFORMS=cpu python -m oncilla_tpu.obs audit "$frdir"; then
+    rm -rf "$frdir"
+else
+    echo "check.sh: obs audit smoke failed (black box kept at $frdir)"
+    fail=1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

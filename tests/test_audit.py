@@ -1,0 +1,377 @@
+"""Cross-rank invariant auditor: every rule catches its seeded
+violation with a typed finding, legitimate timelines stay clean, and
+the ``python -m oncilla_tpu.obs audit`` CLI exits nonzero on findings.
+"""
+
+import pytest
+
+from oncilla_tpu.obs import audit, flightrec, journal
+from oncilla_tpu.obs.__main__ import main as obs_main
+
+
+def _ev(ev, ts, seq, jid="j1", **kw):
+    return {"ev": ev, "ts": ts, "jid": jid, "seq": seq, **kw}
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _audit(events, problems=None):
+    findings, _stats = audit.audit_events(events, problems or [])
+    return findings
+
+
+# -- epoch monotonicity --------------------------------------------------
+
+
+def test_epoch_regression_is_caught():
+    evs = [
+        _ev("member_join", 1.0, 1, track="daemon-r0", rank=2, epoch=5),
+        _ev("member_leave", 2.0, 2, track="daemon-r0", rank=2, epoch=3),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["epoch-monotonic"]
+    f = findings[0]
+    assert f.rank == 0 and "5 -> 3" in f.message
+    assert f.events == ("j1:1", "j1:2")
+
+
+def test_epoch_advance_and_cross_rank_skew_are_clean():
+    evs = [
+        # Rank 1 hears of epoch 4 before rank 0's journal shows 2: skew
+        # ACROSS daemons is fine — only a single daemon regressing is a
+        # violation.
+        _ev("fenced", 1.0, 1, track="daemon-r1", rank=1, epoch=4),
+        _ev("member_join", 2.0, 2, track="daemon-r0", rank=2, epoch=2),
+        _ev("member_leave", 3.0, 3, track="daemon-r0", rank=2, epoch=4),
+    ]
+    assert _audit(evs) == []
+
+
+def test_migrate_abort_begin_epoch_is_exempt():
+    # migrate_abort deliberately reports the migration's BEGIN epoch; a
+    # bump that landed mid-stream must not read as a regression.
+    evs = [
+        _ev("migrate_start", 1.0, 1, track="daemon-r1",
+            alloc_id=9, src=1, target=2, epoch=1),
+        _ev("node_dead", 2.0, 2, track="daemon-r1", dead_rank=2, epoch=2),
+        _ev("migrate_abort", 3.0, 3, track="daemon-r1",
+            alloc_id=9, src=1, target=2, epoch=1),
+    ]
+    assert _audit(evs) == []
+
+
+# -- migration pairing ---------------------------------------------------
+
+
+def test_migration_flip_pairs_cleanly():
+    evs = [
+        _ev("migrate_start", 1.0, 1, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+        _ev("migrate_flip", 2.0, 2, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+    ]
+    assert _audit(evs) == []
+
+
+def test_unterminated_migration_is_caught():
+    evs = [
+        _ev("migrate_start", 1.0, 1, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["migrate-pairing"]
+    assert "never reached" in findings[0].message
+
+
+def test_flip_and_abort_both_firing_is_caught():
+    evs = [
+        _ev("migrate_start", 1.0, 1, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+        _ev("migrate_flip", 2.0, 2, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+        _ev("migrate_abort", 3.0, 3, track="daemon-r2",
+            alloc_id=7, src=1, target=2, epoch=1),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["migrate-pairing"]
+    assert "BOTH" in findings[0].message
+
+
+def test_orphan_terminal_is_caught():
+    evs = [
+        _ev("migrate_flip", 2.0, 1, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["migrate-pairing"]
+    assert "without a migrate_start" in findings[0].message
+
+
+def test_double_abort_from_both_ends_is_clean():
+    # A killed source's own abort AND the target's source-died abort
+    # describe the same outcome; observing it from both ends is not a
+    # fork.
+    evs = [
+        _ev("migrate_start", 1.0, 1, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+        _ev("migrate_abort", 2.0, 2, track="daemon-r1",
+            alloc_id=7, src=1, target=2, stage="stream", epoch=1),
+        _ev("migrate_abort", 3.0, 3, track="daemon-r2",
+            alloc_id=7, src=1, target=2, stage="source-died", epoch=2),
+    ]
+    assert _audit(evs) == []
+
+
+# -- replica fan-out before ack ------------------------------------------
+
+
+def test_ack_before_fanout_is_caught():
+    evs = [
+        _ev("put_ack", 1.0, 1, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, chain=2),
+        _ev("replica_fanout", 2.0, 2, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, legs=1, skips=0),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["replica-ack"]
+    assert findings[0].rank == 1
+
+
+def test_fanout_then_ack_is_clean():
+    evs = [
+        _ev("replica_fanout", 1.0, 1, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, legs=1, skips=0),
+        _ev("put_ack", 2.0, 2, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, chain=2),
+    ]
+    assert _audit(evs) == []
+
+
+def test_unreplicated_ack_needs_no_fanout():
+    evs = [
+        _ev("put_ack", 1.0, 1, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, chain=0),
+    ]
+    assert _audit(evs) == []
+
+
+def test_seq_order_wins_over_colliding_wall_clock():
+    # Same wall-clock millisecond: the (jid, seq) order is program
+    # order, so the fan-out at seq 1 precedes the ack at seq 2 even
+    # though ts ties.
+    evs = [
+        _ev("put_ack", 5.0, 2, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, chain=2),
+        _ev("replica_fanout", 5.0, 1, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=64, legs=1, skips=0),
+    ]
+    assert _audit(evs) == []
+
+
+# -- lease chains --------------------------------------------------------
+
+
+def test_unterminated_lease_chain_is_caught():
+    evs = [
+        _ev("lease_renew", 1.0, 1, track="daemon-r0",
+            app_pid=42, app_rank=0, relayed=False),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["lease-chain"]
+    assert "app 42" in findings[0].message
+
+
+@pytest.mark.parametrize("terminal", [
+    {"ev": "app_disconnect", "track": "daemon-r0", "pid": 42},
+    {"ev": "app_close", "pid": 42, "rank": 0},
+    {"ev": "lease_reclaim", "track": "daemon-r0", "alloc_id": 1,
+     "origin_pid": 42, "origin_rank": 0},
+    {"ev": "free_local", "track": "daemon-r0", "alloc_id": 1,
+     "origin_pid": 42, "origin_rank": 0},
+    {"ev": "qos_evict", "track": "daemon-r0", "alloc_id": 1,
+     "priority": 0, "active": False, "origin_pid": 42},
+])
+def test_each_terminal_closes_the_lease_chain(terminal):
+    evs = [
+        _ev("lease_renew", 1.0, 1, track="daemon-r0",
+            app_pid=42, app_rank=0, relayed=False),
+        _ev(terminal.pop("ev"), 2.0, 2, **terminal),
+    ]
+    assert _audit(evs) == []
+
+
+# -- eviction priority ---------------------------------------------------
+
+
+def test_active_high_priority_eviction_is_caught():
+    evs = [
+        _ev("qos_evict", 1.0, 1, track="daemon-r2", alloc_id=5,
+            priority=2, active=True, origin_pid=9),
+    ]
+    findings = _audit(evs)
+    assert [(f.rule, f.rank) for f in findings] == [
+        ("eviction-priority", 2)
+    ]
+
+
+def test_low_or_expired_evictions_are_clean():
+    evs = [
+        _ev("qos_evict", 1.0, 1, track="daemon-r2", alloc_id=5,
+            priority=0, active=True, origin_pid=9),
+        _ev("qos_evict", 2.0, 2, track="daemon-r2", alloc_id=6,
+            priority=2, active=False, origin_pid=9),
+        # Expired evictions terminate the app's chain, keeping the
+        # timeline clean of lease-chain findings too.
+        _ev("lease_renew", 0.5, 3, track="daemon-r2",
+            app_pid=9, app_rank=0, relayed=False),
+    ]
+    assert _audit(evs) == []
+
+
+# -- fenced silence ------------------------------------------------------
+
+
+def test_post_fence_ack_is_caught():
+    evs = [
+        _ev("fenced", 1.0, 1, track="daemon-r1", rank=1, epoch=2),
+        _ev("put_ack", 2.0, 2, track="daemon-r1",
+            alloc_id=3, offset=0, nbytes=8, chain=0),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["fenced-silence"]
+    assert findings[0].rank == 1
+
+
+def test_other_ranks_keep_acking_after_a_fence():
+    evs = [
+        _ev("fenced", 1.0, 1, track="daemon-r1", rank=1, epoch=2),
+        _ev("put_ack", 2.0, 2, track="daemon-r2",
+            alloc_id=3, offset=0, nbytes=8, chain=0),
+    ]
+    assert _audit(evs) == []
+
+
+# -- journal continuity --------------------------------------------------
+
+
+def test_gap_in_spilled_stream_is_caught():
+    evs = [
+        _ev("span", 1.0, 1, op="a"),
+        _ev("span", 2.0, 2, op="b"),
+        _ev("span", 3.0, 5, op="c"),
+    ]
+    findings = _audit(evs)
+    assert _rules(findings) == ["journal-gap"]
+    assert "2 event(s) missing" in findings[0].message
+
+
+# -- finding shape -------------------------------------------------------
+
+
+def test_finding_render_carries_rule_rank_and_refs():
+    f = audit.AuditFinding(rule="epoch-monotonic", rank=3,
+                           message="epoch regressed 5 -> 3",
+                           events=("j1:7", "j1:9"))
+    s = f.render()
+    assert s.startswith("[epoch-monotonic]")
+    assert "rank=3" in s and "j1:7" in s
+
+
+# -- CLI: typed findings, nonzero exit ------------------------------------
+
+
+def _write_timeline(dirpath, events):
+    prev = flightrec.segment_dir()
+    flightrec.set_dir(str(dirpath))
+    try:
+        flightrec.dump_events(events, label="seeded")
+    finally:
+        flightrec.set_dir(prev)
+
+
+def test_cli_catches_seeded_epoch_violation(tmp_path, capsys):
+    """Acceptance: an injected out-of-order epoch event is caught by
+    ``python -m oncilla_tpu.obs audit`` with a typed finding and a
+    nonzero exit."""
+    _write_timeline(tmp_path / "t", [
+        _ev("member_join", 1.0, 1, track="daemon-r0", rank=1, epoch=4),
+        _ev("fenced", 2.0, 2, track="daemon-r0", rank=0, epoch=1),
+    ])
+    rc = obs_main(["audit", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "[epoch-monotonic]" in out
+    assert "1 finding(s)" in out
+
+
+def test_cli_clean_timeline_exits_zero(tmp_path, capsys):
+    _write_timeline(tmp_path / "t", [
+        _ev("span", 1.0, 1, op="put", track="client"),
+        _ev("migrate_start", 2.0, 2, track="daemon-r0",
+            alloc_id=1, src=0, target=1, epoch=1),
+        _ev("migrate_flip", 3.0, 3, track="daemon-r0",
+            alloc_id=1, src=0, target=1, epoch=1),
+    ])
+    rc = obs_main(["audit", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "clean" in out
+
+
+def test_cli_no_segments_is_usage_error(tmp_path, capsys):
+    assert obs_main(["audit", str(tmp_path)]) == 2
+    assert obs_main(["audit", str(tmp_path / "nope")]) == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    import json
+
+    _write_timeline(tmp_path / "t", [
+        _ev("migrate_flip", 1.0, 1, track="daemon-r1",
+            alloc_id=7, src=1, target=2, epoch=1),
+    ])
+    rc = obs_main(["audit", str(tmp_path), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    assert payload[0]["findings"][0]["rule"] == "migrate-pairing"
+
+
+def test_audit_tree_keeps_timelines_separate(tmp_path):
+    """Sibling recordings must not be conflated: the same (alloc, src,
+    target) migration appearing once per run would read as a double
+    flip if the runs merged."""
+    mig = dict(track="daemon-r0", alloc_id=1, src=0, target=1, epoch=1)
+    for run in ("run1", "run2"):
+        _write_timeline(tmp_path / run, [
+            _ev("migrate_start", 1.0, 1, **mig),
+            _ev("migrate_flip", 2.0, 2, **mig),
+        ])
+    results = audit.audit_tree(str(tmp_path))
+    assert len(results) == 2
+    assert all(findings == [] for _d, findings, _s in results)
+
+
+# -- the recorded() harness seam -----------------------------------------
+
+
+def test_recorded_raises_on_violation(tmp_path):
+    with pytest.raises(AssertionError, match="fenced-silence"):
+        with audit.recorded("seeded", strict=True) as rec:
+            # recorded() resolves its own dir; steer it via env-free
+            # temp default. Inject a fenced daemon that keeps acking.
+            journal.record("fenced", track="daemon-r9", rank=9, epoch=2)
+            journal.record("put_ack", track="daemon-r9", alloc_id=1,
+                           offset=0, nbytes=8, chain=0)
+    # The black box survives for the post-mortem.
+    assert flightrec.read_dir(rec.path)[0]
+
+
+def test_recorded_clean_run_reports_stats():
+    with audit.recorded("clean-run") as rec:
+        journal.record("span", op="x")
+    assert rec.findings == []
+    assert rec.stats["events"] == 1
+    assert "clean" in rec.summary()

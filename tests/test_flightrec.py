@@ -1,0 +1,227 @@
+"""Flight recorder: crash-safe CRC-framed journal spill (OCM_FLIGHTREC).
+
+Covers the spill stream (rotation, ring-overflow completeness, (jid,
+seq) dedup of ring dumps), the corruption contract (CRC mismatch is
+REPORTED, torn tails are tolerated crash evidence), and the kill paths:
+``Daemon.kill()`` and the chaos controller both flush the journal ring
+to disk, so a killed daemon's final events are recoverable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from oncilla_tpu.obs import audit, flightrec, journal
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.utils.config import OcmConfig
+
+from oncilla_tpu import OcmKind
+
+
+def _cfg(**kw) -> OcmConfig:
+    base = dict(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=128 << 10,
+        heartbeat_s=5.0,
+    )
+    base.update(kw)
+    return OcmConfig(**base)
+
+
+@pytest.fixture
+def spill(tmp_path):
+    """Journaling + spill into a fresh dir, prior state restored."""
+    d = str(tmp_path / "fr")
+    with flightrec.recording(d):
+        yield d
+
+
+def _segs(d):
+    return sorted(f for f in os.listdir(d) if f.endswith(".seg"))
+
+
+# -- stream basics -------------------------------------------------------
+
+
+def test_stream_spills_every_event(spill):
+    for i in range(10):
+        journal.record("span", op=f"op{i}", nbytes=i)
+    evs, problems = flightrec.read_dir(spill)
+    assert problems == []
+    assert [e["op"] for e in evs if e["ev"] == "span"] == [
+        f"op{i}" for i in range(10)
+    ]
+    # Spilled events keep their ring identity (the dedup key).
+    assert all("jid" in e and "seq" in e for e in evs)
+
+
+def test_segment_rotation_stays_bounded(spill):
+    old = flightrec._seg_bytes
+    flightrec.set_seg_bytes(600)
+    try:
+        for i in range(40):
+            journal.record("span", op=f"rot{i}")
+    finally:
+        flightrec.set_seg_bytes(old)
+    names = _segs(spill)
+    assert len(names) > 1, "stream never rotated past the segment bound"
+    # Bounded: no segment grows past the threshold by more than one frame.
+    for n in names:
+        assert os.path.getsize(os.path.join(spill, n)) < 600 + 400
+    evs, problems = flightrec.read_dir(spill)
+    assert problems == []
+    assert sum(1 for e in evs if e["ev"] == "span") == 40
+
+
+def test_ring_overflow_spill_keeps_full_stream(spill):
+    """Satellite: the in-memory ring stays bounded at the cap while the
+    spill keeps the complete stream (no journal-gap finding)."""
+    journal.set_cap(32)
+    try:
+        for i in range(200):
+            journal.record("span", op=f"ov{i}")
+        ring = journal.events()
+        assert len(ring) == 32  # bounded: old events fell off
+        assert ring[-1]["op"] == "ov199"
+    finally:
+        journal.set_cap(8192)
+    evs, problems = flightrec.read_dir(spill)
+    assert problems == []
+    assert sum(1 for e in evs if e["ev"] == "span") == 200
+    findings, stats = audit.audit_events(evs, problems)
+    assert [f for f in findings if f.rule == "journal-gap"] == []
+
+
+def test_ring_dump_dedups_against_stream(spill):
+    journal.record("span", op="a")
+    journal.record("span", op="b")
+    path = journal.spill_ring(label="testdump")
+    assert path is not None and os.path.exists(path)
+    evs, problems = flightrec.read_dir(spill)
+    assert problems == []
+    assert sum(1 for e in evs if e["ev"] == "span") == 2  # no duplicates
+
+
+# -- corruption contract -------------------------------------------------
+
+
+def test_crc_corruption_is_reported_not_skipped(spill):
+    for i in range(5):
+        journal.record("span", op=f"c{i}")
+    flightrec.flush()
+    seg = os.path.join(spill, _segs(spill)[0])
+    raw = bytearray(open(seg, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF  # flip a byte mid-stream
+    open(seg, "wb").write(raw)
+    evs, problems = flightrec.read_dir(spill)
+    assert any(p["kind"] == "crc" for p in problems)
+    findings, _stats = audit.audit_events(evs, problems)
+    corrupt = [f for f in findings if f.rule == "segment-corrupt"]
+    assert corrupt and "CRC mismatch" in corrupt[0].message
+
+
+def test_torn_tail_is_tolerated_crash_evidence(spill):
+    for i in range(3):
+        journal.record("span", op=f"t{i}")
+    flightrec.flush()
+    seg = os.path.join(spill, _segs(spill)[0])
+    raw = open(seg, "rb").read()
+    open(seg, "wb").write(raw[:-5])  # SIGKILL mid-write: torn last frame
+    evs, problems = flightrec.read_dir(spill)
+    assert any(p["kind"] == "truncated" for p in problems)
+    assert sum(1 for e in evs if e["ev"] == "span") == 2  # prefix intact
+    findings, stats = audit.audit_events(evs, problems)
+    assert [f for f in findings if f.rule == "segment-corrupt"] == []
+    assert stats["truncated_segments"] == 1
+
+
+def test_bad_magic_is_reported(spill):
+    journal.record("span", op="x")
+    flightrec.flush()
+    seg = os.path.join(spill, _segs(spill)[0])
+    raw = bytearray(open(seg, "rb").read())
+    raw[0] ^= 0xFF
+    open(seg, "wb").write(raw)
+    _evs, problems = flightrec.read_dir(spill)
+    assert any(p["kind"] == "header" for p in problems)
+
+
+# -- kill paths flush the black box --------------------------------------
+
+
+def test_daemon_kill_flushes_ring_to_spill(tmp_path):
+    """Satellite regression: kill a daemon mid-workload and recover its
+    final journal events from the spill dir — the evidence kill() used
+    to discard."""
+    d = str(tmp_path / "fr")
+    with flightrec.recording(d):
+        with local_cluster(2, config=_cfg()) as c:
+            client = c.client(0, heartbeat=False)
+            h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+            data = np.arange(1 << 20, dtype=np.uint8)
+            client.put(h, data, 0)
+            victim = h.rank
+            c.kill(victim)
+        evs, problems = flightrec.read_dir(d)
+    assert problems == []
+    kills = [e for e in evs if e["ev"] == "daemon_kill"]
+    assert [e["rank"] for e in kills] == [victim]
+    # The killed daemon's serve-side events survived onto disk.
+    victim_track = f"daemon-r{victim}"
+    assert any(
+        e.get("track") == victim_track and e["ev"] == "span"
+        for e in evs
+    ), "killed daemon left no serve spans in the black box"
+
+
+def test_chaos_controller_snapshots_victim_ring(tmp_path):
+    from oncilla_tpu.resilience.chaos import ChaosController, ChaosSchedule
+
+    d = str(tmp_path / "fr")
+    entries = [NodeEntry(0, "127.0.0.1", 7001)]
+    killed = []
+    with flightrec.recording(d):
+        journal.record("span", op="pre-kill")
+        schedule = ChaosSchedule.kill_at(seed=1, rank=0, op=1)
+        c = ChaosController(schedule, entries, kill_fn=killed.append)
+        c("127.0.0.1", 7001)  # the pool-lease hook fires the kill
+        assert killed == [0]
+        ring = c.victim_rings[0]
+        assert any(e.get("op") == "pre-kill" for e in ring)
+        evs, problems = flightrec.read_dir(d)
+    assert problems == []
+    # The snapshot was also spilled (dedup keeps one copy of each event).
+    assert sum(1 for e in evs if e.get("op") == "pre-kill") == 1
+
+
+def test_env_var_dir_is_created_lazily(tmp_path, monkeypatch):
+    """Regression: OCM_FLIGHTREC points at a dir nobody ever mkdir'd
+    (the env-var path never goes through set_dir) — the first segment
+    open must create it instead of silently disarming the spill."""
+    d = str(tmp_path / "envdir" / "nested")
+    was = journal.enabled()
+    journal.set_enabled(True)
+    monkeypatch.setattr(flightrec, "_dir", d)
+    try:
+        journal.record("span", op="lazy")
+        flightrec.flush()
+        evs, problems = flightrec.read_dir(d)
+        assert problems == []
+        assert any(e.get("op") == "lazy" for e in evs)
+    finally:
+        flightrec.set_dir(None)
+        journal.set_enabled(was)
+
+
+def test_spill_unconfigured_is_free():
+    was = journal.enabled()
+    journal.set_enabled(True)
+    try:
+        assert not flightrec.configured()
+        journal.record("span", op="nospill")  # must not raise
+        assert journal.spill_ring() is None
+    finally:
+        journal.set_enabled(was)

@@ -321,12 +321,17 @@ def test_trace_disabled_by_config_never_offers(journaling):
 _SAMPLE_RE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$"
 )
+# OpenMetrics-style exemplar tail on a histogram bucket sample:
+# `... 7 # {trace_id="abc"} 0.093 1722...` — scrapers that predate
+# exemplars ignore everything after the `#`.
+_EXEMPLAR_RE = re.compile(r" # \{[^{}]*\} [^ ]+( [^ ]+)?$")
 
 
 def _validate_prom(text: str) -> dict:
     """Minimal Prometheus text-format validator: HELP/TYPE pairs precede
     their family's samples, families are contiguous (never interleaved),
-    no duplicate series, every value parses as a float. Returns
+    histogram samples use their family's _bucket/_sum/_count names, no
+    duplicate series, every value parses as a float. Returns
     {family: [series...]}."""
     families: dict[str, list[str]] = {}
     typed: dict[str, str] = {}
@@ -348,14 +353,28 @@ def _validate_prom(text: str) -> dict:
             assert kind in ("counter", "gauge", "histogram", "summary")
             typed[fam] = kind
         else:
+            raw_line = line
+            ex = _EXEMPLAR_RE.search(line)
+            if ex is not None:
+                assert typed.get(cur) == "histogram", (
+                    f"exemplar outside a histogram family: {line!r}"
+                )
+                line = line[: ex.start()]
             assert _SAMPLE_RE.match(line), f"malformed sample: {line!r}"
             series, value = line.rsplit(" ", 1)
             fam = series.split("{", 1)[0]
-            assert fam == cur, f"sample {fam} interleaved into {cur}"
+            if typed.get(cur) == "histogram":
+                assert fam in (cur, f"{cur}_bucket", f"{cur}_sum",
+                               f"{cur}_count"), (
+                    f"sample {fam} interleaved into histogram {cur}"
+                )
+            else:
+                assert fam == cur, f"sample {fam} interleaved into {cur}"
             assert fam not in closed, f"family {fam} reopened"
             assert series not in seen_series, f"duplicate series {series}"
             seen_series.add(series)
             float(value)  # must parse
+            families[cur].append(raw_line)
     assert families, "no families rendered"
     assert set(families) == set(typed), "family missing a TYPE line"
     return families
@@ -382,6 +401,50 @@ def test_prom_render_validates():
     assert "ocm_op_total" in fams
     assert "ocm_lease_renewals_total" in fams
     assert "ocm_app_heartbeat_age_seconds" in fams
+
+
+def test_prom_histogram_renders_with_exemplars():
+    """The cumulative ocm_op_latency_seconds histogram validates, sums
+    to the span count, and carries a trace-id exemplar on the bucket
+    that holds the most recent traced span."""
+    tr = Tracer(track="histtest")
+    for _ in range(4):
+        with tr.span("put", nbytes=8):
+            pass
+    meta = {
+        "rank": 1, "nnodes": 1, "live_allocs": 0,
+        "ops": tr.snapshot(), "transfers": [],
+        "host_arena": {}, "device_books": [], "leases": {},
+    }
+    text = prom.render(meta)
+    fams = _validate_prom(text)
+    buckets = [s for s in fams["ocm_op_latency_seconds"]
+               if "_bucket{" in s]
+    assert any('le="+Inf"} 4' in s for s in buckets)
+    assert any('_count{' in s and s.endswith(" 4")
+               for s in fams["ocm_op_latency_seconds"])
+    assert any("trace_id=" in s for s in buckets), (
+        "no exemplar on any bucket"
+    )
+
+
+def test_merge_tiebreak_same_rank_same_millisecond():
+    """Satellite: events one process recorded in the same wall-clock
+    instant keep their (jid, seq) program order in the merged stream —
+    a ts-only sort interleaved them arbitrarily."""
+    colliding = [
+        {"ev": "span", "ts": 7.0, "jid": "a", "seq": 3, "op": "third"},
+        {"ev": "span", "ts": 7.0, "jid": "a", "seq": 1, "op": "first"},
+        {"ev": "span", "ts": 7.0, "jid": "a", "seq": 2, "op": "second"},
+    ]
+    merged = export.merge(colliding)
+    assert [e["op"] for e in merged] == ["first", "second", "third"]
+    # Cross-process: jid is the secondary key, so each process's run
+    # stays internally ordered.
+    other = [{"ev": "span", "ts": 7.0, "jid": "b", "seq": 9, "op": "x"}]
+    merged = export.merge(colliding, other)
+    a_ops = [e["op"] for e in merged if e["jid"] == "a"]
+    assert a_ops == ["first", "second", "third"]
 
 
 def _write_nodefile(tmp_path, entries) -> str:
@@ -460,6 +523,24 @@ def test_cli_trace_merges_cluster_journals(tmp_path, capsys, journaling):
     spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
     keys = [(e["args"]["span_id"]) for e in spans]
     assert len(keys) == len(set(keys))
+
+
+def test_cli_watch_single_iteration(tmp_path, capsys):
+    """``--watch`` redraws the table; ``--watch-count 1`` bounds it for
+    non-interactive runs, and the header carries the new latency
+    histogram column."""
+    with local_cluster(1, config=_cfg()) as c:
+        client = c.client(0, heartbeat=False)
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        client.put(h, np.zeros(1 << 20, np.uint8))
+        nodefile = _write_nodefile(tmp_path, c.entries)
+        rc = obs_main(["--nodefile", nodefile, "--watch", "0.1",
+                       "--watch-count", "1"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.count("lat_hist") == 1  # exactly one redraw
+        assert "every 0.1s" in out
+        client.free(h)
 
 
 def test_cli_smoke_passes():
