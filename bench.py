@@ -776,6 +776,16 @@ def bench_dcn(errors: dict) -> dict:
             out["fabric"] = dcn_fabric_sweep(sizes=(256 << 20,), iters=1)
         except Exception as e:  # noqa: BLE001
             errors["dcn_fabric"] = f"{type(e).__name__}: {e}"
+        # Python-vs-native serving on the same host (the --daemon axis):
+        # the same striped/coalesced client against a Python daemon pair
+        # and a native C++ pair, per-cell — detail.dcn.native's ratio
+        # rows isolate the serving implementation in the trajectory.
+        try:
+            from oncilla_tpu.benchmarks.dcn import dcn_daemon_sweep
+
+            out["native"] = dcn_daemon_sweep(nbytes=256 << 20, iters=1)
+        except Exception as e:  # noqa: BLE001
+            errors["dcn_native"] = f"{type(e).__name__}: {e}"
         return out
     except Exception as e:  # noqa: BLE001
         errors["dcn"] = f"{type(e).__name__}: {e}"

@@ -15,7 +15,9 @@ Contracts preserved from the client-resident engine:
   and the only get path (get replies carry the data; nothing coalesces).
 - :func:`stripe_put_coalesced` requires the peer to have granted
   FLAG_CAP_COALESCE: every chunk but the last carries FLAG_MORE and the
-  daemon answers ONCE per burst.
+  daemon answers ONCE per burst. Both serving implementations grant it —
+  the Python daemon since PR 3 and the native C++ daemon since its epoll
+  data plane landed — so the lockstep fallback is for OLD v2 peers only.
 - Both carry absolute offsets, so a retryable failure mid-stripe gets a
   full idempotent re-run of that stripe by the caller's ladder.
 """

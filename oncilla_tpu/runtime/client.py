@@ -647,10 +647,12 @@ class ControlPlaneClient:
         grants; a granted fabric offer additionally carries the daemon's
         fabric descriptor tail, which this probe resolves to an ATTACHED
         PeerFabric (or None when unreachable — cross-host pairs fail the
-        attach and run tcp). Old Python daemons and the unmodified C++
-        daemon reply with flags=0 — the probe is how the new client
-        discovers it must stay on the lockstep one-ACK-per-chunk
-        protocol and ship plain untraced frames."""
+        attach and run tcp). Old v2 Python daemons reply with flags=0 —
+        the probe is how the new client discovers it must stay on the
+        lockstep one-ACK-per-chunk protocol and ship plain untraced
+        frames. The native C++ daemon grants exactly FLAG_CAP_COALESCE
+        (its epoll data plane serves coalesced striped puts) and
+        declines everything else by silence."""
         with self._dcn_lock:
             caps = self._dcn_caps.get(addr)
         if caps is not None:

@@ -1,5 +1,16 @@
 // First-fit coalescing arena suballocator — C++ twin of
 // oncilla_tpu/core/arena.py (same semantics, same error behavior).
+//
+// Concurrency contract the epoll data plane leans on: alloc()/release()
+// are serialized by the internal mutex, and the daemon scrubs an
+// extent's bytes BEFORE release() returns the offset to the free book.
+// A zero-copy DATA_PUT landing (the event loop writing a recycled
+// extent's bytes) can therefore only begin after the allocating
+// request observed the insert that followed this mutex — the
+// release-mutex → alloc-mutex → registry-insert chain is the
+// happens-before edge that keeps scrub, re-allocation, and landing
+// ordered across the serve threads (and visible to TSan as such).
+// Callers must not touch extent bytes outside that discipline.
 
 #pragma once
 

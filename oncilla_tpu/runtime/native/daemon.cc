@@ -3,11 +3,16 @@
 // Production C++ twin of the Python reference implementation in
 // oncilla_tpu/runtime/daemon.py, speaking the identical wire protocol
 // (protocol.hh). The analogue of the reference's bin/oncillamem
-// (/root/reference/src/main.c + mem.c + alloc.c): thread-per-connection TCP
-// server, rank-0 placement master (capacity-aware or neighbor round-robin),
-// allocation registry with heartbeat-renewed leases (the liveness upgrade the
-// reference left as a TODO, main.c:6-7), and the DCN data plane serving
-// one-sided put/get into a daemon-owned host arena.
+// (/root/reference/src/main.c + mem.c + alloc.c): an epoll-driven TCP
+// server (per-connection frame state machines; a bounded worker pool
+// serves the DATA plane, control messages keep their blocking semantics
+// on per-message threads), rank-0 placement master (capacity-aware or
+// neighbor round-robin), allocation registry with heartbeat-renewed
+// leases (the liveness upgrade the reference left as a TODO,
+// main.c:6-7), and the DCN data plane serving one-sided put/get into a
+// daemon-owned host arena — with the v2 data-plane capabilities
+// (FLAG_CAP_COALESCE ACK coalescing, zero-copy recv-into-arena DATA_PUT
+// landings) the Python daemon grew in PR 3.
 //
 // Build: cmake -S . -B build && cmake --build build   (or: make)
 // Run:   oncillamemd --nodefile FILE --rank N [flags]
@@ -17,9 +22,13 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <fcntl.h>
 #include <unistd.h>
+
+#include <deque>
 
 #include <algorithm>
 #include <array>
@@ -72,16 +81,19 @@ uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
   return crc ^ 0xFFFFFFFFu;
 }
 
-// Per-thread bulk-reply buffer pool (each data connection is served by
-// its own thread). take_bulk_buffer hands the pooled capacity to a reply
-// under construction; reclaim_bulk_buffer takes it back after the send.
-// Round-tripping the SAME vector avoids a fresh >=16 MiB allocation
-// (mmap + first-touch page faults) per DATA_GET chunk.
-thread_local std::vector<uint8_t> tl_bulk_buf;
-
-std::vector<uint8_t> take_bulk_buffer(const uint8_t* src, size_t n) {
+// Per-CONNECTION bulk-reply buffer pool. The epoll serve core hands a
+// connection's messages to whichever worker is free, so a per-THREAD
+// pool would interleave unrelated connections' reply buffers (and lose
+// the reuse whenever a different worker picks the next chunk);
+// per-connection pooling keeps the win — no fresh >=16 MiB allocation
+// (mmap + first-touch page faults) per DATA_GET chunk — with ownership
+// that matches the serve core's one-message-per-connection discipline.
+// take_bulk_buffer hands the pooled capacity to a reply under
+// construction; reclaim_bulk_buffer takes it back after the send.
+std::vector<uint8_t> take_bulk_buffer(std::vector<uint8_t>& pool,
+                                      const uint8_t* src, size_t n) {
   std::vector<uint8_t> buf;
-  buf.swap(tl_bulk_buf);
+  buf.swap(pool);
   // assign (not resize-then-copy): resize would value-initialize n bytes
   // only for the copy to overwrite them — a wasted full pass on the hot
   // path. assign reuses the pooled capacity and writes each byte once.
@@ -89,10 +101,10 @@ std::vector<uint8_t> take_bulk_buffer(const uint8_t* src, size_t n) {
   return buf;
 }
 
-void reclaim_bulk_buffer(Message& sent) {
-  if (sent.data.capacity() > tl_bulk_buf.capacity()) {
+void reclaim_bulk_buffer(std::vector<uint8_t>& pool, Message& sent) {
+  if (sent.data.capacity() > pool.capacity()) {
     sent.data.clear();
-    tl_bulk_buf.swap(sent.data);
+    pool.swap(sent.data);
   }
 }
 
@@ -495,6 +507,22 @@ class Daemon {
       throw std::runtime_error("bind failed on port " +
                                std::to_string(entries_[cfg_.rank].port));
     ::listen(listen_fd_, 64);
+    // The LISTEN fd is nonblocking so the event loop's accept drain never
+    // parks; accepted connection fds stay BLOCKING (reads go through
+    // FrameReader's MSG_DONTWAIT; replies ride the plain blocking
+    // send_msg, woken by shutdown(2) at stop time).
+    fcntl(listen_fd_, F_SETFL,
+          fcntl(listen_fd_, F_GETFL, 0) | O_NONBLOCK);
+    epoll_fd_ = ::epoll_create1(0);
+    wake_fd_ = ::eventfd(0, EFD_NONBLOCK);
+    if (epoll_fd_ < 0 || wake_fd_ < 0)
+      throw std::runtime_error("epoll/eventfd setup failed");
+    epoll_event ev = {};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
     running_ = true;
 
     if (cfg_.rank == 0) {
@@ -508,35 +536,60 @@ class Daemon {
     // the TSan test). Started only after the fallible setup above — a throw
     // while a joinable thread is live would hit std::terminate in ~thread.
     reaper_thread_ = std::thread([this] { reaper_loop(); });
+    // Bounded DATA-plane worker pool: N concurrent stripe connections are
+    // served by these few threads instead of N blocking ones. Control
+    // messages never queue here (they may block on nested peer requests;
+    // see handle_complete), so the pool can never deadlock on itself.
+    size_t nworkers = kDefaultWorkers();
+    if (const char* w = getenv("OCM_NATIVE_WORKERS")) {
+      long v = std::atol(w);
+      if (v >= 1 && v <= 64) nworkers = size_t(v);
+    }
+    for (size_t i = 0; i < nworkers; ++i)
+      pool_threads_.emplace_back([this] { worker_loop(); });
     started_ok_ = true;
     std::printf("oncillamemd rank=%lld listening on %s:%d\n",
                 (long long)cfg_.rank, entries_[cfg_.rank].host.c_str(),
                 entries_[cfg_.rank].port);
     std::fflush(stdout);
 
+    // The event loop: readiness only — per-connection frame assembly
+    // happens in FrameReader, dispatch on workers/control threads.
+    std::vector<epoll_event> events(64);
     while (running_) {
-      int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) break;
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      int buf = 4 << 20;  // stream 8 MiB chunks without window stalls
-      setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
-      setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
-      {
-        std::lock_guard<std::mutex> g(conns_mu_);
-        conns_.insert(fd);
+      int n = ::epoll_wait(epoll_fd_, events.data(), int(events.size()), -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
       }
-      std::lock_guard<std::mutex> g(reap_mu_);
-      serve_threads_.emplace_back([this, fd] { serve(fd); });
+      for (int i = 0; i < n && running_; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == wake_fd_) {
+          uint64_t tok;
+          while (::read(wake_fd_, &tok, sizeof(tok)) > 0) {
+          }
+          continue;
+        }
+        if (fd == listen_fd_) {
+          accept_ready();
+          continue;
+        }
+        handle_readable(fd);
+      }
     }
     stop();  // signal handler only requested; do the real teardown here
   }
 
   // Async-signal-safe: called from the SIGINT/SIGTERM handler. Only an
-  // atomic store + shutdown(2); the real teardown (mutexes, file I/O)
-  // happens on the main thread once accept() returns.
+  // atomic store + eventfd write/shutdown(2); the real teardown (mutexes,
+  // file I/O) happens on the main thread once epoll_wait returns.
   void request_stop() {
     running_.store(false);
     if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (wake_fd_ >= 0) {
+      uint64_t one = 1;
+      [[maybe_unused]] ssize_t r = ::write(wake_fd_, &one, sizeof(one));
+    }
   }
 
   void stop() {
@@ -546,21 +599,31 @@ class Daemon {
       ::close(listen_fd_);
       listen_fd_ = -1;
     }
-    // Quiesce serve threads before snapshotting (they check running_ before
-    // each request; kick them off their blocking recvs).
+    // Kick every serving thread off its socket before snapshotting: a
+    // pool worker blocked in a reply send (stalled client) wakes with an
+    // error once its fd is shut down.
     {
       std::lock_guard<std::mutex> g(conns_mu_);
-      for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
+      for (auto& kv : conns_) ::shutdown(kv.first, SHUT_RDWR);
     }
     // Unblock any worker waiting on a peer reply BEFORE joining — a hung
     // peer must not turn SIGTERM into an infinite hang (close_all also
     // refuses new dials from here on).
     peers_.close_all();
-    // Serve threads exit promptly once their sockets are shut down; join
-    // them (and the reaper) so no worker can touch a destroyed Daemon.
-    // Only the accept loop spawns serve threads and it has exited by now.
-    // Joins run outside reap_mu_: an exiting serve thread takes that lock
-    // for its final finished_ push.
+    // Drain the DATA-plane pool: stop flag + wakeup, then join.
+    {
+      std::lock_guard<std::mutex> g(queue_mu_);
+      queue_stop_ = true;
+    }
+    queue_cv_.notify_all();
+    for (std::thread& t : pool_threads_)
+      if (t.joinable()) t.join();
+    pool_threads_.clear();
+    // Control threads exit promptly once their sockets/peers are shut
+    // down; join them (and the reaper) so no thread can touch a
+    // destroyed Daemon. Only the event loop spawns control threads and
+    // it has exited by now. Joins run outside reap_mu_: an exiting
+    // control thread takes that lock for its final finished_ push.
     std::vector<std::thread> leftover;
     {
       std::lock_guard<std::mutex> g(reap_mu_);
@@ -570,6 +633,19 @@ class Daemon {
     for (std::thread& t : leftover)
       if (t.joinable()) t.join();
     if (reaper_thread_.joinable()) reaper_thread_.join();
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      for (auto& kv : conns_) ::close(kv.first);
+      conns_.clear();
+    }
+    if (epoll_fd_ >= 0) {
+      ::close(epoll_fd_);
+      epoll_fd_ = -1;
+    }
+    if (wake_fd_ >= 0) {
+      ::close(wake_fd_);
+      wake_fd_ = -1;
+    }
     if (started_ok_) save_snapshot();
   }
 
@@ -624,78 +700,302 @@ class Daemon {
     }
   }
 
-  void serve(int fd) {
-    // inbound_thread analogue (mem.c:319-393): loop until peer closes.
-    // Per-connection receive scratch: every bulk payload is consumed by
-    // its handler before the next recv (net.hh recv_msg contract).
-    std::vector<uint8_t> scratch;
-    while (running_) {
-      Message msg;
-      try {
-        msg = recv_msg(fd, &scratch);
-      } catch (const UnknownMsgError& e) {
-        // A type this build predates (elastic membership & co): the
-        // frame was fully consumed, the stream is in sync — decline
-        // the family with a typed BAD_MSG and keep serving, exactly
-        // how an un-upgraded v2 Python peer answers.
-        try {
-          send_msg(fd, err(ErrCode::BAD_MSG, e.what()));
-        } catch (const ProtocolError&) {
-          break;
-        }
-        continue;
-      } catch (const ProtocolError& e) {
-        // Clean close at a frame boundary is normal; anything else —
-        // malformed wire input, truncation, a reset from a crashed peer —
-        // is worth a diagnostic saying which (daemon.py twin).
-        if (std::string(e.what()) != "peer closed" && getenv("OCM_VERBOSE"))
-          std::fprintf(stderr, "oncillamemd: dropping conn: %s\n", e.what());
-        break;
-      }
-      Message reply;
-      try {
-        reply = dispatch(msg);
-      } catch (const OomError& e) {
-        reply = err(ErrCode::OOM, e.what());
-      } catch (const BoundsError& e) {
-        reply = err(ErrCode::BOUNDS, e.what());
-      } catch (const BadHandleError& e) {
-        reply = err(ErrCode::BAD_ALLOC_ID, e.what());
-      } catch (const PlacementError& e) {
-        reply = err(ErrCode::PLACEMENT, e.what());
-      } catch (const std::exception& e) {
-        reply = err(ErrCode::UNKNOWN, e.what());
-      }
-      try {
-        send_msg(fd, reply);
-      } catch (const ProtocolError&) {
-        break;
-      }
-      // Hand a sent bulk reply's buffer back to this thread's pool so the
-      // next DATA_GET reuses its capacity: a FRESH vector per 16 MiB
-      // reply goes through mmap + first-touch page faults + copy, which
-      // measured as ~40% of the GET leg's loopback bandwidth. (A pointer
-      // view into the arena would avoid the copy too, but it would extend
-      // the freed-extent race across a potentially stalled send — the
-      // snapshot copy keeps that window bounded to dispatch.)
-      reclaim_bulk_buffer(reply);
-    }
-    {
-      std::lock_guard<std::mutex> g(conns_mu_);
-      conns_.erase(fd);
-    }
-    ::close(fd);
-    // Last member access: report this thread as joinable-now so the accept
-    // loop can reclaim it (a joinable pthread's stack is not freed until
-    // joined; detaching instead would re-open the shutdown use-after-free).
-    std::lock_guard<std::mutex> g(reap_mu_);
-    finished_.push_back(std::this_thread::get_id());
+  // Per-connection serving state for the epoll core. Ownership is
+  // exclusive at any instant: the event loop owns the connection while
+  // assembling a frame (EPOLLONESHOT disarms it on delivery), then hands
+  // it — message attached — to exactly one worker/control thread, which
+  // re-arms it only after the reply is on the wire. `mu` makes each
+  // handoff an explicit synchronization point; it is never contended.
+  struct ServeConn {
+    explicit ServeConn(int f) : fd(f) {}
+    const int fd;
+    FrameReader reader;  // event-loop-thread only
+    std::mutex mu;       // held by the thread processing a message
+    std::vector<uint8_t> bulk_buf;  // pooled DATA_GET_OK reply capacity
+    // Coalesced-burst state (FLAG_MORE): per connection, so concurrent
+    // stripes on sibling sockets never interact (daemon.py twin).
+    uint64_t burst_nbytes = 0;
+    bool burst_open = false;
+    bool burst_err_set = false;
+    Message burst_err;
+  };
+
+  static size_t kDefaultWorkers() {
+    unsigned hw = std::thread::hardware_concurrency();
+    return std::max(2u, std::min(8u, hw ? hw : 2u));
   }
 
-  // Join serve threads that have finished (their stacks are not reclaimed
-  // until joined). Runs from the reaper loop so idle daemons reclaim too,
-  // not just ones with a steady stream of new connections. Joins happen
-  // outside reap_mu_ — the exiting thread's own final push needs that lock.
+  std::shared_ptr<ServeConn> conn_for(int fd) {
+    std::lock_guard<std::mutex> g(conns_mu_);
+    auto it = conns_.find(fd);
+    return it == conns_.end() ? nullptr : it->second;
+  }
+
+  void accept_ready() {
+    int one = 1;
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;  // EAGAIN (drained) or shutdown
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      int buf = 4 << 20;  // stream 8 MiB chunks without window stalls
+      setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+      setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+      {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        conns_.emplace(fd, std::make_shared<ServeConn>(fd));
+      }
+      epoll_event ev = {};
+      ev.events = EPOLLIN | EPOLLONESHOT;
+      ev.data.fd = fd;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  // Re-arm a connection for its next frame (EPOLLONESHOT handoff back to
+  // the event loop). Called by whichever thread finished the message.
+  void rearm(int fd) {
+    epoll_event ev = {};
+    ev.events = EPOLLIN | EPOLLONESHOT;
+    ev.data.fd = fd;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void close_conn(const std::shared_ptr<ServeConn>& c) {
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      conns_.erase(c->fd);
+    }
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, c->fd, nullptr);
+    ::close(c->fd);
+  }
+
+  // Event-loop read path: advance the connection's frame state machine.
+  // DATA_PUT payloads that fully validate land STRAIGHT in the
+  // destination arena extent via the router — the recv is the write.
+  void handle_readable(int fd) {
+    std::shared_ptr<ServeConn> c = conn_for(fd);
+    if (c == nullptr) return;  // raced a close
+    // Take the connection's ownership mutex for the read phase: the
+    // previous message's worker released it only after its rearm, so
+    // this acquire is the explicit happens-before edge for everything
+    // that thread did on the connection (burst state, the fd itself) —
+    // the epoll_ctl -> epoll_wait edge alone is invisible to older
+    // TSan runtimes. Never contended: EPOLLONESHOT guarantees the fd
+    // has no event in flight while a worker owns it.
+    std::lock_guard<std::mutex> own(c->mu);
+    FrameReader::Status st;
+    try {
+      st = c->reader.advance(fd, [this](Message& m, size_t n) {
+        return route_put_payload(m, n);
+      });
+    } catch (const ProtocolError& e) {
+      // Malformed wire input, truncation, a reset from a crashed peer —
+      // worth a diagnostic saying which (daemon.py twin).
+      if (getenv("OCM_VERBOSE"))
+        std::fprintf(stderr, "oncillamemd: dropping conn: %s\n", e.what());
+      close_conn(c);
+      return;
+    }
+    if (st == FrameReader::Status::kNeedMore) {
+      rearm(fd);
+      return;
+    }
+    if (st == FrameReader::Status::kClosed) {
+      close_conn(c);  // clean close at a frame boundary: normal
+      return;
+    }
+    Message msg;
+    try {
+      msg = c->reader.take();
+    } catch (const UnknownMsgError& e) {
+      // A type this build predates (elastic membership & co): the frame
+      // was fully consumed, the stream is in sync — decline the family
+      // with a typed BAD_MSG and keep serving, exactly how an
+      // un-upgraded v2 Python peer answers. The reply rides the pool
+      // (no dispatch, nothing to block on).
+      enqueue_work(c, Message{}, e.what());
+      return;
+    } catch (const ProtocolError& e) {
+      if (getenv("OCM_VERBOSE"))
+        std::fprintf(stderr, "oncillamemd: dropping conn: %s\n", e.what());
+      close_conn(c);
+      return;
+    }
+    handle_complete(c, std::move(msg));
+  }
+
+  // Route a completed message: DATA-plane ops ride the bounded worker
+  // pool (their dispatch never issues a daemon-to-daemon request that
+  // could wait on another pool, so the pool cannot deadlock on itself);
+  // everything else — the control plane, PLANE_* relays — keeps its
+  // blocking semantics on a per-message thread, the finer-grained twin
+  // of the old thread-per-connection serve loop (nested peer legs like
+  // REQ_FREE -> DO_FREE -> NOTE_FREE must never compete with stripe
+  // traffic for pool slots).
+  void handle_complete(const std::shared_ptr<ServeConn>& c, Message msg) {
+    if (msg.type == MsgType::DATA_PUT || msg.type == MsgType::DATA_GET) {
+      enqueue_work(c, std::move(msg), nullptr);
+      return;
+    }
+    std::lock_guard<std::mutex> g(reap_mu_);
+    serve_threads_.emplace_back(
+        [this, c, m = std::move(msg)]() mutable {
+          process_message(c, std::move(m), nullptr);
+          std::lock_guard<std::mutex> g2(reap_mu_);
+          finished_.push_back(std::this_thread::get_id());
+        });
+  }
+
+  struct Work {
+    std::shared_ptr<ServeConn> conn;
+    Message msg;
+    bool is_unknown = false;   // answer BAD_MSG(unknown_detail), no dispatch
+    std::string unknown_detail;
+  };
+
+  void enqueue_work(const std::shared_ptr<ServeConn>& c, Message msg,
+                    const char* unknown_detail) {
+    Work w;
+    w.conn = c;
+    w.msg = std::move(msg);
+    if (unknown_detail != nullptr) {
+      w.is_unknown = true;
+      w.unknown_detail = unknown_detail;
+    }
+    {
+      std::lock_guard<std::mutex> g(queue_mu_);
+      queue_.push_back(std::move(w));
+    }
+    queue_cv_.notify_one();
+  }
+
+  void worker_loop() {
+    while (true) {
+      Work w;
+      {
+        std::unique_lock<std::mutex> g(queue_mu_);
+        queue_cv_.wait(g, [this] { return queue_stop_ || !queue_.empty(); });
+        if (queue_stop_ && queue_.empty()) return;
+        w = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      process_message(w.conn, std::move(w.msg),
+                      w.is_unknown ? w.unknown_detail.c_str() : nullptr);
+    }
+  }
+
+  // Dispatch + reply for one message, on whichever thread owns the
+  // connection right now. Implements the ACK-coalescing contract
+  // (daemon.py _serve_conn twin): a DATA_PUT carrying FLAG_MORE is a
+  // non-final chunk of a burst — applied but NOT answered; the first
+  // chunk without the bit closes the burst and gets ONE reply covering
+  // all of it (total bytes on success, the burst's first ERROR
+  // otherwise). Replies stay FIFO per connection; there are simply
+  // fewer of them.
+  void process_message(const std::shared_ptr<ServeConn>& c, Message msg,
+                       const char* unknown_detail) {
+    std::lock_guard<std::mutex> own(c->mu);
+    Message reply;
+    bool is_put = false;
+    if (unknown_detail != nullptr) {
+      reply = err(ErrCode::BAD_MSG, unknown_detail);
+    } else {
+      is_put = msg.type == MsgType::DATA_PUT;
+      if (c->burst_open && !is_put) {
+        // A sender may not interleave other requests inside an
+        // unfinished burst — the reply stream would desync.
+        c->burst_open = false;
+        c->burst_err_set = false;
+        c->burst_nbytes = 0;
+        reply = err(ErrCode::BAD_MSG,
+                    "request inside an open DATA_PUT burst");
+      } else {
+        try {
+          reply = dispatch(*c, msg);
+        } catch (const OomError& e) {
+          reply = err(ErrCode::OOM, e.what());
+        } catch (const BoundsError& e) {
+          reply = err(ErrCode::BOUNDS, e.what());
+        } catch (const BadHandleError& e) {
+          reply = err(ErrCode::BAD_ALLOC_ID, e.what());
+        } catch (const PlacementError& e) {
+          reply = err(ErrCode::PLACEMENT, e.what());
+        } catch (const std::exception& e) {
+          reply = err(ErrCode::UNKNOWN, e.what());
+        }
+      }
+    }
+    bool more = is_put && (msg.flags & kFlagMore) != 0;
+    if (is_put && (more || c->burst_open)) {
+      if (!c->burst_open) c->burst_open = true;
+      if (reply.type == MsgType::ERR) {
+        if (!c->burst_err_set) {
+          c->burst_err = reply;
+          c->burst_err_set = true;
+        }
+      } else {
+        c->burst_nbytes += reply.u("nbytes");
+      }
+      if (more) {
+        rearm(c->fd);  // reply deferred to the burst's last chunk
+        return;
+      }
+      reply = c->burst_err_set
+                  ? c->burst_err
+                  : Message{MsgType::DATA_PUT_OK,
+                            {{"nbytes", Value::U(c->burst_nbytes)}},
+                            {}};
+      c->burst_open = false;
+      c->burst_err_set = false;
+      c->burst_nbytes = 0;
+    }
+    try {
+      send_msg(c->fd, reply);
+    } catch (const ProtocolError&) {
+      close_conn(c);
+      return;
+    }
+    // Hand a sent bulk reply's buffer back to this CONNECTION's pool so
+    // its next DATA_GET reuses the capacity: a FRESH vector per 16 MiB
+    // reply goes through mmap + first-touch page faults + copy, which
+    // measured as ~40% of the GET leg's loopback bandwidth. (A pointer
+    // view into the arena would avoid the copy too, but it would extend
+    // the freed-extent race across a potentially stalled send — the
+    // snapshot copy keeps that window bounded to dispatch.)
+    reclaim_bulk_buffer(c->bulk_buf, reply);
+    rearm(c->fd);
+  }
+
+  // Zero-copy DATA_PUT landing (daemon.py _route_put_payload twin): only
+  // a chunk that fully validates routes; anything questionable returns
+  // nullptr and takes the copy path, where the handler raises the typed
+  // error. TOCTOU note: a concurrent free could recycle the extent
+  // between this lookup and the recv completing — the same class of
+  // window the copy path already has, reachable only by an app freeing
+  // an allocation while actively writing it; the handler revalidates
+  // after the recv and answers BAD_ALLOC_ID so such a writer cannot
+  // treat the landing as durable.
+  uint8_t* route_put_payload(Message& m, size_t n_data) {
+    if (m.type != MsgType::DATA_PUT) return nullptr;
+    try {
+      uint64_t off = m.u("offset");
+      uint64_t n = m.u("nbytes");
+      if (n != n_data) return nullptr;
+      RegEntry e = registry_.lookup(m.u("alloc_id"));
+      if (!kind_is_host(e.kind)) return nullptr;  // device relay needs
+                                                  // the payload in-frame
+      if (off + n > e.nbytes || off + n < off) return nullptr;
+      return host_store_.data() + e.extent.offset + off;
+    } catch (const std::exception&) {
+      return nullptr;
+    }
+  }
+
+  // Join control threads that have finished (their stacks are not
+  // reclaimed until joined). Runs from the reaper loop so idle daemons
+  // reclaim too, not just ones with a steady stream of new messages.
+  // Joins happen outside reap_mu_ — the exiting thread's own final push
+  // needs that lock.
   void reap_finished() {
     std::vector<std::thread> done;
     {
@@ -718,18 +1018,30 @@ class Daemon {
             {}};
   }
 
-  Message dispatch(const Message& m) {
+  Message dispatch(ServeConn& c, const Message& m) {
     switch (m.type) {
       case MsgType::DISCONNECT:
         on_disconnect(m);
         [[fallthrough]];
-      case MsgType::CONNECT:
-        return {MsgType::CONNECT_CONFIRM,
-                {{"rank", Value::I(cfg_.rank)},
-                 {"nnodes", Value::I(cfg_.rank == 0
-                                         ? placement_.nnodes()
-                                         : int64_t(entries_.size()))}},
-                {}};
+      case MsgType::CONNECT: {
+        Message confirm{MsgType::CONNECT_CONFIRM,
+                        {{"rank", Value::I(cfg_.rank)},
+                         {"nnodes", Value::I(cfg_.rank == 0
+                                                 ? placement_.nnodes()
+                                                 : int64_t(entries_.size()))}},
+                        {}};
+        // Capability negotiation (protocol.py FLAG_CAP_* contract): echo
+        // exactly the offered bits this daemon implements — today only
+        // ACK coalescing. Every other offer (trace, replica, qos,
+        // fabric, and any QoS profile data tail riding the frame) is
+        // declined by silence: masked out of the echo, tail ignored, so
+        // un-upgraded clients and capability-rich ones both get exactly
+        // the protocol they can speak (pinned by the
+        // declined-by-silence tests).
+        if (m.type == MsgType::CONNECT)
+          confirm.flags = m.flags & kCapsImplemented;
+        return confirm;
+      }
       case MsgType::RECLAIM_APP:
         return {MsgType::RECLAIM_APP_OK,
                 {{"count",
@@ -745,7 +1057,7 @@ class Daemon {
       case MsgType::NOTE_FREE: return on_note_free(m);
       case MsgType::NOTE_ALLOC: return on_note_alloc(m);
       case MsgType::DATA_PUT: return on_data_put(m);
-      case MsgType::DATA_GET: return on_data_get(m);
+      case MsgType::DATA_GET: return on_data_get(c, m);
       case MsgType::PLANE_SERVE: return on_plane_serve(m);
       case MsgType::PLANE_PUT: return forward_to_plane(m);
       case MsgType::PLANE_GET: return forward_to_plane(m);
@@ -1112,17 +1424,24 @@ class Daemon {
   Message on_data_put(const Message& m) {
     RegEntry e = registry_.lookup(m.u("alloc_id"));
     uint64_t off = m.u("offset"), n = m.u("nbytes");
-    if (m.data.size() != n) throw ProtocolError("DATA_PUT length mismatch");
+    if (!m.data_landed && m.data.size() != n)
+      throw ProtocolError("DATA_PUT length mismatch");
     if (off + n > e.nbytes)
       throw BoundsError("access [" + std::to_string(off) + ", " +
                         std::to_string(off + n) + ") outside extent of " +
                         std::to_string(e.nbytes) + " B");
     if (!kind_is_host(e.kind)) return relay_device_op(m, e);
-    std::memcpy(host_store_.data() + e.extent.offset + off, m.data.data(), n);
+    // data_landed: the payload was recv'd STRAIGHT into the arena extent
+    // by route_put_payload (which enforced the same bounds); this
+    // post-recv revalidation is what makes the landing durable — a free
+    // racing the recv fails the lookup above and answers BAD_ALLOC_ID.
+    if (!m.data_landed)
+      std::memcpy(host_store_.data() + e.extent.offset + off, m.data.data(),
+                  n);
     return {MsgType::DATA_PUT_OK, {{"nbytes", Value::U(n)}}, {}};
   }
 
-  Message on_data_get(const Message& m) {
+  Message on_data_get(ServeConn& c, const Message& m) {
     RegEntry e = registry_.lookup(m.u("alloc_id"));
     uint64_t off = m.u("offset"), n = m.u("nbytes");
     if (off + n > e.nbytes)
@@ -1131,11 +1450,12 @@ class Daemon {
                         std::to_string(e.nbytes) + " B");
     if (!kind_is_host(e.kind)) return relay_device_op(m, e);
     Message r{MsgType::DATA_GET_OK, {{"nbytes", Value::U(n)}}, {}};
-    // Snapshot copy into this thread's pooled buffer: keeps the
+    // Snapshot copy into this CONNECTION's pooled buffer: keeps the
     // concurrent-free race window bounded to dispatch (a zero-copy arena
     // view would stream freed-then-reused bytes across a stalled send)
     // while skipping the fresh-allocation cost per chunk.
-    r.data = take_bulk_buffer(host_store_.data() + e.extent.offset + off, n);
+    r.data = take_bulk_buffer(c.bulk_buf,
+                              host_store_.data() + e.extent.offset + off, n);
     return r;
   }
 
@@ -1363,13 +1683,23 @@ class Daemon {
   PeerPool peers_;
   std::atomic<bool> running_{false};
   std::thread reaper_thread_;
+  // Per-message control threads (blocking semantics preserved), reaped
+  // from the reaper loop via finished_.
   std::vector<std::thread> serve_threads_;
   std::mutex reap_mu_;
   std::vector<std::thread::id> finished_;
+  // DATA-plane worker pool.
+  std::vector<std::thread> pool_threads_;
+  std::mutex queue_mu_;
+  std::condition_variable queue_cv_;
+  std::deque<Work> queue_;
+  bool queue_stop_ = false;
   bool started_ok_ = false;
   std::mutex conns_mu_;
-  std::set<int> conns_;
+  std::map<int, std::shared_ptr<ServeConn>> conns_;
   int listen_fd_ = -1;
+  int epoll_fd_ = -1;
+  int wake_fd_ = -1;
 };
 
 Daemon* g_daemon = nullptr;

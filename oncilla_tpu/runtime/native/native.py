@@ -7,6 +7,7 @@ ControlPlaneClient works unchanged against either.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
 import subprocess
@@ -17,15 +18,47 @@ BUILD_DIR = NATIVE_DIR / "build"
 BINARY = BUILD_DIR / "oncillamemd"
 
 
-def _stale(target: Path) -> bool:
-    srcs = [
-        *NATIVE_DIR.glob("*.cc"),
-        *NATIVE_DIR.glob("*.c"),
-        *NATIVE_DIR.glob("*.hh"),
-        *NATIVE_DIR.glob("*.h"),
-        NATIVE_DIR / "CMakeLists.txt",
-    ]
-    return target.stat().st_mtime < max(p.stat().st_mtime for p in srcs)
+def _source_fingerprint() -> str:
+    """Content hash over the whole native source tree (names + bytes).
+
+    The build cache is keyed on THIS, not on mtimes: mtime comparison
+    misses real edits (checkout-normalized or editor-preserved
+    timestamps, sub-second truncation on some filesystems, a clock that
+    stepped backwards), and a stale cached binary silently runs old
+    daemon code under every native test in the session."""
+    h = hashlib.sha256()
+    srcs = sorted(
+        p
+        for pat in ("*.cc", "*.c", "*.hh", "*.h", "CMakeLists.txt")
+        for p in NATIVE_DIR.glob(pat)
+    )
+    for p in srcs:
+        h.update(p.name.encode())
+        h.update(b"\0")
+        h.update(p.read_bytes())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def _stamp_path(target: Path) -> Path:
+    return target.with_name(target.name + ".srchash")
+
+
+def _cached(target: Path, fingerprint: str) -> bool:
+    """A target is reusable only when its recorded source fingerprint
+    matches the tree exactly; a missing stamp (pre-hash build dirs)
+    counts as stale."""
+    try:
+        return (
+            target.exists()
+            and _stamp_path(target).read_text().strip() == fingerprint
+        )
+    except OSError:
+        return False
+
+
+def _write_stamp(target: Path, fingerprint: str) -> None:
+    _stamp_path(target).write_text(fingerprint + "\n")
 
 
 def _run_logged(cmd: list[str], what: str) -> None:
@@ -44,25 +77,30 @@ def _run_logged(cmd: list[str], what: str) -> None:
 
 
 def build(force: bool = False, tsan: bool = False) -> Path:
-    """Build oncillamemd with CMake (+ Ninja when available); cached, but
-    rebuilt whenever any native source is newer than the binary (a stale
-    cached binary would silently test old daemon code). Containers
-    without cmake fall back to a direct compiler invocation of the same
-    two translation units — the daemon needs nothing from the build
-    system beyond -pthread, and skipping every native test for want of
-    cmake would leave the one-protocol property (Python client vs C++
-    daemon) unverified exactly where CI runs."""
+    """Build oncillamemd with CMake (+ Ninja when available); cached,
+    keyed on a content hash of the native source tree (mtime staleness
+    can miss edits and silently test old daemon code between runs — see
+    ``_source_fingerprint``). Containers without cmake fall back to a
+    direct compiler invocation of the same two translation units — the
+    daemon needs nothing from the build system beyond -pthread, and
+    skipping every native test for want of cmake would leave the
+    one-protocol property (Python client vs C++ daemon) unverified
+    exactly where CI runs."""
     target = BUILD_DIR / ("oncillamemd_tsan" if tsan else "oncillamemd")
-    if target.exists() and not force and not _stale(target):
+    fingerprint = _source_fingerprint()
+    if not force and _cached(target, fingerprint):
         return target
     if shutil.which("cmake") is None:
-        return _build_direct(target, tsan)
+        target = _build_direct(target, tsan)
+        _write_stamp(target, fingerprint)
+        return target
     gen = ["-G", "Ninja"] if shutil.which("ninja") else []
     cfg = ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), *gen]
     if tsan:
         cfg.append("-DOCM_TSAN=ON")
     _run_logged(cfg, "cmake configure")
     _run_logged(["cmake", "--build", str(BUILD_DIR)], "cmake build")
+    _write_stamp(target, fingerprint)
     return target
 
 
@@ -138,9 +176,11 @@ def spawn(
 
 def build_lib(force: bool = False) -> Path:
     """Build and return libocm_tpu.so — the C-linkable client library
-    (the app-linked libocm.so analogue, /root/reference/SConstruct:176)."""
+    (the app-linked libocm.so analogue, /root/reference/SConstruct:176).
+    Cached on the same source-tree content hash as :func:`build`."""
     target = BUILD_DIR / "libocm_tpu.so"
-    if target.exists() and not force and not _stale(target):
+    fingerprint = _source_fingerprint()
+    if not force and _cached(target, fingerprint):
         return target
     gen = ["-G", "Ninja"] if shutil.which("ninja") else []
     subprocess.run(
@@ -151,4 +191,5 @@ def build_lib(force: bool = False) -> Path:
         ["cmake", "--build", str(BUILD_DIR), "--target", "ocm_tpu", "ocm_c_demo"],
         check=True, capture_output=True,
     )
+    _write_stamp(target, fingerprint)
     return target

@@ -12,6 +12,7 @@
 
 #include <cerrno>
 #include <cstring>
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -121,6 +122,189 @@ inline Message recv_msg(int fd, std::vector<uint8_t>* scratch = nullptr) {
   if (plen) recv_all(fd, payload.data(), plen);
   return unpack(header, payload.data(), plen);
 }
+
+// Zero-copy landing hook for bulk payloads — the C++ twin of protocol.py
+// recv_msg(data_router=): called after a fixed-field bulk message's
+// fields are decoded but BEFORE its payload is read, it may return a
+// writable pointer to exactly n_data bytes (e.g. the destination arena
+// extent of a DATA_PUT — the recv IS the write, no scratch hop, no
+// copy). The message is then delivered with data_landed = true and an
+// empty Message::data. A nullptr return (or a router exception) takes
+// the ordinary copy path, where the handler raises the typed error.
+using DataRouter = std::function<uint8_t*(Message&, size_t)>;
+
+// Incremental frame assembly for ONE connection on a readiness-driven
+// (epoll) serve loop: feed it the fd whenever the loop reports
+// readability and it advances a header -> fields -> data state machine
+// with MSG_DONTWAIT reads, never blocking and never reading past the
+// current frame. The fd itself stays in blocking mode, so replies can
+// ride the ordinary send_msg path (a blocked send is woken by
+// shutdown(2) at stop time, exactly the thread-per-connection
+// semantics this replaces).
+//
+// advance() returns kNeedMore when the socket drained mid-frame,
+// kComplete when a full message is assembled (call take() before the
+// next advance), or kClosed on a clean EOF at a frame boundary; it
+// throws ProtocolError on malformed input or transport errors, leaving
+// the connection to be dropped. Unknown message TYPES are not an
+// advance() failure: the frame is consumed whole (the stream stays in
+// sync) and take() throws UnknownMsgError, which the serve loop
+// answers with a typed BAD_MSG — decline-by-silence for whole
+// families, same as the blocking recv_msg path.
+class FrameReader {
+ public:
+  enum class Status { kNeedMore, kComplete, kClosed };
+
+  Status advance(int fd, const DataRouter& router = nullptr) {
+    while (true) {
+      switch (phase_) {
+        case Phase::kHeader: {
+          Status st = fill(fd, header_ + got_, kHeaderSize);
+          if (st != Status::kComplete) return st;
+          on_header(router);
+          if (phase_ == Phase::kDone) return Status::kComplete;
+          break;
+        }
+        case Phase::kFields: {
+          Status st = fill(fd, fields_ + got_, ffix_);
+          if (st != Status::kComplete) return st;
+          on_fields(router);
+          if (phase_ == Phase::kDone) return Status::kComplete;
+          break;
+        }
+        case Phase::kData: {
+          Status st = fill(fd, data_dst_ + got_, n_data_);
+          if (st != Status::kComplete) return st;
+          phase_ = Phase::kDone;
+          return Status::kComplete;
+        }
+        case Phase::kPayload: {
+          Status st = fill(fd, payload_.data() + got_, plen_);
+          if (st != Status::kComplete) return st;
+          phase_ = Phase::kDone;
+          return Status::kComplete;
+        }
+        case Phase::kDone:
+          // take() was not called; nothing to read until it is.
+          return Status::kComplete;
+      }
+    }
+  }
+
+  // Move the completed message out and reset for the next frame. May
+  // throw (UnknownMsgError for a type this build predates,
+  // ProtocolError for malformed fields) — the reader is ALREADY reset
+  // when it does, so the stream stays usable at the next frame.
+  Message take() {
+    phase_ = Phase::kHeader;
+    got_ = 0;
+    if (fields_parsed_) {
+      fields_parsed_ = false;
+      Message out = std::move(msg_);
+      msg_ = Message{};
+      return out;
+    }
+    std::vector<uint8_t> payload;
+    payload.swap(payload_);
+    return unpack(header_, payload.data(), plen_);
+  }
+
+ private:
+  enum class Phase { kHeader, kFields, kData, kPayload, kDone };
+
+  // Read toward `want` total bytes of the current phase (got_ tracks
+  // progress); dst must point at the next unwritten byte.
+  Status fill(int fd, uint8_t* dst, size_t want) {
+    while (got_ < want) {
+      ssize_t r = ::recv(fd, dst, want - got_, MSG_DONTWAIT);
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return Status::kNeedMore;
+        if (errno == EINTR) continue;
+        throw ProtocolError(std::string("recv failed: ") + strerror(errno));
+      }
+      if (r == 0) {
+        if (phase_ == Phase::kHeader && got_ == 0) return Status::kClosed;
+        throw ProtocolError("peer closed mid-message");
+      }
+      got_ += size_t(r);
+      dst += size_t(r);
+    }
+    got_ = 0;
+    return Status::kComplete;
+  }
+
+  void on_header(const DataRouter&) {
+    if (std::memcmp(header_, kMagic, 4) != 0)
+      throw ProtocolError("bad magic");
+    if (header_[4] != kVersion) throw ProtocolError("unsupported version");
+    plen_ = 0;
+    for (int i = 0; i < 4; ++i)
+      plen_ |= uint64_t(header_[8 + i]) << (8 * i);
+    if (plen_ > kMaxPayload)
+      throw ProtocolError("advertised payload too large");
+    size_t ffix = SIZE_MAX;
+    try {
+      ffix = fixed_fields_size(MsgType(header_[5]));
+    } catch (const ProtocolError&) {
+      ffix = SIZE_MAX;  // unknown type: consume the frame, throw in take()
+    }
+    if (ffix != SIZE_MAX && ffix <= sizeof(fields_) && plen_ >= ffix) {
+      ffix_ = ffix;
+      if (ffix == 0) {
+        // No field bytes to read (e.g. STATUS): decode straight away.
+        // The router is irrelevant here — bulk-routed types all carry
+        // fixed fields.
+        on_fields(nullptr);
+      } else {
+        phase_ = Phase::kFields;
+      }
+    } else {
+      // Variable-width (string) schema or unknown type: assemble the
+      // whole payload and decode in take() (unpack copies the data out,
+      // so the buffer is free for the next frame).
+      payload_.resize(plen_);
+      phase_ = plen_ ? Phase::kPayload : Phase::kDone;
+    }
+  }
+
+  void on_fields(const DataRouter& router) {
+    msg_ = unpack_fields(header_, fields_, ffix_);
+    fields_parsed_ = true;
+    n_data_ = plen_ - ffix_;
+    if (n_data_ == 0) {
+      phase_ = Phase::kDone;
+      return;
+    }
+    uint8_t* sink = nullptr;
+    if (router) {
+      try {
+        sink = router(msg_, n_data_);
+      } catch (...) {
+        sink = nullptr;  // routing is best-effort; the handler raises
+      }
+    }
+    if (sink != nullptr) {
+      data_dst_ = sink;
+      msg_.data_landed = true;  // payload lands at its destination
+    } else {
+      msg_.data.resize(n_data_);
+      data_dst_ = msg_.data.data();
+    }
+    phase_ = Phase::kData;
+  }
+
+  Phase phase_ = Phase::kHeader;
+  uint8_t header_[kHeaderSize] = {};
+  uint8_t fields_[64] = {};
+  size_t got_ = 0;
+  size_t ffix_ = 0;
+  uint64_t plen_ = 0;
+  size_t n_data_ = 0;
+  uint8_t* data_dst_ = nullptr;
+  bool fields_parsed_ = false;
+  Message msg_;
+  std::vector<uint8_t> payload_;
+};
 
 inline int dial(const std::string& host, int port) {
   struct addrinfo hints = {};

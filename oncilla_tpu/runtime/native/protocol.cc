@@ -153,7 +153,7 @@ std::vector<uint8_t> pack_prefix(const Message& m) {
   out.insert(out.end(), kMagic, kMagic + 4);
   out.push_back(kVersion);
   out.push_back(uint8_t(m.type));
-  put_le(out, 0, 2);  // flags
+  put_le(out, m.flags, 2);
   put_le(out, plen, 4);
   out.insert(out.end(), fields.begin(), fields.end());
   return out;
@@ -234,6 +234,7 @@ Message unpack(const uint8_t* header, const uint8_t* payload, size_t plen) {
 
   Message m;
   m.type = MsgType(header[5]);
+  m.flags = uint16_t(get_le(header + 6, 2));
   const std::vector<Field>& sch = schema(m.type);  // throws on unknown type
   size_t off = parse_fields(sch, payload, plen, m);
   m.data.assign(payload + off, payload + plen);
@@ -258,6 +259,7 @@ Message unpack_fields(const uint8_t* header, const uint8_t* fields,
   check_header(header);
   Message m;
   m.type = MsgType(header[5]);
+  m.flags = uint16_t(get_le(header + 6, 2));
   size_t off = parse_fields(schema(m.type), fields, flen, m);
   if (off != flen) throw ProtocolError("trailing bytes in field prefix");
   return m;

@@ -23,6 +23,17 @@ constexpr uint8_t kVersion = 2;
 constexpr size_t kHeaderSize = 12;
 constexpr uint64_t kMaxPayload = 64ull << 20;
 
+// Header-flag bits (protocol.py FLAG_*). The v2 frame always carried a
+// u16 flags word; capabilities ride it without a version bump. This
+// daemon implements exactly the data-plane subset below — every other
+// capability bit (trace, replica, qos, fabric) is declined by silence:
+// the CONNECT_CONFIRM echo masks to kCapsImplemented, so an offer the
+// daemon does not serve comes back 0 and the client stays on the plain
+// v2 protocol (pinned by the declined-by-silence tests).
+constexpr uint16_t kFlagMore = 0x0001;         // non-final coalesced PUT chunk
+constexpr uint16_t kFlagCapCoalesce = 0x0002;  // CONNECT offer/echo
+constexpr uint16_t kCapsImplemented = kFlagCapCoalesce;
+
 enum class MsgType : uint8_t {
   CONNECT = 1,
   CONNECT_CONFIRM = 2,
@@ -116,6 +127,14 @@ struct Message {
   MsgType type;
   std::map<std::string, Value> fields;
   std::vector<uint8_t> data;
+  // Header-flag bits, preserved by the codec both directions (senders
+  // pack them, receivers expose them; unknown bits are tolerated).
+  uint16_t flags = 0;
+  // NOT a wire field: set by the receive path when the bulk payload was
+  // routed STRAIGHT into its destination (the arena extent) instead of
+  // Message::data — the zero-copy DATA_PUT landing. Handlers must skip
+  // their own copy (and trust data.size() == 0) when this is set.
+  bool data_landed = false;
 
   int64_t i(const std::string& k) const { return fields.at(k).i64; }
   uint64_t u(const std::string& k) const { return fields.at(k).u64; }

@@ -33,9 +33,12 @@ HEADER = struct.Struct("<4sBBHI")  # magic, version, type, flags, payload_len
 MAX_PAYLOAD = 64 << 20  # sanity cap; large transfers are chunked above this
 
 # Header-flag bits (the u16 the v2 frame always carried but never used).
-# Capabilities ride the SAME frame format, so a v2 peer that ignores flags
-# (the unmodified C++ daemon packs and parses flags as 0) interoperates
-# unmodified: it simply never grants a capability.
+# Capabilities ride the SAME frame format, so a v2 peer that ignores
+# flags interoperates unmodified: it simply never grants a capability.
+# The native C++ daemon serves the DATA-plane subset (it echoes
+# FLAG_CAP_COALESCE and lands FLAG_MORE bursts zero-copy) and declines
+# every other bit by silence — its grant mask is protocol.hh
+# kCapsImplemented, pinned by the declined-by-silence tests.
 #
 # FLAG_MORE on DATA_PUT marks a non-final chunk of a coalesced burst: the
 # daemon applies the chunk but defers its reply, answering ONCE — at the
@@ -44,9 +47,10 @@ MAX_PAYLOAD = 64 << 20  # sanity cap; large transfers are chunked above this
 # peer granted FLAG_CAP_COALESCE.
 FLAG_MORE = 0x0001
 # FLAG_CAP_COALESCE on CONNECT offers ACK coalescing; a daemon that
-# implements it echoes the bit on CONNECT_CONFIRM. A flags=0 reply (old
-# Python daemon, native C++ daemon) declines, and the sender stays on the
-# lockstep one-reply-per-chunk protocol.
+# implements it (the Python daemon AND the native C++ daemon) echoes the
+# bit on CONNECT_CONFIRM. A flags=0 reply (old v2 Python daemon)
+# declines, and the sender stays on the lockstep one-reply-per-chunk
+# protocol.
 FLAG_CAP_COALESCE = 0x0002
 # FLAG_CAP_TRACE on CONNECT offers distributed-trace propagation (the
 # same offer/echo dance as FLAG_CAP_COALESCE). Only after the peer

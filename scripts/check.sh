@@ -47,6 +47,14 @@ echo "== dcn smoke =="
 # asserted; runs in seconds and needs no chip.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke || fail=1
 
+echo "== native dcn smoke =="
+# Python-client-vs-NATIVE-daemon byte-exactness: an unmodified Python
+# client runs a 4-stripe coalesced 256 MiB put/get against a live C++
+# daemon pair — the daemon must grant FLAG_CAP_COALESCE and serve it
+# byte-exactly. Skips cleanly (with the real build error) when the
+# container has neither cmake nor a C++ compiler.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke --daemon native || fail=1
+
 echo "== fabric smoke =="
 # One-sided fabric proof: shm put/get roundtrip on a 2-daemon local
 # cluster — must actually ride shm (transfer-ring fabric tag), come back

@@ -1,0 +1,89 @@
+"""Unit tests for native.build's content-hash cache: a source edit must
+trigger a rebuild even when mtimes don't move (the failure mode of the
+old mtime staleness probe — checkout-normalized or editor-preserved
+timestamps let a stale cached binary silently serve old daemon code to
+every native test in the session). No compiler needed: the compile step
+is stubbed and only the cache decision is under test."""
+
+import os
+
+import pytest
+
+from oncilla_tpu.runtime.native import native
+
+
+@pytest.fixture
+def fake_tree(tmp_path, monkeypatch):
+    """A miniature native source tree + build dir, with the compile step
+    replaced by a recorder that just drops the target file."""
+    src = tmp_path / "native"
+    src.mkdir()
+    (src / "daemon.cc").write_text("int main() { return 0; }\n")
+    (src / "net.hh").write_text("// header\n")
+    (src / "CMakeLists.txt").write_text("project(x)\n")
+    build_dir = tmp_path / "build"
+    monkeypatch.setattr(native, "NATIVE_DIR", src)
+    monkeypatch.setattr(native, "BUILD_DIR", build_dir)
+    compiles = []
+
+    def fake_direct(target, tsan):
+        build_dir.mkdir(parents=True, exist_ok=True)
+        target.write_bytes(b"\x7fELF fake")
+        compiles.append(target.name)
+        return target
+
+    monkeypatch.setattr(native, "_build_direct", fake_direct)
+    # Force the cmake-less arm so fake_direct is the whole build.
+    monkeypatch.setattr(native.shutil, "which", lambda _name: None)
+    return src, build_dir, compiles
+
+
+def test_build_caches_on_content_hash(fake_tree):
+    src, build_dir, compiles = fake_tree
+    t1 = native.build()
+    assert t1.exists() and compiles == ["oncillamemd"]
+    # Unchanged tree: cache hit, no recompile.
+    assert native.build() == t1
+    assert compiles == ["oncillamemd"]
+
+
+def test_source_edit_triggers_rebuild_even_with_frozen_mtime(fake_tree):
+    src, build_dir, compiles = fake_tree
+    native.build()
+    assert compiles == ["oncillamemd"]
+    daemon = src / "daemon.cc"
+    stat = daemon.stat()
+    # Same length, same mtime, different BYTES — exactly the edit the old
+    # mtime probe waved through as "fresh".
+    daemon.write_text("int main() { return 1; }\n")
+    os.utime(daemon, (stat.st_atime, stat.st_mtime))
+    native.build()
+    assert compiles == ["oncillamemd", "oncillamemd"]
+
+
+def test_new_source_file_triggers_rebuild(fake_tree):
+    src, build_dir, compiles = fake_tree
+    native.build()
+    (src / "extra.hh").write_text("// new header\n")
+    native.build()
+    assert compiles == ["oncillamemd", "oncillamemd"]
+
+
+def test_missing_stamp_counts_as_stale(fake_tree):
+    src, build_dir, compiles = fake_tree
+    target = native.build()
+    # A pre-hash build dir has the binary but no stamp: must rebuild.
+    native._stamp_path(target).unlink()
+    native.build()
+    assert compiles == ["oncillamemd", "oncillamemd"]
+
+
+def test_tsan_variant_keeps_its_own_stamp(fake_tree):
+    src, build_dir, compiles = fake_tree
+    native.build()
+    native.build(tsan=True)
+    assert compiles == ["oncillamemd", "oncillamemd_tsan"]
+    # Both cached independently now.
+    native.build()
+    native.build(tsan=True)
+    assert compiles == ["oncillamemd", "oncillamemd_tsan"]

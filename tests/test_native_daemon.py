@@ -181,12 +181,15 @@ def test_native_pipelined_error_does_not_desync(native_cluster, rng):
     client.close()
 
 
-def test_native_v2_peer_capability_negotiation(native_cluster, rng):
-    """The unmodified C++ daemon is a v2 (non-striping, non-coalescing)
-    peer: the new client's CONNECT capability probe must come back
-    DECLINED (flags=0 — the native codec always packs zero flags), the
-    transfer must fall back to the lockstep one-ACK-per-chunk protocol,
-    and a striped put/get must still complete byte-exact."""
+def test_native_coalesce_capability_granted(native_cluster, rng):
+    """The native daemon serves the v2 DATA-plane capabilities: the
+    UNMODIFIED client's CONNECT probe comes back with exactly
+    FLAG_CAP_COALESCE echoed (every other offered bit still declined by
+    silence), the striped put rides the coalesced one-ACK-per-burst
+    protocol, and the roundtrip is byte-exact — no client changes beyond
+    honoring the grant."""
+    from oncilla_tpu.runtime import protocol as P
+
     entries, cfg = native_cluster
     cfg2 = OcmConfig(
         host_arena_bytes=cfg.host_arena_bytes,
@@ -200,16 +203,106 @@ def test_native_v2_peer_capability_negotiation(native_cluster, rng):
     data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
     client.put(h, data)
     np.testing.assert_array_equal(client.get(h, 2 << 20), data)
-    # Negotiation outcome: capability declined, lockstep engaged, but the
-    # transfer still striped across parallel sockets.
-    assert client._dcn_caps[client._owner_addr(h)] == 0
+    # Negotiation outcome: coalescing granted — and ONLY coalescing —
+    # with the transfer striped across parallel sockets.
+    assert client._dcn_caps[client._owner_addr(h)] == P.FLAG_CAP_COALESCE
     put_rec = [r for r in client.tracer.transfers() if r["op"] == "put"][-1]
-    assert put_rec["coalesced"] is False
+    assert put_rec["coalesced"] is True
     assert put_rec["stripes"] == 4
     # The native daemon's STATUS_OK has no telemetry tail — the client
     # must surface the v2 fields unchanged and only its own ring.
     st = client.status(rank=h.rank)
     assert "dcn" not in st and st["live_allocs"] == 1
+    client.free(h)
+    client.close()
+
+
+def test_native_coalesced_burst_error_stays_in_sync(native_cluster, rng):
+    """A coalesced burst whose chunks go out of bounds must answer ONE
+    typed ERROR exactly where the single burst ACK would sit (the
+    stream-in-sync contract), and the connection must keep serving
+    byte-exact transfers afterwards."""
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        dcn_stripes=1,
+    )
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    h = client.alloc(256 << 10, OcmKind.REMOTE_HOST)
+    # Multi-chunk put past the end of the extent: the burst's first
+    # BOUNDS error is the one reply.
+    with pytest.raises(ocm.OcmError, match="outside extent"):
+        client.put(h, np.zeros(256 << 10, np.uint8), offset=128 << 10)
+    data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 256 << 10), data)
+    client.free(h)
+    client.close()
+
+
+def test_native_bad_msg_while_striped_transfer_in_flight(native_cluster, rng):
+    """Post-PR-8 MsgType families (elastic membership & co) must answer
+    a typed BAD_MSG — never a connection drop — WHILE a striped
+    coalesced transfer is in flight on sibling connections: the epoll
+    serve core preserves the PR-8 stream-in-sync guarantee under
+    concurrent data-plane load."""
+    import threading
+
+    from oncilla_tpu.core.errors import OcmRemoteError
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        dcn_stripes=4,
+        dcn_stripe_min_bytes=64 << 10,
+    )
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    h = client.alloc(4 << 20, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 4 << 20, dtype=np.uint8)
+    stop = threading.Event()
+    errors: list = []
+
+    def hammer():
+        # Keep striped coalesced puts in flight on the owner while the
+        # main thread probes unknown families on fresh connections.
+        try:
+            while not stop.is_set():
+                client.put(h, data)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        owner = client._owner_addr(h)
+        for _ in range(10):
+            s = socket.create_connection(owner, timeout=5.0)
+            try:
+                for msg in (
+                    P.Message(P.MsgType.REQ_LEAVE, {"rank": 1, "inc": 0}),
+                    P.Message(P.MsgType.REQ_LOCATE, {"alloc_id": 1}),
+                    P.Message(P.MsgType.MIGRATE, {
+                        "alloc_id": 1, "target_rank": 1, "epoch": 0,
+                    }),
+                ):
+                    with pytest.raises(OcmRemoteError) as ei:
+                        P.request(s, msg)
+                    assert ei.value.code == int(P.ErrCode.BAD_MSG)
+                # Same connection keeps serving after the rejections.
+                st = P.request(s, P.Message(P.MsgType.STATUS, {}))
+                assert st.fields["live_allocs"] >= 1
+            finally:
+                s.close()
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    assert not errors, errors
+    np.testing.assert_array_equal(client.get(h, 4 << 20), data)
     client.free(h)
     client.close()
 

@@ -113,6 +113,50 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
+        def striped_putter(seed):
+            # TWO of these run concurrently: striped + ACK-coalesced puts
+            # are the epoll core's hot path, exercising per-CONNECTION
+            # bulk-reply buffers and burst state under concurrent stripe
+            # sets (each transfer fans out over 2 leased sockets, every
+            # chunk but the stripe's last carries FLAG_MORE, and the
+            # payloads land zero-copy in the arena from the event loop).
+            try:
+                scfg = OcmConfig(
+                    host_arena_bytes=16 << 20, device_arena_bytes=8 << 20,
+                    chunk_bytes=64 << 10, heartbeat_s=0.2,
+                    dcn_stripes=2, dcn_stripe_min_bytes=64 << 10,
+                    # Pinned OFF so every put stays multi-chunk (the
+                    # tuner would grow the chunk past the transfer size
+                    # and collapse the burst to a single ACK).
+                    dcn_adaptive=False,
+                )
+                client = ControlPlaneClient(entries, 0, config=scfg)
+                ctx = Ocm(config=scfg, remote=client)
+                r = np.random.default_rng(seed)
+                # Per-putter-UNIQUE size: the Tracer ring is process-
+                # global, so filtering by size is the only way to see
+                # exactly this putter's transfers (a round-number size
+                # collides with sibling putters and earlier tests in the
+                # same pytest process).
+                nbytes = (1 << 20) + seed * 8192
+                h = ctx.alloc(nbytes, OcmKind.REMOTE_HOST)
+                data = r.integers(0, 256, nbytes, dtype=np.uint8)
+                for _ in range(4):
+                    ctx.put(h, data)
+                    np.testing.assert_array_equal(ctx.get(h, nbytes), data)
+                recs = [t for t in client.tracer.transfers()
+                        if t["op"] == "put" and t["bytes"] == nbytes]
+                # Every put coalesced; at least one rode the full 2-way
+                # stripe set (lease_set is opportunistic BY DESIGN — under
+                # pool contention a transfer may degrade to fewer stripes
+                # rather than deadlock, so all-of would flake under load).
+                assert recs and all(t["coalesced"] for t in recs), recs
+                assert any(t["stripes"] == 2 for t in recs), recs
+                ctx.free(h)
+                client.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
         def poller():
             try:
                 client = ControlPlaneClient(entries, 0, config=cfg)
@@ -143,6 +187,10 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
                 errors.append(e)
 
         threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+        threads += [
+            threading.Thread(target=striped_putter, args=(100 + s,))
+            for s in range(2)
+        ]
         threads += [threading.Thread(target=leaver) for _ in range(2)]
         threads.append(threading.Thread(target=poller))
         for t in threads:
