@@ -424,11 +424,14 @@ def native_smoke(nbytes: int = 256 << 20, stripes: int = 4) -> dict:
                     "native dcn smoke: striped get not byte-exact"
                 )
             caps = client._dcn_caps[client._owner_addr(h)]
-            if caps != P.FLAG_CAP_COALESCE:
+            expected = P.FLAG_CAP_COALESCE | (
+                P.FLAG_CAP_TRACE if cfg.trace else 0
+            )
+            if caps != expected:
                 raise AssertionError(
                     f"native daemon granted caps {caps:#x}, expected "
-                    f"exactly FLAG_CAP_COALESCE "
-                    f"({P.FLAG_CAP_COALESCE:#x})"
+                    f"exactly {expected:#x} (COALESCE"
+                    + ("|TRACE" if cfg.trace else "") + ")"
                 )
             rec = [r for r in client.tracer.transfers()
                    if r["op"] == "put"][-1]

@@ -67,6 +67,31 @@ def _poll_status(entry) -> dict | None:
     return f
 
 
+def _declines_obs(exc) -> bool:
+    """A typed BAD_MSG to an obs request is a PEER THAT PREDATES the
+    observability surface (a pre-obs native daemon, or one started with
+    OCM_NATIVE_OBS=0) declining the family by silence — a dash cell and
+    a note, never a traceback or an omitted rank."""
+    from oncilla_tpu.core.errors import OcmRemoteError
+    from oncilla_tpu.runtime.protocol import ErrCode
+
+    return (isinstance(exc, OcmRemoteError)
+            and exc.code == int(ErrCode.BAD_MSG))
+
+
+def _poll_events_count(entry) -> tuple[int | None, str | None]:
+    """Journal depth via STATUS_EVENTS (the table's ``events`` column).
+    Returns (count, None), (None, "declined") for a BAD_MSG peer, or
+    (None, "error") when the rank is unreachable."""
+    from oncilla_tpu.runtime.protocol import Message, MsgType
+
+    try:
+        r = _rank_request(entry, Message(MsgType.STATUS_EVENTS, {}))
+    except Exception as e:  # noqa: BLE001 — degrade, never crash the table
+        return None, ("declined" if _declines_obs(e) else "error")
+    return int(r.fields.get("count", 0)), None
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if n < 1024 or unit == "GiB":
@@ -126,18 +151,22 @@ def _app_rows(rank: int, st: dict) -> list[list[str]]:
 
 def _table(entries) -> int:
     cols = ["rank", "nodes", "members", "allocs", "live", "ops", "p50_us",
-            "p99_us", "lat_hist", "gbit/s", "leases r/x/e", "migr ok/ab",
-            "hb_age_s"]
+            "p99_us", "lat_hist", "events", "gbit/s", "leases r/x/e",
+            "migr ok/ab", "hb_age_s"]
     rows = []
     app_rows: list[list[str]] = []
+    declined: list[int] = []
     any_ok = False
     for e in entries:
         st = _poll_status(e)
         if "error" in st:
             rows.append([str(e.rank), "-", "-", "-", "-", "-", "-", "-",
-                         "-", "-", "-", "-", st["error"][:40]])
+                         "-", "-", "-", "-", "-", st["error"][:40]])
             continue
         any_ok = True
+        ev_count, ev_note = _poll_events_count(e)
+        if ev_note == "declined":
+            declined.append(e.rank)
         app_rows.extend(_app_rows(e.rank, st))
         ops = (st.get("dcn") or {}).get("ops") or {}
         count = sum(v.get("count", 0) for v in ops.values())
@@ -160,6 +189,7 @@ def _table(entries) -> int:
             f"{p50:.0f}",
             f"{p99:.0f}",
             _hist_spark(ops),
+            str(ev_count) if ev_count is not None else "-",
             f"{gbps:.2f}",
             (f"{leases.get('renewals', 0)}/{leases.get('reclaims', 0)}"
              f"/{leases.get('expired', 0)}"),
@@ -174,6 +204,11 @@ def _table(entries) -> int:
     print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
     for r in rows:
         print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    if declined:
+        print("note: rank(s) "
+              + ",".join(str(r) for r in sorted(declined))
+              + " decline STATUS_EVENTS/STATUS_PROM (pre-obs daemon); "
+                "obs cells dashed")
     if app_rows:
         acols = ["app", "rank", "prio", "bytes used/quota",
                  "handles", "hb_age_s"]
@@ -195,7 +230,16 @@ def _prom(entries, rank: int) -> int:
         print(f"rank {rank} not in the {len(entries)}-node membership",
               file=sys.stderr)
         return 2
-    r = _rank_request(entries[rank], Message(MsgType.STATUS_PROM, {}))
+    try:
+        r = _rank_request(entries[rank], Message(MsgType.STATUS_PROM, {}))
+    except Exception as e:  # noqa: BLE001 — one-line note, no traceback
+        if _declines_obs(e):
+            print(f"rank {rank}: STATUS_PROM declined (typed BAD_MSG — "
+                  "pre-obs daemon, or OCM_NATIVE_OBS=0)", file=sys.stderr)
+        else:
+            print(f"rank {rank}: STATUS_PROM unavailable "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
+        return 1
     sys.stdout.write(bytes(r.data).decode("utf-8"))
     return 0
 
@@ -212,8 +256,13 @@ def _trace(entries, out_path: str, journal_files: list[str]) -> int:
         try:
             r = _rank_request(e, Message(MsgType.STATUS_EVENTS, {}))
         except Exception as exc:  # noqa: BLE001 — keep merging survivors
-            print(f"rank {e.rank}: journal unavailable "
-                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+            if _declines_obs(exc):
+                print(f"rank {e.rank}: STATUS_EVENTS declined (typed "
+                      "BAD_MSG — pre-obs daemon); merging the rest",
+                      file=sys.stderr)
+            else:
+                print(f"rank {e.rank}: journal unavailable "
+                      f"({type(exc).__name__}: {exc})", file=sys.stderr)
             continue
         polled += 1
         streams.append([

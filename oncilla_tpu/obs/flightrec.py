@@ -45,6 +45,7 @@ from contextlib import contextmanager
 
 ENV_DIR = "OCM_FLIGHTREC"
 ENV_SEG_BYTES = "OCM_FLIGHTREC_SEG_BYTES"
+ENV_MAX_SEGS = "OCM_FLIGHTREC_MAX_SEGS"
 
 MAGIC = b"OCMJ"
 VERSION = 1
@@ -58,6 +59,13 @@ _MAX_FRAME = 16 << 20
 _lock = threading.Lock()
 _dir: str | None = os.environ.get(ENV_DIR) or None
 _seg_bytes = int(os.environ.get(ENV_SEG_BYTES, "") or (4 << 20))
+# 0 = unbounded. With a cap, this WRITER's oldest segment is deleted
+# once the cap is exceeded (a long soak used to grow the directory
+# without bound); other processes' segments are never touched — their
+# names embed a different jid, and deleting someone else's evidence
+# would be tampering, not rotation.
+_max_segs = int(os.environ.get(ENV_MAX_SEGS, "") or 0)
+_own_segs: list[str] = []  # this writer's segments, creation order
 _fh = None
 _fh_path: str | None = None
 _written = 0
@@ -91,6 +99,10 @@ def set_dir(path: str | None) -> None:
             _fh_path = None
         _written = 0
         _failures = 0
+        # The rotation cap is scoped per directory: pointing the spill
+        # elsewhere must never reach back and delete segments of a
+        # finished recording.
+        _own_segs.clear()
         if path is not None:
             os.makedirs(path, exist_ok=True)
         _dir = path
@@ -101,6 +113,14 @@ def set_seg_bytes(n: int) -> None:
     ``OCM_FLIGHTREC_SEG_BYTES``)."""
     global _seg_bytes
     _seg_bytes = int(n)
+
+
+def set_max_segs(n: int) -> None:
+    """Test hook / programmatic twin of ``OCM_FLIGHTREC_MAX_SEGS``:
+    this writer keeps at most ``n`` segments on disk (0 = unbounded),
+    deleting its own oldest past the cap."""
+    global _max_segs
+    _max_segs = int(n)
 
 
 def _open_segment_locked(jid: str, label: str | None = None):
@@ -116,6 +136,12 @@ def _open_segment_locked(jid: str, label: str | None = None):
     path = os.path.join(_dir or ".", name)
     fh = open(path, "wb")
     fh.write(_HDR)
+    _own_segs.append(path)
+    while _max_segs and len(_own_segs) > _max_segs:
+        try:
+            os.unlink(_own_segs.pop(0))
+        except OSError:
+            pass  # already gone (shared tmpdir cleanup): nothing to cap
     if label is None:
         _fh, _fh_path, _written = fh, path, len(_HDR)
     return fh

@@ -14,7 +14,86 @@ daemons through one relabeling path keeps them apart.
 
 from __future__ import annotations
 
+import re
+
 _ESC = str.maketrans({"\\": r"\\", '"': r'\"', "\n": r"\n"})
+
+_SAMPLE_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+_EXEMPLAR_RE = re.compile(r" # \{[^{}]*\} [^ ]+( [^ ]+)?$")
+
+
+def validate(text: str) -> dict[str, list[str]]:
+    """Format-check a text exposition (version 0.0.4): HELP/TYPE pairs
+    precede their family's samples, families are contiguous (never
+    interleaved), histogram samples use their family's
+    ``_bucket``/``_sum``/``_count`` names, no duplicate series, every
+    value parses as a float. Returns ``{family: [sample lines...]}``;
+    raises :class:`ValueError` on the first violation.
+
+    This is the library twin of the test suite's checker — the thing
+    CI scrapes a NATIVE daemon's STATUS_PROM through, so the C++
+    renderer is held to the same format bar as this module."""
+    families: dict[str, list[str]] = {}
+    typed: dict[str, str] = {}
+    cur: str | None = None
+    seen: set[str] = set()
+    closed: set[str] = set()
+
+    def bad(msg: str):
+        raise ValueError(f"prom format: {msg}")
+
+    for line in text.splitlines():
+        if line.strip() != line or not line:
+            bad(f"stray whitespace or blank line: {line!r}")
+        if line.startswith("# HELP "):
+            fam = line.split()[2]
+            if fam in families:
+                bad(f"duplicate HELP for {fam}")
+            if cur is not None:
+                closed.add(cur)
+            families[fam] = []
+            cur = fam
+        elif line.startswith("# TYPE "):
+            _, _, fam, kind = line.split(None, 3)
+            if fam != cur:
+                bad(f"TYPE {fam} outside its family block")
+            if kind not in ("counter", "gauge", "histogram", "summary"):
+                bad(f"unknown TYPE {kind}")
+            typed[fam] = kind
+        else:
+            if cur is None:
+                bad(f"sample before any family: {line!r}")
+            raw = line
+            ex = _EXEMPLAR_RE.search(line)
+            if ex is not None:
+                if typed.get(cur) != "histogram":
+                    bad(f"exemplar outside a histogram family: {line!r}")
+                line = line[: ex.start()]
+            if not _SAMPLE_RE.match(line):
+                bad(f"malformed sample: {line!r}")
+            series, value = line.rsplit(" ", 1)
+            fam = series.split("{", 1)[0]
+            if typed.get(cur) == "histogram":
+                if fam not in (cur, f"{cur}_bucket", f"{cur}_sum",
+                               f"{cur}_count"):
+                    bad(f"sample {fam} interleaved into histogram {cur}")
+            elif fam != cur:
+                bad(f"sample {fam} interleaved into {cur}")
+            if fam in closed:
+                bad(f"family {fam} reopened")
+            if series in seen:
+                bad(f"duplicate series {series}")
+            seen.add(series)
+            try:
+                float(value)
+            except ValueError:
+                bad(f"non-numeric value in {raw!r}")
+            families[cur].append(raw)
+    if not families:
+        bad("no families rendered")
+    if set(families) != set(typed):
+        bad("family missing a TYPE line")
+    return families
 
 
 def _label(**labels: object) -> str:

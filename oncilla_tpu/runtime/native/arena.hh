@@ -55,6 +55,7 @@ class ArenaAllocator {
         free_.erase(it);
         if (span > need) free_[off + need] = span - need;
         live_[off] = need;
+        ++allocs_;
         return Extent{off, nbytes};
       }
     }
@@ -90,6 +91,21 @@ class ArenaAllocator {
     uint64_t span = it->second;
     live_.erase(it);
     insert_free(offset, span);
+    ++releases_;
+  }
+
+  // Lifetime op counters for the Prometheus exposition
+  // (ocm_arena_ops_total): how much churn each arena has absorbed —
+  // the occupancy gauges alone cannot distinguish an idle arena from
+  // one recycling extents at full tilt.
+  uint64_t alloc_count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return allocs_;
+  }
+
+  uint64_t release_count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return releases_;
   }
 
   uint64_t bytes_live() const {
@@ -123,6 +139,8 @@ class ArenaAllocator {
   uint64_t capacity_;
   uint64_t alignment_;
   mutable std::mutex mu_;
+  uint64_t allocs_ = 0;
+  uint64_t releases_ = 0;
   std::map<uint64_t, uint64_t> free_;  // offset -> span (sorted, coalesced)
   std::map<uint64_t, uint64_t> live_;  // offset -> reserved span
 };

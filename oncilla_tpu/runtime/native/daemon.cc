@@ -51,6 +51,7 @@
 #include "arena.hh"
 #include "membership.hh"
 #include "net.hh"
+#include "obs.hh"
 #include "protocol.hh"
 
 namespace ocm {
@@ -62,23 +63,33 @@ double now_s() {
       .count();
 }
 
-// CRC32 (IEEE 802.3 polynomial, zlib-compatible) for the snapshot v2
-// integrity trailer — table built once, no zlib link dependency.
-uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
-  static const auto table = [] {
-    std::array<uint32_t, 256> t{};
-    for (uint32_t i = 0; i < 256; ++i) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; ++k)
-        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      t[i] = c;
-    }
-    return t;
-  }();
-  crc ^= 0xFFFFFFFFu;
-  for (size_t i = 0; i < n; ++i)
-    crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
-  return crc ^ 0xFFFFFFFFu;
+// Serve-span op names for the types this daemon dispatches (the Python
+// daemon's "srv_" + msg.type.name.lower(); data ops use the dcn_*_srv
+// names the obs cluster table and Perfetto export already know).
+const char* srv_op_name(MsgType t) {
+  switch (t) {
+    case MsgType::DATA_PUT: return "dcn_put_srv";
+    case MsgType::DATA_GET: return "dcn_get_srv";
+    case MsgType::CONNECT: return "srv_connect";
+    case MsgType::DISCONNECT: return "srv_disconnect";
+    case MsgType::ADD_NODE: return "srv_add_node";
+    case MsgType::REQ_ALLOC: return "srv_req_alloc";
+    case MsgType::DO_ALLOC: return "srv_do_alloc";
+    case MsgType::REQ_FREE: return "srv_req_free";
+    case MsgType::DO_FREE: return "srv_do_free";
+    case MsgType::NOTE_FREE: return "srv_note_free";
+    case MsgType::NOTE_ALLOC: return "srv_note_alloc";
+    case MsgType::RECLAIM_APP: return "srv_reclaim_app";
+    case MsgType::HEARTBEAT: return "srv_heartbeat";
+    case MsgType::STATUS: return "srv_status";
+    case MsgType::STATUS_PROM: return "srv_status_prom";
+    case MsgType::STATUS_EVENTS: return "srv_status_events";
+    case MsgType::PLANE_SERVE: return "srv_plane_serve";
+    case MsgType::PLANE_PUT: return "srv_plane_put";
+    case MsgType::PLANE_GET: return "srv_plane_get";
+    case MsgType::PLANE_SCRUB: return "srv_plane_scrub";
+    default: return "srv_msg";
+  }
 }
 
 // Per-CONNECTION bulk-reply buffer pool. The epoll serve core hands a
@@ -474,10 +485,19 @@ class Daemon {
         host_arena_(cfg.host_arena_bytes, cfg.alignment),
         host_store_(cfg.host_arena_bytes, 0),
         registry_(cfg.rank, cfg.lease_s),
-        placement_(cfg.capacity_policy) {
+        placement_(cfg.capacity_policy),
+        track_("daemon-r" + std::to_string(cfg.rank)) {
     for (uint32_t i = 0; i < cfg.ndevices; ++i)
       device_books_.emplace_back(std::make_unique<ArenaAllocator>(
           cfg.device_arena_bytes, cfg.alignment));
+    // OCM_NATIVE_OBS=0 reverts the daemon to its pre-obs surface: the
+    // trace capability masked out of the CONNECT echo, STATUS_PROM /
+    // STATUS_EVENTS answered with typed BAD_MSG, no journal, no
+    // flight-recorder spill — what the obs CLI's graceful-degradation
+    // path is regression-tested against.
+    const char* nob = getenv("OCM_NATIVE_OBS");
+    obs_enabled_ = !(nob != nullptr && std::string(nob) == "0");
+    caps_mask_ = kFlagCapCoalesce | (obs_enabled_ ? kFlagCapTrace : 0);
   }
 
   void run() {
@@ -535,7 +555,10 @@ class Daemon {
     // run() returns and the Daemon is destroyed (use-after-free caught by
     // the TSan test). Started only after the fallible setup above — a throw
     // while a joinable thread is live would hit std::terminate in ~thread.
-    reaper_thread_ = std::thread([this] { reaper_loop(); });
+    reaper_thread_ = std::thread([this] {
+      obs::set_thread_name("reaper");
+      reaper_loop();
+    });
     // Bounded DATA-plane worker pool: N concurrent stripe connections are
     // served by these few threads instead of N blocking ones. Control
     // messages never queue here (they may block on nested peer requests;
@@ -546,7 +569,11 @@ class Daemon {
       if (v >= 1 && v <= 64) nworkers = size_t(v);
     }
     for (size_t i = 0; i < nworkers; ++i)
-      pool_threads_.emplace_back([this] { worker_loop(); });
+      pool_threads_.emplace_back([this, i] {
+        obs::set_thread_name("worker-" + std::to_string(i));
+        worker_loop();
+      });
+    obs::set_thread_name("evloop");
     started_ok_ = true;
     std::printf("oncillamemd rank=%lld listening on %s:%d\n",
                 (long long)cfg_.rank, entries_[cfg_.rank].host.c_str(),
@@ -584,6 +611,7 @@ class Daemon {
   // atomic store + eventfd write/shutdown(2); the real teardown (mutexes,
   // file I/O) happens on the main thread once epoll_wait returns.
   void request_stop() {
+    signalled_.store(true);
     running_.store(false);
     if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
     if (wake_fd_ >= 0) {
@@ -593,6 +621,20 @@ class Daemon {
   }
 
   void stop() {
+    // Black-box flush FIRST (the Python Daemon.kill() discipline): a
+    // SIGTERM'd daemon — the closest observable analogue of a chaos
+    // kill for an out-of-process rank — must leave its journal ring on
+    // disk before teardown can hang on sockets or joins. Streamed
+    // duplicates dedup away at merge time via (jid, seq), so the spill
+    // can only ADD evidence. (A SIGKILL leaves no spill, but every
+    // record was already streamed + flushed at record time.)
+    if (jrec()) {
+      if (signalled_.load())
+        journal_.record("daemon_kill", track_,
+                        obs::Fields().i("rank", cfg_.rank).str());
+      journal_.spill_ring("kill-r" + std::to_string(cfg_.rank));
+      journal_.flush();
+    }
     running_ = false;
     if (listen_fd_ >= 0) {
       ::shutdown(listen_fd_, SHUT_RDWR);
@@ -687,7 +729,17 @@ class Daemon {
       slept = 0.0;
       for (uint64_t id : registry_.expired()) {
         try {
+          RegEntry e = registry_.lookup(id);
           do_free_local(id);
+          lease_reclaims_.fetch_add(1, std::memory_order_relaxed);
+          if (jrec())
+            journal_.record("lease_reclaim", track_,
+                            obs::Fields()
+                                .u("alloc_id", e.alloc_id)
+                                .u("nbytes", e.nbytes)
+                                .i("origin_pid", e.origin_pid)
+                                .i("origin_rank", e.origin_rank)
+                                .str());
         } catch (const BadHandleError&) {
         }
       }
@@ -910,6 +962,16 @@ class Daemon {
         reply = err(ErrCode::BAD_MSG,
                     "request inside an open DATA_PUT burst");
       } else {
+        // Serve-side spans (daemon.py _serve_conn twin): data ops are
+        // always measured; control ops get a span only when the request
+        // carried a trace context, so the exported trace shows the
+        // daemon hop, not just the client's view of the round-trip.
+        bool data_op = is_put || msg.type == MsgType::DATA_GET;
+        bool spanned = obs_enabled_ && (data_op || msg.trace_id != 0);
+        uint64_t span_nbytes =
+            data_op && msg.fields.count("nbytes") ? msg.u("nbytes") : 0;
+        double wall0 = spanned ? obs::wall_s() : 0.0;
+        double t0 = spanned ? obs::mono_s() : 0.0;
         try {
           reply = dispatch(*c, msg);
         } catch (const OomError& e) {
@@ -923,6 +985,9 @@ class Daemon {
         } catch (const std::exception& e) {
           reply = err(ErrCode::UNKNOWN, e.what());
         }
+        if (spanned)
+          record_span(srv_op_name(msg.type), wall0, obs::mono_s() - t0,
+                      span_nbytes, msg);
       }
     }
     bool more = is_put && (msg.flags & kFlagMore) != 0;
@@ -1018,6 +1083,24 @@ class Daemon {
             {}};
   }
 
+  // Journaling is on only when the obs surface is enabled AND the
+  // process opted in (OCM_EVENTS / OCM_FLIGHTREC) — the same gate
+  // journal.py applies, so the disarmed daemon does zero extra work.
+  bool jrec() const { return obs_enabled_ && journal_.enabled(); }
+
+  void record_span(const char* op, double wall0, double dt_s,
+                   uint64_t nbytes, const Message& m) {
+    opstats_.note(op, dt_s, nbytes);
+    if (!jrec()) return;
+    obs::Fields f;
+    f.s("op", op).u("nbytes", nbytes).d("t_wall", wall0)
+        .d("dur_us", dt_s * 1e6)
+        .u("trace_id", m.trace_id)
+        .u("span_id", m.trace_id ? obs::rand_id() : 0)
+        .u("parent_span_id", m.trace_span_id);
+    journal_.record("span", track_, f.str());
+  }
+
   Message dispatch(ServeConn& c, const Message& m) {
     switch (m.type) {
       case MsgType::DISCONNECT:
@@ -1031,15 +1114,15 @@ class Daemon {
                                                  : int64_t(entries_.size()))}},
                         {}};
         // Capability negotiation (protocol.py FLAG_CAP_* contract): echo
-        // exactly the offered bits this daemon implements — today only
-        // ACK coalescing. Every other offer (trace, replica, qos,
-        // fabric, and any QoS profile data tail riding the frame) is
-        // declined by silence: masked out of the echo, tail ignored, so
-        // un-upgraded clients and capability-rich ones both get exactly
-        // the protocol they can speak (pinned by the
-        // declined-by-silence tests).
+        // exactly the offered bits this daemon implements — ACK
+        // coalescing and (unless OCM_NATIVE_OBS=0) trace propagation.
+        // Every other offer (replica, qos, fabric, and any QoS profile
+        // data tail riding the frame) is declined by silence: masked
+        // out of the echo, tail ignored, so un-upgraded clients and
+        // capability-rich ones both get exactly the protocol they can
+        // speak (pinned by the declined-by-silence tests).
         if (m.type == MsgType::CONNECT)
-          confirm.flags = m.flags & kCapsImplemented;
+          confirm.flags = m.flags & caps_mask_;
         return confirm;
       }
       case MsgType::RECLAIM_APP:
@@ -1064,9 +1147,16 @@ class Daemon {
       case MsgType::PLANE_SCRUB: return forward_to_plane(m);
       case MsgType::HEARTBEAT: return on_heartbeat(m);
       case MsgType::STATUS: return on_status();
+      case MsgType::STATUS_PROM:
+        if (!obs_enabled_) break;  // OCM_NATIVE_OBS=0: pre-obs surface
+        return on_status_prom();
+      case MsgType::STATUS_EVENTS:
+        if (!obs_enabled_) break;
+        return on_status_events();
       default:
-        return err(ErrCode::BAD_MSG, "unhandled message type");
+        break;
     }
+    return err(ErrCode::BAD_MSG, "unhandled message type");
   }
 
   Message on_add_node(const Message& m) {
@@ -1216,6 +1306,15 @@ class Daemon {
       }
       device_books_[e.device_index]->release(e.extent.offset);
     }
+    if (jrec())
+      journal_.record("free_local", track_,
+                      obs::Fields()
+                          .u("alloc_id", e.alloc_id)
+                          .u("nbytes", e.nbytes)
+                          .i("origin_pid", e.origin_pid)
+                          .i("origin_rank", e.origin_rank)
+                          .b("migrating", false)
+                          .str());
     Message note{MsgType::NOTE_FREE,
                  {{"kind", Value::U(uint64_t(e.kind))},
                   {"rank", Value::I(cfg_.rank)},
@@ -1438,6 +1537,18 @@ class Daemon {
     if (!m.data_landed)
       std::memcpy(host_store_.data() + e.extent.offset + off, m.data.data(),
                   n);
+    // Client-facing ack evidence (daemon.py twin): the native daemon
+    // serves single-copy chains only, so chain is always 1 and the
+    // auditor's replica-ack invariant is trivially satisfied — but the
+    // put timeline itself is what the mixed-cluster audit merges.
+    if (jrec())
+      journal_.record("put_ack", track_,
+                      obs::Fields()
+                          .u("alloc_id", e.alloc_id)
+                          .u("offset", off)
+                          .u("nbytes", n)
+                          .u("chain", 1)
+                          .str());
     return {MsgType::DATA_PUT_OK, {{"nbytes", Value::U(n)}}, {}};
   }
 
@@ -1576,6 +1687,14 @@ class Daemon {
 
   Message on_heartbeat(const Message& m) {
     registry_.renew(m.i("pid"), m.i("rank"));
+    lease_renewals_.fetch_add(1, std::memory_order_relaxed);
+    if (jrec())
+      journal_.record("lease_renew", track_,
+                      obs::Fields()
+                          .i("app_pid", m.i("pid"))
+                          .i("app_rank", m.i("rank"))
+                          .b("relayed", m.i("rank") != cfg_.rank)
+                          .str());
     // Relay local-app heartbeats only to the ranks the app reports as
     // owners of its allocations — O(owners) per beat, not an O(nnodes)
     // broadcast. Relayed copies have origin rank != receiver rank, so no
@@ -1601,6 +1720,11 @@ class Daemon {
   // backstop.
   void on_disconnect(const Message& m) {
     int64_t pid = m.i("pid");
+    // Terminal event for the app's lease-renewal chain: the auditor
+    // requires every renewing app to end in disconnect/free/reclaim.
+    if (jrec())
+      journal_.record("app_disconnect", track_,
+                      obs::Fields().i("pid", pid).str());
     reclaim_app_local(pid, cfg_.rank);
     for (int64_t r : parse_owners(m.s("owners"))) {
       if (r == cfg_.rank || r < 0 || size_t(r) >= entries_.size()) continue;
@@ -1659,6 +1783,101 @@ class Daemon {
             {}};
   }
 
+  // -- in-band observability (STATUS_PROM / STATUS_EVENTS) ---------------
+
+  // Prometheus text exposition rendered natively (obs/prom.py's format,
+  // validated by the same Python format checker): the metrics subset a
+  // native daemon owns — cluster view, op spans, arena occupancy and
+  // churn, lease health. Families the native daemon has no machinery
+  // for (replication, QoS, fabric, elastic) are simply absent, exactly
+  // like a Python daemon with those subsystems idle.
+  Message on_status_prom() {
+    using obs::PromDoc;
+    PromDoc doc;
+    std::string rank = std::to_string(cfg_.rank);
+    doc.sample("ocm_nnodes", "gauge",
+               "Cluster size as this daemon sees it.",
+               double(cfg_.rank == 0 ? placement_.nnodes()
+                                     : int64_t(entries_.size())),
+               {{"rank", rank}});
+    doc.sample("ocm_live_allocs", "gauge",
+               "Live allocations registered on this daemon.",
+               double(registry_.live_count()), {{"rank", rank}});
+    for (const auto& kv : opstats_.snapshot()) {
+      PromDoc::Labels lab{{"rank", rank}, {"op", kv.first}};
+      doc.sample("ocm_op_total", "counter",
+                 "Completed Tracer spans per op.", double(kv.second.count),
+                 lab);
+      doc.sample("ocm_op_bytes_total", "counter",
+                 "Bytes moved by completed spans per op.",
+                 double(kv.second.total_bytes), lab);
+      doc.sample("ocm_op_p50_seconds", "gauge",
+                 "p50 span latency over the sample ring.",
+                 kv.second.p50_s, lab);
+      doc.sample("ocm_op_p99_seconds", "gauge",
+                 "p99 span latency over the sample ring.",
+                 kv.second.p99_s, lab);
+      doc.sample("ocm_op_gigabits_per_second", "gauge",
+                 "Lifetime mean throughput per op (gigabits/s).",
+                 kv.second.total_s > 0
+                     ? double(kv.second.total_bytes) * 8 /
+                           kv.second.total_s / 1e9
+                     : 0.0,
+                 lab);
+    }
+    auto arena_rows = [&](const std::string& name, uint64_t live,
+                          uint64_t cap, uint64_t allocs, uint64_t frees) {
+      doc.sample("ocm_arena_live_bytes", "gauge",
+                 "Bytes currently reserved in an arena.", double(live),
+                 {{"rank", rank}, {"arena", name}});
+      doc.sample("ocm_arena_capacity_bytes", "gauge",
+                 "Arena capacity in bytes.", double(cap),
+                 {{"rank", rank}, {"arena", name}});
+      doc.sample("ocm_arena_ops_total", "counter",
+                 "Lifetime arena operations (allocation churn).",
+                 double(allocs),
+                 {{"rank", rank}, {"arena", name}, {"op", "alloc"}});
+      doc.sample("ocm_arena_ops_total", "counter",
+                 "Lifetime arena operations (allocation churn).",
+                 double(frees),
+                 {{"rank", rank}, {"arena", name}, {"op", "free"}});
+    };
+    arena_rows("host", host_arena_.bytes_live(), cfg_.host_arena_bytes,
+               host_arena_.alloc_count(), host_arena_.release_count());
+    for (size_t i = 0; i < device_books_.size(); ++i)
+      arena_rows("device" + std::to_string(i), device_books_[i]->bytes_live(),
+                 cfg_.device_arena_bytes, device_books_[i]->alloc_count(),
+                 device_books_[i]->release_count());
+    doc.sample("ocm_lease_renewals_total", "counter",
+               "Heartbeat-driven lease renewals processed.",
+               double(lease_renewals_.load()), {{"rank", rank}});
+    doc.sample("ocm_lease_reclaims_total", "counter",
+               "Allocations the lease reaper took back.",
+               double(lease_reclaims_.load()), {{"rank", rank}});
+    doc.sample("ocm_leases_expired", "gauge",
+               "Live allocations currently past their lease.",
+               double(registry_.expired().size()), {{"rank", rank}});
+    std::string text = doc.text();
+    Message r{MsgType::STATUS_PROM_OK, {{"rank", Value::I(cfg_.rank)}}, {}};
+    r.data.assign(text.begin(), text.end());
+    return r;
+  }
+
+  // The journal ring as JSONL — exactly journal.py dump_jsonl's record
+  // shape, so the obs CLI's --trace cluster merge and the Perfetto
+  // exporter consume a native rank with zero changes.
+  Message on_status_events() {
+    std::string jsonl = journal_.dump_jsonl();
+    uint64_t count = 0;
+    for (char ch : jsonl)
+      if (ch == '\n') ++count;
+    Message r{MsgType::STATUS_EVENTS_OK,
+              {{"rank", Value::I(cfg_.rank)}, {"count", Value::U(count)}},
+              {}};
+    r.data.assign(jsonl.begin(), jsonl.end());
+    return r;
+  }
+
   NodeEntry entry(int64_t rank) {
     std::lock_guard<std::mutex> g(entries_mu_);
     return entries_.at(size_t(rank));
@@ -1681,6 +1900,17 @@ class Daemon {
   Registry registry_;
   Placement placement_;
   PeerPool peers_;
+  // Observability (obs.hh): journal ring + flight recorder + op spans.
+  // obs_enabled_ is the OCM_NATIVE_OBS master switch (default on);
+  // caps_mask_ is what CONNECT_CONFIRM echoes.
+  std::string track_;
+  bool obs_enabled_ = true;
+  uint16_t caps_mask_ = kCapsImplemented;
+  obs::Journal journal_;
+  obs::OpStatsBook opstats_;
+  std::atomic<uint64_t> lease_renewals_{0};
+  std::atomic<uint64_t> lease_reclaims_{0};
+  std::atomic<bool> signalled_{false};
   std::atomic<bool> running_{false};
   std::thread reaper_thread_;
   // Per-message control threads (blocking semantics preserved), reaped

@@ -115,6 +115,7 @@ def _build_direct(target: Path, tsan: bool) -> Path:
         cxx, "-std=c++17", "-Wall", "-Wextra", "-pthread",
         *(["-fsanitize=thread", "-g", "-O1"] if tsan else ["-O2"]),
         str(NATIVE_DIR / "daemon.cc"), str(NATIVE_DIR / "protocol.cc"),
+        str(NATIVE_DIR / "obs.cc"),
         "-o", str(target),
     ]
     _run_logged(cmd, "direct compile")

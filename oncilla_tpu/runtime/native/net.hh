@@ -172,6 +172,28 @@ class FrameReader {
           if (phase_ == Phase::kDone) return Status::kComplete;
           break;
         }
+        case Phase::kTrace: {
+          // A kFlagTraceCtx request's data tail starts with a 16-byte
+          // trace context that is NOT payload (obs/trace.py): read it
+          // into its own buffer so the payload proper — including the
+          // burst-closing chunk of a striped coalesced put, the one
+          // chunk that carries the prefix — still lands zero-copy in
+          // the arena via the router.
+          Status st = fill(fd, trace_buf_ + got_, kTraceCtxBytes);
+          if (st != Status::kComplete) return st;
+          uint64_t tid = 0, sid = 0;
+          for (int i = 0; i < 8; ++i) {
+            tid |= uint64_t(trace_buf_[i]) << (8 * i);
+            sid |= uint64_t(trace_buf_[8 + i]) << (8 * i);
+          }
+          msg_.trace_id = tid;
+          msg_.trace_span_id = sid;
+          msg_.flags &= ~kFlagTraceCtx;  // stripped: handlers see payload only
+          n_data_ -= kTraceCtxBytes;
+          begin_data(router);
+          if (phase_ == Phase::kDone) return Status::kComplete;
+          break;
+        }
         case Phase::kData: {
           Status st = fill(fd, data_dst_ + got_, n_data_);
           if (st != Status::kComplete) return st;
@@ -206,11 +228,24 @@ class FrameReader {
     }
     std::vector<uint8_t> payload;
     payload.swap(payload_);
-    return unpack(header_, payload.data(), plen_);
+    Message m = unpack(header_, payload.data(), plen_);
+    // Variable-width (string-schema) types assemble whole and decode
+    // here, so their trace prefix is stripped here too. A tail shorter
+    // than the prefix is malformed-but-tolerated (trace.py split
+    // semantics): flag left set, data untouched.
+    if ((m.flags & kFlagTraceCtx) && m.data.size() >= kTraceCtxBytes) {
+      for (int i = 0; i < 8; ++i) {
+        m.trace_id |= uint64_t(m.data[i]) << (8 * i);
+        m.trace_span_id |= uint64_t(m.data[8 + i]) << (8 * i);
+      }
+      m.data.erase(m.data.begin(), m.data.begin() + kTraceCtxBytes);
+      m.flags &= ~kFlagTraceCtx;
+    }
+    return m;
   }
 
  private:
-  enum class Phase { kHeader, kFields, kData, kPayload, kDone };
+  enum class Phase { kHeader, kFields, kTrace, kData, kPayload, kDone };
 
   // Read toward `want` total bytes of the current phase (got_ tracks
   // progress); dst must point at the next unwritten byte.
@@ -271,6 +306,19 @@ class FrameReader {
     msg_ = unpack_fields(header_, fields_, ffix_);
     fields_parsed_ = true;
     n_data_ = plen_ - ffix_;
+    if ((msg_.flags & kFlagTraceCtx) && n_data_ >= kTraceCtxBytes) {
+      // The data tail leads with a trace context: read it apart from
+      // the payload (see the kTrace arm). A tail shorter than the
+      // prefix is malformed-but-tolerated: flag kept, ordinary path.
+      phase_ = Phase::kTrace;
+      return;
+    }
+    begin_data(router);
+  }
+
+  // Route the (post-trace-prefix) payload: zero-copy sink when the
+  // router accepts, Message::data otherwise.
+  void begin_data(const DataRouter& router) {
     if (n_data_ == 0) {
       phase_ = Phase::kDone;
       return;
@@ -296,6 +344,7 @@ class FrameReader {
   Phase phase_ = Phase::kHeader;
   uint8_t header_[kHeaderSize] = {};
   uint8_t fields_[64] = {};
+  uint8_t trace_buf_[kTraceCtxBytes] = {};
   size_t got_ = 0;
   size_t ffix_ = 0;
   uint64_t plen_ = 0;

@@ -54,6 +54,10 @@ const std::map<MsgType, std::vector<Field>>& schemas() {
       {MsgType::HEARTBEAT, {{"rank", 'q'}, {"pid", 'q'}, {"owners", 's'}}},
       {MsgType::HEARTBEAT_OK, {{"lease_s", 'd'}}},
       {MsgType::STATUS, {}},
+      {MsgType::STATUS_PROM, {}},
+      {MsgType::STATUS_PROM_OK, {{"rank", 'q'}}},
+      {MsgType::STATUS_EVENTS, {}},
+      {MsgType::STATUS_EVENTS_OK, {{"rank", 'q'}, {"count", 'Q'}}},
       {MsgType::STATUS_OK,
        {{"rank", 'q'},
         {"nnodes", 'q'},

@@ -25,14 +25,22 @@ constexpr uint64_t kMaxPayload = 64ull << 20;
 
 // Header-flag bits (protocol.py FLAG_*). The v2 frame always carried a
 // u16 flags word; capabilities ride it without a version bump. This
-// daemon implements exactly the data-plane subset below — every other
-// capability bit (trace, replica, qos, fabric) is declined by silence:
-// the CONNECT_CONFIRM echo masks to kCapsImplemented, so an offer the
-// daemon does not serve comes back 0 and the client stays on the plain
-// v2 protocol (pinned by the declined-by-silence tests).
+// daemon implements exactly the data-plane + observability subset below
+// — every other capability bit (replica, qos, fabric) is declined by
+// silence: the CONNECT_CONFIRM echo masks to kCapsImplemented, so an
+// offer the daemon does not serve comes back 0 and the client stays on
+// the plain v2 protocol (pinned by the declined-by-silence tests).
 constexpr uint16_t kFlagMore = 0x0001;         // non-final coalesced PUT chunk
 constexpr uint16_t kFlagCapCoalesce = 0x0002;  // CONNECT offer/echo
-constexpr uint16_t kCapsImplemented = kFlagCapCoalesce;
+// Distributed-trace propagation (obs/trace.py): the offer/echo dance at
+// CONNECT; once granted, a request may carry kFlagTraceCtx — its data
+// tail starts with a 16-byte (trace_id u64 | span_id u64) prefix that
+// is NOT payload. The frame reader strips it generically (net.hh) and
+// the daemon's serve spans join the client's trace.
+constexpr uint16_t kFlagCapTrace = 0x0004;
+constexpr uint16_t kFlagTraceCtx = 0x0008;
+constexpr uint16_t kCapsImplemented = kFlagCapCoalesce | kFlagCapTrace;
+constexpr size_t kTraceCtxBytes = 16;
 
 enum class MsgType : uint8_t {
   CONNECT = 1,
@@ -60,6 +68,13 @@ enum class MsgType : uint8_t {
   HEARTBEAT_OK = 41,
   STATUS = 42,
   STATUS_OK = 43,
+  // In-band observability (obs/): Prometheus text exposition and the
+  // JSONL journal dump, served over the ordinary control port so no
+  // extra listener exists (protocol.py twin).
+  STATUS_PROM = 44,
+  STATUS_PROM_OK = 45,
+  STATUS_EVENTS = 46,
+  STATUS_EVENTS_OK = 47,
   // Cross-process device plane: the SPMD controller registers its plane
   // endpoint (PLANE_SERVE); daemons relay device-kind data ops to it as
   // PLANE_PUT/PLANE_GET enriched with the registry extent (replies reuse
@@ -135,6 +150,12 @@ struct Message {
   // Message::data — the zero-copy DATA_PUT landing. Handlers must skip
   // their own copy (and trust data.size() == 0) when this is set.
   bool data_landed = false;
+  // NOT wire fields: the inbound trace context, filled by the frame
+  // reader when it strips a kFlagTraceCtx prefix off the data tail
+  // (trace_id == 0 means "untraced request"). The flag bit is cleared
+  // once stripped, so handlers always see payload-only data.
+  uint64_t trace_id = 0;
+  uint64_t trace_span_id = 0;
 
   int64_t i(const std::string& k) const { return fields.at(k).i64; }
   uint64_t u(const std::string& k) const { return fields.at(k).u64; }

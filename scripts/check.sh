@@ -100,6 +100,91 @@ else
     fail=1
 fi
 
+echo "== native obs smoke =="
+# The native daemon's black box, end to end: the native dcn smoke runs
+# with OCM_FLIGHTREC armed (the C++ daemons stream CRC-framed segments
+# in the Python reader's exact format), the auditor merges them with the
+# client's and must report ZERO findings; a deliberately corrupted copy
+# must flip the exit nonzero; and one native STATUS_PROM scrape must
+# pass the Prometheus text-format validator. Skips cleanly with the dcn
+# stage's own toolchain probe.
+nfrdir=$(mktemp -d)
+if ! JAX_PLATFORMS=cpu OCM_FLIGHTREC="$nfrdir" \
+        python -m oncilla_tpu.benchmarks.dcn --smoke --daemon native \
+            --nbytes $((32 << 20)) >/dev/null; then
+    echo "check.sh: native obs smoke failed (dcn leg; black box at $nfrdir)"
+    fail=1
+elif [ -z "$(find "$nfrdir" -name '*.seg' -print -quit)" ]; then
+    # The dcn stage skipped (no native toolchain): nothing spilled.
+    echo "check.sh: native obs smoke skipped (no segments - toolchain absent)"
+    rm -rf "$nfrdir"
+elif JAX_PLATFORMS=cpu python -m oncilla_tpu.obs audit "$nfrdir" \
+    && JAX_PLATFORMS=cpu python - "$nfrdir" <<'EOF'
+import subprocess, sys, os, shutil
+d = sys.argv[1]
+# Nonzero-exit path: a corrupted segment copy must be CAUGHT.
+bad = d + "-bad"
+shutil.copytree(d, bad)
+segs = [f for f in os.listdir(bad) if f.endswith(".seg")]
+seg = max(segs, key=lambda f: os.path.getsize(os.path.join(bad, f)))
+with open(os.path.join(bad, seg), "r+b") as fh:
+    fh.seek(-3, 2)
+    fh.write(b"\xff\xff\xff")
+rc = subprocess.run(
+    [sys.executable, "-m", "oncilla_tpu.obs", "audit", bad],
+    capture_output=True,
+).returncode
+shutil.rmtree(bad)
+assert rc != 0, "auditor missed a corrupted native segment"
+print("native obs smoke: corrupt-segment path exits nonzero - OK")
+EOF
+then
+    rm -rf "$nfrdir"
+else
+    echo "check.sh: native obs smoke failed (black box kept at $nfrdir)"
+    fail=1
+fi
+
+echo "== native prom scrape =="
+# One STATUS_PROM scrape from a live native daemon through the library
+# format validator (oncilla_tpu.obs.prom.validate).
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import socket, time, tempfile, sys
+from oncilla_tpu.runtime.native import native
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.obs import prom
+
+try:
+    native.build()
+except Exception as e:  # toolchain absent: same clean skip as the dcn stage
+    print(f"native prom scrape: skipped ({e})")
+    sys.exit(0)
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+nf = tempfile.NamedTemporaryFile("w", suffix=".nodes", delete=False)
+nf.write(f"0 127.0.0.1 {port}\n"); nf.close()
+proc = native.spawn(nf.name, 0, host_arena_bytes=8 << 20)
+try:
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            c = socket.create_connection(("127.0.0.1", port), timeout=0.5)
+            break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        raise AssertionError("native daemon did not come up")
+    try:
+        r = P.request(c, P.Message(P.MsgType.STATUS_PROM, {}))
+    finally:
+        c.close()
+    fams = prom.validate(bytes(r.data).decode())
+    assert "ocm_nnodes" in fams and "ocm_live_allocs" in fams
+    print(f"native prom scrape: {len(fams)} families validate - OK")
+finally:
+    proc.terminate(); proc.wait(timeout=10)
+EOF
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

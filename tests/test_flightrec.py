@@ -76,6 +76,48 @@ def test_segment_rotation_stays_bounded(spill):
     assert sum(1 for e in evs if e["ev"] == "span") == 40
 
 
+def test_max_segs_rotation_caps_directory(spill):
+    """Satellite: OCM_FLIGHTREC_MAX_SEGS bounds the writer's on-disk
+    footprint — the oldest OWN segment is deleted past the cap, the
+    newest events survive, and survivors still parse clean."""
+    old_bytes = flightrec._seg_bytes
+    flightrec.set_seg_bytes(600)
+    flightrec.set_max_segs(3)
+    try:
+        for i in range(60):
+            journal.record("span", op=f"cap{i}")
+    finally:
+        flightrec.set_seg_bytes(old_bytes)
+        flightrec.set_max_segs(0)
+    names = _segs(spill)
+    assert 0 < len(names) <= 3, names
+    evs, problems = flightrec.read_dir(spill)
+    assert problems == []
+    ops = [e["op"] for e in evs if e["ev"] == "span"]
+    # The newest events are the survivors; the oldest rotated away.
+    assert "cap59" in ops
+    assert "cap0" not in ops
+
+
+def test_max_segs_never_touches_other_writers_segments(spill):
+    """Rotation deletes this WRITER's segments only: a foreign jid's
+    segment in the same directory is evidence, not rotation fodder."""
+    foreign = os.path.join(spill, "fr-feedbeef-00001.seg")
+    with open(foreign, "wb") as fh:
+        fh.write(b"OCMJ\x01")
+    flightrec.set_seg_bytes(600)
+    flightrec.set_max_segs(2)
+    try:
+        for i in range(40):
+            journal.record("span", op=f"own{i}")
+    finally:
+        flightrec.set_seg_bytes(4 << 20)
+        flightrec.set_max_segs(0)
+    assert os.path.exists(foreign)
+    own = [n for n in _segs(spill) if "feedbeef" not in n]
+    assert 0 < len(own) <= 2, own
+
+
 def test_ring_overflow_spill_keeps_full_stream(spill):
     """Satellite: the in-memory ring stays bounded at the cap while the
     spill keeps the complete stream (no journal-gap finding)."""

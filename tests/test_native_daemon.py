@@ -184,10 +184,10 @@ def test_native_pipelined_error_does_not_desync(native_cluster, rng):
 def test_native_coalesce_capability_granted(native_cluster, rng):
     """The native daemon serves the v2 DATA-plane capabilities: the
     UNMODIFIED client's CONNECT probe comes back with exactly
-    FLAG_CAP_COALESCE echoed (every other offered bit still declined by
-    silence), the striped put rides the coalesced one-ACK-per-burst
-    protocol, and the roundtrip is byte-exact — no client changes beyond
-    honoring the grant."""
+    FLAG_CAP_COALESCE | FLAG_CAP_TRACE echoed (every other offered bit
+    still declined by silence), the striped put rides the coalesced
+    one-ACK-per-burst protocol, and the roundtrip is byte-exact — no
+    client changes beyond honoring the grant."""
     from oncilla_tpu.runtime import protocol as P
 
     entries, cfg = native_cluster
@@ -203,9 +203,12 @@ def test_native_coalesce_capability_granted(native_cluster, rng):
     data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
     client.put(h, data)
     np.testing.assert_array_equal(client.get(h, 2 << 20), data)
-    # Negotiation outcome: coalescing granted — and ONLY coalescing —
-    # with the transfer striped across parallel sockets.
-    assert client._dcn_caps[client._owner_addr(h)] == P.FLAG_CAP_COALESCE
+    # Negotiation outcome: coalescing + trace granted — and nothing
+    # else — with the transfer striped across parallel sockets.
+    expected = P.FLAG_CAP_COALESCE | (
+        P.FLAG_CAP_TRACE if cfg2.trace else 0
+    )
+    assert client._dcn_caps[client._owner_addr(h)] == expected
     put_rec = [r for r in client.tracer.transfers() if r["op"] == "put"][-1]
     assert put_rec["coalesced"] is True
     assert put_rec["stripes"] == 4

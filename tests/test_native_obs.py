@@ -1,0 +1,455 @@
+"""Observability parity of the native daemon (oncillamemd): trace
+propagation (one trace_id stitching client -> native daemon), the C++
+journal ring + CRC-framed flight-recorder segments the Python auditor
+merges with zero changes, native STATUS_PROM/STATUS_EVENTS, and the
+graceful-degradation path against a pre-obs (OCM_NATIVE_OBS=0) daemon."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from _helpers import free_ports
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.obs import audit, export, flightrec, journal, prom
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.membership import NodeEntry
+from oncilla_tpu.runtime.native import native
+from oncilla_tpu.utils.config import OcmConfig
+
+
+@pytest.fixture(scope="module")
+def binary():
+    try:
+        return native.build()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native build unavailable: {e}")
+
+
+def _write_nodefile(tmp_path, ports):
+    nf = tmp_path / "nodefile"
+    nf.write_text(
+        "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
+    )
+    return nf
+
+
+def _wait_up(entries, deadline_s=15.0):
+    deadline = time.time() + deadline_s
+    for e in entries:
+        while time.time() < deadline:
+            try:
+                socket.create_connection((e.host, e.port),
+                                         timeout=0.5).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            raise AssertionError("daemon did not come up")
+
+
+def _wait_joined(entries, deadline_s=15.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(
+                (entries[0].host, entries[0].port), timeout=2.0
+            )
+            try:
+                st = P.request(s, P.Message(P.MsgType.STATUS, {}))
+            finally:
+                s.close()
+            if st.fields["nnodes"] >= len(entries):
+                return
+        except (OSError, ocm.OcmProtocolError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError("cluster never converged")
+
+
+@pytest.fixture
+def native_obs_cluster(binary, tmp_path):
+    """Two native daemons with the journal armed (OCM_EVENTS=1)."""
+    ports = free_ports(2)
+    nf = _write_nodefile(tmp_path, ports)
+    procs = [
+        native.spawn(
+            str(nf), r, host_arena_bytes=32 << 20,
+            device_arena_bytes=4 << 20, lease_s=30.0, heartbeat_s=0.5,
+            env={"OCM_EVENTS": "1"}, binary=binary,
+        )
+        for r in range(2)
+    ]
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    try:
+        _wait_up(entries)
+        _wait_joined(entries)
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    yield entries
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            p.kill()
+
+
+def _rank_events(entry) -> list[dict]:
+    s = socket.create_connection((entry.host, entry.port), timeout=5.0)
+    try:
+        r = P.request(s, P.Message(P.MsgType.STATUS_EVENTS, {}))
+    finally:
+        s.close()
+    return [
+        json.loads(line)
+        for line in bytes(r.data).decode("utf-8").splitlines()
+        if line.strip()
+    ]
+
+
+def _cfg(**kw):
+    base = dict(
+        host_arena_bytes=32 << 20, device_arena_bytes=4 << 20,
+        chunk_bytes=128 << 10, dcn_stripes=2,
+        dcn_stripe_min_bytes=128 << 10,
+    )
+    base.update(kw)
+    return OcmConfig(**base)
+
+
+# -- tentpole: trace propagation into the native daemon ------------------
+
+
+def test_native_trace_capability_granted_and_one_trace_id(
+    native_obs_cluster, rng,
+):
+    """FLAG_CAP_TRACE is granted at CONNECT, and ONE trace_id stitches
+    the client's op span to the native daemon's srv/dcn spans — the
+    Dapper property PR 4 proved across Python hops, now crossing the
+    C++ fast path. The Perfetto export of the merged journals shows a
+    cross-track flow with no exporter changes."""
+    entries = native_obs_cluster
+    was = journal.enabled()
+    journal.set_enabled(True)
+    journal.clear()
+    client = ControlPlaneClient(entries, 0, config=_cfg(), heartbeat=False)
+    try:
+        h = client.alloc(4 << 20, OcmKind.REMOTE_HOST)
+        assert h.rank == 1
+        data = rng.integers(0, 256, 4 << 20, dtype=np.uint8)
+        client.put(h, data)
+        np.testing.assert_array_equal(client.get(h, 4 << 20), data)
+        caps = client._dcn_caps[client._owner_addr(h)]
+        assert caps & P.FLAG_CAP_TRACE, f"trace not granted: {caps:#x}"
+        client_spans = [e for e in journal.events() if e.get("ev") == "span"
+                        and e.get("trace_id")]
+        native_events = _rank_events(entries[1])
+        native_spans = [e for e in native_events if e.get("ev") == "span"]
+        assert any(e["op"] == "dcn_put_srv" for e in native_spans)
+        assert any(e["op"] == "dcn_get_srv" for e in native_spans)
+        # The native record shape is journal.py's: envelope + identity.
+        rec = native_spans[0]
+        for key in ("ts", "mono", "pid", "tid", "thread", "jid", "seq",
+                    "track"):
+            assert key in rec, f"native span missing {key}: {rec}"
+        assert rec["track"] == "daemon-r1"
+        client_traces = {e["trace_id"] for e in client_spans}
+        native_traces = {e.get("trace_id", 0) for e in native_spans}
+        shared = client_traces & native_traces
+        assert shared, (
+            f"no trace_id crosses client->native: client={client_traces} "
+            f"native={native_traces}"
+        )
+        # End to end through the exporter: the merged timeline stitches
+        # a flow across the client track and daemon-r1.
+        merged = export.merge(journal.events(), native_events)
+        trace = export.chrome_trace(merged)
+        assert export.cross_track_flows(trace) >= 1
+        client.free(h)
+    finally:
+        client.close()
+        journal.set_enabled(was)
+        journal.clear()
+
+
+def test_native_status_prom_validates(native_obs_cluster, rng):
+    """The C++-rendered exposition passes the same text-format checker
+    the Python daemon's does, and carries the op/arena/lease families
+    after real traffic."""
+    entries = native_obs_cluster
+    client = ControlPlaneClient(entries, 0, config=_cfg(), heartbeat=False)
+    try:
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        client.put(h, rng.integers(0, 256, 1 << 20, dtype=np.uint8))
+        s = socket.create_connection(
+            (entries[1].host, entries[1].port), timeout=5.0
+        )
+        try:
+            r = P.request(s, P.Message(P.MsgType.STATUS_PROM, {}))
+        finally:
+            s.close()
+        assert r.fields["rank"] == 1
+        text = bytes(r.data).decode("utf-8")
+        fams = prom.validate(text)
+        for fam in ("ocm_nnodes", "ocm_live_allocs", "ocm_op_total",
+                    "ocm_arena_live_bytes", "ocm_arena_ops_total",
+                    "ocm_lease_renewals_total"):
+            assert fam in fams, f"{fam} missing from native exposition"
+        assert any('op="dcn_put_srv"' in line
+                   for line in fams["ocm_op_total"])
+        client.free(h)
+    finally:
+        client.close()
+
+
+def test_native_segment_rotation_bounded(binary, tmp_path, rng):
+    """OCM_FLIGHTREC_MAX_SEGS bounds the native writer's directory
+    footprint: tiny segments + a put barrage leave at most the cap on
+    disk (oldest rotated out), and what remains still parses."""
+    ports = free_ports(1)
+    nf = _write_nodefile(tmp_path, ports)
+    frdir = tmp_path / "fr"
+    proc = native.spawn(
+        str(nf), 0, host_arena_bytes=16 << 20, lease_s=60.0,
+        heartbeat_s=5.0, binary=binary,
+        env={
+            "OCM_FLIGHTREC": str(frdir),
+            "OCM_FLIGHTREC_SEG_BYTES": "2048",
+            "OCM_FLIGHTREC_MAX_SEGS": "3",
+        },
+    )
+    entries = [NodeEntry(0, "127.0.0.1", ports[0])]
+    try:
+        _wait_up(entries)
+        client = ControlPlaneClient(
+            entries, 0, config=_cfg(dcn_stripes=1), heartbeat=False,
+        )
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)  # 1 node: demotes
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        for _ in range(8):
+            client.put(h, data)
+        client.free(h)
+        client.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    segs = sorted(frdir.glob("*.seg"))
+    assert segs, "native daemon wrote no segments"
+    assert len(segs) <= 3, [s.name for s in segs]
+    # Survivors parse as ordinary flight-recorder segments.
+    events, problems = flightrec.read_dir(str(frdir))
+    assert events
+    assert not [p for p in problems if p["kind"] != "truncated"]
+
+
+# -- mixed-cluster audit: the native black box joins the timeline --------
+
+
+def test_mixed_cluster_chaos_kill_audited(binary, tmp_path, rng):
+    """One Python daemon (rank 0, in-process) + one native daemon
+    (rank 1, OCM_FLIGHTREC armed), chaos-killed mid-striped-put: the
+    auditor merges the native rank's segments with the Python side's,
+    sees daemon_kill plus the put timeline, and reports zero invariant
+    findings — the PR-9 oracle now covers the C++ fast path."""
+    from oncilla_tpu.runtime.daemon import Daemon
+
+    ports = free_ports(2)
+    nf = _write_nodefile(tmp_path, ports)
+    frdir = str(tmp_path / "fr")
+    cfg = _cfg(failover_wait_s=1.0)
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    pyd = Daemon(0, entries, config=cfg)
+    pyd.start()
+    proc = native.spawn(
+        str(nf), 1, host_arena_bytes=64 << 20, lease_s=60.0,
+        heartbeat_s=0.5, binary=binary, env={"OCM_FLIGHTREC": frdir},
+    )
+    put_err: list = []
+    try:
+        _wait_up(entries)
+        _wait_joined(entries)
+        with flightrec.recording(frdir):
+            client = ControlPlaneClient(entries, 0, config=cfg,
+                                        heartbeat=False)
+            h = client.alloc(32 << 20, OcmKind.REMOTE_HOST)
+            assert h.rank == 1
+            data = rng.integers(0, 256, 32 << 20, dtype=np.uint8)
+            client.put(h, data)  # a completed put: definite timeline
+
+            def chaos_put():
+                try:
+                    client.put(h, data)
+                except Exception as e:  # noqa: BLE001 — the kill's point
+                    put_err.append(e)
+
+            t = threading.Thread(target=chaos_put)
+            t.start()
+            time.sleep(0.02)  # let stripes open mid-transfer
+            proc.terminate()  # the chaos kill: SIGTERM, black box spills
+            t.join(timeout=30)
+            assert not t.is_alive()
+            proc.wait(timeout=10)
+            client.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        pyd.stop()
+    events, problems = flightrec.read_dir(frdir)
+    native_evs = [e for e in events if e.get("track") == "daemon-r1"]
+    assert any(e.get("ev") == "daemon_kill" for e in native_evs), (
+        "native rank left no daemon_kill evidence"
+    )
+    assert any(e.get("ev") == "span" and e.get("op") == "dcn_put_srv"
+               for e in native_evs), "native put timeline missing"
+    assert any(e.get("ev") == "put_ack" for e in native_evs)
+    findings, stats = audit.audit_dir(frdir)
+    assert findings == [], [f.render() for f in findings]
+    assert 1 in stats["ranks"]
+
+
+# -- satellite: graceful degradation against a pre-obs native daemon -----
+
+
+@pytest.fixture
+def pr10_native_cluster(binary, tmp_path):
+    """A native pair with the new obs caps DISABLED via env — the
+    PR-10-era wire surface (trace declined, STATUS_PROM/STATUS_EVENTS
+    answered with typed BAD_MSG, nothing written to OCM_FLIGHTREC)."""
+    ports = free_ports(2)
+    nf = _write_nodefile(tmp_path, ports)
+    frdir = tmp_path / "fr-disabled"
+    procs = [
+        native.spawn(
+            str(nf), r, host_arena_bytes=16 << 20, lease_s=30.0,
+            heartbeat_s=0.5, binary=binary,
+            env={"OCM_NATIVE_OBS": "0", "OCM_FLIGHTREC": str(frdir),
+                 "OCM_EVENTS": "1"},
+        )
+        for r in range(2)
+    ]
+    entries = [NodeEntry(r, "127.0.0.1", p) for r, p in enumerate(ports)]
+    try:
+        _wait_up(entries)
+        _wait_joined(entries)
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    yield entries, nf, frdir
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            p.kill()
+
+
+def test_obs_disabled_env_reverts_to_pr10_surface(pr10_native_cluster, rng):
+    entries, _nf, frdir = pr10_native_cluster
+    client = ControlPlaneClient(entries, 0, config=_cfg(), heartbeat=False)
+    try:
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h, data)
+        np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+        # Trace declined by silence again; coalescing still granted.
+        assert (client._dcn_caps[client._owner_addr(h)]
+                == P.FLAG_CAP_COALESCE)
+        assert client._ctrl_caps & P.FLAG_CAP_TRACE == 0
+        # The obs families answer typed BAD_MSG with the stream in sync.
+        s = socket.create_connection(
+            (entries[h.rank].host, entries[h.rank].port), timeout=5.0
+        )
+        try:
+            for mt in (P.MsgType.STATUS_PROM, P.MsgType.STATUS_EVENTS):
+                with pytest.raises(ocm.OcmRemoteError) as ei:
+                    P.request(s, P.Message(mt, {}))
+                assert ei.value.code == int(P.ErrCode.BAD_MSG)
+            st = P.request(s, P.Message(P.MsgType.STATUS, {}))
+            assert st.fields["live_allocs"] >= 1
+        finally:
+            s.close()
+        client.free(h)
+    finally:
+        client.close()
+    assert not frdir.exists() or not list(frdir.glob("*.seg")), (
+        "OCM_NATIVE_OBS=0 daemon must not write flight-recorder segments"
+    )
+
+
+def test_obs_cli_degrades_gracefully_on_bad_msg(
+    pr10_native_cluster, capsys,
+):
+    """The cluster table renders every rank with dash obs cells plus a
+    one-line note (no traceback, no omitted rank); --prom and --trace
+    print a note instead of crashing."""
+    from oncilla_tpu.obs.__main__ import main as obs_main
+
+    entries, nf, _frdir = pr10_native_cluster
+    rc = obs_main(["--nodefile", str(nf)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert "events" in lines[0]
+    # Both ranks present, with a dashed events cell each.
+    for rank in ("0", "1"):
+        row = next(ln for ln in lines[1:] if ln.split()[0] == rank)
+        assert "-" in row.split()
+    assert any("decline STATUS_EVENTS/STATUS_PROM" in ln for ln in lines)
+
+    rc = obs_main(["--nodefile", str(nf), "--prom", "0"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "STATUS_PROM declined" in err
+
+    out_json = str(_frdir) + "-trace.json"
+    obs_main(["--nodefile", str(nf), "--trace", out_json])
+    err = capsys.readouterr().err
+    assert "STATUS_EVENTS declined" in err.splitlines()[0]
+
+
+# -- acceptance: obs-unset wire stays byte-identical ---------------------
+
+
+def test_obs_unset_wire_byte_identical_to_pr10(native_obs_cluster, rng):
+    """Tracing disarmed (config.trace False, the OCM_TRACE=0 path): the
+    CONNECT offer carries no trace bit, DATA frames carry no prefix —
+    byte-for-byte the PR-10 wire — and the native daemon echoes exactly
+    FLAG_CAP_COALESCE, serving byte-exact transfers. STATUS_OK still
+    has no telemetry tail."""
+    entries = native_obs_cluster
+    cfg = _cfg(trace=False)
+    # Pack-level pin: the frames a trace-less client emits are the
+    # pre-obs frames exactly.
+    connect = P.pack(P.Message(P.MsgType.CONNECT, {"pid": 7, "rank": 0}))
+    _, _, _, flags, plen = P.HEADER.unpack(connect[:P.HEADER.size])
+    assert flags == 0 and plen == 16
+    get = P.pack(P.Message(
+        P.MsgType.DATA_GET, {"alloc_id": 1, "offset": 0, "nbytes": 64},
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(get[:P.HEADER.size])
+    assert flags == 0 and plen == 24
+    client = ControlPlaneClient(entries, 0, config=cfg, heartbeat=False)
+    try:
+        h = client.alloc(2 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+        client.put(h, data)
+        np.testing.assert_array_equal(client.get(h, 2 << 20), data)
+        assert (client._dcn_caps[client._owner_addr(h)]
+                == P.FLAG_CAP_COALESCE)
+        st = client.status(rank=h.rank)
+        assert "dcn" not in st
+        client.free(h)
+    finally:
+        client.close()

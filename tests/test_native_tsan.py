@@ -46,7 +46,18 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
         "".join(f"{r} 127.0.0.1 {p}\n" for r, p in enumerate(ports))
     )
     snap_path = str(tmp_path / "r1.ocms")
-    env = {"TSAN_OPTIONS": f"halt_on_error=0 exitcode={TSAN_EXIT}"}
+    # Tracing + flight recorder ARMED (PR-11 satellite): the journal
+    # ring is appended from the worker pool, the epoll loop, and control
+    # threads while striped traced puts are in flight — the HB edges of
+    # obs.hh's journal/recorder mutexes must be explicit, per the PR-10
+    # discipline. Clients trace by default, so every request carries a
+    # 16-byte prefix through the frame reader's trace phase.
+    frdir = str(tmp_path / "fr")
+    env = {
+        "TSAN_OPTIONS": f"halt_on_error=0 exitcode={TSAN_EXIT}",
+        "OCM_EVENTS": "1",
+        "OCM_FLIGHTREC": frdir,
+    }
     logs = [str(tmp_path / f"daemon{r}.log") for r in range(2)]
     procs = [
         native.spawn(
@@ -227,3 +238,12 @@ def test_native_daemon_race_free_under_load(tsan_binary, tmp_path, rng):
     assert "WARNING: ThreadSanitizer" not in report, report
     for p in procs:
         assert p.returncode != TSAN_EXIT, report
+    # The armed flight recorder wrote parseable segments from both
+    # ranks under the concurrent load (no CRC corruption, no holes).
+    from oncilla_tpu.obs import flightrec
+
+    events, problems = flightrec.read_dir(frdir)
+    assert events, "no flight-recorder evidence under TSan load"
+    assert not [p for p in problems if p["kind"] != "truncated"], problems
+    assert any(e.get("ev") == "span" and e.get("op") == "dcn_put_srv"
+               for e in events)
