@@ -27,7 +27,7 @@ import time
 from oncilla_tpu.core.errors import OcmConnectError, OcmError, OcmRemoteError
 from oncilla_tpu.runtime.membership import ClusterView, NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
-from oncilla_tpu.runtime.protocol import Message, MsgType
+from oncilla_tpu.runtime.protocol import ErrCode, Message, MsgType
 from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import printd
 
@@ -79,18 +79,32 @@ def join_cluster(
         # REQ_JOIN retries idempotently, which IS the protocol claim the
         # smoke proves.
         pool = PeerPool()
+        seed = (rank0_host, rank0_port)
         try:
             reply = None
             for i in range(retries):
                 try:
-                    reply = pool.request(rank0_host, rank0_port, req)
+                    reply = pool.request(seed[0], seed[1], req)
                     break
+                except OcmRemoteError as e:
+                    # Leadership moved off the seed (control/): the
+                    # NOT_MASTER redirect names the live leader's
+                    # address explicitly — a joiner has no member table
+                    # yet, so the rank alone would be useless.
+                    addr = getattr(e, "leader_addr", None)
+                    if e.code == int(ErrCode.NOT_MASTER) and addr:
+                        printd("join: seed %s:%d is not the leader; "
+                               "redirected to %s:%d",
+                               seed[0], seed[1], addr[0], addr[1])
+                        seed = tuple(addr)
+                        continue
+                    raise
                 except (OSError, OcmConnectError) as e:
                     printd("join: REQ_JOIN attempt %d failed: %s", i, e)
                     time.sleep(min(0.05 * 2 ** i, 2.0))
             if reply is None:
                 raise OcmConnectError(
-                    f"rank 0 unreachable at {rank0_host}:{rank0_port} "
+                    f"leader unreachable at {seed[0]}:{seed[1]} "
                     f"after {retries} REQ_JOIN attempts"
                 )
         finally:
@@ -111,6 +125,13 @@ def join_cluster(
             incarnation=inc, listener=listener,
         )
         listener = None  # owned by the daemon now
+        # The daemon that granted JOIN_OK IS the leader (only leaders
+        # admit): seed leader_rank from the address that answered, so a
+        # joiner admitted after a leadership transfer aims its ADD_NODE
+        # and proxies at the live leader instead of bouncing off rank 0.
+        lead = view.find(seed[0], seed[1])
+        if lead is not None:
+            d.leader_rank = lead
         d._adopt_epoch(epoch)
         d.start()
         # The granted view may name members a boot-time constructor never
@@ -125,34 +146,55 @@ def join_cluster(
 
 
 def leave_cluster(daemon, retries: int = 3) -> dict:
-    """Gracefully depart: drain-then-drop via rank 0, then stop serving.
+    """Gracefully depart: drain-then-drop via the leader, then stop
+    serving.
+
+    A daemon that currently LEADS first hands the role off to the
+    lowest live standby (``Daemon.handoff_leadership`` — final master
+    state pushed synchronously under the CRC discipline), then departs
+    as an ordinary member through the successor. This closes the
+    "rank 0 cannot leave" hole noted in PR 8; without standby masters
+    configured there is nobody to hand to and the leader still refuses.
 
     Returns ``{"epoch": ..., "moved": ...}`` from LEAVE_OK. Raises (and
-    leaves the daemon RUNNING) if rank 0 refuses — e.g. the drain could
-    not complete, or this daemon's incarnation no longer matches the
-    member table (a restarted daemon at the same address must re-join
-    before it may leave).
+    leaves the daemon RUNNING) if the leader refuses — e.g. the drain
+    could not complete, or this daemon's incarnation no longer matches
+    the member table (a restarted daemon at the same address must
+    re-join before it may leave).
     """
-    if daemon.rank == 0:
-        raise OcmError("rank 0 (the placement master) cannot leave")
-    r0 = daemon.entries[0]
+    if daemon.rank == daemon.leader_rank:
+        if daemon.config.standby_masters <= 0:
+            raise OcmError(
+                f"rank {daemon.rank} leads the cluster and cannot leave: "
+                "no standby masters configured (OCM_STANDBY_MASTERS)"
+            )
+        daemon.handoff_leadership()
     req = Message(
         MsgType.REQ_LEAVE,
         {"rank": daemon.rank, "inc": daemon.incarnation},
     )
     last: Exception | None = None
     for i in range(retries):
+        le = daemon._leader_entry()
         try:
-            reply = daemon.peers.request(r0.connect_host, r0.port, req)
+            reply = daemon.peers.request(le.connect_host, le.port, req)
             break
+        except OcmRemoteError as e:
+            if e.code == int(ErrCode.NOT_MASTER) and getattr(
+                e, "leader_rank", None
+            ) is not None:
+                daemon._adopt_leader_hint(e)
+                last = e
+                continue
+            # A typed refusal (drain incomplete, stale incarnation) is
+            # the caller's problem, not noise.
+            raise
         except (OSError, OcmConnectError) as e:
-            # Transport-only retry: a typed refusal (drain incomplete,
-            # stale incarnation) is the caller's problem, not noise.
             last = e
             time.sleep(min(0.05 * 2 ** i, 1.0))
     else:
         raise OcmRemoteError(
-            0, f"rank 0 unreachable for REQ_LEAVE: {last}"
+            0, f"leader unreachable for REQ_LEAVE: {last}"
         )
     out = {"epoch": reply.fields["epoch"], "moved": reply.fields["moved"]}
     printd("leave: rank %d departed at epoch %d (%d extents moved)",

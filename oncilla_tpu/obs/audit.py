@@ -32,6 +32,14 @@ rule                  violated when
                       the low priority class
 ``fenced-silence``    a fenced daemon emitted a post-fence client ack or
                       replica fan-out (split-brain writes)
+``leader-unique``     more than one rank claimed leadership under the
+                      same cluster epoch (``leader_elect`` /
+                      ``leader_handoff`` events) — the split brain the
+                      epoch-fenced lease must make impossible
+``placement-agreement`` a ``hash_place`` event's chain disagrees with
+                      the rendezvous plan recomputed over its recorded
+                      member set, or one alloc id was hash-placed twice
+                      with different chains
 ====================  ==================================================
 
 Findings follow the ``analysis``-family style: typed rule, rank, event
@@ -57,6 +65,7 @@ from oncilla_tpu.obs import flightrec
 EPOCH_EVENTS = frozenset({
     "fenced", "member_join", "member_leave", "node_dead",
     "failover_promote", "rereplicate", "migrate_start",
+    "leader_elect", "leader_fence", "leader_handoff",
 })
 
 # The low priority class (qos/policy.py PRIO_LOW); the reaper may evict
@@ -341,6 +350,86 @@ def _check_fenced(tl: Timeline) -> list[AuditFinding]:
                             "write)",
                     events=(_ref(fenced_at[track]), _ref(e)),
                 ))
+    return out
+
+
+@invariant("leader-unique")
+def _check_leader_unique(tl: Timeline) -> list[AuditFinding]:
+    """At most one unfenced leader per epoch (control/): every
+    leadership claim — an election win or a handoff adoption — bumps
+    the epoch first, so two claims under ONE epoch mean two daemons
+    each believed they held the lease simultaneously. Both ends of a
+    handoff journal the same (target, epoch) pair; that is one claimant,
+    not two."""
+    claims: dict[int, dict[int, dict]] = defaultdict(dict)  # epoch->rank->ev
+    for e in tl.events:
+        ev = e.get("ev")
+        if ev == "leader_elect":
+            rank, epoch = e.get("rank"), e.get("epoch")
+        elif ev == "leader_handoff":
+            rank, epoch = e.get("target"), e.get("epoch")
+        else:
+            continue
+        if rank is None or epoch is None:
+            continue
+        claims[int(epoch)].setdefault(int(rank), e)
+    out = []
+    for epoch, by_rank in sorted(claims.items()):
+        if len(by_rank) > 1:
+            out.append(AuditFinding(
+                rule="leader-unique",
+                message=f"epoch {epoch}: leadership claimed by ranks "
+                        f"{sorted(by_rank)} — more than one unfenced "
+                        "leader per epoch (split brain)",
+                events=tuple(_ref(e) for _, e in sorted(by_rank.items())),
+            ))
+    return out
+
+
+@invariant("placement-agreement")
+def _check_placement_agreement(tl: Timeline) -> list[AuditFinding]:
+    """Every rank that hash-placed an allocation agrees with the
+    rendezvous plan: the ``hash_place`` event records the member set the
+    placer used, and the plan is a pure function of (alloc_id, members,
+    k) — so the auditor simply recomputes it. A second placement of the
+    same id with a DIFFERENT chain is flagged too (two origins can never
+    mint the same id, so a duplicate means a replayed or forged
+    placement)."""
+    # Local import: hashring is stdlib-only by contract, but audit must
+    # stay importable even if the control package is absent/broken.
+    try:
+        from oncilla_tpu.control import hashring
+    except Exception:  # noqa: BLE001 — no hash placements to verify then
+        hashring = None
+    out = []
+    seen: dict[object, tuple] = {}
+    for e in tl.events:
+        if e.get("ev") != "hash_place":
+            continue
+        aid = e.get("alloc_id")
+        chain = tuple(int(r) for r in e.get("chain") or ())
+        live = [int(r) for r in e.get("live") or ()]
+        k = int(e.get("k", len(chain) or 1))
+        if hashring is not None and live:
+            want = hashring.plan(int(aid), live, k)
+            if want != chain:
+                out.append(AuditFinding(
+                    rule="placement-agreement", rank=_rank_of(e),
+                    message=f"alloc {aid}: placed chain {list(chain)} "
+                            f"disagrees with the rendezvous plan "
+                            f"{list(want)} over members {live} (k={k})",
+                    events=(_ref(e),),
+                ))
+        prev = seen.get(aid)
+        if prev is not None and prev[0] != chain:
+            out.append(AuditFinding(
+                rule="placement-agreement", rank=_rank_of(e),
+                message=f"alloc {aid}: hash-placed twice with different "
+                        f"chains {list(prev[0])} vs {list(chain)}",
+                events=(prev[1], _ref(e)),
+            ))
+        else:
+            seen.setdefault(aid, (chain, _ref(e)))
     return out
 
 

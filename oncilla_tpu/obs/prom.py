@@ -263,6 +263,35 @@ def render(meta: dict) -> str:
                    "Put fan-out legs skipped because the replica is "
                    "DEAD (degraded until re-replication).",
                    fo.get("repl_put_skips", 0), rank=rank)
+        # Leadership (control/): who coordinates, under which epoch,
+        # and how often the role moved.
+        doc.sample("ocm_leader_rank", "gauge",
+                   "Rank this daemon believes currently leads the "
+                   "cluster (the master role as an epoch-fenced lease).",
+                   res.get("leader", 0), rank=rank)
+        doc.sample("ocm_leader_epoch", "gauge",
+                   "Cluster epoch at which leadership last changed, as "
+                   "this daemon adopted it.",
+                   res.get("leader_epoch", 0), rank=rank)
+        lc = res.get("leadership", {})
+        for outcome, key in (("won", "elections_won"),
+                             ("observed", "elections_observed"),
+                             ("handoff", "handoffs")):
+            doc.sample("ocm_elections_total", "counter",
+                       "Leadership changes seen by this daemon, by how "
+                       "it was involved.",
+                       lc.get(key, 0), rank=rank, outcome=outcome)
+        doc.sample("ocm_master_state_pushes_total", "counter",
+                   "MASTER_STATE replication pushes sent as leader.",
+                   lc.get("state_pushes", 0), rank=rank)
+        doc.sample("ocm_master_state_resyncs_total", "counter",
+                   "Whole re-syncs at promotion (replicated copy "
+                   "missing, stale, or CRC-refused).",
+                   lc.get("state_resyncs", 0), rank=rank)
+        doc.sample("ocm_hash_placements_total", "counter",
+                   "REQ_ALLOCs placed locally by rendezvous hashing "
+                   "(zero leader round trips).",
+                   lc.get("hash_placements", 0), rank=rank)
 
     qos = meta.get("qos", {})
     if qos:

@@ -171,6 +171,338 @@ def smoke(seed: int, verbose: bool = False) -> int:
     return 0
 
 
+# -- leader chaos smoke (control/): the cluster survives losing ANY rank,
+# -- including the coordinator itself ------------------------------------
+
+
+def _leader_cfg(**kw):
+    from oncilla_tpu.utils.config import OcmConfig
+
+    base = dict(
+        host_arena_bytes=32 << 20,
+        device_arena_bytes=8 << 20,
+        heartbeat_s=0.05,
+        lease_s=5.0,
+        replicas=2,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        dcn_stripes=1,
+        chunk_bytes=256 << 10,
+        standby_masters=2,
+        failover_wait_s=15.0,
+    )
+    base.update(kw)
+    return OcmConfig(**base)
+
+
+def _wait(pred, timeout_s: float, what: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _wait_state_push(cl, ranks, timeout_s: float = 10.0) -> None:
+    _wait(
+        lambda: all(
+            cl.daemons[r]._master_state_raw is not None for r in ranks
+        ),
+        timeout_s, f"master-state replication to standbys {ranks}",
+    )
+
+
+def run_leader_kill(seed: int, verbose: bool = False) -> dict:
+    """Scenario 1 — kill the LEADER mid-alloc-storm. Consistent-hash
+    placement (every alloc placed at the origin, zero leader round
+    trips) + k=2 chains + 2 standby masters on a 4-rank cluster: the
+    storm keeps allocating while rank 0 dies, the lowest live standby
+    takes the lease under a bumped epoch and resumes the dead leader's
+    failover coordination, and every in-quota op reads back byte-exact.
+    """
+    import numpy as np
+
+    from oncilla_tpu.core.kinds import OcmKind
+    from oncilla_tpu.runtime.cluster import local_cluster
+
+    cfg = _leader_cfg(placement="hash")
+    rng = np.random.default_rng(seed)
+    with local_cluster(4, config=cfg) as cl:
+        client = cl.client(1)
+        handles: list = []
+        datas: list = []
+
+        def storm(n: int) -> None:
+            for _ in range(n):
+                data = rng.integers(0, 256, 192 << 10, dtype=np.uint8)
+                h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+                client.put(h, data, 0)
+                handles.append(h)
+                datas.append(data)
+
+        storm(4)  # calm phase
+        _wait_state_push(cl, (1, 2))
+        schedule = ChaosSchedule.kill_at(
+            seed, 0, op=6,
+            extra=(Fault(op=3, action="drop"),
+                   Fault(op=9, action="delay", delay_s=0.002)),
+        )
+        controller = ChaosController(schedule, cl.entries, kill_fn=cl.kill)
+        with controller.inject():
+            storm(10)  # the leader dies somewhere in here
+        assert not controller.pending(), (
+            f"workload too short for schedule: {controller.pending()}"
+        )
+        _wait(lambda: cl.daemons[1].is_leader, 15.0,
+              "standby rank 1 to take leadership")
+        leader = cl.daemons[1]
+        assert leader.epoch > 0, "election never bumped the epoch"
+        # Every in-quota client op completes byte-exact.
+        for h, d in zip(handles, datas):
+            got = client.get(h, d.nbytes)
+            assert bytes(got) == d.tobytes(), (
+                f"alloc {h.alloc_id} not byte-exact after leader kill"
+            )
+        # The hash-placement pin: NOT ONE allocation was placed by a
+        # leader — rank 0's placement counter (and everyone else's)
+        # stayed at zero while every alloc journaled a hash_place.
+        assert all(
+            d.ldr_counters["placements"] == 0 for d in cl.daemons
+        ), "REQ_ALLOC took a leader round trip under OCM_PLACEMENT=hash"
+        placed = sum(
+            d.ldr_counters["hash_placements"] for d in cl.daemons
+        )
+        assert placed >= len(handles), (
+            f"{placed} hash placements for {len(handles)} allocs"
+        )
+        epoch = leader.epoch
+        won = leader.ldr_counters["elections_won"]
+    return {
+        "seed": seed, "schedule": schedule, "log": list(controller.log),
+        "leader": 1, "epoch": epoch, "elections_won": won,
+        "allocs": len(handles),
+    }
+
+
+def run_leader_splitbrain(seed: int, verbose: bool = False) -> dict:
+    """Scenario 2 — partition the leader from its standbys (the
+    split-brain drill): rank 0 is isolated live (inbound drops,
+    outbound refuses, probes fail) so it keeps BELIEVING it leads while
+    rank 1 is elected under a bumped epoch. On heal the deposed leader
+    learns its verdict from the PING STALE_EPOCH sentinel, fences
+    itself, and answers STALE_EPOCH to coordination traffic — it never
+    coordinates again, which is exactly what the flight recorder's
+    leader-unique invariant certifies."""
+    import numpy as np
+
+    from oncilla_tpu.core.errors import OcmRemoteError
+    from oncilla_tpu.core.kinds import OcmKind
+    from oncilla_tpu.runtime import protocol as P
+    from oncilla_tpu.runtime.cluster import local_cluster
+
+    cfg = _leader_cfg(placement="leader")
+    rng = np.random.default_rng(seed)
+    total = 2 << 20
+    data = rng.integers(0, 256, total, dtype=np.uint8)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(1)
+        h = client.alloc(total, OcmKind.REMOTE_HOST)
+        client.put(h, data, 0)
+        _wait_state_push(cl, (1, 2))
+        schedule = ChaosSchedule(
+            seed=seed,
+            faults=(Fault(op=4, action="isolate", rank=0),
+                    Fault(op=7, action="delay", delay_s=0.002)),
+        )
+        controller = ChaosController(
+            schedule, cl.entries,
+            isolate_fn=lambda r, on: cl.daemons[r].set_partitioned(on),
+        )
+        step = 256 << 10
+        with controller.inject():
+            # Puts drive the op counter past the isolation point; the
+            # ladder rides out the ownership churn retryably.
+            for off in range(0, total, step):
+                client.put(h, data[off:off + step], off)
+            got = client.get(h, total)
+        assert bytes(got) == data.tobytes()
+        assert not controller.pending(), (
+            f"workload too short for schedule: {controller.pending()}"
+        )
+        _wait(lambda: cl.daemons[1].is_leader, 15.0,
+              "standby rank 1 to take leadership")
+        # While partitioned, the old leader still believes it leads.
+        assert cl.daemons[0].leader_rank == 0
+        # Heal: the deposed leader's next probe meets the STALE_EPOCH
+        # sentinel and it fences itself.
+        cl.daemons[0].set_partitioned(False)
+        _wait(lambda: cl.daemons[0]._fenced, 15.0,
+              "the deposed leader to fence itself after the heal")
+        # A fenced old leader answers STALE_EPOCH to coordination
+        # traffic — it must never coordinate again.
+        import socket as _socket
+
+        e0 = cl.entries[0]
+        s = _socket.create_connection((e0.connect_host, e0.port),
+                                      timeout=5.0)
+        try:
+            for m in (
+                P.Message(P.MsgType.REQ_ALLOC,
+                          {"orig_rank": 1, "pid": 999, "kind": 3,
+                           "nbytes": 4096}),
+                P.Message(P.MsgType.ADD_NODE,
+                          {"rank": 2, "host": "127.0.0.1", "port": 1,
+                           "ndevices": 1, "device_arena_bytes": 1,
+                           "host_arena_bytes": 1}),
+            ):
+                try:
+                    P.request(s, m)
+                except OcmRemoteError as err:
+                    assert err.code == int(P.ErrCode.STALE_EPOCH), (
+                        f"fenced leader answered {err.code}, not "
+                        "STALE_EPOCH"
+                    )
+                else:
+                    raise AssertionError(
+                        "fenced old leader served a coordination request"
+                    )
+        finally:
+            s.close()
+        got2 = client.get(h, total)
+        assert bytes(got2) == data.tobytes()
+        epoch = cl.daemons[1].epoch
+    return {
+        "seed": seed, "schedule": schedule, "log": list(controller.log),
+        "leader": 1, "epoch": epoch,
+    }
+
+
+def run_leader_double_kill(seed: int, verbose: bool = False) -> dict:
+    """Scenario 3 — kill the leader AND an owner simultaneously: the
+    two coordinated recoveries (election, then the dead owner's
+    promotion + re-replication) stack. The standby leads, the surviving
+    replica serves byte-exact, and k is restored among the survivors."""
+    import numpy as np
+
+    from oncilla_tpu.core.kinds import OcmKind
+    from oncilla_tpu.runtime.cluster import local_cluster
+
+    cfg = _leader_cfg(placement="leader")
+    rng = np.random.default_rng(seed)
+    total = 1 << 20
+    with local_cluster(4, config=cfg) as cl:
+        client = cl.client(1)
+        # Find a victim handle whose whole chain avoids ranks 0 and 1:
+        # we kill 0 (the leader) + the primary, and need the replica to
+        # survive the double kill.
+        victim = None
+        vdata = None
+        keep = []
+        for _ in range(12):
+            d = rng.integers(0, 256, total, dtype=np.uint8)
+            h = client.alloc(total, OcmKind.REMOTE_HOST)
+            client.put(h, d, 0)
+            keep.append((h, d))
+            if (
+                h.rank in (2, 3) and h.replica_ranks
+                and all(r in (2, 3) for r in h.replica_ranks)
+            ):
+                victim, vdata = h, d
+                break
+        assert victim is not None, (
+            f"no chain landed wholly on ranks 2/3: "
+            f"{[(h.rank, h.replica_ranks) for h, _ in keep]}"
+        )
+        owner = victim.rank
+        _wait_state_push(cl, (1, 2))
+        schedule = ChaosSchedule(
+            seed=seed,
+            faults=(Fault(op=3, action="kill", rank=0),
+                    Fault(op=5, action="kill", rank=owner)),
+        )
+        controller = ChaosController(schedule, cl.entries, kill_fn=cl.kill)
+        with controller.inject():
+            step = 256 << 10
+            for off in range(0, total, step):
+                client.put(victim, vdata[off:off + step], off)
+            got = client.get(victim, total)
+        assert bytes(got) == vdata.tobytes()
+        assert not controller.pending(), (
+            f"workload too short for schedule: {controller.pending()}"
+        )
+        _wait(lambda: cl.daemons[1].is_leader, 15.0,
+              "standby rank 1 to take leadership")
+        promoted = victim.rank
+        assert promoted not in (0, owner), "handle never failed over"
+        # k restored among the survivors.
+        deadline = time.monotonic() + 20.0
+        chain = ()
+        while time.monotonic() < deadline:
+            try:
+                e = cl.daemons[promoted].registry.lookup(victim.alloc_id)
+            except Exception:  # noqa: BLE001 — registry churn mid-repair
+                time.sleep(0.05)
+                continue
+            chain = e.chain
+            if len(chain) >= 2 and owner not in chain and 0 not in chain:
+                break
+            time.sleep(0.05)
+        assert len(chain) >= 2 and owner not in chain and 0 not in chain, (
+            f"re-replication never restored k=2 (chain={chain})"
+        )
+        epoch = cl.daemons[1].epoch
+    return {
+        "seed": seed, "schedule": schedule, "log": list(controller.log),
+        "leader": 1, "owner": owner, "promoted": promoted,
+        "chain": list(chain), "epoch": epoch,
+    }
+
+
+_LEADER_SCENARIOS = (
+    ("kill-leader-mid-alloc-storm", run_leader_kill),
+    ("leader-splitbrain-partition", run_leader_splitbrain),
+    ("kill-leader-and-owner", run_leader_double_kill),
+)
+
+
+def leader_smoke(seed: int, verbose: bool = False) -> int:
+    """Run every leader chaos scenario TWICE under the flight recorder:
+    each replay must fire the identical fault interleaving, converge to
+    the same leader, and pass the full invariant audit — including the
+    new leader-unique and placement-agreement checks — with zero
+    findings."""
+    from oncilla_tpu.obs import audit as obs_audit
+
+    for name, fn in _LEADER_SCENARIOS:
+        print(f"leader smoke [{name}]: seed={seed} run 1/2 ...")
+        with obs_audit.recorded(f"leader-{name}-run1") as rec1:
+            r1 = fn(seed, verbose=verbose)
+        print(f"  flight recorder: {rec1.summary()}")
+        print(f"  chaos log: {r1['log']}  (leader -> rank {r1['leader']},"
+              f" epoch {r1['epoch']})")
+        print(f"leader smoke [{name}]: seed={seed} run 2/2 (replay) ...")
+        with obs_audit.recorded(f"leader-{name}-run2") as rec2:
+            r2 = fn(seed, verbose=verbose)
+        print(f"  flight recorder: {rec2.summary()}")
+        print(f"  chaos log: {r2['log']}")
+        if r1["schedule"] != r2["schedule"] or r1["log"] != r2["log"]:
+            print(f"leader smoke [{name}]: FAIL — interleavings differ: "
+                  f"{r1['log']} vs {r2['log']}")
+            return 1
+        if r1["leader"] != r2["leader"]:
+            print(f"leader smoke [{name}]: FAIL — different leaders "
+                  f"elected across replays")
+            return 1
+    print("leader smoke: OK — leader kill / split-brain partition / "
+          "leader+owner double kill all converge byte-exact, replays "
+          "identical, invariant audits clean (leader-unique + "
+          "placement-agreement included)")
+    return 0
+
+
 def main(argv=None) -> int:
     from oncilla_tpu.utils.platform import honor_cpu_env
 
@@ -182,6 +514,12 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="run the kill-owner scenario twice and verify "
                          "byte-exact failover + deterministic replay")
+    ap.add_argument("--leader-smoke", action="store_true",
+                    help="run the decentralized-control-plane scenarios "
+                         "(kill leader mid-alloc-storm, split-brain "
+                         "partition, leader+owner double kill) twice "
+                         "each with deterministic replay + invariant "
+                         "audit")
     ap.add_argument("--plan", action="store_true",
                     help="print the generated random schedule for --seed")
     ap.add_argument("--seed", type=int, default=1234)
@@ -198,8 +536,13 @@ def main(argv=None) -> int:
                   + (f" rank {f.rank}" if f.rank >= 0 else "")
                   + (f" ({f.delay_s}s)" if f.action == "delay" else ""))
         return 0
+    if args.smoke and args.leader_smoke:
+        rc = smoke(args.seed, verbose=args.verbose)
+        return rc or leader_smoke(args.seed, verbose=args.verbose)
     if args.smoke:
         return smoke(args.seed, verbose=args.verbose)
+    if args.leader_smoke:
+        return leader_smoke(args.seed, verbose=args.verbose)
     ap.print_help()
     return 2
 

@@ -19,6 +19,15 @@ Fault vocabulary:
                 target rank raises (one-way partition at the seam).
 - ``corrupt_snapshot`` — flip one byte of the target rank's snapshot
                 file (exercises the CRC refusal path on restart).
+- ``isolate``/``heal_isolate`` — FULL host partition of one daemon
+                (control/ split-brain scenarios): fired through the
+                harness-bound ``isolate_fn(rank, on)``, which flips
+                ``Daemon.set_partitioned`` — inbound connections drop
+                mid-frame, outbound pool leases refuse, probes fail —
+                so a live-but-unreachable leader keeps believing it
+                leads until the heal lets the fence reach it. Unlike
+                ``partition`` (one-way, at the pool seam only), this
+                models the whole host vanishing from the network.
 - ``join``/``leave``/``migrate`` — elastic-membership fault points
                 (elastic/): fire the harness-bound ``join_fn`` /
                 ``leave_fn(rank)`` / ``migrate_fn`` at a deterministic
@@ -46,7 +55,7 @@ from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.runtime import pool as _pool
 
 ACTIONS = ("kill", "drop", "delay", "partition", "heal", "corrupt_snapshot",
-           "join", "leave", "migrate")
+           "join", "leave", "migrate", "isolate", "heal_isolate")
 
 
 @dataclass(frozen=True)
@@ -116,10 +125,12 @@ class ChaosController:
 
     def __init__(self, schedule: ChaosSchedule, entries,
                  kill_fn=None, snapshot_paths: dict[int, str] | None = None,
-                 join_fn=None, leave_fn=None, migrate_fn=None):
+                 join_fn=None, leave_fn=None, migrate_fn=None,
+                 isolate_fn=None):
         self.schedule = schedule
         self.entries = entries  # live membership list (ports resolve late)
         self.kill_fn = kill_fn
+        self.isolate_fn = isolate_fn
         self.snapshot_paths = snapshot_paths or {}
         # Elastic-membership fault points (elastic/): bound by the
         # harness; a schedule naming them without a binding is a no-op
@@ -191,6 +202,12 @@ class ChaosController:
             elif f.action == "migrate":
                 if self.migrate_fn is not None:
                     self.migrate_fn()
+            elif f.action == "isolate":
+                if self.isolate_fn is not None:
+                    self.isolate_fn(f.rank, True)
+            elif f.action == "heal_isolate":
+                if self.isolate_fn is not None:
+                    self.isolate_fn(f.rank, False)
         if drop:
             raise OSError(f"chaos: dropped lease to {host}:{port} (op {n})")
         if blocked:

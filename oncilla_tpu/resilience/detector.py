@@ -28,10 +28,11 @@ from __future__ import annotations
 
 import enum
 import socket
+from typing import NamedTuple
 
 from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.errors import OcmProtocolError, OcmRemoteError
-from oncilla_tpu.runtime.protocol import Message, MsgType, request
+from oncilla_tpu.runtime.protocol import ErrCode, Message, MsgType, request
 
 
 class PeerState(enum.IntEnum):
@@ -48,6 +49,23 @@ class PeerState(enum.IntEnum):
 _DEAD_PROBE_EVERY = 8
 
 
+class DeadVerdict(NamedTuple):
+    """probe()'s sentinel for "YOU were declared dead": the verdict
+    holder's authority — its leadership epoch and cluster epoch. The
+    receiver fences itself only when ``(leader_epoch, epoch)``
+    lexicographically exceeds its own: leadership epoch dominates so a
+    deposed leader that kept bumping its cluster epoch in isolation can
+    never out-rank the elected one, and a survivor that already adopted
+    the new leadership ignores the deposed claimant's stale verdicts
+    entirely (control/)."""
+
+    leader_epoch: int
+    epoch: int
+
+    def outranks(self, leader_epoch: int, epoch: int) -> bool:
+        return (self.leader_epoch, self.epoch) > (leader_epoch, epoch)
+
+
 def probe(
     host: str,
     port: int,
@@ -62,7 +80,13 @@ def probe(
     the peer pool — a pooled lease to a wedged host blocks for the full
     30 s connect timeout, which would stall the reaper loop driving the
     probes. An ERROR reply means alive-but-PING-less (v2/native peer):
-    (0, 0)."""
+    (0, 0) — EXCEPT a typed STALE_EPOCH, which is the peer telling the
+    SENDER it was declared dead: surfaced as a :class:`DeadVerdict`
+    sentinel (with the verdict holder's authority) so a
+    merely-partitioned daemon that heals fences itself instead of
+    resuming as a split brain. (The sentinel was documented since PR 5
+    but the probe flattened every typed rejection to (0, 0); the
+    detector-driven self-fence now works as specified.)"""
     try:
         s = socket.create_connection((host, port), timeout=timeout)
     except OSError:
@@ -75,7 +99,14 @@ def probe(
         if r.type != MsgType.PING_OK:
             return None
         return r.fields["epoch"], r.fields["inc"]
-    except OcmRemoteError:
+    except OcmRemoteError as e:
+        if e.code == int(ErrCode.STALE_EPOCH):
+            # "YOU were declared dead" — with the verdict holder's
+            # authority so the caller can decide whether it binds.
+            return DeadVerdict(
+                getattr(e, "verdict_leader_epoch", 0),
+                getattr(e, "verdict_epoch", 0),
+            )
         return 0, 0  # typed rejection: the peer is alive, just older
     except (OSError, OcmProtocolError):
         return None

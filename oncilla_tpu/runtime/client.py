@@ -232,8 +232,14 @@ class ControlPlaneClient:
         self.ici_plane = ici_plane
         self.tracer = GLOBAL_TRACER
         self._pool = PeerPool()
-        me = entries[rank]
-        self._ctrl = self._connect_ctrl(me.connect_host, me.port)
+        # Bootstrap CONNECT ladder (control/): the preferred seat is the
+        # local rank's daemon, but boot must not hard-depend on any ONE
+        # seed address being alive (the old behavior made the nodefile's
+        # own-rank row — rank 0 for most single-host tools — a single
+        # point of failure). Walk the remaining seed addresses with
+        # capped backoff; the first live daemon becomes this app's local
+        # daemon, and the client adopts ITS rank as the app's origin.
+        self._ctrl, self.rank = self._connect_ladder(entries, rank)
         self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._ctrl_lock = make_lock("client._ctrl_lock")
         # Which ranks own this app's live remote allocations (rank -> count).
@@ -272,7 +278,8 @@ class ControlPlaneClient:
         # same frame as a FLAG_QOS_TAIL data tail; decliners (old
         # daemons, the native C++ daemon) ignore both bit and tail.
         connect = Message(
-            MsgType.CONNECT, {"pid": self.pid, "rank": rank}, flags=offer
+            MsgType.CONNECT, {"pid": self.pid, "rank": self.rank},
+            flags=offer,
         )
         if self.config.qos_offer:
             connect.flags |= FLAG_CAP_QOS | FLAG_QOS_TAIL
@@ -308,28 +315,65 @@ class ControlPlaneClient:
 
     # -- plumbing --------------------------------------------------------
 
-    def _connect_ctrl(self, host: str, port: int) -> socket.socket:
-        """Dial the local daemon with capped exponential backoff +
-        jitter: a daemon restarting (snapshot restore, mid-failover
-        replacement) refuses connections for a beat, and a hard error on
-        the very first attempt would surface that routine window to the
-        app. Jitter (uniform in [0.5, 1.0] of the step) keeps a herd of
+    def _connect_ctrl(self, host: str, port: int,
+                      retries: int | None = None) -> socket.socket:
+        """Dial one daemon with capped exponential backoff + jitter: a
+        daemon restarting (snapshot restore, mid-failover replacement)
+        refuses connections for a beat, and a hard error on the very
+        first attempt would surface that routine window to the app.
+        Jitter (uniform in [0.5, 1.0] of the step) keeps a herd of
         clients from re-dialing a rebinding daemon in lockstep."""
         cfg = self.config
+        retries = cfg.connect_retries if retries is None else retries
         delay = max(cfg.connect_backoff_s, 1e-3)
         last: OSError | None = None
-        for attempt in range(cfg.connect_retries + 1):
+        for attempt in range(retries + 1):
             try:
                 return socket.create_connection((host, port), timeout=30.0)
             except OSError as e:
                 last = e
-                if attempt == cfg.connect_retries:
+                if attempt == retries:
                     break
                 backoff_sleep(min(delay, cfg.connect_backoff_cap_s))
                 delay *= 2
         raise OcmConnectError(
             f"local daemon unreachable at {host}:{port} after "
-            f"{cfg.connect_retries + 1} attempts: {last}"
+            f"{retries + 1} attempts: {last}"
+        ) from last
+
+    def _connect_ladder(
+        self, entries, rank: int
+    ) -> tuple[socket.socket, int]:
+        """Walk the seed addresses: the app's own rank first (with the
+        full retry budget — a restarting local daemon is the routine
+        case), then every other seed once each with one quick retry.
+        Returns (socket, rank of the daemon it reaches). Boot therefore
+        survives any single seed being down — including the nodefile's
+        rank-0 row — as long as ANY seeded daemon answers; leader
+        discovery from there is the daemons' NOT_MASTER/REQ_LOCATE
+        backstop, not the client's problem."""
+        me = entries[rank]
+        try:
+            return self._connect_ctrl(me.connect_host, me.port), rank
+        except OcmConnectError as e:
+            last: OcmConnectError = e
+        for e in entries:
+            r = getattr(e, "rank", None)
+            if r is None or r == rank or not e.port:
+                continue
+            try:
+                sock = self._connect_ctrl(e.connect_host, e.port, retries=1)
+            except OcmConnectError as err:
+                last = err
+                continue
+            printd(
+                "client: seed rank %d unreachable, attached to rank %d "
+                "at %s:%d instead", rank, r, e.connect_host, e.port,
+            )
+            return sock, r
+        raise OcmConnectError(
+            f"no seed daemon reachable (own rank {rank} and every other "
+            f"nodefile address refused): {last}"
         ) from last
 
     def _request(self, msg: Message) -> Message:
@@ -964,16 +1008,24 @@ class ControlPlaneClient:
         daemon that just answered MOVED (its tombstone knows the target,
         and its live view knows the target's address — essential when
         the redirect names a rank beyond this client's boot view), then
-        rank 0 (the rebalancer records every flip it drives)."""
+        the seed ranks in order — rank 0 first as before, but no longer
+        ONLY rank 0: once leadership is dynamic (control/) the
+        coordinator holding the relocation records may be any rank, and
+        the new owner's own registry answers REQ_LOCATE too, so the
+        first seed that knows the id wins. Bounded: at most two distinct
+        answers are collected per retry round."""
         out = []
         moved = getattr(last_err, "moved_to_rank", None)
         if moved is not None and self._rank_addr(moved) is None:
             loc = self._locate_at(self._owner_addr(handle), handle)
             if loc is not None:
                 out.append(loc)
-        loc = self._locate_at(self._rank_addr(0), handle)
-        if loc is not None and loc not in out:
-            out.append(loc)
+        for r in range(len(self.entries)):
+            loc = self._locate_at(self._rank_addr(r), handle)
+            if loc is not None and loc not in out:
+                out.append(loc)
+                if len(out) >= 2:
+                    break
         return out
 
     def _failover_handle(

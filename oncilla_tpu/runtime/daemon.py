@@ -46,6 +46,8 @@ from oncilla_tpu.core.errors import (
     OcmReplicaUnavailable,
 )
 from oncilla_tpu import fabric as fabric_mod
+from oncilla_tpu.control import hashring
+from oncilla_tpu.control import leader as control_leader
 from oncilla_tpu.core.hostmem import HostArena
 from oncilla_tpu.core.kinds import OcmKind
 from oncilla_tpu.elastic.rebalance import Rebalancer
@@ -66,7 +68,12 @@ from oncilla_tpu.qos.policy import (
     suggest_backoff_ms,
     unpack_profile,
 )
-from oncilla_tpu.resilience.detector import FailureDetector, PeerState, probe
+from oncilla_tpu.resilience.detector import (
+    DeadVerdict,
+    FailureDetector,
+    PeerState,
+    probe,
+)
 from oncilla_tpu.resilience.failover import FailoverCoordinator
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
@@ -87,6 +94,7 @@ from oncilla_tpu.runtime.protocol import (
     Message,
     MsgType,
     RecvScratch,
+    pack_leader_tail,
     recv_msg,
     request,
     send_msg,
@@ -265,12 +273,53 @@ class Daemon:
             "migration_bytes": 0,        # bytes whose ownership flipped
         }
         self.res_counters = {
-            "deaths": 0,           # DEAD verdicts issued (rank 0 only)
+            "deaths": 0,           # DEAD verdicts issued (leader only)
             "promotions": 0,       # replica entries promoted to primary here
-            "rereplications": 0,   # repair copies driven (rank 0 only)
+            "rereplications": 0,   # repair copies driven (leader only)
             "repl_put_errors": 0,  # put fan-out legs that failed
             "repl_put_skips": 0,   # fan-out legs skipped (replica DEAD)
         }
+        # -- decentralized control plane (control/) ----------------------
+        # The master role is a dynamic LEADERSHIP, not rank 0's identity:
+        # every master-bound leg (ADD_NODE, REQ_ALLOC proxy, NOTE_*,
+        # SUSPECT reports, plane master hop, JOIN/LEAVE) targets
+        # entries[leader_rank]. Boot-time leader is rank 0 — with
+        # OCM_STANDBY_MASTERS unset it never moves, and none of the
+        # MASTER_STATE/LEADER_* family ever rides the wire.
+        self.leader_rank = 0
+        self.leader_epoch = 0
+        self._elect_lock = make_lock("daemon._elect_lock")
+        self.ldr_counters = {
+            "elections_won": 0,       # this daemon took leadership
+            "elections_observed": 0,  # leadership changed under us
+            "handoffs": 0,            # voluntary transfers (either end)
+            "placements": 0,          # REQ_ALLOCs placed HERE as leader
+            "hash_placements": 0,     # REQ_ALLOCs hash-placed locally
+            "state_pushes": 0,        # MASTER_STATE pushes sent (leader)
+            "state_resyncs": 0,       # whole-resyncs at promotion
+        }
+        # Replicated master state held AS a standby: the raw CRC-framed
+        # document exactly as pushed (validated before storing AND again
+        # at promotion — a copy torn on disk/in memory is refused whole).
+        self._master_state_raw: bytes | None = None
+        self._master_state_ts = 0.0
+        self._master_state_seq = 0
+        self._state_seq = 0          # leader-side push sequence
+        self._state_lock = make_lock("daemon._state_lock")
+        # LEADER_UPDATE broadcast retry set + the fields to re-send
+        # (the _member_unsynced pattern: reaper retries stragglers).
+        self._leader_unsynced: set[int] = set()
+        self._leader_update_fields: dict | None = None
+        self._leader_sync_lock = make_lock("daemon._leader_sync_lock")
+        # Hash placement's deferred accounting: NOTE_ALLOC messages bound
+        # for the leader, drained by the reaper so the alloc path itself
+        # makes ZERO leader round trips (the acceptance pin).
+        self._acct_pending: list[Message] = []
+        self._acct_lock = make_lock("daemon._acct_lock")
+        # Harness-level partition emulation (resilience/chaos "isolate"):
+        # inbound connections are dropped, outbound pool leases refused,
+        # probes short-circuit to failures — a fully partitioned host.
+        self._partitioned = False
         self.detector = (
             FailureDetector(
                 len(entries), rank,
@@ -279,8 +328,11 @@ class Daemon:
             )
             if self.config.detect and len(entries) > 1 else None
         )
-        self._failover = FailoverCoordinator(self) if rank == 0 else None
-        self._rebalancer = Rebalancer(self) if rank == 0 else None
+        # Every daemon carries the coordination machinery (cheap, inert
+        # objects); only the CURRENT leader drives it — a promoted
+        # standby resumes failover/rebalance without construction races.
+        self._failover = FailoverCoordinator(self)
+        self._rebalancer = Rebalancer(self)
         self._last_probe = time.monotonic()
 
     # -- lifecycle -------------------------------------------------------
@@ -314,10 +366,10 @@ class Daemon:
         # the listen backlog queues early connections, so no request can
         # claim an extent the snapshot needs (the C++ daemon orders the same
         # way, native/daemon.cc restore-before-accept).
-        if self.rank == 0:
+        if self.rank == self.leader_rank:
             self.policy.add_node(self._own_resources())
         else:
-            self._notify_rank0()
+            self._notify_leader()
         self._maybe_restore()
         t = threading.Thread(target=self._accept_loop, daemon=True, name=f"d{self.rank}-accept")
         t.start()
@@ -420,7 +472,8 @@ class Daemon:
     # -- epoch / fencing (resilience/) -----------------------------------
 
     def bump_epoch(self) -> int:
-        """Rank-0 only: advance the cluster epoch for a DEAD verdict."""
+        """Leader only: advance the cluster epoch (DEAD verdicts,
+        membership changes, leadership transfer)."""
         with self._epoch_lock:
             self.epoch += 1
             return self.epoch
@@ -440,6 +493,459 @@ class Daemon:
             )
             printd("daemon %d FENCED at epoch %d: refusing writes",
                    self.rank, epoch)
+
+    # -- leadership (control/): the master role as an epoch-fenced lease -
+
+    @property
+    def is_leader(self) -> bool:
+        """Whether THIS daemon currently coordinates the cluster. A
+        fenced daemon is never the leader, whatever it believes — its
+        verdicts were superseded by a newer epoch."""
+        return self.rank == self.leader_rank and not self._fenced
+
+    def _leader_entry(self) -> NodeEntry:
+        r = self.leader_rank
+        if 0 <= r < len(self.entries):
+            return self.entries[r]
+        return self.entries[0]
+
+    def _not_master_err(self, what: str) -> Message:
+        """Typed NOT_MASTER rejection. Once leadership is dynamic the
+        tail names the current leader (rank + address) so the sender
+        re-aims instead of spinning — the MOVED redirect pattern applied
+        to the master role. Static clusters keep the PR-11 tail-less
+        frame (wire byte-identity when the feature is unset)."""
+        tail = b""
+        if self.config.standby_masters > 0 or self.leader_rank != 0:
+            le = self._leader_entry()
+            tail = pack_leader_tail(
+                self.leader_rank, le.connect_host, le.port
+            )
+        return _err(
+            ErrCode.NOT_MASTER, f"{what} sent to non-master", tail
+        )
+
+    def _adopt_leader_hint(self, err) -> None:
+        """A peer's NOT_MASTER redirect named the current leader."""
+        lr = getattr(err, "leader_rank", None)
+        if lr is not None and 0 <= lr < len(self.entries):
+            if lr != self.leader_rank:
+                printd("daemon %d: leader hint %d -> %d",
+                       self.rank, self.leader_rank, lr)
+            self.leader_rank = lr
+
+    def set_partitioned(self, on: bool) -> None:
+        """Harness seam (resilience/chaos "isolate"): emulate a full
+        network partition of this daemon's host. Inbound requests are
+        dropped mid-frame (peers and probes see a torn connection),
+        outbound pool leases refuse, and the detector tick records
+        probe failures without dialing — deterministic, reversible, and
+        honest about what a partitioned process can still do: keep its
+        own state and keep believing it leads."""
+        self._partitioned = bool(on)
+        self.peers.set_blocked(on)
+        obs_journal.record(
+            "chaos_isolate" if on else "chaos_heal_isolate",
+            track=self.tracer.track, rank=self.rank,
+        )
+
+    def _standby_ranks(self) -> list[int]:
+        """The k lowest-rank live members after the leader — where the
+        master state replicates. Deterministic from the shared view, so
+        every rank agrees who the standbys are."""
+        k = self.config.standby_masters
+        if k <= 0:
+            return []
+        out = [
+            e.rank for e in self.entries
+            if e.rank != self.rank
+            and e.port
+            and not self.entries.has_left(e.rank)
+            and not self._believed_dead(e.rank)
+        ]
+        return sorted(out)[:k]
+
+    def _push_master_state(self) -> None:
+        """Leader, reaper-tick cadence: replicate the coordination state
+        to every standby under the snapshot+CRC discipline. Small (a few
+        KiB), so a full copy per tick beats delta bookkeeping; the seq
+        lets standbys drop stale reordered pushes."""
+        with self._state_lock:
+            self._state_seq += 1
+            seq = self._state_seq
+        doc = control_leader.build_state(self, seq)
+        raw = control_leader.pack_state(doc)
+        msg_fields = {"seq": seq, "epoch": self.epoch, "leader": self.rank}
+        for r in self._standby_ranks():
+            e = self.entries[r]
+            try:
+                self.peers.request(
+                    e.connect_host, e.port,
+                    Message(MsgType.MASTER_STATE, dict(msg_fields), raw),
+                )
+                self.ldr_counters["state_pushes"] += 1
+            except (OSError, OcmError):
+                pass  # next tick retries; the standby resyncs whole if
+                # it must lead from a stale copy
+
+    def _on_master_state(self, msg: Message) -> Message:
+        """Standby side: store the leader's pushed state. The CRC is
+        verified BEFORE the copy is stored (a torn push is refused with
+        a typed error, and the leader re-pushes next tick) and verified
+        AGAIN at promotion — the copy may rot in between."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        if 0 <= f["leader"] < len(self.entries):
+            self.leader_rank = f["leader"]
+        control_leader.unpack_state(msg.data)  # raises on any corruption
+        with self._state_lock:
+            if f["seq"] >= self._master_state_seq:
+                self._master_state_raw = bytes(msg.data)
+                self._master_state_seq = f["seq"]
+                self._master_state_ts = time.monotonic()
+        return Message(MsgType.MASTER_STATE_OK, {"seq": f["seq"]})
+
+    def _adopt_master_state(self) -> bool:
+        """Promotion path: lead from the replicated copy if — and only
+        if — it verifies AND is fresh within the leader lease. Returns
+        False when the winner must re-sync whole instead."""
+        with self._state_lock:
+            raw, ts = self._master_state_raw, self._master_state_ts
+        if raw is None:
+            return False
+        age = time.monotonic() - ts
+        horizon = max(self.config.leader_lease_s,
+                      3 * self.config.heartbeat_s)
+        if age > horizon:
+            printd("daemon %d: replicated master state is %.2fs old "
+                   "(lease %.2fs) — resyncing whole", self.rank, age,
+                   horizon)
+            return False
+        try:
+            doc = control_leader.unpack_state(raw)
+        except OcmProtocolError as e:
+            obs_journal.record(
+                "master_state_corrupt", track=self.tracer.track,
+                rank=self.rank, error=str(e),
+            )
+            printd("daemon %d: replicated master state REFUSED: %s",
+                   self.rank, e)
+            return False
+        control_leader.apply_state(self, doc)
+        return True
+
+    def _rebuild_master_state(self) -> None:
+        """Whole re-sync: reconstruct the placement accounting from the
+        survivors' own numbers (STATUS carries capacities + live bytes)
+        instead of trusting a torn or stale replica. Unreachable peers
+        are skipped — the detector resolves them, and NOTE_* traffic
+        self-corrects the books as it always has."""
+        self.ldr_counters["state_resyncs"] += 1
+        obs_journal.record(
+            "leader_resync", track=self.tracer.track,
+            rank=self.rank, epoch=self.epoch,
+        )
+        rows = [{
+            "rank": self.rank,
+            "ndevices": self.ndevices,
+            "device_arena_bytes": self.config.device_arena_bytes,
+            "host_arena_bytes": self.config.host_arena_bytes,
+            "device_used": [b.bytes_live for b in self.device_books],
+            "host_used": self.host_arena.allocator.bytes_live,
+        }]
+        for e in self.entries:
+            if e.rank == self.rank or not e.port:
+                continue
+            if self.entries.has_left(e.rank) or self._believed_dead(e.rank):
+                continue
+            try:
+                r = self.peers.request(
+                    e.connect_host, e.port, Message(MsgType.STATUS, {})
+                )
+            except (OSError, OcmError):
+                continue
+            caps = {}
+            if r.data:
+                import json
+
+                try:
+                    caps = json.loads(bytes(r.data)).get("caps") or {}
+                except (ValueError, UnicodeDecodeError):
+                    caps = {}
+            rows.append({
+                "rank": e.rank,
+                "ndevices": caps.get("ndevices", 1),
+                "device_arena_bytes": caps.get(
+                    "device_arena_bytes", self.config.device_arena_bytes
+                ),
+                "host_arena_bytes": caps.get(
+                    "host_arena_bytes", self.config.host_arena_bytes
+                ),
+                # The total is accurate; the per-device split is not
+                # reported — park it on device 0 (device placement is
+                # capacity-gated per device, so this only errs safe).
+                "device_used": [r.fields.get("device_bytes_live", 0)],
+                "host_used": r.fields.get("host_bytes_live", 0),
+            })
+        dead = self.detector.dead_ranks() if self.detector else set()
+        self.policy.restore(rows, dead)
+
+    def _maybe_elect(self) -> None:
+        """Standby election check (reaper tick, leader believed dead):
+        the lowest live rank takes over. Everyone computes the same rule
+        from their own view; non-winners keep probing the smaller ranks
+        so a dead would-be winner is discovered and the rule re-runs."""
+        det = self.detector
+        dead = det.dead_ranks() if det is not None else set()
+        winner = control_leader.elect(self.entries, dead, self.rank)
+        if winner == self.rank:
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        """Take the master role after the leader's DEAD verdict: adopt
+        (or rebuild) the replicated state, bump + fence under a new
+        epoch, broadcast LEADER_UPDATE, then resume the dead leader's
+        coordination — failover, promotion, re-replication — exactly
+        where it stopped."""
+        with self._elect_lock:
+            if self.is_leader or self._fenced:
+                return
+            old = self.leader_rank
+            if not self._believed_dead(old):
+                return
+            old_inc = (
+                self.detector.incarnation(old) if self.detector else 0
+            )
+            resync = not self._adopt_master_state()
+            if resync:
+                self._rebuild_master_state()
+            self.leader_rank = self.rank
+            epoch = self.bump_epoch()
+            self.leader_epoch = epoch
+            self.ldr_counters["elections_won"] += 1
+        self.policy.mark_dead(old)
+        if self.detector is not None:
+            self.detector.mark_dead(old)
+        obs_journal.record(
+            "leader_elect", track=self.tracer.track,
+            rank=self.rank, prev=old, epoch=epoch, resync=resync,
+        )
+        obs_journal.record(
+            "leader_fence", track=self.tracer.track,
+            rank=old, epoch=epoch,
+        )
+        printd("daemon %d: ELECTED leader at epoch %d (rank %d fenced%s)",
+               self.rank, epoch, old, ", state resynced" if resync else "")
+        if 0 <= old < len(self.entries):
+            de = self.entries[old]
+            self.peers.evict(de.connect_host, de.port)
+        self._queue_leader_sync(dead_rank=old, inc=old_inc)
+        # Resume coordination: the deposed leader's allocations fail
+        # over under this leadership (promote + re-replicate), through
+        # the same coordinator a rank-0 master always ran.
+        try:
+            self._failover.node_dead(old)
+        except Exception as e:  # noqa: BLE001 — leadership must survive
+            # a partially unreachable cluster; repair retries via the
+            # detector's ongoing verdicts
+            printd("daemon %d: post-election failover for rank %d "
+                   "failed: %s", self.rank, old, e)
+
+    def handoff_leadership(self) -> int:
+        """Voluntary transfer (the clean-LEAVE path rank 0 never had):
+        push the final state synchronously inside the handoff frame —
+        the successor refuses a CRC-failing copy, and then this daemon
+        simply remains leader — and demote only once the successor
+        confirmed. Returns the new leader's rank."""
+        if not self.is_leader:
+            raise OcmError(f"rank {self.rank} is not the leader")
+        det_dead = self.detector.dead_ranks() if self.detector else set()
+        succ = min(
+            (e.rank for e in self.entries
+             if e.rank != self.rank and e.port
+             and e.rank not in det_dead
+             and not self.entries.has_left(e.rank)),
+            default=None,
+        )
+        if succ is None:
+            raise OcmError("no live member to hand leadership to")
+        with self._elect_lock:
+            epoch = self.bump_epoch()
+            with self._state_lock:
+                self._state_seq += 1
+                seq = self._state_seq
+            doc = control_leader.build_state(self, seq, leader=succ)
+            doc["epoch"] = epoch
+            raw = control_leader.pack_state(doc)
+        se = self.entries[succ]
+        self.peers.request(
+            se.connect_host, se.port,
+            Message(
+                MsgType.LEADER_HANDOFF,
+                {"leader": succ, "epoch": epoch,
+                 "from_rank": self.rank, "inc": self.incarnation},
+                raw,
+            ),
+        )
+        self.leader_rank = succ
+        self.leader_epoch = epoch
+        self.ldr_counters["handoffs"] += 1
+        obs_journal.record(
+            "leader_handoff", track=self.tracer.track,
+            src=self.rank, target=succ, epoch=epoch,
+        )
+        printd("daemon %d: leadership handed off to rank %d (epoch %d)",
+               self.rank, succ, epoch)
+        return succ
+
+    def _on_leader_handoff(self, msg: Message) -> Message:
+        """Successor side of a voluntary transfer: verify + adopt the
+        final state (a torn tail REFUSES the handoff — the old leader
+        keeps leading), then announce."""
+        f = msg.fields
+        if f["leader"] != self.rank:
+            raise OcmInvalidHandle(
+                f"handoff names rank {f['leader']}, this is {self.rank}"
+            )
+        doc = control_leader.unpack_state(msg.data)  # raises on corruption
+        control_leader.apply_state(self, doc)
+        self._adopt_epoch(f["epoch"])
+        with self._elect_lock:
+            self.leader_rank = self.rank
+            self.leader_epoch = f["epoch"]
+            self.ldr_counters["handoffs"] += 1
+        obs_journal.record(
+            "leader_handoff", track=self.tracer.track,
+            src=f["from_rank"], target=self.rank, epoch=f["epoch"],
+        )
+        printd("daemon %d: leadership ADOPTED from rank %d (epoch %d)",
+               self.rank, f["from_rank"], f["epoch"])
+        self._queue_leader_sync(dead_rank=-1, inc=0)
+        return Message(MsgType.LEADER_OK, {"epoch": self.epoch})
+
+    def _queue_leader_sync(self, dead_rank: int, inc: int) -> None:
+        """(Re)arm the LEADER_UPDATE broadcast toward every live member
+        and push once inline; the reaper retries stragglers (the
+        _member_unsynced pattern)."""
+        with self._leader_sync_lock:
+            self._leader_update_fields = {
+                "leader": self.leader_rank,
+                "epoch": self.epoch,
+                "dead_rank": dead_rank,
+                "inc": inc,
+            }
+            self._leader_unsynced = {
+                e.rank for e in self.entries
+                if e.rank != self.rank and e.port
+                and not self.entries.has_left(e.rank)
+            }
+        self._sync_leader_update()
+
+    def _sync_leader_update(self) -> None:
+        with self._leader_sync_lock:
+            fields = self._leader_update_fields
+            pending = sorted(self._leader_unsynced)
+        if fields is None:
+            return
+        dead_rank = fields["dead_rank"]
+        for r in pending:
+            if self.entries.has_left(r):
+                with self._leader_sync_lock:
+                    self._leader_unsynced.discard(r)
+                continue
+            # The deposed leader gets the broadcast best-effort exactly
+            # once (it fences itself on receipt, or later via the PING
+            # STALE_EPOCH sentinel); other dead ranks are skipped.
+            if r != dead_rank and self._believed_dead(r):
+                with self._leader_sync_lock:
+                    self._leader_unsynced.discard(r)
+                continue
+            e = self.entries[r]
+            try:
+                self.peers.request(
+                    e.connect_host, e.port,
+                    Message(MsgType.LEADER_UPDATE, dict(fields)),
+                )
+                with self._leader_sync_lock:
+                    self._leader_unsynced.discard(r)
+            except (OSError, OcmError):
+                if r == dead_rank:
+                    # One best-effort attempt only — a genuinely dead
+                    # leader would pin the retry set forever.
+                    with self._leader_sync_lock:
+                        self._leader_unsynced.discard(r)
+
+    def _on_leader_update(self, msg: Message) -> Message:
+        """Adopt an election/handoff broadcast. The deposed leader —
+        matched by (rank, incarnation), exactly the PR-5 owner-fencing
+        discipline — fences itself; everyone else re-aims master-bound
+        traffic at the new leader and EAGERLY drops pooled connections
+        to the dead one (the detector's evict discipline)."""
+        f = msg.fields
+        self._adopt_epoch(f["epoch"])
+        dr = f["dead_rank"]
+        if dr == self.rank:
+            if f["inc"] in (0, self.incarnation):
+                self._fence(f["epoch"])
+                return Message(MsgType.LEADER_OK, {"epoch": self.epoch})
+        lr = f["leader"]
+        if 0 <= lr < len(self.entries):
+            prev = self.leader_rank
+            self.leader_rank = lr
+            self.leader_epoch = max(self.leader_epoch, f["epoch"])
+            if prev != lr and lr != self.rank:
+                self.ldr_counters["elections_observed"] += 1
+        if dr >= 0 and dr != self.rank and dr < len(self.entries):
+            if self.detector is not None:
+                self.detector.mark_dead(dr)
+            self.policy.mark_dead(dr)
+            de = self.entries[dr]
+            self.peers.evict(de.connect_host, de.port)
+        return Message(MsgType.LEADER_OK, {"epoch": self.epoch})
+
+    def _queue_note_alloc(self, kind: OcmKind, rank: int,
+                          nbytes: int) -> None:
+        """Hash placement's accounting leg: applied locally when this
+        daemon leads, queued for the reaper otherwise — the alloc path
+        itself never waits on the leader."""
+        note = Message(
+            MsgType.NOTE_ALLOC,
+            {"kind": WIRE_KIND[kind.value], "rank": rank,
+             "device_index": 0, "nbytes": nbytes},
+        )
+        if self.is_leader:
+            self._on_note_alloc(note)
+        else:
+            with self._acct_lock:
+                self._acct_pending.append(note)
+
+    def _drain_accounting(self) -> None:
+        """Reaper: flush queued NOTE_ALLOCs to the current leader.
+        Unreachable leader ⇒ requeue whole (the books are advisory —
+        capacity placement degrades gracefully, and a resync rebuilds
+        them from live numbers anyway)."""
+        with self._acct_lock:
+            pending, self._acct_pending = self._acct_pending, []
+        if not pending:
+            return
+        if self.is_leader:
+            for m in pending:
+                self._on_note_alloc(m)
+            return
+        le = self._leader_entry()
+        if self._believed_dead(le.rank):
+            with self._acct_lock:
+                self._acct_pending = pending + self._acct_pending
+            return
+        for i, m in enumerate(pending):
+            try:
+                self.peers.request(le.connect_host, le.port, m)
+            except (OSError, OcmError):
+                with self._acct_lock:
+                    self._acct_pending = (
+                        pending[i:] + self._acct_pending
+                    )
+                return
 
     # -- checkpoint / resume (SURVEY.md §5.4 upgrade) --------------------
 
@@ -526,21 +1032,22 @@ class Daemon:
                     "nbytes": e.nbytes,
                 },
             )
-            if self.rank == 0:
+            if self.is_leader:
                 self._on_note_alloc(note)
             else:
                 try:
-                    r0 = self.entries[0]
-                    self.peers.request(r0.connect_host, r0.port, note)
+                    le = self._leader_entry()
+                    self.peers.request(le.connect_host, le.port, note)
                 except (OSError, OcmConnectError):
-                    printd("daemon %d: NOTE_ALLOC to rank0 failed", self.rank)
+                    printd("daemon %d: NOTE_ALLOC to the leader failed",
+                           self.rank)
         printd(
             "daemon %d restored %d allocations from snapshot",
             self.rank, len(sp.entries),
         )
 
     def _on_note_alloc(self, msg: Message) -> Message:
-        if self.rank == 0:
+        if self.is_leader:
             f = msg.fields
             self.policy.note_alloc(
                 Placement(
@@ -560,10 +1067,12 @@ class Daemon:
             host_arena_bytes=self.config.host_arena_bytes,
         )
 
-    def _notify_rank0(self, retries: int = 20) -> None:
+    def _notify_leader(self, retries: int = 20) -> None:
         """ADD_NODE to the master (notify_rank0 analogue, main.c:144-160;
         the reference SIGINTs itself if the master is absent, mem.c:466-474 —
-        here we retry with backoff)."""
+        here we retry with backoff). A NOT_MASTER redirect re-aims at the
+        leader it names (control/): the seed leader may have moved by
+        the time a restarted daemon re-announces."""
         msg = Message(
             MsgType.ADD_NODE,
             {
@@ -577,14 +1086,24 @@ class Daemon:
                 "host_arena_bytes": self.config.host_arena_bytes,
             },
         )
-        r0 = self.entries[0]
+        le = self._leader_entry()
         for i in range(retries):
             try:
-                self.peers.request(r0.connect_host, r0.port, msg)
+                self.peers.request(le.connect_host, le.port, msg)
                 return
+            except OcmRemoteError as e:
+                if e.code == int(ErrCode.NOT_MASTER) and getattr(
+                    e, "leader_rank", None
+                ) is not None:
+                    self._adopt_leader_hint(e)
+                    le = self._leader_entry()
+                    continue
+                raise
             except (OSError, OcmConnectError):
                 time.sleep(min(0.05 * 2**i, 2.0))
-        raise OcmError(f"rank 0 daemon unreachable at {r0.connect_host}:{r0.port}")
+        raise OcmError(
+            f"leader daemon unreachable at {le.connect_host}:{le.port}"
+        )
 
     # -- server loops ----------------------------------------------------
 
@@ -639,6 +1158,11 @@ class Daemon:
                     if str(e) != "peer closed":
                         printd("daemon %d: dropping conn on malformed "
                                "input: %s", self.rank, e)
+                    return
+                if self._partitioned:
+                    # Chaos isolation: a partitioned host's replies never
+                    # arrive — drop the connection mid-exchange so peers
+                    # (and probes) see exactly a torn network.
                     return
                 # Inbound trace context: a FLAG_TRACE_CTX request carries
                 # a 16-byte context prefix on its data tail. Strip it
@@ -806,6 +1330,18 @@ class Daemon:
                 except Exception as e:  # noqa: BLE001 — gossip must never
                     # kill the reaper; unsynced peers retry next tick
                     printd("daemon %d: member sync failed: %s", self.rank, e)
+            # Decentralized control plane (control/): replicate the
+            # master state to standbys, retry LEADER_UPDATE stragglers,
+            # flush hash placement's deferred accounting. Each guarded —
+            # leadership machinery must never kill the reaper.
+            try:
+                if self.config.standby_masters > 0 and self.is_leader:
+                    self._push_master_state()
+                if self._leader_unsynced:
+                    self._sync_leader_update()
+                self._drain_accounting()
+            except Exception as e:  # noqa: BLE001 — see above
+                printd("daemon %d: leader tick failed: %s", self.rank, e)
             self._prune_tombstones()
             try:
                 self._detector_tick()
@@ -868,7 +1404,7 @@ class Daemon:
         policy's per-rank load scores from each daemon's live stats —
         its own locally, peers via the same STATUS the obs CLI polls."""
         observe = getattr(self.policy, "observe", None)
-        if self.rank != 0 or observe is None:
+        if not self.is_leader or observe is None:
             return
         now = time.monotonic()
         if now - self._last_load_poll < self.config.loadaware_poll_s:
@@ -928,25 +1464,38 @@ class Daemon:
     # -- failure detection (resilience/detector.py) ----------------------
 
     def _probe_ranks(self) -> list[int]:
-        """Star topology + one neighbor: rank 0 probes everyone (it is
-        the arbiter); every other rank probes rank 0 plus its next
-        neighbor, so each non-master is watched by a second witness whose
-        SUSPECT report gives rank 0 an early arbitration trigger. Total
-        probe load stays O(n) per interval."""
+        """Star topology + one neighbor: the LEADER probes everyone (it
+        is the arbiter); every other rank probes the leader plus its
+        next neighbor, so each non-master is watched by a second witness
+        whose SUSPECT report gives the leader an early arbitration
+        trigger. Total probe load stays O(n) per interval.
+
+        Election evidence (control/): once a standby believes the
+        leader dead it additionally probes every SMALLER live rank —
+        the election rule is lowest-live-rank, so a waiting standby
+        must be able to discover that the would-be winner is dead too,
+        or the election would stall on a rank nobody was watching."""
         det = self.detector
         allowed = set(det.probe_targets())
-        if self.rank == 0:
+        lr = self.leader_rank
+        if self.rank == lr:
             return sorted(allowed)
         n = len(self.entries)
-        targets = [0]
+        targets = {lr}
         r = (self.rank + 1) % n
-        while r in (self.rank, 0):
+        while r in (self.rank, lr):
             r = (r + 1) % n
-            if r == self.rank:  # 2-node cluster: rank 0 is the only peer
+            if r == self.rank:  # 2-node cluster: the leader is the only peer
                 break
-        if r not in (self.rank, 0):
-            targets.append(r)
-        return [t for t in targets if t in allowed]
+        if r not in (self.rank, lr):
+            targets.add(r)
+        if self.config.standby_masters > 0 and self._believed_dead(lr):
+            targets.update(
+                e.rank for e in self.entries
+                if e.rank < self.rank and e.rank != lr and e.port
+                and not self.entries.has_left(e.rank)
+            )
+        return sorted(t for t in targets if t in allowed)
 
     def _detector_tick(self) -> None:
         det = self.detector
@@ -960,16 +1509,25 @@ class Daemon:
             e = self.entries[r]
             if e.port == 0:
                 continue  # ephemeral-port test daemon not started yet
-            res = probe(
-                e.connect_host, e.port, self.rank, self.epoch,
-                self.incarnation, timeout=self.config.probe_timeout_s,
+            res = (
+                None if self._partitioned  # chaos isolation: packets drop
+                else probe(
+                    e.connect_host, e.port, self.rank, self.epoch,
+                    self.incarnation,
+                    timeout=self.config.probe_timeout_s,
+                )
             )
             if not self._running.is_set():
                 return
-            if res == (-1, -1):
-                # The peer (rank 0) says WE were declared dead: fence.
-                self._fence(self.epoch)
-                return
+            if isinstance(res, DeadVerdict):
+                # The peer says WE were declared dead. Binding only when
+                # its authority outranks ours — a deposed leader's stale
+                # claim (lower leader_epoch) is ignored, while the real
+                # leader's verdict fences a healed partitioned daemon.
+                if res.outranks(self.leader_epoch, self.epoch):
+                    self._fence(self.epoch)
+                    return
+                continue  # deluded claimant: neither alive nor dead news
             if res is not None:
                 self._adopt_epoch(res[0])
                 prev = det.record_ok(r, res[1])
@@ -977,7 +1535,7 @@ class Daemon:
                     obs_journal.record(
                         "node_recovered", track=self.tracer.track, rank=r,
                     )
-                    if self.rank == 0:
+                    if self.is_leader:
                         self.policy.mark_alive(r)
                 continue
             st = det.record_fail(r)
@@ -985,11 +1543,11 @@ class Daemon:
                 # Evict pooled connections NOW: stale sockets to a dead
                 # rank otherwise fail lazily, one costly error per lease.
                 self.peers.evict(e.connect_host, e.port)
-            if st == PeerState.SUSPECT and self.rank != 0:
-                r0 = self.entries[0]
+            if st == PeerState.SUSPECT and not self.is_leader:
+                le = self._leader_entry()
                 try:
                     self.peers.request(
-                        r0.connect_host, r0.port,
+                        le.connect_host, le.port,
                         Message(MsgType.SUSPECT_NODE,
                                 {"rank": r, "reporter": self.rank,
                                  "epoch": self.epoch}),
@@ -997,8 +1555,18 @@ class Daemon:
                 except (OSError, OcmError):
                     printd("daemon %d: SUSPECT report for %d failed",
                            self.rank, r)
-            elif st == PeerState.DEAD and self.rank == 0:
+            elif st == PeerState.DEAD and self.is_leader:
                 self._failover.node_dead(r)
+        # Election check (control/): a standby whose detector holds the
+        # LEADER dead runs the lowest-live-rank rule each tick until a
+        # LEADER_UPDATE lands or it wins.
+        if (
+            self.config.standby_masters > 0
+            and not self.is_leader
+            and not self._fenced
+            and self._believed_dead(self.leader_rank)
+        ):
+            self._maybe_elect()
 
     # -- trace-aware peer forwarding -------------------------------------
 
@@ -1091,7 +1659,7 @@ class Daemon:
             MsgType.CONNECT_CONFIRM,
             {
                 "rank": self.rank,
-                "nnodes": self.policy.nnodes if self.rank == 0
+                "nnodes": self.policy.nnodes if self.is_leader
                 else len(self.entries),
             },
             flags=msg.flags
@@ -1169,8 +1737,8 @@ class Daemon:
     # ADD_NODE: only the master records membership (alloc_add_node,
     # alloc.c:60-74).
     def _on_add_node(self, msg: Message) -> Message:
-        if self.rank != 0:
-            return _err(ErrCode.NOT_MASTER, "ADD_NODE sent to non-master")
+        if not self.is_leader:
+            return self._not_master_err("ADD_NODE")
         f = msg.fields
         self.policy.add_node(
             NodeResources(
@@ -1221,7 +1789,7 @@ class Daemon:
             raise OcmPlacementError(
                 f"invalid allocation size {nbytes}: must be > 0"
             )
-        if self.rank == 0:
+        if self.is_leader:
             cap = self.policy.max_capacity(kind)
             if cap and nbytes > cap:
                 raise OcmOutOfMemory(
@@ -1236,16 +1804,16 @@ class Daemon:
             # id on success, rolled back on any downstream failure.
             self.qos.admit(app[0], app[1], nbytes)
         try:
-            if self.rank != 0:
-                r0 = self.entries[0]
-                r = self._peer_request(
-                    r0.connect_host, r0.port,
-                    self._with_priority_tail(
-                        msg, self.qos.priority_of(*app) if local_app
-                        else None,
-                        r0.connect_host, r0.port,
-                    ),
-                )
+            if (
+                self.config.placement == "hash"
+                and kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
+            ):
+                # Consistent-hash plan shape (control/hashring): the
+                # placement is computed HERE, at the app's origin — no
+                # leader round trip on the alloc path at all.
+                r = self._hash_alloc(msg, kind, nbytes)
+            elif not self.is_leader:
+                r = self._proxy_alloc_to_leader(msg, local_app, app)
             else:
                 r = self._place_alloc(msg, kind, nbytes)
         except BaseException:
@@ -1255,6 +1823,210 @@ class Daemon:
         if local_app:
             self.qos.commit(app[0], app[1], r.fields["alloc_id"], nbytes)
         return r
+
+    def _proxy_alloc_to_leader(self, msg: Message, local_app: bool,
+                               app: tuple[int, int]) -> Message:
+        """Forward REQ_ALLOC to the current leader. With leadership
+        transfer armed, retryable failures — a dead leader mid-election,
+        a fenced old leader's STALE_EPOCH, a NOT_MASTER redirect — are
+        re-walked against the (possibly updated) leader until
+        failover_wait_s elapses, so in-flight allocs converge through a
+        leader change instead of surfacing the election window to the
+        app. Unarmed clusters keep the single-shot PR-11 behavior."""
+        deadline = time.monotonic() + (
+            self.config.failover_wait_s
+            if self.config.standby_masters > 0 else 0.0
+        )
+        last: BaseException | None = None
+        while True:
+            le = self._leader_entry()
+            fwd = self._with_priority_tail(
+                msg,
+                self.qos.priority_of(*app) if local_app else None,
+                le.connect_host, le.port,
+            )
+            try:
+                return self._peer_request(le.connect_host, le.port, fwd)
+            except OcmRemoteError as e:
+                if e.code == int(ErrCode.NOT_MASTER) and getattr(
+                    e, "leader_rank", None
+                ) is not None:
+                    self._adopt_leader_hint(e)
+                    last = e
+                elif e.code == int(ErrCode.STALE_EPOCH):
+                    last = e  # fenced old leader: wait out the election
+                else:
+                    raise
+            except (OSError, OcmConnectError) as e:
+                last = e
+            if time.monotonic() >= deadline:
+                raise last
+            time.sleep(0.05)  # let the election/LEADER_UPDATE land
+
+    def _hash_live_ranks(self) -> list[int]:
+        return sorted(
+            e.rank for e in self.entries
+            if e.port
+            and not self.entries.has_left(e.rank)
+            and not self._believed_dead(e.rank)
+        )
+
+    def _hash_alloc(self, msg: Message, kind: OcmKind,
+                    nbytes: int) -> Message:
+        """Origin-local placement by rendezvous hashing: mint the id
+        from THIS daemon's globally-unique space, compute the chain over
+        the live view, provision via DO_REPLICA (idempotent chain
+        upsert — the same provisioning contract the leader uses), and
+        defer the leader's capacity accounting to the reaper. A primary
+        whose provision fails on transport (a just-died rank the
+        detector hasn't verdicted yet) is barred and the plan recomputed
+        over the shrunken set; the journaled ``hash_place`` records the
+        member set actually used, which is exactly what the auditor's
+        ``placement-agreement`` invariant recomputes against."""
+        import json
+
+        f = msg.fields
+        data = bytes(msg.data)
+        off = 0
+        k = 1
+        if msg.flags & FLAG_REPLICAS and len(data) > off:
+            k = max(1, min(data[off], 8))
+            off += 1
+        if msg.flags & FLAG_QOS_TAIL and len(data) > off:
+            prio = min(max(data[off], PRIO_LOW), PRIO_HIGH)
+        elif f["orig_rank"] == self.rank:
+            prio = self.qos.priority_of(f["pid"], f["orig_rank"])
+        else:
+            prio = PRIO_NORMAL
+        alloc_id = self.registry.next_id()
+        barred: set[int] = set()
+        last: BaseException | None = None
+        live = self._hash_live_ranks()
+        for _ in range(max(1, len(live))):
+            cands = [r for r in live if r not in barred]
+            if not cands:
+                break
+            chain = hashring.plan(alloc_id, cands, k)
+            try:
+                confirmed, offset0 = self._provision_chain(
+                    alloc_id, chain, kind, nbytes,
+                    f["orig_rank"], f["pid"], prio,
+                )
+            except (OSError, OcmError) as e:
+                # Primary unreachable: bar it and re-plan — the detector
+                # will verdict it; placement must not wait for that.
+                barred.add(chain[0])
+                last = e
+                continue
+            obs_journal.record(
+                "hash_place", track=self.tracer.track,
+                alloc_id=alloc_id, epoch=self.entries.epoch,
+                live=list(cands), k=k, chain=list(chain),
+            )
+            self.ldr_counters["hash_placements"] += 1
+            for rr in confirmed:
+                self._queue_note_alloc(kind, rr, nbytes)
+            owner = self.entries[chain[0]]
+            tail = (
+                json.dumps({"replicas": confirmed[1:]}).encode()
+                if len(confirmed) > 1 else b""
+            )
+            return Message(
+                MsgType.ALLOC_RESULT,
+                {
+                    "alloc_id": alloc_id,
+                    "rank": chain[0],
+                    "device_index": 0,
+                    "kind": WIRE_KIND[kind.value],
+                    "offset": offset0,
+                    "nbytes": nbytes,
+                    "owner_host": owner.connect_host,
+                    "owner_port": owner.port,
+                },
+                tail,
+            )
+        raise OcmPlacementError(
+            f"hash placement found no reachable primary among "
+            f"{live} (last: {last})"
+        )
+
+    def _provision_chain(
+        self, alloc_id: int, chain: tuple[int, ...], kind: OcmKind,
+        nbytes: int, orig_rank: int, pid: int, prio: int,
+    ) -> tuple[list[int], int]:
+        """Provision one owner chain under a pre-minted id: DO_REPLICA
+        to each member, primary first. The primary must succeed (its
+        failure raises and nothing is charged); a replica that fails
+        just shrinks the chain (degraded, journaled), and confirmed
+        members are re-sent the corrected chain so every holder agrees
+        on the promotion order. Shared by the leader's replicated-alloc
+        path and the origin-local hash path — one provisioning contract.
+        Returns (confirmed members, primary extent offset)."""
+        csv = ",".join(str(r) for r in chain)
+        qflags, qtail = _priority_tail(prio)
+        confirmed: list[int] = []
+        offset0 = 0
+        for rr in chain:
+            m = Message(
+                MsgType.DO_REPLICA,
+                {
+                    "alloc_id": alloc_id,
+                    "kind": WIRE_KIND[kind.value],
+                    "nbytes": nbytes,
+                    "orig_rank": orig_rank,
+                    "pid": pid,
+                    "chain": csv,
+                    "epoch": self.epoch,
+                },
+                qtail,
+                flags=qflags,
+            )
+            try:
+                if rr == self.rank:
+                    r = self._on_do_replica(m)
+                else:
+                    e = self.entries[rr]
+                    r = self._peer_request(e.connect_host, e.port, m)
+            except (OSError, OcmError):
+                if rr == chain[0]:
+                    raise  # no primary, no allocation
+                obs_journal.record(
+                    "replica_provision_fail", track=self.tracer.track,
+                    alloc_id=alloc_id, rank=rr,
+                )
+                printd("daemon %d: replica provision on rank %d failed",
+                       self.rank, rr)
+                continue
+            if rr == chain[0]:
+                offset0 = r.fields["offset"]
+            confirmed.append(rr)
+        if len(confirmed) < len(chain):
+            fixed = ",".join(str(r) for r in confirmed)
+            m2_fields = {
+                "alloc_id": alloc_id,
+                "kind": WIRE_KIND[kind.value],
+                "nbytes": nbytes,
+                "orig_rank": orig_rank,
+                "pid": pid,
+                "chain": fixed,
+                "epoch": self.epoch,
+            }
+            for rr in confirmed:
+                try:
+                    if rr == self.rank:
+                        self._on_do_replica(
+                            Message(MsgType.DO_REPLICA, dict(m2_fields))
+                        )
+                    else:
+                        e = self.entries[rr]
+                        self._peer_request(
+                            e.connect_host, e.port,
+                            Message(MsgType.DO_REPLICA, dict(m2_fields)),
+                        )
+                except (OSError, OcmError):
+                    printd("daemon %d: chain fixup on rank %d failed",
+                           self.rank, rr)
+        return confirmed, offset0
 
     def _with_priority_tail(
         self, msg: Message, priority: int | None, host: str, port: int
@@ -1278,9 +2050,12 @@ class Daemon:
 
     def _place_alloc(self, msg: Message, kind: OcmKind,
                      nbytes: int) -> Message:
-        """Rank-0 placement: parse the optional tails, run back-pressure,
+        """Leader placement: parse the optional tails, run back-pressure,
         site the allocation, drive the DO_ALLOC/DO_REPLICA leg(s)."""
         f = msg.fields
+        # Pinned by the hash-placement acceptance test: with
+        # OCM_PLACEMENT=hash no REQ_ALLOC is ever placed here.
+        self.ldr_counters["placements"] += 1
         # Data-tail layout after the generic trace strip:
         # [k u8 if FLAG_REPLICAS] [priority u8 if FLAG_QOS_TAIL].
         data = bytes(msg.data)
@@ -1363,87 +2138,27 @@ class Daemon:
 
     def _alloc_replicated(self, f: dict, placed, nbytes: int,
                           priority: int = PRIO_NORMAL) -> Message:
-        """Provision a k-way replicated allocation (rank 0 only): one
-        alloc_id minted HERE (rank 0's id space is globally unique, so
-        every chain member can register the same id), then DO_REPLICA to
-        each chain member — primary first. The primary must succeed; a
-        replica that fails provisioning just shrinks the chain (degraded,
-        journaled), and the confirmed members are re-sent the corrected
-        chain (DO_REPLICA upserts an existing entry's chain), so every
-        holder agrees on the promotion order."""
+        """Provision a k-way replicated allocation (leader path): one
+        alloc_id minted HERE (every daemon's id space is globally
+        unique, so every chain member can register the same id), then
+        the shared chain-provisioning contract (_provision_chain):
+        primary must succeed, failed replicas shrink the chain, and the
+        corrected chain is re-pushed so every holder agrees on the
+        promotion order. Non-default priority rides every leg
+        (FLAG_QOS_TAIL u8) so a promoted replica inherits the class —
+        eviction discipline must survive failover."""
         import json
 
         chain = (placed.rank, *placed.replica_ranks)
         alloc_id = self.registry.next_id()
-        csv = ",".join(str(r) for r in chain)
-        confirmed: list[int] = []
-        offset0 = 0
-        # Non-default priority rides every chain leg (FLAG_QOS_TAIL u8)
-        # so a promoted replica inherits the class — eviction discipline
-        # must survive failover.
-        qflags = FLAG_QOS_TAIL if priority != PRIO_NORMAL else 0
-        qtail = bytes([priority]) if qflags else b""
-        for rr in chain:
-            m = Message(
-                MsgType.DO_REPLICA,
-                {
-                    "alloc_id": alloc_id,
-                    "kind": WIRE_KIND[placed.kind.value],
-                    "nbytes": nbytes,
-                    "orig_rank": f["orig_rank"],
-                    "pid": f["pid"],
-                    "chain": csv,
-                    "epoch": self.epoch,
-                },
-                qtail,
-                flags=qflags,
-            )
-            try:
-                if rr == self.rank:
-                    r = self._on_do_replica(m)
-                else:
-                    e = self.entries[rr]
-                    r = self._peer_request(e.connect_host, e.port, m)
-            except (OSError, OcmError):
-                if rr == placed.rank:
-                    raise  # no primary, no allocation
-                obs_journal.record(
-                    "replica_provision_fail", track=self.tracer.track,
-                    alloc_id=alloc_id, rank=rr,
-                )
-                printd("daemon 0: replica provision on rank %d failed", rr)
-                continue
-            if rr == placed.rank:
-                offset0 = r.fields["offset"]
-            confirmed.append(rr)
+        confirmed, offset0 = self._provision_chain(
+            alloc_id, chain, placed.kind, nbytes,
+            f["orig_rank"], f["pid"], priority,
+        )
+        for rr in confirmed:
             self.policy.note_alloc(
                 Placement(rank=rr, device_index=0, kind=placed.kind), nbytes
             )
-        if len(confirmed) < len(chain):
-            fixed = ",".join(str(r) for r in confirmed)
-            m2_fields = {
-                "alloc_id": alloc_id,
-                "kind": WIRE_KIND[placed.kind.value],
-                "nbytes": nbytes,
-                "orig_rank": f["orig_rank"],
-                "pid": f["pid"],
-                "chain": fixed,
-                "epoch": self.epoch,
-            }
-            for rr in confirmed:
-                try:
-                    if rr == self.rank:
-                        self._on_do_replica(
-                            Message(MsgType.DO_REPLICA, dict(m2_fields))
-                        )
-                    else:
-                        e = self.entries[rr]
-                        self._peer_request(
-                            e.connect_host, e.port,
-                            Message(MsgType.DO_REPLICA, dict(m2_fields)),
-                        )
-                except (OSError, OcmError):
-                    printd("daemon 0: chain fixup on rank %d failed", rr)
         owner = self.entries[placed.rank]
         return Message(
             MsgType.ALLOC_RESULT,
@@ -1665,9 +2380,9 @@ class Daemon:
                 printd("daemon %d: replica free of %d on rank %d failed "
                        "(lease reaper is the backstop)",
                        self.rank, e.alloc_id, rr)
-        self._note_free_rank0(e)
+        self._note_free_leader(e)
 
-    def _note_free_rank0(self, e: RegEntry) -> None:
+    def _note_free_leader(self, e: RegEntry) -> None:
         note = Message(
             MsgType.NOTE_FREE,
             {
@@ -1677,17 +2392,18 @@ class Daemon:
                 "nbytes": e.nbytes,
             },
         )
-        if self.rank == 0:
+        if self.is_leader:
             self._on_note_free(note)
         else:
-            r0 = self.entries[0]
+            le = self._leader_entry()
             try:
-                self._peer_request(r0.connect_host, r0.port, note)
+                self._peer_request(le.connect_host, le.port, note)
             except (OSError, OcmConnectError):
-                printd("daemon %d: NOTE_FREE to rank0 failed", self.rank)
+                printd("daemon %d: NOTE_FREE to the leader failed",
+                       self.rank)
 
     def _on_note_free(self, msg: Message) -> Message:
-        if self.rank == 0:
+        if self.is_leader:
             f = msg.fields
             self.policy.note_free(
                 Placement(
@@ -2036,8 +2752,8 @@ class Daemon:
                 self._plane_unsynced = {
                     r for r in range(len(self.entries)) if r != self.rank
                 }
-            if self.rank != 0:
-                self._sync_plane_endpoint(only_rank=0)
+            if not self.is_leader:
+                self._sync_plane_endpoint(only_rank=self.leader_rank)
         return Message(MsgType.PLANE_SERVE_OK, {"port": f["port"]})
 
     def _sync_plane_endpoint(self, only_rank: int | None = None) -> None:
@@ -2102,9 +2818,10 @@ class Daemon:
                     # fall through to the master hop / typed error.
                     self.plane_addr = None
                     addr = None
-            if self.rank != 0:
-                r0 = self.entries[0]  # master hop: it learns endpoints first
-                return self.peers.request(r0.connect_host, r0.port, relay)
+            if not self.is_leader:
+                le = self._leader_entry()  # master hop: the leader
+                # learns endpoints first
+                return self.peers.request(le.connect_host, le.port, relay)
         except OcmRemoteError as err:
             return _err(ErrCode(err.code) if err.code in
                         ErrCode._value2member_map_ else ErrCode.UNKNOWN,
@@ -2126,7 +2843,7 @@ class Daemon:
         detector holds DEAD gets STALE_EPOCH instead of PING_OK: that is
         how a merely-partitioned owner that heals learns it was declared
         dead and fences itself (probe() surfaces the verdict as the
-        (-1, -1) sentinel). Revival is only ever via ADD_NODE — a fresh
+        DeadVerdict sentinel). Revival is only ever via ADD_NODE — a fresh
         daemon process announcing itself."""
         f = msg.fields
         self._adopt_epoch(f["epoch"])
@@ -2134,11 +2851,23 @@ class Daemon:
         det = self.detector
         if det is not None and 0 <= r < len(self.entries) and r != self.rank:
             if det.state(r) == PeerState.DEAD:
-                return _err(
-                    ErrCode.STALE_EPOCH,
-                    f"rank {r} was declared dead at epoch {self.epoch}",
-                )
-            det.record_ok(r, f["inc"])
+                if self.is_leader:
+                    # Only the (believed) leader issues probe verdicts,
+                    # and the verdict carries its authority: the prober
+                    # fences itself only when (leader_epoch, epoch)
+                    # outranks its own, so a deposed claimant's stale
+                    # verdicts can never fence a survivor (control/).
+                    return _err(
+                        ErrCode.STALE_EPOCH,
+                        f"rank {r} was declared dead at epoch "
+                        f"{self.epoch}",
+                        struct.pack("<QQ", self.leader_epoch, self.epoch),
+                    )
+                # Non-leaders hold ADOPTED verdicts with no authority to
+                # fence; answer plainly (without resurrecting the rank —
+                # revival is the leader's call via ADD_NODE).
+            else:
+                det.record_ok(r, f["inc"])
         return Message(
             MsgType.PING_OK,
             {"rank": self.rank, "epoch": self.epoch,
@@ -2150,8 +2879,8 @@ class Daemon:
         so a single partitioned reporter can never take a healthy node
         down. Only the arbiter's consecutive-failure count reaching
         dead_after produces the DEAD verdict."""
-        if self.rank != 0:
-            return _err(ErrCode.NOT_MASTER, "SUSPECT_NODE sent to non-master")
+        if not self.is_leader:
+            return self._not_master_err("SUSPECT_NODE")
         f = msg.fields
         self._adopt_epoch(f["epoch"])
         r = f["rank"]
@@ -2166,7 +2895,7 @@ class Daemon:
                     self.incarnation,
                     timeout=self.config.probe_timeout_s,
                 )
-                if res is not None and res != (-1, -1):
+                if res is not None and not isinstance(res, DeadVerdict):
                     self._adopt_epoch(res[0])
                     det.record_ok(r, res[1])
                     state = PeerState.ALIVE
@@ -2401,8 +3130,8 @@ class Daemon:
         or the SAME rank when the address was seen before, so a joiner
         whose JOIN_OK was lost retries idempotently instead of leaking a
         half-member slot — bump the epoch, adopt it everywhere."""
-        if self.rank != 0:
-            return _err(ErrCode.NOT_MASTER, "REQ_JOIN sent to non-master")
+        if not self.is_leader:
+            return self._not_master_err("REQ_JOIN")
         f = msg.fields
         view = self.entries
         existing = view.find(f["host"], f["port"])
@@ -2429,9 +3158,15 @@ class Daemon:
             rank=rank, host=f["host"], port=f["port"], epoch=epoch,
             rejoin=existing is not None,
         )
-        printd("daemon 0: rank %d joined at %s:%d (epoch %d)",
-               rank, f["host"], f["port"], epoch)
+        printd("daemon %d: rank %d joined at %s:%d (epoch %d)",
+               self.rank, rank, f["host"], f["port"], epoch)
         self._queue_member_sync(defer=(rank,))
+        if self.leader_rank != 0:
+            # Joiners boot believing rank 0 leads; once leadership has
+            # moved, the reaper pushes them the current LEADER_UPDATE.
+            with self._leader_sync_lock:
+                if self._leader_update_fields is not None:
+                    self._leader_unsynced.add(rank)
         if self.config.rebalance and self._rebalancer is not None:
             threading.Thread(
                 target=self._rebalancer.rebalance_safe,
@@ -2450,13 +3185,20 @@ class Daemon:
         that cannot complete fails the leave — the member stays, because
         departing with data aboard is just a slow crash (the unclean
         path is simply dying, which the DEAD-verdict failover handles)."""
-        if self.rank != 0:
-            return _err(ErrCode.NOT_MASTER, "REQ_LEAVE sent to non-master")
+        if not self.is_leader:
+            return self._not_master_err("REQ_LEAVE")
         f = msg.fields
         rank = f["rank"]
         view = self.entries
-        if rank == 0:
-            raise OcmInvalidHandle("rank 0 (the placement master) cannot leave")
+        if rank == self.rank:
+            # The serving leader cannot drain itself mid-coordination;
+            # the clean path is a voluntary handoff FIRST (closing the
+            # "rank 0 cannot leave" hole noted in PR 8), then an
+            # ordinary member departure via the successor.
+            raise OcmInvalidHandle(
+                f"rank {rank} is the serving leader and cannot leave — "
+                "hand off leadership first (handoff_leadership)"
+            )
         if not 0 <= rank < len(view) or view.has_left(rank):
             raise OcmInvalidHandle(f"rank {rank} is not a member")
         det = self.detector
@@ -3010,12 +3752,21 @@ class Daemon:
             "qos": self._qos_meta(),
             "fabric": self._fabric_meta(),
             "elastic": self._elastic_meta(),
+            # Arena capacities (control/): what a promoted leader's
+            # whole-resync reads to rebuild placement accounting from
+            # the survivors' own numbers.
+            "caps": {
+                "ndevices": self.ndevices,
+                "device_arena_bytes": self.config.device_arena_bytes,
+                "host_arena_bytes": self.config.host_arena_bytes,
+            },
         }
         return Message(
             MsgType.STATUS_OK,
             {
                 "rank": self.rank,
-                "nnodes": self.policy.nnodes if self.rank == 0 else len(self.entries),
+                "nnodes": self.policy.nnodes if self.is_leader
+                else len(self.entries),
                 "live_allocs": self.registry.live_count(),
                 "host_bytes_live": self.host_arena.allocator.bytes_live,
                 "device_bytes_live": sum(
@@ -3033,6 +3784,12 @@ class Daemon:
             "fenced": self._fenced,
             "peers": self.detector.states() if self.detector else {},
             "failover": dict(self.res_counters),
+            # Leadership (control/): who coordinates, since when, and
+            # how this daemon got (or observed) the role.
+            "leader": self.leader_rank,
+            "leader_epoch": self.leader_epoch,
+            "is_leader": self.is_leader,
+            "leadership": dict(self.ldr_counters),
         }
 
     def _qos_meta(self) -> dict:
@@ -3040,7 +3797,7 @@ class Daemon:
         obs cluster table's per-app rows."""
         meta = self.qos.metrics()
         scores = getattr(self.policy, "load_scores", None)
-        if self.rank == 0 and scores is not None:
+        if self.is_leader and scores is not None:
             meta["load_scores"] = scores()
         return meta
 
@@ -3057,7 +3814,7 @@ class Daemon:
         op counters, the transfer ring, arena occupancy, lease health."""
         return {
             "rank": self.rank,
-            "nnodes": self.policy.nnodes if self.rank == 0
+            "nnodes": self.policy.nnodes if self.is_leader
             else len(self.entries),
             "ops": self.tracer.snapshot(),
             "transfers": self.tracer.transfers(last=32),
@@ -3236,6 +3993,14 @@ _FENCED_REJECT = frozenset({
     MsgType.REQ_LEAVE,
     MsgType.MIGRATE,
     MsgType.MIGRATE_BEGIN,
+    # A fenced old LEADER must never coordinate (control/): membership
+    # announcements, suspicion arbitration, and state replication all
+    # bounce STALE_EPOCH so the sender re-aims at the live leader —
+    # the split-brain scenario the leader-unique invariant audits.
+    MsgType.ADD_NODE,
+    MsgType.SUSPECT_NODE,
+    MsgType.MASTER_STATE,
+    MsgType.LEADER_HANDOFF,
     # The shm fabric's control legs are data ops: a fenced daemon must
     # refuse to bless a segment write OR hand out a mapping — the
     # STALE_EPOCH reply is what sends the client down its failover
@@ -3282,6 +4047,9 @@ _HANDLERS = {
     MsgType.MIGRATE_BEGIN: Daemon._on_migrate_begin,
     MsgType.REQ_LOCATE: Daemon._on_req_locate,
     MsgType.REQ_EXTENTS: Daemon._on_req_extents,
+    MsgType.MASTER_STATE: Daemon._on_master_state,
+    MsgType.LEADER_UPDATE: Daemon._on_leader_update,
+    MsgType.LEADER_HANDOFF: Daemon._on_leader_handoff,
 }
 
 if __name__ == "__main__":

@@ -103,6 +103,45 @@ class PlacementPolicy:
         with self._lock:
             return len(self._nodes)
 
+    # -- master-state replication (control/leader.py) --------------------
+
+    def export_rows(self) -> list[dict]:
+        """The accounting table as plain rows — what the leader
+        replicates to standby masters (JSON + CRC, snapshot discipline)
+        so a promoted standby resumes placement from live numbers
+        instead of zeros."""
+        with self._lock:
+            return [
+                {
+                    "rank": n.rank,
+                    "ndevices": n.ndevices,
+                    "device_arena_bytes": n.device_arena_bytes,
+                    "host_arena_bytes": n.host_arena_bytes,
+                    "device_used": list(n.device_used),
+                    "host_used": n.host_used,
+                }
+                for _, n in sorted(self._nodes.items())
+            ]
+
+    def restore(self, rows: list[dict], dead=()) -> None:
+        """Adopt a replicated (or rebuilt) accounting table WHOLE —
+        the promotion path. Replaces the node table; the dead set is
+        reset to ``dead`` so a deposed leader's verdicts carry over."""
+        nodes: dict[int, NodeResources] = {}
+        for r in rows:
+            n = NodeResources(
+                rank=int(r["rank"]),
+                ndevices=int(r["ndevices"]),
+                device_arena_bytes=int(r["device_arena_bytes"]),
+                host_arena_bytes=int(r["host_arena_bytes"]),
+                device_used=[int(x) for x in r.get("device_used", [])],
+                host_used=int(r.get("host_used", 0)),
+            )
+            nodes[n.rank] = n
+        with self._lock:
+            self._nodes = nodes
+            self._dead = {int(d) for d in dead if int(d) in nodes}
+
     # -- cluster-wide queries (qos/: validation + back-pressure) ---------
 
     def max_capacity(self, kind: OcmKind) -> int:

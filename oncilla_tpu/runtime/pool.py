@@ -76,12 +76,26 @@ class PeerPool:
         self._lock = make_lock("pool._lock")
         self._cond = threading.Condition(self._lock)
         self._closed = False
+        self._blocked = False
+
+    def set_blocked(self, on: bool) -> None:
+        """Harness-level partition emulation (resilience/chaos): while
+        set, every lease raises OcmConnectError — what a fully
+        partitioned host's outbound traffic looks like to its own
+        daemon. Unlike close(), fully reversible; cached connections
+        survive for the heal."""
+        self._blocked = bool(on)
 
     def lease(self, host: str, port: int) -> PoolEntry:
         """An exclusively held connection (``entry.lock`` acquired):
         an idle cached one, else a fresh dial — callers doing multi-frame
         pipelining keep the lease for the whole exchange, then
         :meth:`release` (still in sync) or :meth:`discard` (broken)."""
+        if self._blocked:
+            raise OcmConnectError(
+                f"peer {host}:{port} unreachable: pool partitioned "
+                "(chaos isolation)"
+            )
         hook = _chaos_hook
         if hook is not None:
             try:

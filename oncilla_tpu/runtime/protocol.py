@@ -234,6 +234,24 @@ class MsgType(enum.IntEnum):
     LOCATE_OK = 86
     REQ_EXTENTS = 87        # rank 0 -> member: your host-kind inventory
     EXTENTS_OK = 88
+    # Decentralized control plane (control/): the master role as an
+    # epoch-fenced lease. All new types, only ever sent when
+    # OCM_STANDBY_MASTERS > 0 arms leadership replication — with it
+    # unset none of them ride, so the default wire stays byte-for-byte
+    # PR-11. A v2/native peer answers typed BAD_MSG (decline by
+    # silence), which just means "no standby there".
+    MASTER_STATE = 89       # leader -> standby: replicated master state
+    #                       (JSON + CRC32 trailer data tail, the
+    #                       snapshot-v2 integrity discipline)
+    MASTER_STATE_OK = 90
+    LEADER_UPDATE = 91      # new leader -> all: leadership + epoch bump
+    #                       (dead_rank/inc fence the deposed leader the
+    #                       way EPOCH_UPDATE fences a dead owner;
+    #                       dead_rank -1 = voluntary handoff, no fence)
+    LEADER_OK = 92
+    LEADER_HANDOFF = 93     # old leader -> successor: voluntary transfer
+    #                       (final master state rides the data tail; a
+    #                       CRC-failing tail REFUSES the handoff)
     # failure
     ERROR = 99
 
@@ -585,6 +603,32 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
     # capacity-weighted planner and the LEAVE drain walk.
     MsgType.REQ_EXTENTS: [],
     MsgType.EXTENTS_OK: [("rank", "q"), ("count", "Q")],
+    # Decentralized control plane (control/leader.py). MASTER_STATE's
+    # data tail is the leader's replicated coordination state (placement
+    # accounting, member view, dead set) as JSON with a trailing CRC32 —
+    # the snapshot-v2 discipline, so a standby can refuse a torn copy
+    # WHOLE instead of leading from it. "seq" is the push sequence
+    # (monotonic per leader incarnation); stale pushes are dropped.
+    MsgType.MASTER_STATE: [("seq", "Q"), ("epoch", "Q"), ("leader", "q")],
+    MsgType.MASTER_STATE_OK: [("seq", "Q")],
+    # LEADER_UPDATE: the election/handoff broadcast. "dead_rank"/"inc"
+    # fence the deposed leader by (rank, incarnation) exactly like
+    # EPOCH_UPDATE fences a dead owner; dead_rank -1 marks a voluntary
+    # handoff (nobody is fenced). Receivers adopt the leader, evict the
+    # dead leader's pooled connections, and re-aim master-bound traffic.
+    MsgType.LEADER_UPDATE: [
+        ("leader", "q"),
+        ("epoch", "Q"),
+        ("dead_rank", "q"),
+        ("inc", "Q"),
+    ],
+    MsgType.LEADER_OK: [("epoch", "Q")],
+    MsgType.LEADER_HANDOFF: [
+        ("leader", "q"),
+        ("epoch", "Q"),
+        ("from_rank", "q"),
+        ("inc", "Q"),
+    ],
     MsgType.ERROR: [("code", "I"), ("detail", "s")],
 }
 
@@ -596,6 +640,15 @@ class ErrCode(enum.IntEnum):
     BOUNDS = 3
     BAD_MSG = 4
     PLACEMENT = 5
+    # A master-bound message (ADD_NODE, REQ_JOIN, SUSPECT_NODE, ...)
+    # reached a daemon that is not the current leader. Once leadership
+    # is dynamic (control/: OCM_STANDBY_MASTERS > 0, or the leader ever
+    # moved off rank 0) the ERROR frame's data tail names the CURRENT
+    # leader — i64 rank, then host (u16-length string) + u32 port —
+    # which request() surfaces as OcmRemoteError.leader_rank /
+    # .leader_addr so senders re-aim instead of spinning (the MOVED
+    # redirect pattern applied to the master role). Static clusters
+    # ship the tail-less PR-11 frame.
     NOT_MASTER = 6
     # The serving daemon was fenced by a newer cluster epoch (a DEAD
     # verdict it outlived): it must not serve data or grant extents, and
@@ -914,7 +967,36 @@ def remote_error(reply: Message) -> OcmRemoteError:
         (err.retry_after_ms,) = struct.unpack_from("<I", reply.data, 0)
     if code == int(ErrCode.MOVED) and len(reply.data) >= 8:
         (err.moved_to_rank,) = struct.unpack_from("<q", reply.data, 0)
+    if code == int(ErrCode.STALE_EPOCH) and len(reply.data) >= 16:
+        # A PING answered with a DEAD verdict carries the verdict
+        # holder's authority as a (leader_epoch u64, epoch u64) tail:
+        # the probing daemon fences itself only when that authority
+        # exceeds its own — a deposed leader's stale claim must never
+        # fence a survivor (control/).
+        (err.verdict_leader_epoch, err.verdict_epoch) = struct.unpack_from(
+            "<QQ", reply.data, 0
+        )
+    if code == int(ErrCode.NOT_MASTER) and len(reply.data) >= 8:
+        # Leader redirect (control/): rank, then optionally the leader's
+        # explicit address (a joiner bounced off a non-leader seed has
+        # no member table to resolve the rank through).
+        (err.leader_rank,) = struct.unpack_from("<q", reply.data, 0)
+        err.leader_addr = None
+        try:
+            host, off = _unpack_str(reply.data, 8)
+            (port,) = struct.unpack_from("<I", reply.data, off)
+            if host and port:
+                err.leader_addr = (host, port)
+        except (OcmProtocolError, struct.error):
+            pass  # rank-only tail from a terser sender
     return err
+
+
+def pack_leader_tail(rank: int, host: str, port: int) -> bytes:
+    """The NOT_MASTER redirect tail: current leader rank + address.
+    Parsed back by :func:`remote_error` into ``leader_rank`` /
+    ``leader_addr``; old peers ignore trailing data on ERROR frames."""
+    return struct.pack("<q", rank) + _pack_str(host) + struct.pack("<I", port)
 
 
 def request(sock: socket.socket, msg: Message) -> Message:

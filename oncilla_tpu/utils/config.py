@@ -205,6 +205,39 @@ class OcmConfig:
         default_factory=lambda: _env_int("OCM_PROBE_TIMEOUT_MS", 1000) / 1e3
     )
 
+    # Decentralized control plane (control/). OCM_STANDBY_MASTERS = k
+    # replicates the leader's coordination state (placement accounting,
+    # member view, dead set — JSON + CRC32, the snapshot-v2 discipline)
+    # to the k lowest-rank live standbys every reaper tick, and arms the
+    # election machinery: on a DEAD verdict for the leader the lowest
+    # live rank bumps the epoch, fences the old leader by
+    # (rank, incarnation), broadcasts LEADER_UPDATE and resumes
+    # coordination from the replicated state. 0 (the default) disables
+    # the whole family — no MASTER_STATE/LEADER_* frame ever rides, the
+    # master stays pinned at rank 0, and the wire is byte-for-byte the
+    # pre-leadership protocol.
+    standby_masters: int = field(
+        default_factory=lambda: _env_int("OCM_STANDBY_MASTERS", 0)
+    )
+    # Replicated-state freshness bound: a standby whose newest
+    # MASTER_STATE copy is older than this at election time refuses to
+    # lead from it and re-syncs WHOLE from the survivors (STATUS polls),
+    # exactly as it does for a CRC-failing copy.
+    leader_lease_s: float = field(
+        default_factory=lambda: _env_int("OCM_LEADER_LEASE_MS", 3000) / 1e3
+    )
+    # Placement plan shape. "leader" (default) is the PR-11 behavior:
+    # every REQ_ALLOC funnels through the leader for placement. "hash"
+    # computes host-kind placements at the app's ORIGIN daemon by
+    # rendezvous/HRW hashing over the live member view
+    # (control/hashring.py) — zero leader round trips on the alloc
+    # path; admission/quota checks stay at the origin, and accounting
+    # syncs to the leader in the background. Device kinds and the
+    # back-pressure watermark check keep the leader path.
+    placement: str = field(
+        default_factory=lambda: os.environ.get("OCM_PLACEMENT") or "leader"
+    )
+
     # Elastic membership (elastic/): OCM_REBALANCE=1 makes rank 0 kick a
     # background capacity-weighted rebalance after every JOIN (LEAVE
     # always drains regardless — a graceful departure without moving the
@@ -331,6 +364,25 @@ class OcmConfig:
             raise ValueError(
                 "fabric_shm_min_bytes must be >= 0 "
                 f"(got {self.fabric_shm_min_bytes})"
+            )
+        # Same u8/short-csv bound as replica chains: standbys beyond a
+        # handful add replication traffic for no availability win.
+        if not 0 <= self.standby_masters <= 8:
+            raise ValueError(
+                f"standby_masters must be in [0, 8] (got "
+                f"{self.standby_masters}); 0 disables leadership transfer"
+            )
+        if self.leader_lease_s <= 0:
+            raise ValueError(
+                f"leader_lease_s must be > 0 (got {self.leader_lease_s}) — "
+                "a zero lease makes every replicated state copy stale"
+            )
+        if self.placement not in ("leader", "hash"):
+            raise ValueError(
+                f"placement must be 'leader' or 'hash' (got "
+                f"{self.placement!r}); 'leader' is the rank-0-funneled "
+                "PR-11 plan shape, 'hash' computes host-kind placements "
+                "at the origin daemon by rendezvous hashing"
             )
 
     @property

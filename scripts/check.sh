@@ -83,6 +83,15 @@ echo "== chaos smoke =="
 # restore k, and the same seed must replay the identical interleaving.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --smoke || fail=1
 
+echo "== leader chaos smoke =="
+# Decentralized control plane proof: kill the LEADER mid-alloc-storm
+# (consistent-hash placement, zero leader round trips pinned), a
+# split-brain partition (the fenced old leader must answer STALE_EPOCH,
+# never coordinate), and a leader+owner double kill — each run twice
+# with identical seeded interleavings, wrapped in the flight-recorder
+# audit including the leader-unique and placement-agreement invariants.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --leader-smoke || fail=1
+
 echo "== obs audit smoke =="
 # Flight recorder + cross-rank invariant auditor, end to end through
 # the CLI: re-run the kill-owner chaos scenario with OCM_FLIGHTREC

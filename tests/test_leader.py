@@ -1,0 +1,482 @@
+"""Decentralized control plane (control/): leadership transfer,
+replicated master state, rendezvous-hash placement, and the satellites
+(client bootstrap ladder, LEADER_UPDATE pool eviction, torn-state
+refusal, voluntary handoff + rank-0 LEAVE)."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from oncilla_tpu.control import hashring
+from oncilla_tpu.control import leader as control_leader
+from oncilla_tpu.core.errors import (
+    OcmError,
+    OcmProtocolError,
+    OcmRemoteError,
+)
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.elastic.join import leave_cluster
+from oncilla_tpu.obs import audit as obs_audit
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def ldr_cfg(**kw):
+    d = dict(
+        host_arena_bytes=16 << 20,
+        device_arena_bytes=4 << 20,
+        chunk_bytes=128 << 10,
+        heartbeat_s=0.05,
+        lease_s=5.0,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        dcn_stripes=1,
+        standby_masters=2,
+        failover_wait_s=10.0,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+def wait_for(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260804)
+
+
+@pytest.fixture
+def journaled():
+    """Arm the event journal for tests that assert on journal events."""
+    was = obs_journal.enabled()
+    obs_journal.set_enabled(True)
+    obs_journal.clear()
+    yield
+    obs_journal.set_enabled(was)
+
+
+# -- rendezvous hashing (unit) -------------------------------------------
+
+
+def test_hashring_deterministic_and_stable():
+    ranks = [0, 1, 2, 3]
+    for key in (2, 4096, (7 << 32) | 2, (3 << 32) | 10):
+        c1 = hashring.plan(key, ranks, 2)
+        c2 = hashring.plan(key, list(reversed(ranks)), 2)
+        assert c1 == c2, "plan must not depend on member order"
+        assert len(c1) == 2 and len(set(c1)) == 2
+    # Churn stability: removing one member only re-homes keys it owned.
+    moved = 0
+    for key in range(500):
+        before = hashring.plan(key, ranks, 1)[0]
+        after = hashring.plan(key, [0, 1, 3], 1)[0]
+        if before != after:
+            assert before == 2, "a surviving member's key moved"
+            moved += 1
+    assert moved > 0  # rank 2 did own some keys
+    # Degraded sets shrink, never error.
+    assert hashring.plan(1, [5], 3) == (5,)
+    assert hashring.plan(1, [], 2) == ()
+
+
+def test_hashring_balance():
+    from collections import Counter
+
+    c = Counter(hashring.plan(k, [0, 1, 2, 3], 1)[0] for k in range(2000))
+    for r in range(4):
+        assert 350 < c[r] < 650, f"rank {r} badly unbalanced: {c}"
+
+
+# -- master state (unit) -------------------------------------------------
+
+
+def test_master_state_roundtrip_and_crc_refusal():
+    doc = {
+        "seq": 7, "epoch": 3, "leader": 1, "inc": 42,
+        "view": {"epoch": 3, "members": [], "left": []},
+        "placement": [{"rank": 0, "ndevices": 1,
+                       "device_arena_bytes": 1, "host_arena_bytes": 2,
+                       "device_used": [0], "host_used": 1}],
+        "dead": [2],
+    }
+    raw = control_leader.pack_state(doc)
+    back = control_leader.unpack_state(raw)
+    assert back["epoch"] == 3 and back["placement"][0]["rank"] == 0
+    # Any flipped byte is refused WHOLE.
+    for off in (0, len(raw) // 2, len(raw) - 1):
+        bad = bytearray(raw)
+        bad[off] ^= 0xFF
+        with pytest.raises(OcmProtocolError):
+            control_leader.unpack_state(bytes(bad))
+    # Truncation too.
+    with pytest.raises(OcmProtocolError):
+        control_leader.unpack_state(raw[:3])
+
+
+def test_election_rule():
+    from oncilla_tpu.runtime.membership import ClusterView, NodeEntry
+
+    view = ClusterView([NodeEntry(r, "h", 1000 + r) for r in range(4)])
+    assert control_leader.elect(view, {0}, 2) == 1
+    assert control_leader.elect(view, {0, 1}, 2) == 2
+    view.mark_left(1)
+    assert control_leader.elect(view, {0}, 2) == 2
+    assert control_leader.elect(view, {0, 2, 3}, 2) is None
+
+
+# -- protocol surface pin (the PR-5/8 exhaustiveness precedent) ----------
+
+
+def test_leader_protocol_surface_pinned():
+    from oncilla_tpu.runtime import daemon as dmod
+
+    new = (P.MsgType.MASTER_STATE, P.MsgType.MASTER_STATE_OK,
+           P.MsgType.LEADER_UPDATE, P.MsgType.LEADER_OK,
+           P.MsgType.LEADER_HANDOFF)
+    for t in new:
+        assert t in P._SCHEMAS, f"{t.name} missing a schema"
+    for t in (P.MsgType.MASTER_STATE, P.MsgType.LEADER_UPDATE,
+              P.MsgType.LEADER_HANDOFF):
+        assert t in dmod._HANDLERS, f"{t.name} unhandled"
+        # A fenced old leader must never accept coordination traffic.
+        if t != P.MsgType.LEADER_UPDATE:
+            assert t in dmod._FENCED_REJECT
+    # LEADER_UPDATE must stay serveable while fenced — it is how a
+    # fenced daemon learns leadership moved on.
+    assert P.MsgType.LEADER_UPDATE not in dmod._FENCED_REJECT
+    # The NOT_MASTER redirect tail parses into the typed error.
+    tail = P.pack_leader_tail(3, "198.51.100.7", 17983)
+    err = P.remote_error(P.Message(
+        P.MsgType.ERROR,
+        {"code": int(P.ErrCode.NOT_MASTER), "detail": "x"}, tail,
+    ))
+    assert err.leader_rank == 3
+    assert err.leader_addr == ("198.51.100.7", 17983)
+
+
+# -- election + fencing (integration) ------------------------------------
+
+
+def test_election_promotes_standby_and_evicts_pool(rng):
+    cfg = ldr_cfg(replicas=2)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(1)
+        data = rng.integers(0, 256, 512 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data, 0)
+        wait_for(lambda: cl.daemons[1]._master_state_raw is not None,
+                 10.0, "master-state replication")
+        # Seed a pooled connection from rank 2 to the doomed leader so
+        # the LEADER_UPDATE eviction has something to drop.
+        e0 = cl.entries[0]
+        r = cl.daemons[2].peers.request(
+            e0.connect_host, e0.port, P.Message(P.MsgType.STATUS, {})
+        )
+        assert r.type == P.MsgType.STATUS_OK
+        key = (e0.connect_host, e0.port)
+        assert cl.daemons[2].peers._conns.get(key), "no pooled conn seeded"
+        cl.kill(0)
+        wait_for(lambda: cl.daemons[1].is_leader, 10.0, "election")
+        d1 = cl.daemons[1]
+        assert d1.epoch > 0
+        assert d1.ldr_counters["elections_won"] == 1
+        assert d1.ldr_counters["state_resyncs"] == 0  # led from replica
+        # Rank 2 adopted the new leader AND eagerly dropped its pooled
+        # connections to the dead one (the PR-5 evict discipline).
+        wait_for(lambda: cl.daemons[2].leader_rank == 1, 10.0,
+                 "LEADER_UPDATE adoption at rank 2")
+        assert not cl.daemons[2].peers._conns.get(key), (
+            "stale pooled connections to the dead leader survived "
+            "LEADER_UPDATE adoption"
+        )
+        # Data still byte-exact; new allocs place through the new leader.
+        assert bytes(client.get(h, data.nbytes)) == data.tobytes()
+        h2 = client.alloc(128 << 10, OcmKind.REMOTE_HOST)
+        d2 = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+        client.put(h2, d2, 0)
+        assert bytes(client.get(h2, d2.nbytes)) == d2.tobytes()
+
+
+def test_torn_standby_state_refused_and_resynced(rng):
+    """Satellite: a standby whose replicated snapshot fails its CRC must
+    NOT lead from it — it re-syncs whole from the survivors instead."""
+    cfg = ldr_cfg(replicas=2)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(1)
+        data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        assert h.replica_ranks, "k=2 placement assigned no replica"
+        client.put(h, data, 0)
+        wait_for(lambda: cl.daemons[1]._master_state_raw is not None,
+                 10.0, "master-state replication")
+        # Corrupt the standby's copy in place (rot between push and
+        # promotion) and keep the leader from re-pushing a good one.
+        with cl.daemons[1]._state_lock:
+            raw = bytearray(cl.daemons[1]._master_state_raw)
+            raw[len(raw) // 2] ^= 0xFF
+            cl.daemons[1]._master_state_raw = bytes(raw)
+            cl.daemons[1]._master_state_seq += 1 << 32
+        cl.kill(0)
+        wait_for(lambda: cl.daemons[1].is_leader, 10.0, "election")
+        d1 = cl.daemons[1]
+        assert d1.ldr_counters["state_resyncs"] == 1, (
+            "torn replicated state was not refused"
+        )
+        # The rebuilt accounting covers the survivors and placement works.
+        assert set(d1.policy.host_capacities()) >= {1, 2}
+        assert bytes(client.get(h, data.nbytes)) == data.tobytes()
+        h2 = client.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        assert h2.alloc_id
+
+
+def test_stale_pooled_conn_to_fenced_leader_not_retried(rng):
+    """Satellite: a client holding a pooled connection to a daemon that
+    gets fenced sees STALE_EPOCH through it, and the failover ladder
+    lands the op elsewhere instead of re-trying the fenced rank."""
+    cfg = ldr_cfg(replicas=2)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0)
+        data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+        # Find a handle primaried on a NON-rank-0 daemon with a replica,
+        # so fencing the primary leaves a live copy to fail over to.
+        h = None
+        for _ in range(8):
+            cand = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+            if cand.rank != 0 and cand.replica_ranks:
+                h = cand
+                break
+        assert h is not None, "no replicated non-rank-0 placement found"
+        client.put(h, data, 0)
+        victim = cl.daemons[h.rank]
+        old_rank = h.rank
+        # Warm a pooled data connection to the primary.
+        assert bytes(client.get(h, data.nbytes)) == data.tobytes()
+        # Fence the primary (epoch bump + verdict, as a failover would)
+        # and let every survivor believe it dead so the replica serves.
+        victim._adopt_epoch(victim.epoch + 1)
+        victim._fence(victim.epoch)
+        for d in cl.daemons:
+            if d is not victim and d.detector is not None:
+                d.detector.mark_dead(victim.rank)
+        got = client.get(h, data.nbytes)
+        assert bytes(got) == data.tobytes()
+        assert h.rank != old_rank, "handle never left the fenced primary"
+
+
+# -- client bootstrap ladder (satellite) ---------------------------------
+
+
+def test_client_bootstrap_with_rank0_down(rng):
+    cfg = ldr_cfg(replicas=1)
+    with local_cluster(3, config=cfg) as cl:
+        cl.kill(0)
+        wait_for(lambda: cl.daemons[1].is_leader, 10.0, "election")
+        # Boot a client whose OWN seed rank is the dead rank 0: the
+        # CONNECT ladder walks the remaining seeds and adopts the rank
+        # of the daemon that answers.
+        c = ControlPlaneClient(cl.entries, 0, config=cfg)
+        try:
+            assert c.rank != 0, "client attached to a dead seed"
+            data = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+            h = c.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+            c.put(h, data, 0)
+            assert bytes(c.get(h, data.nbytes)) == data.tobytes()
+            c.free(h)
+        finally:
+            c.close()
+
+
+# -- voluntary handoff + rank-0 LEAVE ------------------------------------
+
+
+def test_handoff_and_rank0_leaves_cleanly(rng):
+    cfg = ldr_cfg(replicas=1)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(1)
+        data = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data, 0)
+        wait_for(lambda: cl.daemons[1]._master_state_raw is not None,
+                 10.0, "master-state replication")
+        # Rank 0 leaves: handoff first (to rank 1), then an ordinary
+        # drained departure through the successor.
+        out = leave_cluster(cl.daemons[0])
+        assert cl.daemons[1].is_leader
+        assert cl.daemons[0].leader_rank == 1
+        assert cl.daemons[1].entries.has_left(0)
+        assert out["epoch"] >= 2  # handoff bump + leave bump
+        wait_for(lambda: cl.daemons[2].leader_rank == 1, 10.0,
+                 "LEADER_UPDATE adoption at rank 2")
+        # The departed rank holds nothing; the cluster keeps serving.
+        assert cl.daemons[0].registry.live_count() == 0
+        assert bytes(client.get(h, data.nbytes)) == data.tobytes()
+        h2 = client.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        assert h2.rank in (1, 2)
+
+
+def test_leader_without_standbys_refuses_leave():
+    cfg = ldr_cfg(standby_masters=0, replicas=1)
+    with local_cluster(2, config=cfg) as cl:
+        with pytest.raises(OcmError, match="cannot leave"):
+            leave_cluster(cl.daemons[0])
+
+
+# -- hash placement ------------------------------------------------------
+
+
+def test_hash_alloc_zero_leader_roundtrips(rng, journaled):
+    cfg = ldr_cfg(placement="hash", replicas=2)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(1)
+        obs_journal.clear()
+        handles = []
+        datas = []
+        for _ in range(6):
+            data = rng.integers(0, 256, 96 << 10, dtype=np.uint8)
+            h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+            client.put(h, data, 0)
+            handles.append(h)
+            datas.append(data)
+        for h, d in zip(handles, datas):
+            assert bytes(client.get(h, d.nbytes)) == d.tobytes()
+        # THE pin: nobody — rank 0 included — placed a single REQ_ALLOC
+        # as leader, while every alloc journaled a hash_place.
+        assert all(d.ldr_counters["placements"] == 0 for d in cl.daemons)
+        placed = [e for e in obs_journal.events()
+                  if e.get("ev") == "hash_place"]
+        assert len(placed) >= len(handles)
+        # Every placement agrees with the recomputed rendezvous plan
+        # (the placement-agreement invariant, checked inline).
+        for e in placed:
+            want = hashring.plan(e["alloc_id"], e["live"], e["k"])
+            assert tuple(e["chain"]) == want
+        # k=2 chains really exist on the owners.
+        reg_e = cl.daemons[handles[0].rank].registry.lookup(
+            handles[0].alloc_id
+        )
+        assert len(reg_e.chain) == 2
+
+
+def test_hash_alloc_survives_dead_primary_replan(rng):
+    """An alloc planned onto a just-died rank re-plans over the
+    shrunken set instead of failing: the journaled live set is the one
+    actually used, keeping the auditor's recompute exact."""
+    cfg = ldr_cfg(placement="hash", replicas=1)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(1)
+        cl.kill(2)  # dies without anyone's detector knowing yet
+        ok = 0
+        for _ in range(8):
+            h = client.alloc(64 << 10, OcmKind.REMOTE_HOST)
+            assert h.rank != 2
+            ok += 1
+        assert ok == 8
+
+
+def test_hash_disabled_is_default_and_inert(rng, journaled):
+    assert OcmConfig(host_arena_bytes=1 << 20).placement == "leader"
+    cfg = ldr_cfg(standby_masters=0, replicas=1)
+    with local_cluster(2, config=cfg) as cl:
+        client = cl.client(0)
+        obs_journal.clear()
+        h = client.alloc(64 << 10, OcmKind.REMOTE_HOST)
+        client.free(h)
+        assert not [e for e in obs_journal.events()
+                    if e.get("ev") == "hash_place"]
+        assert all(d.ldr_counters["hash_placements"] == 0
+                   for d in cl.daemons)
+
+
+# -- auditor invariants (unit) -------------------------------------------
+
+
+def _ev(ev, jid="j1", seq=0, ts=0.0, track="daemon-r0", **kw):
+    return {"ev": ev, "jid": jid, "seq": seq, "ts": ts, "track": track,
+            **kw}
+
+
+def test_leader_unique_invariant():
+    # Clean: one election per epoch, a handoff recorded by both ends.
+    clean = [
+        _ev("leader_elect", seq=1, rank=1, prev=0, epoch=3),
+        _ev("leader_handoff", seq=2, src=1, target=2, epoch=4),
+        _ev("leader_handoff", jid="j2", seq=1, src=1, target=2, epoch=4),
+    ]
+    findings, _ = obs_audit.audit_events(clean)
+    assert not [f for f in findings if f.rule == "leader-unique"]
+    # Split brain: two claimants under ONE epoch.
+    split = clean + [
+        _ev("leader_elect", jid="j3", seq=1, rank=2, prev=0, epoch=3,
+            track="daemon-r2"),
+    ]
+    findings, _ = obs_audit.audit_events(split)
+    bad = [f for f in findings if f.rule == "leader-unique"]
+    assert len(bad) == 1 and "epoch 3" in bad[0].message
+
+
+def test_placement_agreement_invariant():
+    live = [0, 1, 2]
+    aid = (1 << 32) | 2
+    good_chain = list(hashring.plan(aid, live, 2))
+    ok = [_ev("hash_place", seq=1, alloc_id=aid, epoch=1, live=live,
+              k=2, chain=good_chain)]
+    findings, _ = obs_audit.audit_events(ok)
+    assert not [f for f in findings if f.rule == "placement-agreement"]
+    # A forged chain disagrees with the recompute.
+    forged = [_ev("hash_place", seq=1, alloc_id=aid, epoch=1, live=live,
+                  k=2, chain=list(reversed(good_chain)))]
+    findings, _ = obs_audit.audit_events(forged)
+    assert [f for f in findings if f.rule == "placement-agreement"]
+    # The same id placed twice with different chains is flagged even
+    # when each matches its own recorded member set.
+    twice = ok + [_ev("hash_place", jid="j2", seq=1, alloc_id=aid,
+                      epoch=2, live=[0, 1],
+                      k=2, chain=list(hashring.plan(aid, [0, 1], 2)))]
+    findings, _ = obs_audit.audit_events(twice)
+    assert [f for f in findings if f.rule == "placement-agreement"
+            and "twice" in f.message]
+
+
+# -- NOT_MASTER redirect (wire) ------------------------------------------
+
+
+def test_not_master_redirect_names_leader(rng):
+    cfg = ldr_cfg(replicas=1)
+    with local_cluster(3, config=cfg) as cl:
+        cl.kill(0)
+        wait_for(lambda: cl.daemons[1].is_leader, 10.0, "election")
+        wait_for(lambda: cl.daemons[2].leader_rank == 1, 10.0,
+                 "adoption at rank 2")
+        # A master-bound message at a NON-leader answers NOT_MASTER
+        # with the live leader's rank + address in the tail.
+        e2 = cl.entries[2]
+        s = socket.create_connection((e2.connect_host, e2.port),
+                                     timeout=5.0)
+        try:
+            with pytest.raises(OcmRemoteError, match="non-master") as ei:
+                P.request(s, P.Message(
+                    P.MsgType.ADD_NODE,
+                    {"rank": 2, "host": "127.0.0.1", "port": 1,
+                     "ndevices": 1, "device_arena_bytes": 1,
+                     "host_arena_bytes": 1},
+                ))
+            assert ei.value.leader_rank == 1
+            assert ei.value.leader_addr == (
+                cl.entries[1].connect_host, cl.entries[1].port
+            )
+        finally:
+            s.close()

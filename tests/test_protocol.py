@@ -32,6 +32,8 @@ def test_roundtrip_all_schemas():
         "seg": "ocm-fab-1a2b-00112233aabbccdd",
         # elastic family (REQ_JOIN/LEAVE_OK/MIGRATE_BEGIN/...)
         "moved": 3, "src_rank": 1,
+        # leadership family (MASTER_STATE/LEADER_UPDATE/LEADER_HANDOFF)
+        "seq": 17, "leader": 1, "from_rank": 0,
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})
