@@ -332,6 +332,246 @@ def dcn_fabric_sweep(
     }
 
 
+def _mux_lockstep_arm(entries, cfg, tenants: int, rounds: int,
+                      op_bytes: int) -> dict:
+    """The TODAY arm: one blocking ControlPlaneClient per tenant (its
+    own ctrl socket + pool), one thread per tenant, every small op a
+    lockstep round trip — exactly what the mux core replaces."""
+    import threading
+
+    import numpy as np
+
+    clients = [
+        ControlPlaneClient(entries, 0, config=cfg, heartbeat=False,
+                           app_id=40_000 + i)
+        for i in range(tenants)
+    ]
+    try:
+        handles = [
+            c.alloc(op_bytes, OcmKind.REMOTE_HOST) for c in clients
+        ]
+        datas = [
+            np.full(op_bytes, i % 256, dtype=np.uint8)
+            for i in range(tenants)
+        ]
+        errs: list = [None] * tenants
+
+        def worker(i: int) -> None:
+            c, h, d = clients[i], handles[i], datas[i]
+            try:
+                for _ in range(rounds):
+                    c.put(h, d)
+                    got = c.get(h, op_bytes)
+                    if bytes(got[:1]) != d[:1].tobytes():
+                        raise AssertionError(f"tenant {i} readback bleed")
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs[i] = e
+
+        threads = [
+            threading.Thread(target=worker, args=(i,),
+                             name=f"lockstep-{i}")
+            for i in range(tenants)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        for e in errs:
+            if e is not None:
+                raise e
+        sockets = sum(
+            c.client_footprint()["sockets"] for c in clients
+        )
+        for c, h in zip(clients, handles):
+            c.free(h)
+    finally:
+        for c in clients:
+            c.close()
+    ops = tenants * rounds * 2  # one put + one get per round
+    return {
+        "ops_per_s": round(ops / dt, 1),
+        "wall_s": round(dt, 3),
+        "sockets": sockets,
+        "threads": tenants,
+    }
+
+
+def _mux_async_arm(entries, cfg, tenants: int, rounds: int,
+                   op_bytes: int) -> dict:
+    """The mux arm: every tenant an AsyncOcm coroutine over ONE shared
+    ChannelMap — one connection per peer for the whole fleet, tagged
+    pipelining, batched writes."""
+    import asyncio
+
+    import numpy as np
+
+    from oncilla_tpu.runtime.mux import AsyncOcm, ChannelMap
+
+    async def run() -> dict:
+        loop = asyncio.get_running_loop()
+        chmap = ChannelMap(loop, cfg)
+        try:
+            ocms = await asyncio.gather(*(
+                AsyncOcm.open(entries, 0, config=cfg,
+                              app_id=50_000 + i, channels=chmap,
+                              heartbeat=False)
+                for i in range(tenants)
+            ))
+            handles = await asyncio.gather(*(
+                o.alloc(op_bytes) for o in ocms
+            ))
+            datas = [
+                np.full(op_bytes, i % 256, dtype=np.uint8)
+                for i in range(tenants)
+            ]
+
+            async def tenant(i: int) -> None:
+                o, h, d = ocms[i], handles[i], datas[i]
+                for _ in range(rounds):
+                    await o.put(h, d)
+                    got = await o.get(h, op_bytes)
+                    if bytes(got[:1]) != d[:1].tobytes():
+                        raise AssertionError(f"tenant {i} readback bleed")
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(tenant(i) for i in range(tenants)))
+            dt = time.perf_counter() - t0
+            sockets = chmap.fd_count()
+            counters = chmap.counters()
+            await asyncio.gather(*(
+                o.free(h) for o, h in zip(ocms, handles)
+            ))
+            for o in ocms:
+                await o.aclose()
+        finally:
+            chmap.close()
+            await asyncio.sleep(0.05)
+        ops = tenants * rounds * 2
+        return {
+            "ops_per_s": round(ops / dt, 1),
+            "wall_s": round(dt, 3),
+            "sockets": sockets,
+            "threads": 1,
+            "mux": counters,
+        }
+
+    return asyncio.run(run())
+
+
+def dcn_mux_sweep(
+    tenants: int = 64,
+    rounds: int = 100,
+    op_bytes: int = 512,
+    large_nbytes: int = 64 << 20,
+    smoke: bool = False,
+) -> dict:
+    """Paired lockstep-vs-mux sweep (the ISSUE-13 acceptance cell):
+
+    - **small ops** — ``tenants`` concurrent tenants each doing
+      ``rounds`` put+get round trips of ``op_bytes``. The lockstep arm
+      is today's client (thread + sockets per tenant); the mux arm is
+      the same workload as coroutines over ONE connection per peer.
+      ``small_op_ratio`` is mux/lockstep ops/s — the ≥2x bar.
+    - **large** — one ``large_nbytes`` put/get per arm: the striped
+      engine (unchanged default path, the <5%-regression baseline) vs
+      the same transfer riding the mux channel.
+
+    ``smoke=True`` bounds everything for CI and ASSERTS the contracts
+    (byte-exactness via the readback checks, mux fd budget ≤ live
+    peers + 1)."""
+    import os
+
+    if smoke:
+        tenants = min(tenants, 8)
+        rounds = min(rounds, 25)
+        large_nbytes = min(large_nbytes, 8 << 20)
+    arena = max(2 * large_nbytes, tenants * op_bytes * 8 + (32 << 20))
+    mk = dict(
+        host_arena_bytes=arena,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=4 << 20,
+        inflight_ops=2,
+        heartbeat_s=5.0,
+        dcn_adaptive=False,
+    )
+    cfg_lock = OcmConfig(**mk)
+    cfg_mux = OcmConfig(**mk, mux=True)
+    data = _bench_data(large_nbytes)
+    out: dict = {
+        "tenants": tenants, "rounds": rounds, "op_bytes": op_bytes,
+        "large_nbytes": large_nbytes,
+    }
+    with _daemon_pair(cfg_lock, native=False) as entries:
+        probe = ControlPlaneClient(entries, 0, config=cfg_lock,
+                                   heartbeat=False)
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline and probe.status()["nnodes"] < 2:
+                time.sleep(0.1)
+        finally:
+            probe.close()
+        out["lockstep"] = _mux_lockstep_arm(
+            entries, cfg_lock, tenants, rounds, op_bytes
+        )
+        out["mux"] = _mux_async_arm(
+            entries, cfg_mux, tenants, rounds, op_bytes
+        )
+        out["large"] = {
+            "striped": _timed_roundtrip(
+                entries, cfg_lock, large_nbytes, 2, data
+            ),
+            "mux": _timed_roundtrip(
+                entries, cfg_mux, large_nbytes, 2, data
+            ),
+        }
+    out["small_op_ratio"] = round(
+        out["mux"]["ops_per_s"] / max(out["lockstep"]["ops_per_s"], 1e-9),
+        3,
+    )
+    # The PR-3/PR-7 measurement-honesty precedent: on a 1-core container
+    # the serving daemon's per-op Python cost is a term BOTH arms pay in
+    # full (client and daemon serialize on the same core), which caps
+    # the ratio regardless of how cheap the mux client gets — the
+    # nominal ≥2x bar needs a multicore host, where the lockstep arm
+    # additionally pays its 64-thread context-switch tax. Record what
+    # is measured, with the bound named.
+    out["cores"] = os.cpu_count()
+    if (os.cpu_count() or 1) <= 1:
+        out["note"] = (
+            "1-core container: client+server share the core, so the "
+            "shared serving cost bounds small_op_ratio below the "
+            "multicore figure"
+        )
+    out["large_put_ratio"] = round(
+        out["large"]["mux"]["put_gbps"]
+        / max(out["large"]["striped"]["put_gbps"], 1e-9), 3,
+    )
+    out["large_get_ratio"] = round(
+        out["large"]["mux"]["get_gbps"]
+        / max(out["large"]["striped"]["get_gbps"], 1e-9), 3,
+    )
+    out["verified"] = bool(
+        out["large"]["striped"]["verified"]
+        and out["large"]["mux"]["verified"]
+    )
+    if smoke:
+        # Contracts the CI stage gates on: byte-exactness held above
+        # (readback checks + verified large cells) and the fd budget —
+        # the WHOLE mux fleet held at most one socket per live peer
+        # (+1 headroom for a plane listener none of these tenants has).
+        peers = len(entries)
+        if out["mux"]["sockets"] > peers + 1:
+            raise AssertionError(
+                f"mux smoke: fd budget blown — {out['mux']['sockets']} "
+                f"sockets for {peers} peers"
+            )
+        if not out["verified"]:
+            raise AssertionError("mux smoke: large roundtrip mismatch")
+    return out
+
+
 def smoke(nbytes: int = 4 << 20) -> dict:
     """Seconds-scale loopback DCN smoke for CI (scripts/check.sh): a tiny
     striped put/get roundtrip through an in-process 2-daemon cluster,
@@ -474,6 +714,14 @@ def main(argv=None) -> int:
                     help="stripe x window sweep against daemon processes")
     ap.add_argument("--fabrics", action="store_true",
                     help="tcp vs shm fabric x size sweep (fabric/)")
+    ap.add_argument("--mux", action="store_true",
+                    help="paired lockstep-vs-mux sweep (runtime/mux.py): "
+                         "N concurrent tenants' small ops per-connection "
+                         "vs multiplexed, plus large-transfer cells; "
+                         "with --smoke, the bounded CI gate asserting "
+                         "byte-exactness and the fd budget")
+    ap.add_argument("--tenants", type=int, default=None,
+                    help="tenant count for the --mux sweep (default 64)")
     ap.add_argument("--daemon", choices=["python", "native", "both"],
                     default=None,
                     help="which daemon serves: the Python reference, the "
@@ -484,7 +732,12 @@ def main(argv=None) -> int:
                     help="deprecated alias for --daemon python")
     args = ap.parse_args(argv)
     daemon = args.daemon or ("python" if args.python_daemons else None)
-    if args.smoke:
+    if args.mux:
+        out = dcn_mux_sweep(
+            tenants=args.tenants or (8 if args.smoke else 64),
+            smoke=args.smoke,
+        )
+    elif args.smoke:
         if daemon == "native":
             out = native_smoke(args.nbytes or (256 << 20))
         else:

@@ -108,7 +108,12 @@ def plan_stripes(config: OcmConfig, total: int) -> int:
     """How many stripes a ``total``-byte transfer is worth: capped by
     config, and shrunk so each stripe moves at least
     ``dcn_stripe_min_bytes`` (a thread + socket per few hundred KiB
-    would cost more than the parallelism buys)."""
+    would cost more than the parallelism buys). Under the mux runtime
+    (OCM_MUX) striped transfers ride the peer's ONE shared channel —
+    pipelining inside the connection replaces parallel sockets, so the
+    plan is always a single stripe."""
+    if config.mux:
+        return 1
     per = max(1, config.dcn_stripe_min_bytes)
     return max(1, min(config.dcn_stripes, total // per))
 

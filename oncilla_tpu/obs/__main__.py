@@ -152,7 +152,7 @@ def _app_rows(rank: int, st: dict) -> list[list[str]]:
 def _table(entries) -> int:
     cols = ["rank", "nodes", "members", "allocs", "live", "ops", "p50_us",
             "p99_us", "lat_hist", "events", "gbit/s", "leases r/x/e",
-            "migr ok/ab", "hb_age_s"]
+            "migr ok/ab", "mux if/pk/ops", "hb_age_s"]
     rows = []
     app_rows: list[list[str]] = []
     declined: list[int] = []
@@ -161,7 +161,7 @@ def _table(entries) -> int:
         st = _poll_status(e)
         if "error" in st:
             rows.append([str(e.rank), "-", "-", "-", "-", "-", "-", "-",
-                         "-", "-", "-", "-", "-", st["error"][:40]])
+                         "-", "-", "-", "-", "-", "-", st["error"][:40]])
             continue
         any_ok = True
         ev_count, ev_note = _poll_events_count(e)
@@ -195,6 +195,12 @@ def _table(entries) -> int:
              f"/{leases.get('expired', 0)}"),
             (f"{ec.get('migrations_completed', 0)}"
              f"/{ec.get('migrations_aborted', 0)}"),
+            # Mux serving (runtime/mux.py): tagged control ops in flight
+            # NOW / peak / total tagged ops — dash for pre-mux daemons
+            # (the C++ twin sends no mux tail).
+            (f"{mx.get('inflight', 0)}/{mx.get('peak_inflight', 0)}"
+             f"/{mx.get('tagged_ops', 0)}") if (mx := st.get("mux"))
+            else "-",
             f"{max(apps.values()):.1f}" if apps else "-",
         ])
     widths = [

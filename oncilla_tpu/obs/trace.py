@@ -50,18 +50,21 @@ def decode(buf) -> TraceCtx:
     return TraceCtx(trace_id=trace_id, span_id=span_id)
 
 
-# Per-process RNG for ids: ``random.getrandbits`` is ~100 ns — cheap
+# Per-THREAD RNG for ids: ``random.getrandbits`` is ~100 ns — cheap
 # enough for the span hot path — and non-crypto is fine (ids only need to
-# be collision-unlikely within a trace's lifetime). Seeded from urandom so
-# forked workers do not mint identical id streams.
-_rng = random.Random(os.urandom(8))
-_rng_lock = threading.Lock()
+# be collision-unlikely within a trace's lifetime). One Random per thread
+# (seeded from urandom, so forked workers and sibling threads do not mint
+# identical id streams) keeps the hot path lock-free: every span mints
+# 1-2 ids, and a process-wide lock here was measurable under the mux
+# runtime's small-op load.
+_rng_tls = threading.local()
 
 
 def _new_id() -> int:
-    with _rng_lock:
-        n = _rng.getrandbits(64)
-    return n or 1  # 0 means "absent" on the wire
+    rng = getattr(_rng_tls, "rng", None)
+    if rng is None:
+        rng = _rng_tls.rng = random.Random(os.urandom(8))
+    return rng.getrandbits(64) or 1  # 0 means "absent" on the wire
 
 
 def mint() -> TraceCtx:
@@ -109,6 +112,20 @@ class use_ctx:
             _tls.ctx = self._saved
 
 
+def swap(ctx: TraceCtx | None) -> TraceCtx | None:
+    """Install ``ctx`` as the thread's active context, returning the
+    previous one — the raw pair use_ctx is built from, exposed for hot
+    paths (Tracer._Span) that cannot afford a context-manager object per
+    span. Always pair with :func:`restore`."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+def restore(prev: TraceCtx | None) -> None:
+    _tls.ctx = prev
+
+
 def enabled() -> bool:
     """Context minting/propagation is always-on (the Dapper premise: ids
     are too cheap to gate) unless ``OCM_TRACE=0`` opts the process out."""
@@ -148,7 +165,11 @@ def attach(msg, ctx: TraceCtx, flag: int):
 def split(data) -> tuple[TraceCtx | None, object]:
     """Strip a 16-byte context prefix off a data tail. A tail shorter than
     the prefix is malformed-but-tolerated (receivers must not die on a
-    confused peer): returns (None, data) unchanged."""
+    confused peer): returns (None, data) unchanged. The rest is a VIEW —
+    no payload copy on the per-frame strip path; Message.data consumers
+    treat it as a read-only buffer already."""
     if len(data) < CTX_BYTES:
         return None, data
-    return decode(data), data[CTX_BYTES:]
+    rest = (data if isinstance(data, memoryview)
+            else memoryview(data))[CTX_BYTES:]
+    return decode(data), rest

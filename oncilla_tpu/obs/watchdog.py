@@ -30,17 +30,34 @@ _thread: threading.Thread | None = None
 
 
 def threshold_us() -> int:
-    """0 = watchdog disabled."""
+    """0 = watchdog disabled. Cached: this sits on EVERY span's entry
+    path (thousands of small ops per second under the mux runtime) and
+    an os.environ lookup per span is measurable; tests that flip the
+    knob mid-process call :func:`reload_threshold`."""
+    return _threshold_us
+
+
+def reload_threshold() -> int:
+    """Re-read OCM_SLOWOP_US (test hook / runtime re-decision)."""
+    global _threshold_us
     try:
-        return int(os.environ.get("OCM_SLOWOP_US", "") or 0)
+        _threshold_us = int(os.environ.get("OCM_SLOWOP_US", "") or 0)
     except ValueError:
-        return 0
+        _threshold_us = 0
+    return _threshold_us
+
+
+_threshold_us = 0
+reload_threshold()
 
 
 def register(tracer) -> None:
     """Called by every Tracer.__init__; starts the scan thread lazily on
-    the first registration with the env knob set."""
+    the first registration with the env knob set. Re-reads the env knob
+    so a Tracer constructed after OCM_SLOWOP_US changes (tests, runtime
+    re-decisions) sees the new threshold despite the hot-path cache."""
     with _lock:
+        reload_threshold()
         _tracers.add(tracer)
         _maybe_start_locked()
 

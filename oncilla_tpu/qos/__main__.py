@@ -119,7 +119,7 @@ def _assert(cond, msg: str) -> None:
 
 
 def run_soak(seed: int, tenants_n: int, rounds: int, chaos: bool,
-             verbose: bool = False) -> dict:
+             verbose: bool = False, mux: bool = False) -> dict:
     import numpy as np
 
     from oncilla_tpu.analysis import alloctrace
@@ -137,7 +137,11 @@ def run_soak(seed: int, tenants_n: int, rounds: int, chaos: bool,
         host_arena_bytes=arena,
         device_arena_bytes=4 << 20,
         lease_s=3.0,
-        heartbeat_s=0.2,
+        # Mux mode hosts HUNDREDS of tenants in this one process over
+        # one connection per daemon; a 0.2 s beat x 200 tenants would
+        # be pure heartbeat load, so the beat relaxes (still ≥4 beats
+        # per lease).
+        heartbeat_s=0.5 if mux else 0.2,
         arena_high_pct=60,
         arena_low_pct=40,
         chunk_bytes=256 << 10,
@@ -147,8 +151,9 @@ def run_soak(seed: int, tenants_n: int, rounds: int, chaos: bool,
         suspect_after=1,
         dead_after=2,
         probe_timeout_s=0.25,
+        mux=mux,
     )
-    outcome: dict = {"seed": seed, "tenants": tenants_n}
+    outcome: dict = {"seed": seed, "tenants": tenants_n, "mux": mux}
     with local_cluster(3, config=_mk_cfg(base)) as cl:
         # -- phase A: fairness rounds ---------------------------------
         tenants = [
@@ -175,6 +180,40 @@ def run_soak(seed: int, tenants_n: int, rounds: int, chaos: bool,
         if verbose:
             print(f"  fairness: {outcome['fair_rounds']} rounds across "
                   f"{tenants_n} tenants, all complete")
+
+        # -- phase A' (mux only): fd/thread footprint + p99s ----------
+        # The ISSUE-13 acceptance pin: the WHOLE tenant fleet shares
+        # one connection per live peer (vs O(tenants x stripes) pooled
+        # sockets today), and the tail latencies of the storm are in
+        # the obs histograms (Tracer bucket counts feed
+        # ocm_op_latency_seconds_bucket).
+        if mux:
+            fp = tenants[0].client.client_footprint()
+            peers = len(cl.daemons)
+            _assert(
+                fp["sockets"] <= peers + 1,
+                f"mux fd budget blown: {fp['sockets']} client sockets "
+                f"for {peers} peers (want <= peers + 1)",
+            )
+            snap = tenants[0].client.tracer.snapshot()
+            p99s = {
+                op: st.get("p99_us")
+                for op, st in snap.items() if op.startswith("dcn_")
+            }
+            _assert(
+                any(v for v in p99s.values()),
+                "no dcn p99 recorded in the client histograms",
+            )
+            outcome["footprint"] = {
+                "sockets": fp["sockets"],
+                "threads": fp["threads"],
+                "mux": fp["mux"],
+                "p99_us": p99s,
+            }
+            if verbose:
+                print(f"  footprint: {fp['sockets']} sockets / "
+                      f"{fp['threads']} threads for {tenants_n} tenants; "
+                      f"p99_us={p99s}")
 
         # -- phase B: quota enforcement -------------------------------
         probe = next(t for t in tenants if t.quota)
@@ -349,16 +388,29 @@ def main(argv=None) -> int:
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--no-chaos", action="store_true",
                     help="skip the mid-soak daemon kill")
+    ap.add_argument("--mux", action="store_true",
+                    help="run the tenant fleet over the async mux "
+                         "runtime (OCM_MUX): hundreds of tenants in "
+                         "this ONE process over one connection per "
+                         "daemon, fd budget asserted <= peers + 1")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
     if not (args.soak or args.smoke):
         ap.print_help()
         return 2
-    tenants = args.tenants or (6 if args.smoke else 18)
-    rounds = args.rounds or (3 if args.smoke else 10)
+    mux = args.mux or bool(int(os.environ.get("OCM_MUX", "0") or 0))
+    # Mux scale: the serving-scale acceptance runs >= 200 tenants in one
+    # process; the smoke keeps CI bounded but still a real multi-tenant
+    # fleet over one connection per peer.
+    if mux:
+        tenants = args.tenants or (24 if args.smoke else 200)
+        rounds = args.rounds or (2 if args.smoke else 3)
+    else:
+        tenants = args.tenants or (6 if args.smoke else 18)
+        rounds = args.rounds or (3 if args.smoke else 10)
     label = "smoke" if args.smoke else "soak"
     print(f"qos {label}: seed={args.seed} tenants={tenants} "
-          f"rounds={rounds} chaos={not args.no_chaos} ...")
+          f"rounds={rounds} chaos={not args.no_chaos} mux={mux} ...")
     t0 = time.monotonic()
     try:
         # The soak records under the flight recorder and its timeline
@@ -371,7 +423,7 @@ def main(argv=None) -> int:
         with obs_audit.recorded(f"qos-{label}") as rec:
             outcome = run_soak(args.seed, tenants, rounds,
                                chaos=not args.no_chaos,
-                               verbose=args.verbose)
+                               verbose=args.verbose, mux=mux)
         print(f"  flight recorder: {rec.summary()}")
     except AssertionError as e:
         print(f"qos {label}: FAIL — {e}", file=sys.stderr)
@@ -380,11 +432,18 @@ def main(argv=None) -> int:
         f", killed rank {outcome['chaos']['killed_rank']} mid-soak"
         if "chaos" in outcome else ""
     )
+    mux_note = ""
+    if "footprint" in outcome:
+        fp = outcome["footprint"]
+        mux_note = (
+            f", mux fleet: {fp['sockets']} sockets / {fp['threads']} "
+            f"threads for {tenants} tenants"
+        )
     print(f"qos {label}: OK in {time.monotonic() - t0:.1f}s — "
           f"{outcome['fair_rounds']} fair rounds, "
           f"busy={outcome['busy_total']}, "
           f"low evictions={outcome['evicted_low']}, no active "
-          f"normal/high eviction, ledger drained{chaos_note}")
+          f"normal/high eviction, ledger drained{chaos_note}{mux_note}")
     return 0
 
 

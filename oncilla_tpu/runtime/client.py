@@ -41,6 +41,7 @@ from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
+from oncilla_tpu.runtime import mux as mux_rt
 from oncilla_tpu.qos.policy import pack_profile
 from oncilla_tpu.runtime.protocol import (
     ErrCode,
@@ -232,15 +233,37 @@ class ControlPlaneClient:
         self.ici_plane = ici_plane
         self.tracer = GLOBAL_TRACER
         self._pool = PeerPool()
-        # Bootstrap CONNECT ladder (control/): the preferred seat is the
-        # local rank's daemon, but boot must not hard-depend on any ONE
-        # seed address being alive (the old behavior made the nodefile's
-        # own-rank row — rank 0 for most single-host tools — a single
-        # point of failure). Walk the remaining seed addresses with
-        # capped backoff; the first live daemon becomes this app's local
-        # daemon, and the client adopts ITS rank as the app's origin.
-        self._ctrl, self.rank = self._connect_ladder(entries, rank)
-        self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Async mux runtime (runtime/mux.py, OCM_MUX=1): the process-
+        # shared one-connection-per-peer channel set replaces BOTH the
+        # dedicated ctrl socket and the per-tenant data-plane pool
+        # leases — this client becomes a thin sync facade over the
+        # background event loop. Unset keeps the blocking per-request
+        # client (and the wire) exactly as before.
+        self._mux: mux_rt.MuxRuntime | None = None
+        self._mux_hb = None
+        self._hb_beats = 0
+        self._ctrl_addr: tuple[str, int] | None = None
+        if self.config.mux:
+            self._mux = mux_rt.acquire_runtime(self.config)
+            self._ctrl = None
+            try:
+                self._ctrl_addr, self.rank = self._mux_bootstrap(
+                    entries, rank
+                )
+            except BaseException:
+                mux_rt.release_runtime(self._mux)
+                raise
+        else:
+            # Bootstrap CONNECT ladder (control/): the preferred seat is
+            # the local rank's daemon, but boot must not hard-depend on
+            # any ONE seed address being alive (the old behavior made
+            # the nodefile's own-rank row — rank 0 for most single-host
+            # tools — a single point of failure). Walk the remaining
+            # seed addresses with capped backoff; the first live daemon
+            # becomes this app's local daemon, and the client adopts ITS
+            # rank as the app's origin.
+            self._ctrl, self.rank = self._connect_ladder(entries, rank)
+            self._ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._ctrl_lock = make_lock("client._ctrl_lock")
         # Which ranks own this app's live remote allocations (rank -> count).
         # Reported on HEARTBEAT/DISCONNECT so daemons relay/reclaim with
@@ -309,9 +332,16 @@ class ControlPlaneClient:
                 )
         self._hb_stop = threading.Event()
         if heartbeat:
-            t = threading.Thread(target=self._heartbeat_loop, daemon=True,
-                                 name=f"ocm-hb-{rank}")
-            t.start()
+            if self._mux is not None:
+                # One loop task per tenant instead of one thread each —
+                # the thread-footprint half of the mux win.
+                self._mux_hb = self._mux.add_periodic(
+                    self.config.heartbeat_s, self._hb_messages
+                )
+            else:
+                t = threading.Thread(target=self._heartbeat_loop,
+                                     daemon=True, name=f"ocm-hb-{rank}")
+                t.start()
 
     # -- plumbing --------------------------------------------------------
 
@@ -376,7 +406,71 @@ class ControlPlaneClient:
             f"nodefile address refused): {last}"
         ) from last
 
+    def _mux_bootstrap(
+        self, entries, rank: int
+    ) -> tuple[tuple[str, int], int]:
+        """The CONNECT ladder over mux channels: the own-rank seed gets
+        the full capped-backoff retry budget (a restarting local daemon
+        is the routine case), every other seed one attempt; the channel
+        to the first live daemon becomes this tenant's ctrl stream and
+        the client adopts that daemon's rank as its origin."""
+        cfg = self.config
+        me = entries[rank]
+        last: OcmError | None = None
+        delay = max(cfg.connect_backoff_s, 1e-3)
+        for attempt in range(cfg.connect_retries + 1):
+            try:
+                self._mux.open_sync((me.connect_host, me.port), rank)
+                return (me.connect_host, me.port), rank
+            except OcmConnectError as e:
+                last = e
+                if attempt < cfg.connect_retries:
+                    backoff_sleep(min(delay, cfg.connect_backoff_cap_s))
+                    delay *= 2
+        for e in entries:
+            r = getattr(e, "rank", None)
+            if r is None or r == rank or not e.port:
+                continue
+            try:
+                ch = self._mux.open_sync((e.connect_host, e.port), rank)
+            except OcmConnectError as err:
+                last = err
+                continue
+            adopted = ch.peer_rank if ch.peer_rank is not None else r
+            printd(
+                "client: seed rank %d unreachable, attached to rank %d "
+                "at %s:%d over mux", rank, adopted, e.connect_host, e.port,
+            )
+            return (e.connect_host, e.port), adopted
+        raise OcmConnectError(
+            f"no seed daemon reachable over mux (own rank {rank} and "
+            f"every other nodefile address refused): {last}"
+        ) from last
+
+    def _hb_messages(self) -> list:
+        """One heartbeat tick's messages for the mux runtime's periodic
+        scheduler — the loop-task twin of _heartbeat_loop (including the
+        every-15th-beat plane re-registration)."""
+        self._hb_beats += 1
+        msgs = [(self._ctrl_addr, Message(
+            MsgType.HEARTBEAT,
+            {"rank": self.rank, "pid": self.pid,
+             "owners": self._owners_field()},
+        ))]
+        if self._plane_server is not None and self._hb_beats % 15 == 0:
+            msgs.append((self._ctrl_addr, Message(
+                MsgType.PLANE_SERVE,
+                {"host": os.environ.get("OCM_ADVERTISE_HOST", "127.0.0.1"),
+                 "port": self._plane_server.port, "relay": 0},
+            )))
+        return msgs
+
     def _request(self, msg: Message) -> Message:
+        # Mux path: the runtime captures the ambient trace context and
+        # the channel attaches it (peer-grant-gated) — exactly the
+        # discipline below, one hop later.
+        if self._mux is not None:
+            return self._mux.request_sync(self._ctrl_addr, msg)
         # Trace propagation: an ambient span context (Ocm.put/get/alloc
         # wrap ops in Tracer.span) rides the request as a 16-byte data
         # prefix — only on types the wire declares traceable and only
@@ -452,6 +546,9 @@ class ControlPlaneClient:
         (without detach) reclaims the process's allocations at that rank.
         """
         self._hb_stop.set()
+        if self._mux is not None and self._mux_hb is not None:
+            self._mux.cancel_periodic(self._mux_hb)
+            self._mux_hb = None
         if self._plane_server is not None and not detach:
             # Deregister the plane endpoint before it goes dark so daemons
             # stop relaying (and scrubbing) into a dead socket.
@@ -467,12 +564,28 @@ class ControlPlaneClient:
             # lease reaper is the backstop), so the client's own journal
             # records that this app's lease chain ended deliberately.
             obs_journal.record("app_close", pid=self.pid, rank=self.rank)
+            if self._mux is not None:
+                # Over the SHARED channel DISCONNECT must be awaited
+                # like any tagged request — an unread reply would desync
+                # the other tenants' demux.
+                try:
+                    self._mux.request_sync(
+                        self._ctrl_addr,
+                        Message(MsgType.DISCONNECT,
+                                {"pid": self.pid,
+                                 "owners": self._owners_field()}),
+                        timeout=10.0,
+                    )
+                except (OSError, OcmError):
+                    pass  # the lease reaper covers it
             # Bounded lock (mirrors libocm.cc's try_lock teardown): a beat
             # already inside _request holds _ctrl_lock mid send/recv, and an
             # unlocked send here would interleave frames and corrupt the
             # stream, losing the DISCONNECT. If the lock stays held (daemon
             # wedged), skip the courtesy message — the lease reaper covers it.
-            if self._ctrl_lock.acquire(timeout=2.0):
+            elif self._ctrl is not None and self._ctrl_lock.acquire(
+                timeout=2.0
+            ):
                 try:
                     send_msg(
                         self._ctrl,
@@ -495,10 +608,16 @@ class ControlPlaneClient:
                 pass
         if self._plane_server is not None:
             self._plane_server.close()
-        try:
-            self._ctrl.close()
-        except OSError:
-            pass
+        if self._ctrl is not None:
+            try:
+                self._ctrl.close()
+            except OSError:
+                pass
+        if self._mux is not None:
+            # Refcounted: the shared channel set (and its event loop)
+            # lives while ANY tenant in the process still uses it.
+            mux_rt.release_runtime(self._mux)
+            self._mux = None
 
     # -- RemoteBackend: alloc / free ------------------------------------
 
@@ -770,9 +889,13 @@ class ControlPlaneClient:
         stripes. Small transfers stay on tcp: below the shm threshold
         the control round-trip is the whole cost either way."""
         if (
-            not self.config.fabric_offer
+            self._mux is not None
+            or not self.config.fabric_offer
             or total < self.config.fabric_shm_min_bytes
         ):
+            # Mux channels don't negotiate one-sided fabrics (the shm
+            # probe needs a pool lease); OCM_MUX and OCM_FABRIC=shm are
+            # mutually exclusive by configuration.
             return None
         with self._dcn_lock:
             if addr in self._dcn_caps:
@@ -1150,6 +1273,21 @@ class ControlPlaneClient:
         self, handle: OcmAlloc, start: int, length: int, offset: int,
         put_mv, get_arr, addr, entry, stats: dict, idx: int,
     ) -> None:
+        if self._mux is not None:
+            # The whole range rides the peer's mux channel (plan_stripes
+            # pins nstripes to 1 under mux — one connection per peer is
+            # the contract). The surrounding ladder (_stripe_run) keeps
+            # every retry/failover/MOVED semantic: transfer errors come
+            # back as the same typed exceptions the pool path raises.
+            st = self._mux.transfer_sync(
+                (addr[0], addr[1]), handle, start, length, offset,
+                put_mv, get_arr,
+            )
+            stats["window"][idx] = st.get("window", 0)
+            stats["chunk"][idx] = st.get("chunk", 0)
+            stats["coalesced"][idx] = st.get("coalesced", False)
+            stats["fabric"] = "mux"
+            return
         host, port = addr
         if entry is None:
             entry = self._pool.lease(host, port)  # exclusive for the stripe
@@ -1268,10 +1406,13 @@ class ControlPlaneClient:
 
     def _rank_request(self, rank: int | None, msg: Message) -> Message:
         """One STATUS-family request to a rank's daemon: the ctrl stream
-        for the local rank, a short-lived direct dial otherwise."""
+        for the local rank, the peer's shared mux channel (no fresh
+        socket) under mux, a short-lived direct dial otherwise."""
         if rank is None or rank == self.rank:
             return self._request(msg)
         e = self.entries[rank]
+        if self._mux is not None:
+            return self._mux.request_sync((e.connect_host, e.port), msg)
         s = socket.create_connection((e.connect_host, e.port), timeout=30.0)
         try:
             return request(s, msg)
@@ -1316,4 +1457,26 @@ class ControlPlaneClient:
             except (ValueError, UnicodeDecodeError):
                 pass  # tail from a future daemon we don't understand
         f["dcn_client"] = {"transfers": self.tracer.transfers(last=32)}
+        f["client"] = self.client_footprint()
         return f
+
+    def client_footprint(self) -> dict:
+        """Open-socket and thread counts for this client process — what
+        the mux soak asserts its fd win against (mux: one shared
+        connection per live peer + the plane listener, vs today's
+        O(tenants x stripes) pool). ``sockets`` under mux is the
+        PROCESS-shared channel count (every tenant reports the same
+        number, because they share the same fds)."""
+        if self._mux is not None:
+            sockets = self._mux.fd_count()
+            mux = self._mux.counters()
+        else:
+            sockets = (0 if self._ctrl is None else 1) + self._pool.size()
+            mux = None
+        if self._plane_server is not None:
+            sockets += 1
+        return {
+            "sockets": sockets,
+            "threads": threading.active_count(),
+            "mux": mux,
+        }

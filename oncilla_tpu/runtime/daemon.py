@@ -78,30 +78,73 @@ from oncilla_tpu.resilience.failover import FailoverCoordinator
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
     FLAG_CAP_FABRIC,
+    FLAG_CAP_MUX,
     FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
     FLAG_FANOUT,
     FLAG_MORE,
     FLAG_HB_FWD,
+    FLAG_MUX_TAG,
     FLAG_QOS_TAIL,
     FLAG_REPLICAS,
     FLAG_TRACE_CTX,
     VALID_FLAGS,
     WIRE_KIND,
     WIRE_KIND_INV,
+    BufferedSock,
     ErrCode,
     Message,
     MsgType,
     RecvScratch,
+    attach_tag,
+    pack,
     pack_leader_tail,
     recv_msg,
     request,
     send_msg,
+    split_tag,
+)
+from oncilla_tpu.runtime.protocol import (
+    _data_len as _data_len_of,
+    _sendall_vec as protocol_sendall_vec,
 )
 from oncilla_tpu.runtime.registry import AllocRegistry, RegEntry
 from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import Tracer, printd
+
+
+# Bounded worker pool for out-of-order tagged control ops (mux serving).
+# Control ops are short (or block on nested relay legs, which the pool
+# must ride out) — size like the native daemon's data pool.
+_MUX_POOL_WORKERS = min(8, max(2, os.cpu_count() or 2))
+
+
+class _ConnMuxState:
+    """Per-connection arrival bookkeeping for tagged control ops: which
+    sequence numbers are still in flight, so a completion can tell
+    whether it overtook an earlier arrival (the ``ooo`` counter — proof
+    the out-of-order contract is actually exercised)."""
+
+    __slots__ = ("_lock", "_seq", "_inflight")
+
+    def __init__(self) -> None:
+        self._lock = make_lock("daemon._conn_mux_state")
+        self._seq = 0
+        self._inflight: set[int] = set()
+
+    def note_start(self) -> int:
+        with self._lock:
+            self._seq += 1
+            self._inflight.add(self._seq)
+            return self._seq
+
+    def note_done(self, seq: int) -> bool:
+        """Retire ``seq``; True when an EARLIER arrival is still open
+        (this completion is out of order)."""
+        with self._lock:
+            self._inflight.discard(seq)
+            return any(s < seq for s in self._inflight)
 
 
 class Daemon:
@@ -320,6 +363,21 @@ class Daemon:
         # inbound connections are dropped, outbound pool leases refused,
         # probes short-circuit to failures — a fully partitioned host.
         self._partitioned = False
+        # Mux serving (runtime/mux.py): tagged control ops complete OUT
+        # OF ORDER on a small shared worker pool (created lazily — a
+        # daemon that never sees a mux client never pays the threads);
+        # per-connection write locks keep reply frames whole. Counters
+        # feed STATUS/prom and the obs table's in-flight column.
+        self._mux_pool = None
+        self._mux_pool_lock = make_lock("daemon._mux_pool_lock")
+        self._mux_counters = {
+            "conns": 0,          # connections that negotiated mux
+            "tagged_ops": 0,     # tagged requests served
+            "inflight": 0,       # tagged control ops in the pool NOW
+            "peak_inflight": 0,
+            "ooo": 0,            # replies sent out of arrival order
+        }
+        self._mux_ctr_lock = make_lock("daemon._mux_ctr_lock")
         self.detector = (
             FailureDetector(
                 len(entries), rank,
@@ -419,6 +477,10 @@ class Daemon:
             except OSError:
                 printd("daemon %d: snapshot write failed", self.rank)
         self.peers.close()
+        with self._mux_pool_lock:
+            pool, self._mux_pool = self._mux_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         # Unregister fabrics LAST: the snapshot above reads the arena,
         # which an shm fabric backs. Idempotent (kill() may have run).
         for f in self.fabrics.values():
@@ -462,6 +524,10 @@ class Daemon:
             except OSError:
                 pass
         self.peers.close()
+        with self._mux_pool_lock:
+            pool, self._mux_pool = self._mux_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         # A killed daemon must not leak its segment name in /dev/shm:
         # unlink NOW (attached peers' mappings stay valid; only the name
         # dies — exactly a SIGKILL'd process whose parent reaps the
@@ -1133,22 +1199,58 @@ class Daemon:
         of a burst — it is applied but NOT answered; the first chunk
         without the bit closes the burst and gets ONE reply covering all
         of it (total bytes on success, the burst's first ERROR otherwise).
-        Replies stay FIFO per connection; there are simply fewer of them.
         Burst state is per-connection local, so concurrent stripes on
         sibling sockets never interact.
+
+        Mux serving (runtime/mux.py): a request carrying FLAG_MUX_TAG has
+        a u32 correlation id prefixed to its data tail (stripped FIRST,
+        before the trace prefix); its reply carries the same tag back.
+        Tagged CONTROL ops are handed to a shared worker pool and may
+        complete OUT OF ORDER — one tenant's slow REQ_ALLOC relay no
+        longer blocks every other tenant on the shared connection — while
+        DATA ops stay inline on this thread (the zero-copy recv-into-
+        arena landing and the burst state machine are serve-loop local).
+        A per-connection write lock keeps concurrently-sent reply frames
+        whole. Untagged traffic is served exactly as before: FIFO, one
+        reply per request, byte-identical to the pre-mux protocol.
         """
         # Reusable receive buffer: every inbound bulk payload (DATA_PUT
         # chunks) is fully consumed by its handler before the next recv —
-        # the RecvScratch contract.
+        # the RecvScratch contract. (Tagged control ops handed to the
+        # worker pool first detach their payload from the scratch.)
+        # Reads are buffered (one kernel recv per ~64 KiB of small
+        # frames, not 2-3 per frame) — the small-op serve path is
+        # syscall-bound without it; bulk payloads bypass the buffer and
+        # keep the recv-into-arena landing.
         scratch = RecvScratch()
+        rsock = BufferedSock(conn)
+        wlock = make_lock("daemon.conn_wlock")
+        cstate = _ConnMuxState()
         burst_nbytes = 0        # DATA_PUT_OK bytes accumulated this burst
         burst_err: Message | None = None  # first failure, reported once
         burst_open = False
         burst_t0 = 0.0
+        # Reply batching for pipelined tagged traffic: while MORE
+        # requests are already buffered (the client streamed a batch),
+        # small tagged replies accumulate here and flush as ONE vectored
+        # send when the inbound buffer drains — the server-side writev
+        # twin of the client's send coalescing. Untagged lockstep flows
+        # never batch (one request in hand at a time), so their reply
+        # timing is unchanged.
+        pending_out: list[bytes] = []
+
+        def flush_replies() -> None:
+            if pending_out:
+                with wlock:
+                    protocol_sendall_vec(conn, pending_out)
+                pending_out.clear()
+
         try:
             while self._running.is_set():
+                if pending_out and not rsock.buffered():
+                    flush_replies()
                 try:
-                    msg = recv_msg(conn, scratch,
+                    msg = recv_msg(rsock, scratch,
                                    data_router=self._route_put_payload)
                 except OcmProtocolError as e:
                     # Clean EOF between frames is normal disconnect; any
@@ -1164,6 +1266,17 @@ class Daemon:
                     # arrive — drop the connection mid-exchange so peers
                     # (and probes) see exactly a torn network.
                     return
+                # Mux correlation tag: stripped before anything else (it
+                # is the OUTERMOST data-tail prefix), remembered so the
+                # reply can echo it.
+                mux_tag = None
+                if msg.flags & FLAG_MUX_TAG:
+                    mux_tag, rest = split_tag(msg.data)
+                    if mux_tag is not None:
+                        msg.data = rest
+                        msg.flags &= ~FLAG_MUX_TAG
+                        with self._mux_ctr_lock:
+                            self._mux_counters["tagged_ops"] += 1
                 # Inbound trace context: a FLAG_TRACE_CTX request carries
                 # a 16-byte context prefix on its data tail. Strip it
                 # BEFORE any length-validating handler sees the payload,
@@ -1180,84 +1293,23 @@ class Daemon:
                     # A sender may not interleave other requests inside an
                     # unfinished burst — the reply stream would desync.
                     burst_nbytes, burst_err, burst_open = 0, None, False
-                    send_msg(conn, _err(
+                    self._send_reply(conn, wlock, _err(
                         ErrCode.BAD_MSG,
                         f"{msg.type.name} inside an open DATA_PUT burst",
-                    ))
+                    ), mux_tag)
                     continue
-                try:
-                    if is_put or msg.type == MsgType.DATA_GET:
-                        op = "dcn_put_srv" if is_put else "dcn_get_srv"
-                        with obs_trace.use_ctx(tctx), \
-                                self.tracer.span(op,
-                                                 nbytes=msg.fields["nbytes"]):
-                            reply = self._dispatch(msg)
-                    elif tctx is not None:
-                        # A traced control op gets a serve-side span so the
-                        # exported trace shows the daemon hop, not just the
-                        # client's view of the round-trip.
-                        with obs_trace.use_ctx(tctx), \
-                                self.tracer.span(
-                                    "srv_" + msg.type.name.lower()):
-                            reply = self._dispatch(msg)
-                    else:
-                        reply = self._dispatch(msg)
-                except OcmOutOfMemory as e:
-                    reply = _err(ErrCode.OOM, str(e))
-                except OcmQuotaExceeded as e:
-                    reply = _err(ErrCode.QUOTA_EXCEEDED, str(e))
-                except OcmAdmissionDenied as e:
-                    reply = _err(ErrCode.ADMISSION_DENIED, str(e))
-                except OcmBusy as e:
-                    # Retryable back-pressure: the server-suggested
-                    # backoff rides as a u32 (ms) data tail — invisible
-                    # to peers that don't know the code.
-                    reply = _err(ErrCode.BUSY, str(e),
-                                 struct.pack("<I", e.retry_after_ms))
-                except OcmReplicaUnavailable as e:
-                    reply = _err(ErrCode.REPLICA_UNAVAILABLE, str(e))
-                except OcmNotPrimary as e:
-                    reply = _err(ErrCode.NOT_PRIMARY, str(e))
-                except OcmMoved as e:
-                    # Live-migration redirect: the new owner rank rides
-                    # as an i64 data tail (invisible to old peers).
-                    reply = _err(ErrCode.MOVED, str(e),
-                                 struct.pack("<q", e.rank))
-                except OcmBoundsError as e:
-                    reply = _err(ErrCode.BOUNDS, str(e))
-                except OcmInvalidHandle as e:
-                    reply = _err(ErrCode.BAD_ALLOC_ID, str(e))
-                except OcmPlacementError as e:
-                    reply = _err(ErrCode.PLACEMENT, str(e))
-                except OcmRemoteError as e:
-                    # A relayed hop's typed rejection (REQ_ALLOC proxied
-                    # to rank 0, DO_FREE to an owner) keeps its code —
-                    # clients switch on it (BUSY backoff, failover
-                    # ladder), so flattening to UNKNOWN here would break
-                    # them one hop out. BUSY re-carries its backoff tail.
-                    code = (
-                        ErrCode(e.code)
-                        if e.code in ErrCode._value2member_map_
-                        else ErrCode.UNKNOWN
-                    )
-                    if code == ErrCode.BUSY:
-                        tail = struct.pack(
-                            "<I", getattr(e, "retry_after_ms", 0)
-                        )
-                    elif code == ErrCode.MOVED and hasattr(
-                        e, "moved_to_rank"
-                    ):
-                        # Relayed migration redirects keep their rank
-                        # tail — the redirect is useless without it.
-                        tail = struct.pack("<q", e.moved_to_rank)
-                    else:
-                        tail = b""
-                    reply = _err(code, e.detail, tail)
-                except OcmError as e:
-                    reply = _err(ErrCode.UNKNOWN, str(e))
-                except Exception as e:  # noqa: BLE001 — always answer with a
-                    # typed ERROR frame rather than killing the connection.
-                    reply = _err(ErrCode.UNKNOWN, f"{type(e).__name__}: {e}")
+                if (
+                    mux_tag is not None
+                    and not is_put
+                    and msg.type != MsgType.DATA_GET
+                ):
+                    # Out-of-order completion for tagged control ops.
+                    if self._serve_tagged_async(conn, wlock, msg, tctx,
+                                                mux_tag, cstate):
+                        continue
+                    # Pool unavailable (daemon stopping): fall through to
+                    # the inline path — still correct, just FIFO.
+                reply = self._dispatch_guarded(msg, tctx)
                 more = is_put and bool(msg.flags & FLAG_MORE)
                 if is_put and (more or burst_open):
                     if not burst_open:
@@ -1278,7 +1330,19 @@ class Daemon:
                             time.perf_counter() - burst_t0, coalesced=True,
                         )
                     burst_nbytes, burst_err, burst_open = 0, None, False
-                send_msg(conn, reply)
+                if (
+                    mux_tag is not None
+                    and rsock.buffered()
+                    and _data_len_of(reply.data) < 4096
+                ):
+                    pending_out.append(pack(attach_tag(
+                        Message(reply.type, reply.fields, reply.data,
+                                reply.flags),
+                        mux_tag,
+                    )))
+                    continue
+                flush_replies()
+                self._send_reply(conn, wlock, reply, mux_tag)
         except OSError:
             pass
         finally:
@@ -1288,6 +1352,156 @@ class Daemon:
                 conn.close()
             except OSError:
                 pass
+
+    def _dispatch_guarded(self, msg: Message, tctx) -> Message:
+        """Dispatch plus the typed-error mapping: every handler failure
+        becomes a typed ERROR frame (never a dropped connection). Shared
+        by the inline serve loop and the mux worker pool, so the two
+        completion paths cannot drift on error semantics."""
+        try:
+            if msg.type in (MsgType.DATA_PUT, MsgType.DATA_GET):
+                op = ("dcn_put_srv" if msg.type == MsgType.DATA_PUT
+                      else "dcn_get_srv")
+                with obs_trace.use_ctx(tctx), \
+                        self.tracer.span(op, nbytes=msg.fields["nbytes"]):
+                    return self._dispatch(msg)
+            elif tctx is not None:
+                # A traced control op gets a serve-side span so the
+                # exported trace shows the daemon hop, not just the
+                # client's view of the round-trip.
+                with obs_trace.use_ctx(tctx), \
+                        self.tracer.span("srv_" + msg.type.name.lower()):
+                    return self._dispatch(msg)
+            else:
+                return self._dispatch(msg)
+        except OcmOutOfMemory as e:
+            return _err(ErrCode.OOM, str(e))
+        except OcmQuotaExceeded as e:
+            return _err(ErrCode.QUOTA_EXCEEDED, str(e))
+        except OcmAdmissionDenied as e:
+            return _err(ErrCode.ADMISSION_DENIED, str(e))
+        except OcmBusy as e:
+            # Retryable back-pressure: the server-suggested backoff
+            # rides as a u32 (ms) data tail — invisible to peers that
+            # don't know the code.
+            return _err(ErrCode.BUSY, str(e),
+                        struct.pack("<I", e.retry_after_ms))
+        except OcmReplicaUnavailable as e:
+            return _err(ErrCode.REPLICA_UNAVAILABLE, str(e))
+        except OcmNotPrimary as e:
+            return _err(ErrCode.NOT_PRIMARY, str(e))
+        except OcmMoved as e:
+            # Live-migration redirect: the new owner rank rides as an
+            # i64 data tail (invisible to old peers).
+            return _err(ErrCode.MOVED, str(e), struct.pack("<q", e.rank))
+        except OcmBoundsError as e:
+            return _err(ErrCode.BOUNDS, str(e))
+        except OcmInvalidHandle as e:
+            return _err(ErrCode.BAD_ALLOC_ID, str(e))
+        except OcmPlacementError as e:
+            return _err(ErrCode.PLACEMENT, str(e))
+        except OcmRemoteError as e:
+            # A relayed hop's typed rejection (REQ_ALLOC proxied to the
+            # leader, DO_FREE to an owner) keeps its code — clients
+            # switch on it (BUSY backoff, failover ladder), so
+            # flattening to UNKNOWN here would break them one hop out.
+            # BUSY re-carries its backoff tail.
+            code = (
+                ErrCode(e.code)
+                if e.code in ErrCode._value2member_map_
+                else ErrCode.UNKNOWN
+            )
+            if code == ErrCode.BUSY:
+                tail = struct.pack("<I", getattr(e, "retry_after_ms", 0))
+            elif code == ErrCode.MOVED and hasattr(e, "moved_to_rank"):
+                # Relayed migration redirects keep their rank tail —
+                # the redirect is useless without it.
+                tail = struct.pack("<q", e.moved_to_rank)
+            else:
+                tail = b""
+            return _err(code, e.detail, tail)
+        except OcmError as e:
+            return _err(ErrCode.UNKNOWN, str(e))
+        except Exception as e:  # noqa: BLE001 — always answer with a
+            # typed ERROR frame rather than killing the connection.
+            return _err(ErrCode.UNKNOWN, f"{type(e).__name__}: {e}")
+
+    def _send_reply(self, conn: socket.socket, wlock, reply: Message,
+                    tag: int | None) -> None:
+        """One reply frame, tag echoed, whole under the connection's
+        write lock (the mux pool's out-of-order completions share the
+        socket with the serve loop)."""
+        if tag is not None:
+            reply = attach_tag(
+                Message(reply.type, reply.fields, reply.data, reply.flags),
+                tag,
+            )
+        with wlock:
+            send_msg(conn, reply)  # ocm-lint: allow[blocking-call-under-lock]
+            # — wlock is a leaf serializing exactly this socket's writes.
+
+    def _ensure_mux_pool(self):
+        with self._mux_pool_lock:
+            if self._mux_pool is None and self._running.is_set():
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._mux_pool = ThreadPoolExecutor(
+                    max_workers=_MUX_POOL_WORKERS,
+                    thread_name_prefix=f"d{self.rank}-mux",
+                )
+            return self._mux_pool
+
+    def _serve_tagged_async(self, conn, wlock, msg: Message, tctx,
+                            tag: int, cstate) -> bool:
+        """Queue one tagged control op on the mux worker pool. Returns
+        False when the pool cannot take it (daemon stopping) — the
+        caller serves inline instead."""
+        pool = self._ensure_mux_pool()
+        if pool is None:
+            return False
+        if not isinstance(msg.data, (bytes, bytearray)):
+            # Detach from the connection's RecvScratch: the serve loop
+            # recvs the NEXT frame while the worker still reads this one.
+            msg.data = bytes(msg.data)
+        seq = cstate.note_start()
+        with self._mux_ctr_lock:
+            self._mux_counters["inflight"] += 1
+            self._mux_counters["peak_inflight"] = max(
+                self._mux_counters["peak_inflight"],
+                self._mux_counters["inflight"],
+            )
+        try:
+            pool.submit(
+                self._serve_tagged, conn, wlock, msg, tctx, tag, cstate,
+                seq,
+            )
+        except RuntimeError:  # pool shut down between check and submit
+            cstate.note_done(seq)
+            with self._mux_ctr_lock:
+                self._mux_counters["inflight"] -= 1
+            return False
+        return True
+
+    def _serve_tagged(self, conn, wlock, msg: Message, tctx, tag: int,
+                      cstate, seq: int) -> None:
+        try:
+            reply = self._dispatch_guarded(msg, tctx)
+        finally:
+            ooo = cstate.note_done(seq)
+            with self._mux_ctr_lock:
+                self._mux_counters["inflight"] -= 1
+                if ooo:
+                    self._mux_counters["ooo"] += 1
+        try:
+            self._send_reply(conn, wlock, reply, tag)
+        except OSError:
+            pass  # connection died; the serve loop's own path closes it
+
+    def _mux_meta(self) -> dict:
+        """Mux serving counters for STATUS / STATUS_PROM / the obs
+        cluster table's in-flight column."""
+        with self._mux_ctr_lock:
+            return dict(self._mux_counters)
 
     def _reaper_loop(self) -> None:
         """Reclaim expired leases — the capability the reference left as a
@@ -1655,6 +1869,9 @@ class Daemon:
         # Capability negotiation: grant exactly the offered bits we
         # implement. Peers that never offer (old clients, the C++ daemon's
         # own dials) get flags=0 and the lockstep protocol unchanged.
+        # FLAG_CAP_MUX (tagged request multiplexing) is granted unless
+        # OCM_MUX_SERVE=0 pins this daemon to the un-upgraded behavior
+        # (the interop tests' decline-by-silence lever).
         reply = Message(
             MsgType.CONNECT_CONFIRM,
             {
@@ -1664,8 +1881,12 @@ class Daemon:
             },
             flags=msg.flags
             & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
-               | FLAG_CAP_QOS),
+               | FLAG_CAP_QOS
+               | (FLAG_CAP_MUX if self.config.mux_serve else 0)),
         )
+        if reply.flags & FLAG_CAP_MUX:
+            with self._mux_ctr_lock:
+                self._mux_counters["conns"] += 1
         # Fabric negotiation (fabric/): an offered FLAG_CAP_FABRIC is
         # granted only when this daemon actually registered a fabric —
         # the grant carries the descriptor tail the client needs to
@@ -1901,6 +2122,7 @@ class Daemon:
         alloc_id = self.registry.next_id()
         barred: set[int] = set()
         last: BaseException | None = None
+        busy_hint = -1  # max retry hint seen; >= 0 once any rank was BUSY
         live = self._hash_live_ranks()
         for _ in range(max(1, len(live))):
             cands = [r for r in live if r not in barred]
@@ -1913,8 +2135,16 @@ class Daemon:
                     f["orig_rank"], f["pid"], prio,
                 )
             except (OSError, OcmError) as e:
-                # Primary unreachable: bar it and re-plan — the detector
-                # will verdict it; placement must not wait for that.
+                # Primary unreachable OR past its watermark (typed BUSY
+                # from the owner-side check, _on_do_replica): bar it and
+                # re-plan over the rest — the leader path's "place on
+                # the least-loaded rank below high" becomes "spill to a
+                # rank that still admits". Only when EVERY candidate is
+                # busy does the origin surface BUSY (below), with the
+                # largest suggested backoff seen.
+                hint = _busy_hint_of(e)
+                if hint is not None:
+                    busy_hint = max(busy_hint, hint)
                 barred.add(chain[0])
                 last = e
                 continue
@@ -1944,6 +2174,24 @@ class Daemon:
                     "owner_port": owner.port,
                 },
                 tail,
+            )
+        if busy_hint >= 0:
+            # Hash-mode back-pressure (ROADMAP item 2 remaining): every
+            # live rank is past the high watermark — the retryable BUSY
+            # the leader path would have raised, now enforced at the
+            # origin from the owners' own arena accounting. The reaper's
+            # pressure eviction is busy making room; clients absorb this
+            # with the standard jittered backoff.
+            self.qos.note_busy()
+            obs_journal.record(
+                "backpressure_busy", track=self.tracer.track,
+                nbytes=nbytes, pid=f["pid"], orig_rank=f["orig_rank"],
+                origin="hash",
+            )
+            raise OcmBusy(
+                f"every live rank past the high watermark "
+                f"({self.config.arena_high_pct}%): retry later",
+                retry_after_ms=busy_hint or self.config.busy_backoff_ms,
             )
         raise OcmPlacementError(
             f"hash placement found no reachable primary among "
@@ -2203,6 +2451,14 @@ class Daemon:
         prio = PRIO_NORMAL
         if msg.flags & FLAG_QOS_TAIL and len(msg.data) >= 1:
             prio = min(max(bytes(msg.data[:1])[0], PRIO_LOW), PRIO_HIGH)
+        # Hash-mode back-pressure: with OCM_PLACEMENT=hash there is no
+        # leader on the alloc path to run the watermark check, so the
+        # OWNER enforces it on every fresh provision from its own arena
+        # book — the one ledger that is exactly synced by construction.
+        # High-priority traffic bypasses, as on the leader path; the
+        # origin (_hash_alloc) spills to another rank or surfaces BUSY.
+        if self.config.placement == "hash" and prio < PRIO_HIGH:
+            self._check_arena_watermark(f["nbytes"])
         extent = self.host_arena.alloc(f["nbytes"])
         self.registry.insert(
             RegEntry(
@@ -2240,6 +2496,25 @@ class Daemon:
             priority=prio,
         )
         return Message(MsgType.DO_ALLOC_OK, {"alloc_id": alloc_id, "offset": offset})
+
+    def _check_arena_watermark(self, nbytes: int) -> None:
+        """Owner-side BUSY watermark (hash placement): refuse a fresh
+        host-kind provision once this arena crossed the high watermark,
+        with the same suggested-backoff tail the leader path ships. The
+        reaper's pressure eviction brings occupancy back below low."""
+        cap = self.config.host_arena_bytes
+        if cap <= 0:
+            return
+        high = self.config.arena_high_pct / 100.0
+        occ = self.host_arena.allocator.bytes_live / cap
+        if occ >= high:
+            raise OcmBusy(
+                f"rank {self.rank} host arena at {occ:.0%} (high "
+                f"watermark {self.config.arena_high_pct}%): retry later",
+                retry_after_ms=suggest_backoff_ms(
+                    occ, high, self.config.busy_backoff_ms
+                ),
+            )
 
     def _do_alloc_local(
         self, kind: OcmKind, device_index: int, nbytes: int, orig_rank: int,
@@ -3752,6 +4027,7 @@ class Daemon:
             "qos": self._qos_meta(),
             "fabric": self._fabric_meta(),
             "elastic": self._elastic_meta(),
+            "mux": self._mux_meta(),
             # Arena capacities (control/): what a promoted leader's
             # whole-resync reads to rebuild placement accounting from
             # the survivors' own numbers.
@@ -3835,6 +4111,7 @@ class Daemon:
             "qos": self._qos_meta(),
             "fabric": self._fabric_meta(),
             "elastic": self._elastic_meta(),
+            "mux": self._mux_meta(),
         }
 
     def _on_status_prom(self, msg: Message) -> Message:
@@ -3856,6 +4133,17 @@ class Daemon:
 
 def _err(code: ErrCode, detail: str, data: bytes = b"") -> Message:
     return Message(MsgType.ERROR, {"code": int(code), "detail": detail}, data)
+
+
+def _busy_hint_of(e: BaseException) -> int | None:
+    """The retry hint of a BUSY-shaped error (a local OcmBusy from this
+    process's own provisioning leg, or the typed wire rejection from a
+    peer owner), else None."""
+    if isinstance(e, OcmBusy):
+        return e.retry_after_ms
+    if isinstance(e, OcmRemoteError) and e.code == int(ErrCode.BUSY):
+        return getattr(e, "retry_after_ms", 0)
+    return None
 
 
 def _priority_tail(priority: int) -> tuple[int, bytes]:
@@ -3941,33 +4229,46 @@ def main(argv=None) -> int:
 _FLAGS_HANDLED = {
     # FLAG_CAP_QOS / FLAG_QOS_TAIL: QoS profile declaration parsed in
     # _on_connect; priority tails parsed in _place_alloc / _on_do_alloc /
-    # _on_do_replica (qos/).
+    # _on_do_replica (qos/). FLAG_CAP_MUX: granted in _on_connect (gated
+    # on config.mux_serve). FLAG_MUX_TAG: the u32 correlation id is
+    # stripped GENERICALLY in _serve_conn (before the trace prefix) and
+    # echoed on the reply — the same generic-strip discipline as
+    # FLAG_TRACE_CTX, so it appears on every client-facing request type.
     MsgType.CONNECT: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
         | FLAG_CAP_QOS | FLAG_QOS_TAIL | FLAG_CAP_FABRIC
+        | FLAG_CAP_MUX | FLAG_MUX_TAG
     ),
     # FLAG_FANOUT: replica-chain role discipline in _check_data_role /
     # _route_put_payload (fan-out legs land, clients need primary role).
-    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT,
-    MsgType.DATA_GET: FLAG_TRACE_CTX,
+    MsgType.DATA_PUT: (
+        FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT | FLAG_MUX_TAG
+    ),
+    MsgType.DATA_GET: FLAG_TRACE_CTX | FLAG_MUX_TAG,
     # FLAG_REPLICAS: the data tail's u8 copy count, read in _place_alloc.
-    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL,
+    MsgType.REQ_ALLOC: (
+        FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL | FLAG_MUX_TAG
+    ),
     MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
     MsgType.DO_REPLICA: FLAG_QOS_TAIL,
     # FLAG_QOS_TAIL: the migrated copy inherits the allocation's QoS
     # class — parsed in _on_migrate_begin (elastic/).
     MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL,
-    MsgType.REQ_FREE: FLAG_TRACE_CTX,
+    MsgType.REQ_FREE: FLAG_TRACE_CTX | FLAG_MUX_TAG,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
     MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
     MsgType.NOTE_FREE: FLAG_TRACE_CTX,
     # FLAG_HB_FWD: a tombstone-forwarded beat is renewed but never
     # re-relayed (elastic/; the loop-prevention contract).
-    MsgType.HEARTBEAT: FLAG_TRACE_CTX | FLAG_HB_FWD,
-    MsgType.STATUS: FLAG_TRACE_CTX,
-    MsgType.STATUS_PROM: FLAG_TRACE_CTX,
-    MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
+    MsgType.HEARTBEAT: FLAG_TRACE_CTX | FLAG_HB_FWD | FLAG_MUX_TAG,
+    MsgType.STATUS: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.STATUS_PROM: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.STATUS_EVENTS: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    # Over a mux channel DISCONNECT/REQ_LOCATE are awaited tagged
+    # requests (generic tag strip + echo, handlers unchanged).
+    MsgType.DISCONNECT: FLAG_MUX_TAG,
+    MsgType.REQ_LOCATE: FLAG_MUX_TAG,
     # shm fabric control legs (fabric/): validated in _shm_entry; the
     # FLAG_CAP_FABRIC offer itself is handled in _on_connect (echo +
     # descriptor tail).

@@ -1,0 +1,1420 @@
+"""Async multiplexed client runtime — one connection per peer, tagged
+request pipelining, thousands of tenants per process.
+
+The reference OncillaMem library is a synchronous per-request client
+(``send_recv_msg``, /root/reference/src/mem.c:63-88); our client
+inherited that shape and pays one socket per (tenant × stripe) plus a
+full lockstep round trip per small op. This module rebuilds the client
+data plane on an asyncio core:
+
+- **MuxChannel** — ONE connection to one peer daemon. At CONNECT it
+  offers ``FLAG_CAP_MUX``; once granted, every request carries a u32
+  correlation id (``FLAG_MUX_TAG``, the first 4 bytes of the data tail,
+  outside any trace prefix) and a response demultiplexer matches
+  replies to waiters regardless of completion order — the daemon may
+  finish control ops out of order. Un-upgraded peers (old Python
+  daemons, the native C++ daemon) decline by silence and are served
+  LOCKSTEP over the same single connection: one request in flight,
+  plain frames, wire-identical to the pre-mux protocol.
+- **small-op batching** — senders enqueue packed frames; a single writer
+  task drains the queue with one ``writelines`` per wakeup, so adjacent
+  control ops from different tenants coalesce into one syscall (the
+  writev discipline).
+- **per-peer in-flight window** — an asyncio semaphore
+  (``OCM_MUX_WINDOW``) bounds outstanding tagged requests, exactly as
+  ``inflight_ops`` bounds a pipelined transfer.
+- **MuxRuntime** — the sync facade: a background thread runs the event
+  loop; ``ControlPlaneClient`` (and with it the unchanged sync ``Ocm``)
+  drives the same channels via ``run_coroutine_threadsafe``, and tenant
+  heartbeats become loop-scheduled tasks instead of one thread each.
+- **AsyncOcm** — the ``async``/``await`` public API (alloc / put / get /
+  free / status) on the caller's own event loop.
+
+Large transfers ride the channel too: a coalesced ``FLAG_MORE`` burst is
+enqueued as ONE atomic batch (no foreign frame can interleave inside an
+open burst), tagged only on its closing chunk; gets issue windowed
+tagged chunks whose replies land by tag into disjoint views of the
+destination. Failover keeps the established ladder semantics: transport
+errors and retryable typed rejections (STALE_EPOCH / NOT_PRIMARY /
+MOVED / REPLICA_UNAVAILABLE) surface as the same exception types the
+sync engine's ladder already climbs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+
+from oncilla_tpu.analysis import alloctrace
+from oncilla_tpu.analysis.lockwatch import make_lock
+from oncilla_tpu.core.arena import Extent
+from oncilla_tpu.core.errors import (
+    OcmConnectError,
+    OcmError,
+    OcmProtocolError,
+    OcmRemoteError,
+)
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.kinds import Fabric, OcmKind
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.obs import trace as obs_trace
+from oncilla_tpu.runtime import pool as peer_pool
+from oncilla_tpu.runtime.protocol import (
+    FLAG_CAP_COALESCE,
+    FLAG_CAP_MUX,
+    FLAG_CAP_QOS,
+    FLAG_CAP_REPLICA,
+    FLAG_CAP_TRACE,
+    FLAG_MORE,
+    FLAG_MUX_TAG,
+    FLAG_QOS_TAIL,
+    FLAG_REPLICAS,
+    FLAG_TRACE_CTX,
+    HEADER,
+    MAGIC,
+    MAX_PAYLOAD,
+    VALID_FLAGS,
+    VERSION,
+    WIRE_KIND,
+    WIRE_KIND_INV,
+    ErrCode,
+    Message,
+    MsgType,
+    _data_parts,
+    _pack_prefix,
+    attach_tag,
+    remote_error,
+    split_tag,
+    unpack,
+)
+from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
+
+Addr = tuple[str, int]
+
+# Capability bits a tenant-level CONNECT may carry back (the same mask
+# the blocking client stores as _ctrl_caps).
+TENANT_CAPS = FLAG_CAP_TRACE | FLAG_CAP_REPLICA | FLAG_CAP_QOS
+
+
+def _chaos_gate(addr: Addr) -> None:
+    """The pool's chaos seam, honored at channel dials and data-plane
+    transfers (the pool-lease analogues — ctrl ops and heartbeats never
+    leased either) so the deterministic fault injector (drop / partition
+    / scheduled kill at a logical op index) keeps working when the mux
+    path bypasses PeerPool.lease entirely."""
+    hook = peer_pool.current_chaos_hook()
+    if hook is not None:
+        try:
+            hook(addr[0], addr[1])
+        except OSError as e:
+            raise OcmConnectError(
+                f"peer {addr[0]}:{addr[1]} unreachable: {e}"
+            ) from e
+
+
+def _frame_parts(msg: Message) -> list:
+    """Packed frame as a scatter-gather part list (prefix + data parts):
+    bulk payloads stay views of the caller's buffer all the way into the
+    transport (the sender awaits the reply, so the buffer outlives the
+    write)."""
+    return [_pack_prefix(msg), *(p for p in _data_parts(msg.data)
+                                 if len(p))]
+
+
+class _MuxProtocol(asyncio.Protocol):
+    """Transport glue for one MuxChannel: an incremental frame parser in
+    ``data_received`` (no stream-reader task, no readexactly wakeups —
+    every complete frame demuxes synchronously in the receive callback)
+    and write-side flow-control callbacks. The channel owns all state;
+    this class is deliberately dumb."""
+
+    def __init__(self, ch: "MuxChannel") -> None:
+        self.ch = ch
+        self._buf = bytearray()
+
+    def connection_made(self, transport) -> None:
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _s
+
+            try:
+                sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+                for opt in (_s.SO_SNDBUF, _s.SO_RCVBUF):
+                    sock.setsockopt(_s.SOL_SOCKET, opt, 4 << 20)
+            except OSError:
+                pass
+
+    def data_received(self, data: bytes) -> None:
+        buf = self._buf
+        buf += data
+        pos = 0
+        end = len(buf)
+        hsize = HEADER.size
+        try:
+            while end - pos >= hsize:
+                magic, version, _mt, _fl, plen = HEADER.unpack_from(buf, pos)
+                if magic != MAGIC or version != VERSION:
+                    raise OcmProtocolError(
+                        f"bad frame header {bytes(buf[pos:pos + hsize])!r}"
+                    )
+                if plen > MAX_PAYLOAD:
+                    raise OcmProtocolError(
+                        f"advertised payload {plen} exceeds cap"
+                    )
+                if end - pos - hsize < plen:
+                    break
+                msg = unpack(
+                    bytes(buf[pos:pos + hsize]),
+                    bytes(buf[pos + hsize:pos + hsize + plen]),
+                )
+                pos += hsize + plen
+                self.ch._on_frame(msg)
+        except OcmError as e:
+            self.ch._fail(e)
+            return
+        if pos:
+            del buf[:pos]
+
+    def pause_writing(self) -> None:
+        self.ch._write_paused = True
+
+    def resume_writing(self) -> None:
+        self.ch._write_paused = False
+        waiter = self.ch._drain_waiter
+        if waiter is not None and not waiter.done():
+            waiter.set_result(None)
+
+    def connection_lost(self, exc) -> None:
+        self.ch._fail(exc or OcmConnectError("peer closed"))
+
+
+class MuxChannel:
+    """One multiplexed connection to one peer daemon. Loop-confined: all
+    methods run on the event loop that opened it."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, addr: Addr,
+                 config) -> None:
+        self.addr = addr
+        self.config = config
+        self._loop = loop
+        self._transport = None
+        self.caps = 0
+        self.peer_rank: int | None = None
+        self._tag = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        # Tags whose waiter gave up (cancelled heartbeat task, timed-out
+        # sync bridge) before the reply arrived: the demux must DISCARD
+        # the orphan reply once instead of treating it as unmatched —
+        # which would tear the shared channel down for every tenant.
+        self._orphans: set[int] = set()
+        # In-flight window as a raw credit counter: an asyncio.Semaphore
+        # costs a few µs per acquire/release even uncontended, and this
+        # sits on every tagged request. Waiters queue only at saturation.
+        self._credits = config.mux_window
+        self._credit_waiters: list[asyncio.Future] = []
+        self._lockstep_mu = asyncio.Lock()
+        # Batched sends: frames enqueue here; one call_soon-scheduled
+        # flush per loop beat hands the whole batch to the transport in
+        # one writelines — the writev discipline, with zero writer task.
+        self._sendq: list = []
+        self._write_paused = False
+        self._drain_waiter: asyncio.Future | None = None
+        # Lockstep mode (peer declined mux): the single outstanding
+        # reply's future — _on_frame resolves it instead of demuxing.
+        self._ls_waiter: asyncio.Future | None = None
+        self._dead: BaseException | None = None
+        self.counters = {
+            "ops": 0, "batches": 0, "frames": 0,
+            "inflight": 0, "peak_inflight": 0, "lockstep": 0,
+        }
+
+    # -- lifecycle -------------------------------------------------------
+
+    @classmethod
+    async def open(cls, loop, addr: Addr, config, pid: int,
+                   rank: int) -> "MuxChannel":
+        ch = cls(loop, addr, config)
+        _chaos_gate(addr)
+        try:
+            transport, _proto = await loop.create_connection(
+                lambda: _MuxProtocol(ch), addr[0], addr[1]
+            )
+        except OSError as e:
+            raise OcmConnectError(
+                f"peer {addr[0]}:{addr[1]} unreachable: {e}"
+            ) from e
+        ch._transport = transport
+        # Capability probe: one lockstep CONNECT offering mux (plus the
+        # data-plane capabilities the channel itself exercises). The
+        # reply's echoed bits are what the peer serves; flags=0 (old
+        # Python daemon, native C++ daemon) declines by silence and the
+        # channel runs lockstep.
+        offer = FLAG_CAP_MUX | (
+            FLAG_CAP_COALESCE if config.dcn_coalesce else 0
+        ) | (FLAG_CAP_TRACE if config.trace else 0)
+        try:
+            reply = await ch._request_lockstep(Message(
+                MsgType.CONNECT, {"pid": pid, "rank": rank}, flags=offer,
+            ), raw=True)
+        except OcmConnectError:
+            ch.close()
+            raise
+        if reply.type != MsgType.CONNECT_CONFIRM:
+            ch.close()
+            raise OcmConnectError(
+                f"bad mux probe reply {reply.type.name}"
+            )
+        ch.caps = reply.flags & offer
+        ch.peer_rank = reply.fields["rank"]
+        if not ch.muxed:
+            ch.counters["lockstep"] = 1
+            obs_journal.record(
+                "mux_declined", host=addr[0], port=addr[1],
+            )
+        return ch
+
+    @property
+    def alive(self) -> bool:
+        return self._dead is None
+
+    @property
+    def muxed(self) -> bool:
+        return bool(self.caps & FLAG_CAP_MUX)
+
+    def _fail(self, exc: BaseException) -> None:
+        if self._dead is not None:
+            return
+        self._dead = exc
+        err = OcmConnectError(
+            f"mux channel to {self.addr[0]}:{self.addr[1]} failed: {exc}"
+        )
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+        self._orphans.clear()
+        if self._ls_waiter is not None and not self._ls_waiter.done():
+            self._ls_waiter.set_exception(err)
+        if self._drain_waiter is not None and not self._drain_waiter.done():
+            self._drain_waiter.set_result(None)
+        self._sendq.clear()
+        if self._transport is not None:
+            try:
+                self._transport.close()
+            except (OSError, RuntimeError):
+                pass
+
+    def close(self) -> None:
+        self._fail(OcmConnectError("mux channel closed"))
+
+    # -- frame demux (runs inside data_received) -------------------------
+
+    def _on_frame(self, msg: Message) -> None:
+        if msg.flags & FLAG_MUX_TAG:
+            tag, rest = split_tag(msg.data)
+            msg.data = rest
+            msg.flags &= ~FLAG_MUX_TAG
+        else:
+            tag = None
+        if tag is None:
+            # Untagged reply: legal only as the single outstanding
+            # lockstep exchange (the probe, or a declined peer's serve).
+            waiter = self._ls_waiter
+            if waiter is None or waiter.done():
+                self._fail(OcmProtocolError(
+                    f"mux demux: unsolicited untagged {msg.type.name}"
+                ))
+                return
+            waiter.set_result(msg)
+            return
+        fut = self._pending.pop(tag, None)
+        if fut is None:
+            if tag in self._orphans:
+                self._orphans.discard(tag)
+                return  # abandoned waiter's late reply
+            self._fail(OcmProtocolError(
+                f"mux demux: unmatched reply {msg.type.name} (tag {tag})"
+            ))
+            return
+        if not fut.done():
+            fut.set_result(msg)
+
+    # -- batched sends ----------------------------------------------------
+
+    def _enqueue(self, parts: list) -> None:
+        if not self._sendq:
+            self._loop.call_soon(self._flush)
+        self._sendq.append(parts)
+
+    def _flush(self) -> None:
+        batch, self._sendq = self._sendq, []
+        if not batch or self._dead is not None:
+            return
+        out: list = []
+        for parts in batch:
+            out.extend(parts)
+        try:
+            self._transport.writelines(out)
+        except (OSError, RuntimeError) as e:
+            self._fail(e)
+            return
+        self.counters["batches"] += 1
+        self.counters["frames"] += len(batch)
+
+    async def _drained(self) -> None:
+        """Await write-side flow control (after enqueueing a large
+        burst): resume_writing releases the waiter."""
+        while self._write_paused and self._dead is None:
+            if self._drain_waiter is None or self._drain_waiter.done():
+                self._drain_waiter = self._loop.create_future()
+            await self._drain_waiter
+
+    # -- tagged request/reply --------------------------------------------
+
+    async def _take_credit(self) -> None:
+        while self._credits <= 0:
+            fut = self._loop.create_future()
+            self._credit_waiters.append(fut)
+            await fut
+        self._credits -= 1
+
+    def _give_credit(self) -> None:
+        self._credits += 1
+        while self._credit_waiters:
+            fut = self._credit_waiters.pop()
+            if not fut.done():
+                fut.set_result(None)
+                break
+
+    def _next_tag(self) -> int:
+        while True:
+            self._tag = (self._tag + 1) & 0xFFFFFFFF
+            if (
+                self._tag
+                and self._tag not in self._pending
+                and self._tag not in self._orphans
+            ):
+                return self._tag
+
+    def _trace_wrap(self, msg: Message, tctx) -> Message:
+        """Attach the trace context to a shallow copy when the peer
+        granted FLAG_CAP_TRACE and the type is traceable."""
+        if (
+            tctx is not None
+            and self.caps & FLAG_CAP_TRACE
+            and VALID_FLAGS.get(msg.type, 0) & FLAG_TRACE_CTX
+        ):
+            return obs_trace.attach(
+                Message(msg.type, msg.fields, msg.data, msg.flags),
+                tctx, FLAG_TRACE_CTX,
+            )
+        return msg
+
+    async def request(self, msg: Message, tctx=None,
+                      owned: bool = False) -> Message:
+        """One round trip. Muxed: tagged, pipelined, window-bounded, and
+        completion-order independent. Lockstep (peer declined): plain
+        frames, one at a time — the pre-mux protocol byte-for-byte.
+
+        ``owned=True`` promises ``msg`` was built for this one call and
+        may be tagged in place (the data-plane hot path skips a Message
+        copy per op); callers that may retry the same object leave it
+        False."""
+        if self._dead is not None:
+            raise OcmConnectError(
+                f"mux channel to {self.addr[0]}:{self.addr[1]} is down: "
+                f"{self._dead}"
+            )
+        msg = self._trace_wrap(msg, tctx)
+        if not self.muxed:
+            return await self._request_lockstep(msg)
+        await self._take_credit()
+        tag = self._next_tag()
+        fut = self._loop.create_future()
+        self._pending[tag] = fut
+        # Tag a shallow copy unless owned: callers may retry the
+        # same Message via the failover ladder and must not
+        # accumulate stale tags.
+        tagged = attach_tag(
+            msg if owned else
+            Message(msg.type, msg.fields, msg.data, msg.flags), tag
+        )
+        c = self.counters
+        c["ops"] += 1
+        c["inflight"] += 1
+        if c["inflight"] > c["peak_inflight"]:
+            c["peak_inflight"] = c["inflight"]
+        try:
+            self._enqueue(_frame_parts(tagged))
+            reply = await fut
+        finally:
+            self._reap(tag)
+            c["inflight"] -= 1
+            self._give_credit()
+        if reply.type == MsgType.ERROR:
+            raise remote_error(reply)
+        return reply
+
+    def _reap(self, tag: int) -> None:
+        """End a tagged exchange. If the reply never arrived (the waiter
+        was cancelled or timed out) the tag becomes an orphan the demux
+        discards on arrival, keeping the channel in sync for everyone
+        else."""
+        if self._pending.pop(tag, None) is not None and self.alive:
+            self._orphans.add(tag)
+
+    async def _request_lockstep(self, msg: Message,
+                                raw: bool = False) -> Message:
+        """One request, one reply, nothing else in flight — the pre-mux
+        protocol against a declining peer (and the CONNECT probe itself,
+        ``raw=True``: the reply is returned even when it is an ERROR)."""
+        async with self._lockstep_mu:
+            if self._dead is not None:
+                raise OcmConnectError(
+                    f"mux channel to {self.addr[0]}:{self.addr[1]} is "
+                    f"down: {self._dead}"
+                )
+            self.counters["ops"] += 1
+            waiter = self._ls_waiter = self._loop.create_future()
+            try:
+                self._enqueue(_frame_parts(msg))
+                reply = await waiter
+            finally:
+                self._ls_waiter = None
+        if not raw and reply.type == MsgType.ERROR:
+            raise remote_error(reply)
+        return reply
+
+    # -- data plane ------------------------------------------------------
+
+    async def put_range(self, handle: OcmAlloc, mv, start: int,
+                        length: int, offset: int, tctx=None) -> dict:
+        """Write [start, start+length) of ``mv`` at handle-relative
+        ``offset+start``. Absolute offsets per chunk, so a failed range
+        is idempotently re-runnable by the caller's ladder."""
+        _chaos_gate(self.addr)  # data-plane parity with PeerPool.lease
+        chunk = self.config.chunk_bytes
+        base = offset + start
+        end = start + length
+        if length <= chunk and self.muxed:
+            # Single-chunk fast path — the small-op hot loop: one tagged
+            # request, no burst machinery, no per-chunk closures.
+            r = await self.request(Message(
+                MsgType.DATA_PUT,
+                {"alloc_id": handle.alloc_id, "offset": base,
+                 "nbytes": length},
+                mv[start:end],
+            ), tctx, owned=True)
+            if r.type != MsgType.DATA_PUT_OK or r.fields["nbytes"] != length:
+                raise OcmProtocolError(
+                    f"mux put ack mismatch: {r.type.name} "
+                    f"{r.fields.get('nbytes')} != {length}"
+                )
+            return {"window": self.config.mux_window, "chunk": chunk,
+                    "coalesced": False}
+        coalesced = (
+            self.muxed
+            and bool(self.caps & FLAG_CAP_COALESCE)
+            and length > chunk
+        )
+        if coalesced:
+            await self._put_burst(handle, mv, start, end, base, chunk, tctx)
+        else:
+            # Windowed tagged chunks when muxed (independent requests,
+            # replies matched by tag — no FIFO assumption), sequential
+            # lockstep chunks against a declining peer.
+            async def one(pos: int, n: int) -> None:
+                m = Message(
+                    MsgType.DATA_PUT,
+                    {"alloc_id": handle.alloc_id,
+                     "offset": base + (pos - start), "nbytes": n},
+                    mv[pos:pos + n],
+                )
+                if self.muxed:
+                    r = await self.request(m, tctx, owned=True)
+                else:
+                    r = await self._request_lockstep(
+                        self._trace_wrap(m, tctx)
+                    )
+                if (
+                    r.type != MsgType.DATA_PUT_OK
+                    or r.fields["nbytes"] != n
+                ):
+                    raise OcmProtocolError(
+                        f"mux put ack mismatch: {r.type.name} "
+                        f"{r.fields.get('nbytes')} != {n}"
+                    )
+
+            await self._chunked(one, start, end, chunk)
+        return {"window": self.config.mux_window, "chunk": chunk,
+                "coalesced": coalesced}
+
+    async def _put_burst(self, handle: OcmAlloc, mv, start: int, end: int,
+                         base: int, chunk: int, tctx=None) -> None:
+        """Coalesced FLAG_MORE burst as ONE atomic send-queue item: the
+        whole burst's frames are enqueued in one synchronous step, so no
+        other sender's frame can interleave inside the open burst (the
+        daemon answers BAD_MSG to foreign frames mid-burst) — and the
+        daemon replies ONCE, at the tagged closing chunk."""
+        await self._take_credit()
+        tag = self._next_tag()
+        fut = self._loop.create_future()
+        self._pending[tag] = fut
+        parts: list = []
+        pos = start
+        while pos < end:
+            n = min(chunk, end - pos)
+            last = pos + n >= end
+            m = Message(
+                MsgType.DATA_PUT,
+                {"alloc_id": handle.alloc_id,
+                 "offset": base + (pos - start), "nbytes": n},
+                mv[pos:pos + n],
+                flags=0 if last else FLAG_MORE,
+            )
+            if last:
+                m = self._trace_wrap(m, tctx)
+                attach_tag(m, tag)
+            parts.extend(_frame_parts(m))
+            pos += n
+        self.counters["ops"] += 1
+        self.counters["inflight"] += 1
+        self.counters["peak_inflight"] = max(
+            self.counters["peak_inflight"], self.counters["inflight"]
+        )
+        try:
+            self._enqueue(parts)
+            await self._drained()  # flow control: bound the burst's
+            # footprint in the transport buffer before awaiting
+            reply = await fut
+        finally:
+            self._reap(tag)
+            self.counters["inflight"] -= 1
+            self._give_credit()
+        if reply.type == MsgType.ERROR:
+            raise remote_error(reply)
+        if (
+            reply.type != MsgType.DATA_PUT_OK
+            or reply.fields["nbytes"] != end - start
+        ):
+            raise OcmProtocolError(
+                f"mux burst ack mismatch: {reply.type.name} "
+                f"{reply.fields.get('nbytes')} != {end - start}"
+            )
+
+    async def get_range(self, handle: OcmAlloc, out_mv, start: int,
+                        length: int, offset: int, tctx=None) -> dict:
+        """Read [start, start+length) into the matching view of
+        ``out_mv``. Muxed gets pipeline chunked tagged requests; each
+        reply lands by tag into its disjoint destination slice."""
+        _chaos_gate(self.addr)  # data-plane parity with PeerPool.lease
+        chunk = self.config.chunk_bytes
+        base = offset + start
+        end = start + length
+        if length <= chunk and self.muxed:
+            # Single-chunk fast path (see put_range).
+            r = await self.request(Message(
+                MsgType.DATA_GET,
+                {"alloc_id": handle.alloc_id, "offset": base,
+                 "nbytes": length},
+            ), tctx, owned=True)
+            if len(r.data) != length:
+                raise OcmProtocolError(
+                    f"mux get reply length {len(r.data)} != {length}"
+                )
+            out_mv[start:end] = r.data
+            return {"window": self.config.mux_window, "chunk": chunk,
+                    "coalesced": False}
+
+        async def one(pos: int, n: int) -> None:
+            m = Message(
+                MsgType.DATA_GET,
+                {"alloc_id": handle.alloc_id,
+                 "offset": base + (pos - start), "nbytes": n},
+            )
+            if self.muxed:
+                r = await self.request(m, tctx, owned=True)
+            else:
+                r = await self._request_lockstep(self._trace_wrap(m, tctx))
+            if len(r.data) != n:
+                raise OcmProtocolError(
+                    f"mux get reply length {len(r.data)} != {n}"
+                )
+            out_mv[pos:pos + n] = r.data
+
+        await self._chunked(one, start, end, chunk)
+        return {"window": self.config.mux_window, "chunk": chunk,
+                "coalesced": False}
+
+    async def _chunked(self, one, start: int, end: int,
+                       chunk: int) -> None:
+        """Run ``one(pos, n)`` over every chunk of [start, end):
+        concurrently (window-bounded by request()) when muxed, strictly
+        sequentially against a lockstep peer."""
+        if end - start <= chunk:
+            # Single-chunk fast path: no gather, no Task per op — the
+            # small-op hot loop is exactly this branch.
+            await one(start, end - start)
+            return
+        if self.muxed:
+            waits = []
+            pos = start
+            while pos < end:
+                n = min(chunk, end - pos)
+                waits.append(one(pos, n))
+                pos += n
+            await asyncio.gather(*waits)
+        else:
+            pos = start
+            while pos < end:
+                n = min(chunk, end - pos)
+                await one(pos, n)
+                pos += n
+
+
+class ChannelMap:
+    """Lazy per-address channel registry, loop-confined. Shared by the
+    background-thread runtime (sync facade) and AsyncOcm (caller loop).
+    A dead channel is replaced on the next request; concurrent opens to
+    one address are deduplicated so two racing tenants share one dial."""
+
+    def __init__(self, loop, config, pid: int | None = None) -> None:
+        self._loop = loop
+        self.config = config
+        self.pid = os.getpid() if pid is None else pid
+        self._channels: dict[Addr, MuxChannel] = {}
+        self._opening: dict[Addr, asyncio.Task] = {}
+
+    async def channel(self, addr: Addr, rank: int = -1) -> MuxChannel:
+        addr = (addr[0], addr[1])
+        ch = self._channels.get(addr)
+        if ch is not None and ch.alive:
+            return ch
+        task = self._opening.get(addr)
+        if task is None:
+            task = self._loop.create_task(
+                MuxChannel.open(self._loop, addr, self.config,
+                                self.pid, rank)
+            )
+            self._opening[addr] = task
+        try:
+            ch = await asyncio.shield(task)
+        except asyncio.CancelledError:
+            raise
+        except OcmError:
+            raise
+        except OSError as e:
+            raise OcmConnectError(
+                f"peer {addr[0]}:{addr[1]} unreachable: {e}"
+            ) from e
+        finally:
+            if self._opening.get(addr) is task:
+                self._opening.pop(addr, None)
+        self._channels[addr] = ch
+        return ch
+
+    def drop(self, addr: Addr) -> None:
+        ch = self._channels.pop((addr[0], addr[1]), None)
+        if ch is not None:
+            ch.close()
+
+    def live_channels(self) -> list[MuxChannel]:
+        return [c for c in self._channels.values() if c.alive]
+
+    def fd_count(self) -> int:
+        return len(self.live_channels())
+
+    def counters(self) -> dict:
+        agg = {"conns": 0, "ops": 0, "batches": 0, "frames": 0,
+               "inflight": 0, "peak_inflight": 0, "lockstep": 0,
+               "window": self.config.mux_window}
+        for c in self.live_channels():
+            agg["conns"] += 1
+            for k in ("ops", "batches", "frames", "inflight",
+                      "peak_inflight", "lockstep"):
+                agg[k] += c.counters[k]
+        return agg
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+
+
+# -- failover ladder (shared shape with runtime/client.py) ---------------
+
+RETRYABLE_CODES = frozenset({
+    int(ErrCode.STALE_EPOCH),
+    int(ErrCode.NOT_PRIMARY),
+    int(ErrCode.REPLICA_UNAVAILABLE),
+    int(ErrCode.MOVED),
+})
+
+
+def is_failover_err(err: BaseException) -> bool:
+    if isinstance(err, OcmRemoteError):
+        return err.code in RETRYABLE_CODES
+    return isinstance(err, (OSError, OcmConnectError, OcmProtocolError))
+
+
+def failover_candidates(entries, handle: OcmAlloc,
+                        last_err: BaseException | None
+                        ) -> list[tuple[int, Addr]]:
+    """A MOVED redirect first, then the membership address of the owner
+    rank, then each replica in chain order — the sync ladder's exact
+    preference order (runtime/client.py)."""
+    def rank_addr(rank: int) -> Addr | None:
+        if 0 <= rank < len(entries):
+            e = entries[rank]
+            if e.port:
+                return (e.connect_host, e.port)
+        return None
+
+    out: list[tuple[int, Addr]] = []
+    moved = getattr(last_err, "moved_to_rank", None)
+    if moved is not None:
+        a = rank_addr(moved)
+        if a is not None:
+            out.append((moved, a))
+    a = rank_addr(handle.rank)
+    if a is not None and (handle.rank, a) not in out:
+        out.append((handle.rank, a))
+    for rr in handle.replica_ranks:
+        if rr == handle.rank:
+            continue
+        a = rank_addr(rr)
+        if a is not None and (rr, a) not in out:
+            out.append((rr, a))
+    return out
+
+
+def _mint_op_ctx():
+    """A per-op trace context for the async client: child of any
+    ambient context (a sync caller's enclosing span), else a fresh
+    root — WITHOUT installing it thread-locally (see
+    Tracer.note_span)."""
+    if not obs_trace.enabled():
+        return None
+    parent = obs_trace.current()
+    return obs_trace.child(parent) if parent is not None \
+        else obs_trace.mint()
+
+
+def handle_from_alloc_result(reply: Message, nbytes: int,
+                             origin_rank: int) -> OcmAlloc:
+    """Build the client-side handle from an ALLOC_RESULT — shared by the
+    blocking client and AsyncOcm so the two front ends cannot drift on
+    kind demotion, fabric selection, or the replica tail."""
+    f = reply.fields
+    placed_kind = OcmKind(WIRE_KIND_INV[f["kind"]])
+    fabric = (
+        Fabric.LOCAL if not placed_kind.is_remote
+        else (Fabric.ICI if placed_kind == OcmKind.REMOTE_DEVICE
+              else Fabric.DCN)
+    )
+    h = OcmAlloc(
+        alloc_id=f["alloc_id"],
+        kind=placed_kind,
+        fabric=fabric,
+        nbytes=nbytes,
+        rank=f["rank"],
+        device_index=f["device_index"],
+        extent=Extent(offset=f["offset"], nbytes=nbytes),
+        origin_rank=origin_rank,
+    )
+    h.owner_addr = (f["owner_host"], f["owner_port"])
+    h.daemon_owned = True
+    if reply.data:
+        import json
+
+        try:
+            reps = json.loads(bytes(reply.data)).get("replicas", [])
+            h.replica_ranks = tuple(
+                int(x) for x in reps if int(x) != h.rank
+            )
+        except (ValueError, TypeError):
+            pass  # tail from a future daemon we don't understand
+    return h
+
+
+class MuxRuntime:
+    """Sync facade over one event loop on a background thread. Shared
+    process-wide (refcounted via :func:`acquire_runtime`) so every
+    tenant's ``ControlPlaneClient`` in the process drives the SAME
+    one-connection-per-peer channel set — the fd-footprint win."""
+
+    def __init__(self, config) -> None:
+        self.config = config
+        self._loop = asyncio.new_event_loop()
+        self._refs = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="ocm-mux-loop", daemon=True
+        )
+        self._thread.start()
+        self.channels = ChannelMap(self._loop, config)
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+        try:
+            self._loop.close()
+        except RuntimeError:
+            pass
+
+    # -- sync bridge -----------------------------------------------------
+
+    def run(self, coro, timeout: float = 120.0):
+        import concurrent.futures
+
+        if self._closed:
+            raise OcmConnectError("mux runtime is shut down")
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            raise OcmConnectError(
+                f"mux operation timed out after {timeout}s"
+            ) from None
+
+    def open_sync(self, addr: Addr, rank: int = -1,
+                  timeout: float = 60.0) -> MuxChannel:
+        return self.run(self.channels.channel(addr, rank), timeout)
+
+    def request_sync(self, addr: Addr, msg: Message,
+                     timeout: float = 120.0) -> Message:
+        tctx = obs_trace.current()
+
+        async def go():
+            ch = await self.channels.channel(addr)
+            return await ch.request(msg, tctx)
+
+        return self.run(go(), timeout)
+
+    def transfer_sync(self, addr: Addr, handle: OcmAlloc, start: int,
+                      length: int, offset: int, put_mv=None,
+                      get_arr=None, timeout: float = 600.0) -> dict:
+        """One stripe-range transfer for the sync engine's ladder. On
+        transport failure the channel is dropped so the ladder's next
+        attempt re-dials (the PeerPool.discard discipline)."""
+        tctx = obs_trace.current()
+
+        async def go():
+            ch = await self.channels.channel(addr)
+            try:
+                if put_mv is not None:
+                    return await ch.put_range(
+                        handle, put_mv, start, length, offset, tctx
+                    )
+                return await ch.get_range(
+                    handle, memoryview(get_arr), start, length, offset,
+                    tctx,
+                )
+            except (OSError, OcmConnectError, asyncio.IncompleteReadError):
+                self.channels.drop(addr)
+                raise
+
+        return self.run(go(), timeout)
+
+    # -- loop-scheduled heartbeats ---------------------------------------
+
+    def add_periodic(self, interval_s: float, fn) -> "asyncio.Task":
+        """Schedule ``fn`` — a fast, non-blocking callable returning a
+        list of (addr, Message) to send (or None to skip a beat) — every
+        ``interval_s``. One tenant's heartbeat costs a loop task, not a
+        thread. Returns the task; cancel via :meth:`cancel_periodic`."""
+        async def loop_body():
+            import random
+
+            await asyncio.sleep(interval_s * random.random())
+            while True:
+                try:
+                    for addr, msg in (fn() or ()):
+                        ch = await self.channels.channel(addr)
+                        await ch.request(msg)
+                except asyncio.CancelledError:
+                    raise
+                except (OSError, OcmError) as e:
+                    printd("mux heartbeat failed: %s", e)
+                await asyncio.sleep(interval_s)
+
+        return asyncio.run_coroutine_threadsafe(
+            _task_holder(loop_body()), self._loop
+        ).result(10.0)
+
+    def cancel_periodic(self, task) -> None:
+        if task is not None:
+            self._loop.call_soon_threadsafe(task.cancel)
+
+    # -- introspection / teardown ----------------------------------------
+
+    def fd_count(self) -> int:
+        return self.channels.fd_count()
+
+    def counters(self) -> dict:
+        return self.channels.counters()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+
+        def _teardown():
+            self.channels.close()
+            # One extra loop beat so just-cancelled reader/writer tasks
+            # actually process their CancelledError before the loop
+            # stops (a hard stop leaves "task was destroyed but it is
+            # pending" noise behind).
+            self._loop.call_soon(self._loop.stop)
+
+        try:
+            self._loop.call_soon_threadsafe(_teardown)
+            self._thread.join(timeout=10.0)
+        except RuntimeError:
+            pass
+
+
+async def _task_holder(coro):
+    """Wrap a coroutine into a Task from inside the loop (so add_periodic
+    can hand the Task object back across the thread boundary)."""
+    return asyncio.get_running_loop().create_task(coro)
+
+
+_runtime: MuxRuntime | None = None
+_runtime_lock = make_lock("mux._runtime_lock")
+
+
+def acquire_runtime(config) -> MuxRuntime:
+    """The process-shared runtime, created on first use. The FIRST
+    acquirer's config shapes the channels (window, chunking); per-tenant
+    QoS profiles still ride each tenant's own CONNECT frames."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None or _runtime._closed:
+            _runtime = MuxRuntime(config)
+        _runtime._refs += 1
+        return _runtime
+
+
+def release_runtime(rt: MuxRuntime) -> None:
+    global _runtime
+    with _runtime_lock:
+        rt._refs -= 1
+        if rt._refs <= 0:
+            rt.close()
+            if _runtime is rt:
+                _runtime = None
+
+
+def runtime_stats() -> dict | None:
+    """Live counters of the process-shared runtime (None when no mux
+    client is active) — what Ocm.status() surfaces as ``client.mux``."""
+    with _runtime_lock:
+        rt = _runtime
+    if rt is None or rt._closed:
+        return None
+    out = rt.counters()
+    out["fds"] = rt.fd_count()
+    return out
+
+
+# -- the async public API ------------------------------------------------
+
+
+class AsyncOcm:
+    """``async``/``await`` client for host-kind disaggregated memory:
+    ``alloc`` / ``put`` / ``get`` / ``free`` / ``status`` over the mux
+    core on the CALLER's event loop — no background threads at all.
+
+    One process can host thousands of these (one per tenant, each with
+    its own ``app_id``, leases and QoS profile) over one connection per
+    peer: pass a shared :class:`ChannelMap` via ``channels=``. Device
+    kinds still need the SPMD plane and stay with the blocking client.
+
+    Usage::
+
+        async with await AsyncOcm.open(entries, rank=0) as ocm:
+            h = await ocm.alloc(1 << 20)
+            await ocm.put(h, data)
+            back = await ocm.get(h, 1 << 20)
+            await ocm.free(h)
+    """
+
+    def __init__(self, entries, rank: int, config, app_id: int | None,
+                 channels: ChannelMap) -> None:
+        self.entries = entries
+        self.rank = rank
+        self.config = config
+        self.pid = os.getpid() if app_id is None else int(app_id)
+        self.channels = channels
+        self._own_channels = False
+        self.tracer = GLOBAL_TRACER
+        self._ctrl_addr: Addr | None = None
+        self._ctrl_caps = 0
+        self._hb_task: asyncio.Task | None = None
+        self._owner_ranks: dict[int, int] = {}
+        self._closed = False
+        self._trace_scope = f"actx-{self.pid}"
+
+    @classmethod
+    async def open(cls, entries, rank: int, config=None,
+                   app_id: int | None = None,
+                   channels: ChannelMap | None = None,
+                   heartbeat: bool = True) -> "AsyncOcm":
+        from oncilla_tpu.utils.config import OcmConfig
+
+        config = config or OcmConfig()
+        loop = asyncio.get_running_loop()
+        own = channels is None
+        if channels is None:
+            channels = ChannelMap(loop, config)
+        ocm = cls(entries, rank, config, app_id, channels)
+        ocm._own_channels = own
+        await ocm._bootstrap(heartbeat)
+        return ocm
+
+    async def __aenter__(self) -> "AsyncOcm":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.aclose()
+
+    # -- bootstrap / teardown -------------------------------------------
+
+    async def _bootstrap(self, heartbeat: bool) -> None:
+        """Walk the seed addresses (own rank first) exactly like the
+        blocking client's CONNECT ladder, then register this tenant with
+        its own tagged CONNECT — profile tail, replica offer and all."""
+        last: BaseException | None = None
+        seeds = [self.entries[self.rank]] + [
+            e for e in self.entries
+            if getattr(e, "rank", None) not in (None, self.rank) and e.port
+        ]
+        ch = None
+        for e in seeds:
+            addr = (e.connect_host, e.port)
+            try:
+                ch = await self.channels.channel(addr, self.rank)
+            except (OcmConnectError, OSError) as err:
+                last = err
+                continue
+            self._ctrl_addr = addr
+            if ch.peer_rank is not None and ch.peer_rank != self.rank:
+                printd("async client: seed rank %d unreachable, attached "
+                       "to rank %d", self.rank, ch.peer_rank)
+                self.rank = ch.peer_rank
+            break
+        if ch is None:
+            raise OcmConnectError(
+                f"no seed daemon reachable: {last}"
+            ) from last
+        from oncilla_tpu.qos.policy import pack_profile
+
+        connect = Message(
+            MsgType.CONNECT, {"pid": self.pid, "rank": self.rank},
+            flags=(FLAG_CAP_TRACE if self.config.trace else 0) | (
+                FLAG_CAP_REPLICA if self.config.replicas > 1 else 0
+            ),
+        )
+        if self.config.qos_offer:
+            connect.flags |= FLAG_CAP_QOS | FLAG_QOS_TAIL
+            connect.data = pack_profile(
+                self.config.priority,
+                self.config.quota_bytes,
+                self.config.quota_handles,
+            )
+        r = await ch.request(connect)
+        if r.type != MsgType.CONNECT_CONFIRM:
+            raise OcmConnectError(f"bad handshake reply {r.type.name}")
+        self._ctrl_caps = r.flags & TENANT_CAPS
+        self.nnodes = r.fields["nnodes"]
+        if heartbeat:
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeat_loop()
+            )
+
+    async def _heartbeat_loop(self) -> None:
+        import random
+
+        await asyncio.sleep(self.config.heartbeat_s * random.random())
+        while True:
+            try:
+                await self._ctrl_request(Message(
+                    MsgType.HEARTBEAT,
+                    {"rank": self.rank, "pid": self.pid,
+                     "owners": self._owners_field()},
+                ))
+            except asyncio.CancelledError:
+                raise
+            except (OSError, OcmError) as e:
+                printd("async client %d: heartbeat failed: %s",
+                       self.pid, e)
+            await asyncio.sleep(self.config.heartbeat_s)
+
+    async def aclose(self, detach: bool = False) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        if not detach and self._ctrl_addr is not None:
+            obs_journal.record("app_close", pid=self.pid, rank=self.rank)
+            try:
+                await self._ctrl_request(Message(
+                    MsgType.DISCONNECT,
+                    {"pid": self.pid, "owners": self._owners_field()},
+                ))
+            except (OSError, OcmError):
+                pass  # the lease reaper is the backstop
+        if self._own_channels:
+            self.channels.close()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _owners_field(self) -> str:
+        return ",".join(str(r) for r in sorted(self._owner_ranks))
+
+    def _note_owner(self, rank: int, delta: int) -> None:
+        if rank == self.rank:
+            return
+        n = self._owner_ranks.get(rank, 0) + delta
+        if n > 0:
+            self._owner_ranks[rank] = n
+        else:
+            self._owner_ranks.pop(rank, None)
+
+    async def _ctrl_request(self, msg: Message) -> Message:
+        ch = await self.channels.channel(self._ctrl_addr)
+        return await ch.request(msg, obs_trace.current())
+
+    def _owner_addr(self, handle: OcmAlloc) -> Addr:
+        addr = getattr(handle, "owner_addr", None)
+        if addr is not None:
+            return tuple(addr)
+        e = self.entries[handle.rank]
+        return (e.connect_host, e.port)
+
+    # -- API -------------------------------------------------------------
+
+    async def alloc(self, nbytes: int,
+                    kind: OcmKind = OcmKind.REMOTE_HOST) -> OcmAlloc:
+        if kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE):
+            raise OcmError(
+                "AsyncOcm serves host kinds; device arms need the SPMD "
+                "plane (use the blocking client)"
+            )
+        req = Message(
+            MsgType.REQ_ALLOC,
+            {"orig_rank": self.rank, "pid": self.pid,
+             "kind": WIRE_KIND[kind.value], "nbytes": nbytes},
+        )
+        if (
+            self.config.replicas > 1
+            and self._ctrl_caps & FLAG_CAP_REPLICA
+            and kind == OcmKind.REMOTE_HOST
+        ):
+            req.flags |= FLAG_REPLICAS
+            req.data = bytes([self.config.replicas])
+        r = await self._busy_absorbing(req)
+        h = handle_from_alloc_result(r, nbytes, self.rank)
+        self._note_owner(h.rank, +1)
+        for rr in h.replica_ranks:
+            self._note_owner(rr, +1)
+        if alloctrace.enabled():
+            alloctrace.note_alloc(
+                self._trace_scope, h.alloc_id, nbytes, h.kind.name
+            )
+        return h
+
+    async def _busy_absorbing(self, req: Message) -> Message:
+        """REQ_ALLOC with the QoS BUSY retry contract — async twin of the
+        blocking client's _alloc_request (capped jittered backoff seeded
+        by the server's hint)."""
+        import random
+
+        cfg = self.config
+        delay = max(cfg.busy_backoff_ms, 1) / 1e3
+        for attempt in range(cfg.busy_retries + 1):
+            try:
+                return await self._ctrl_request(req)
+            except OcmRemoteError as e:
+                if (
+                    e.code != int(ErrCode.BUSY)
+                    or attempt == cfg.busy_retries
+                ):
+                    raise
+                hint = getattr(e, "retry_after_ms", 0) / 1e3
+                step = min(max(delay, hint), cfg.connect_backoff_cap_s)
+                obs_journal.record(
+                    "backpressure_wait", attempt=attempt,
+                    wait_s=round(step, 4),
+                    nbytes=req.fields.get("nbytes", 0),
+                )
+                await asyncio.sleep(step * (0.5 + random.random() / 2))
+                delay *= 2
+        raise AssertionError("unreachable")
+
+    async def free(self, handle: OcmAlloc) -> None:
+        self._note_owner(handle.rank, -1)
+        for rr in handle.replica_ranks:
+            self._note_owner(rr, -1)
+        try:
+            await self._ctrl_request(Message(
+                MsgType.REQ_FREE,
+                {"alloc_id": handle.alloc_id, "rank": handle.rank},
+            ))
+        except BaseException:
+            self._note_owner(handle.rank, +1)
+            for rr in handle.replica_ranks:
+                self._note_owner(rr, +1)
+            raise
+        handle.freed = True
+        if alloctrace.enabled():
+            alloctrace.note_free(self._trace_scope, handle.alloc_id)
+
+    async def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+        import numpy as np
+
+        if (
+            isinstance(data, np.ndarray)
+            and data.dtype == np.uint8
+            and data.ndim == 1
+            and data.flags.c_contiguous
+        ):
+            raw = data  # small-op fast path: no coerce chain
+        else:
+            raw = np.ascontiguousarray(
+                np.asarray(data)
+            ).view(np.uint8).reshape(-1)
+        mv = memoryview(raw)
+        ctx = _mint_op_ctx()
+        t0 = time.perf_counter()
+        stats = await self._transfer(
+            handle, raw.nbytes, offset, put_mv=mv, tctx=ctx
+        )
+        dt = time.perf_counter() - t0
+        self.tracer.note_span("dcn_put", raw.nbytes, dt, ctx)
+        self._note(stats, "put", raw.nbytes, dt)
+
+    async def get(self, handle: OcmAlloc, nbytes: int | None = None,
+                  offset: int = 0, out=None):
+        import numpy as np
+
+        n = handle.nbytes if nbytes is None else nbytes
+        dest = np.empty(n, dtype=np.uint8) if out is None else out
+        flat = dest if dest.ndim == 1 else dest.reshape(-1)
+        ctx = _mint_op_ctx()
+        t0 = time.perf_counter()
+        stats = await self._transfer(handle, n, offset, get_arr=flat,
+                                     tctx=ctx)
+        dt = time.perf_counter() - t0
+        self.tracer.note_span("dcn_get", n, dt, ctx)
+        self._note(stats, "get", n, dt)
+        return dest
+
+    async def status(self, rank: int | None = None) -> dict:
+        if rank is None or rank == self.rank:
+            r = await self._ctrl_request(Message(MsgType.STATUS, {}))
+        else:
+            e = self.entries[rank]
+            ch = await self.channels.channel((e.connect_host, e.port))
+            r = await ch.request(Message(MsgType.STATUS, {}))
+        f = dict(r.fields)
+        if r.data:
+            import json
+
+            try:
+                f.update(json.loads(bytes(r.data)))
+            except (ValueError, UnicodeDecodeError):
+                pass
+        f["client"] = {
+            "sockets": self.channels.fd_count(),
+            "mux": self.channels.counters(),
+        }
+        return f
+
+    async def _transfer(self, handle: OcmAlloc, total: int, offset: int,
+                        put_mv=None, get_arr=None, tctx=None) -> dict:
+        """One whole transfer with the failover ladder: first the cached
+        owner address, then — on retryable failure — the MOVED redirect /
+        membership / replica-chain candidates, re-walked with a short
+        pause until failover_wait_s elapses (the window IS the failure-
+        detection latency). ``tctx`` is threaded EXPLICITLY (never the
+        thread-local ambient: coroutines must not install it across
+        awaits)."""
+        addr = self._owner_addr(handle)
+        # First attempt inline (no per-op closure): the hot path.
+        try:
+            ch = await self.channels.channel(addr)
+            if put_mv is not None:
+                return await ch.put_range(
+                    handle, put_mv, 0, total, offset, tctx
+                )
+            return await ch.get_range(
+                handle, memoryview(get_arr), 0, total, offset, tctx
+            )
+        except BaseException as err:
+            if isinstance(err, (OSError, OcmConnectError)):
+                self.channels.drop(addr)
+            if not is_failover_err(err):
+                raise
+            last = err
+
+        async def attempt(a: Addr):
+            ch = await self.channels.channel(a)
+            try:
+                if put_mv is not None:
+                    return await ch.put_range(
+                        handle, put_mv, 0, total, offset, tctx
+                    )
+                return await ch.get_range(
+                    handle, memoryview(get_arr), 0, total, offset, tctx
+                )
+            except (OSError, OcmConnectError, asyncio.IncompleteReadError):
+                self.channels.drop(a)
+                raise
+
+        deadline = time.monotonic() + self.config.failover_wait_s
+        while True:
+            for rank_i, cand in failover_candidates(
+                self.entries, handle, last
+            ):
+                obs_journal.record(
+                    "stripe_retry", stripe=0, alloc_id=handle.alloc_id,
+                    owner_rank=rank_i, nbytes=total,
+                    error=f"{type(last).__name__}: {last}",
+                )
+                try:
+                    stats = await attempt(cand)
+                except BaseException as err:
+                    if not is_failover_err(err):
+                        raise
+                    last = err
+                    continue
+                if handle.rank != rank_i:
+                    self._note_owner(rank_i, +1)
+                    self._note_owner(handle.rank, -1)
+                    handle.replica_ranks = tuple(
+                        r for r in handle.replica_ranks if r != rank_i
+                    )
+                    handle.rank = rank_i
+                handle.owner_addr = cand
+                stats["retries"] = 1
+                return stats
+            if time.monotonic() >= deadline:
+                raise last
+            await asyncio.sleep(0.05)
+
+    def _note(self, stats: dict, op: str, nbytes: int, dt: float) -> None:
+        self.tracer.note_transfer(
+            op, nbytes, dt,
+            stripes=1,
+            window=stats.get("window", 0),
+            chunk_bytes=stats.get("chunk", 0),
+            retries=stats.get("retries", 0),
+            coalesced=stats.get("coalesced", False),
+            fabric="mux",
+        )

@@ -54,6 +54,14 @@ def set_chaos_hook(fn) -> None:
     _chaos_hook = fn
 
 
+def current_chaos_hook():
+    """The installed chaos hook (or None). The mux channel runtime
+    (runtime/mux.py) honors the same seam per request, so deterministic
+    fault schedules keyed by logical op index keep firing when the data
+    plane bypasses pool leases entirely."""
+    return _chaos_hook
+
+
 class PoolEntry:
     """One pooled connection; ``lock`` is held by whoever leased it."""
 
@@ -265,6 +273,15 @@ class PeerPool:
         if lst:
             obs_journal.record("pool_evict", host=host, port=port, n=len(lst))
         return len(lst)
+
+    def size(self) -> int:
+        """Cached connections across all peers — the pool's share of the
+        client's fd footprint (Ocm.status() ``client.sockets``)."""
+        with self._lock:
+            return sum(
+                sum(1 for e in lst if not e.dead)
+                for lst in self._conns.values()
+            )
 
     def reset(self) -> None:
         """Drop every cached connection but keep the pool usable (e.g. to

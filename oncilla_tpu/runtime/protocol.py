@@ -122,6 +122,27 @@ FLAG_CAP_FABRIC = 0x0200
 # With no migrations there are no tombstones and the bit never rides,
 # so the static-membership heartbeat stays byte-identical.
 FLAG_HB_FWD = 0x0400
+# FLAG_CAP_MUX on CONNECT offers tagged request multiplexing
+# (runtime/mux.py): once granted, the sender may interleave many
+# in-flight requests on ONE connection, each carrying a u32 correlation
+# id (FLAG_MUX_TAG), and the daemon may complete them OUT OF ORDER —
+# every reply carries its request's tag back, so a response
+# demultiplexer matches them regardless of completion order. Same
+# offer/echo dance as every capability: a flags=0 reply (un-upgraded v2
+# Python daemon, the native C++ daemon) declines by silence and the
+# sender stays on the lockstep one-request-one-reply protocol over that
+# same single connection. With OCM_MUX unset the bit is never offered,
+# so the default wire is byte-for-byte the pre-mux protocol.
+FLAG_CAP_MUX = 0x0800
+# FLAG_MUX_TAG: the FIRST 4 bytes of the data tail are a u32 correlation
+# id, NOT payload (prefixed OUTSIDE any trace context — strip order on
+# receive is tag, then trace, then payload). Requests carry it only
+# toward a peer that granted FLAG_CAP_MUX; the peer echoes the same tag
+# on the reply (ERROR replies included — a typed rejection must reach
+# the tenant that earned it, not a random waiter). A coalesced DATA_PUT
+# burst tags only its CLOSING chunk: body chunks produce no reply and
+# stay eligible for the zero-copy recv-into-arena landing.
+FLAG_MUX_TAG = 0x1000
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -268,37 +289,67 @@ WIRE_KIND_INV = {v: k for k, v in WIRE_KIND.items()}
 VALID_FLAGS.update({
     # Capability offer/echo bits. CONNECT may also carry the QoS profile
     # tail (FLAG_QOS_TAIL) alongside the FLAG_CAP_QOS offer; decliners
-    # ignore both the bit and the tail.
+    # ignore both the bit and the tail. A TENANT's CONNECT riding an
+    # already-multiplexed channel (one process hosting many app ids over
+    # one connection) is itself a tagged request, hence FLAG_MUX_TAG.
     MsgType.CONNECT: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
         | FLAG_CAP_QOS | FLAG_QOS_TAIL | FLAG_CAP_FABRIC
+        | FLAG_CAP_MUX | FLAG_MUX_TAG
     ),
     MsgType.CONNECT_CONFIRM: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
-        | FLAG_CAP_QOS | FLAG_CAP_FABRIC
+        | FLAG_CAP_QOS | FLAG_CAP_FABRIC | FLAG_CAP_MUX | FLAG_MUX_TAG
     ),
     # Requests that may carry a trace-context prefix once the peer
     # granted FLAG_CAP_TRACE. DATA_PUT also keeps the coalesced-burst
     # bit; its trace prefix rides the burst-CLOSING chunk only, so the
     # body chunks stay eligible for the zero-copy recv-into-arena path.
-    MsgType.DATA_PUT: FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT,
-    MsgType.DATA_GET: FLAG_TRACE_CTX,
-    MsgType.REQ_ALLOC: FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL,
+    # FLAG_MUX_TAG marks the client-facing request set a mux channel
+    # interleaves (the same discipline: a burst tags only its closing
+    # chunk).
+    MsgType.DATA_PUT: (
+        FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT | FLAG_MUX_TAG
+    ),
+    MsgType.DATA_GET: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.REQ_ALLOC: (
+        FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL | FLAG_MUX_TAG
+    ),
     MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
     MsgType.DO_REPLICA: FLAG_QOS_TAIL,
     # A migration-provisioned copy inherits the allocation's QoS class
     # (elastic/): non-default priorities ride the same u8 tail DO_REPLICA
     # carries; default-class migrations ship unchanged frames.
     MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL,
-    MsgType.REQ_FREE: FLAG_TRACE_CTX,
+    MsgType.REQ_FREE: FLAG_TRACE_CTX | FLAG_MUX_TAG,
     MsgType.DO_FREE: FLAG_TRACE_CTX,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
     MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
     MsgType.NOTE_FREE: FLAG_TRACE_CTX,
-    MsgType.HEARTBEAT: FLAG_TRACE_CTX | FLAG_HB_FWD,
-    MsgType.STATUS: FLAG_TRACE_CTX,
-    MsgType.STATUS_PROM: FLAG_TRACE_CTX,
-    MsgType.STATUS_EVENTS: FLAG_TRACE_CTX,
+    MsgType.HEARTBEAT: FLAG_TRACE_CTX | FLAG_HB_FWD | FLAG_MUX_TAG,
+    MsgType.STATUS: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.STATUS_PROM: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.STATUS_EVENTS: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    # Over a shared mux channel DISCONNECT is awaited like any request
+    # (fire-and-forget would leave an unmatched reply to desync the
+    # demux); REQ_LOCATE is part of the client failover ladder, which
+    # runs over the channel too.
+    MsgType.DISCONNECT: FLAG_MUX_TAG,
+    MsgType.REQ_LOCATE: FLAG_MUX_TAG,
+    # Replies: a request that arrived tagged is answered tagged — the
+    # echo is what lets the demultiplexer match out-of-order
+    # completions. ERROR included: typed rejections (BUSY, MOVED,
+    # QUOTA_EXCEEDED) must reach exactly the tenant that earned them.
+    MsgType.ALLOC_RESULT: FLAG_MUX_TAG,
+    MsgType.FREE_OK: FLAG_MUX_TAG,
+    MsgType.DATA_PUT_OK: FLAG_MUX_TAG,
+    MsgType.DATA_GET_OK: FLAG_MUX_TAG,
+    MsgType.HEARTBEAT_OK: FLAG_MUX_TAG,
+    MsgType.STATUS_OK: FLAG_MUX_TAG,
+    MsgType.STATUS_PROM_OK: FLAG_MUX_TAG,
+    MsgType.STATUS_EVENTS_OK: FLAG_MUX_TAG,
+    MsgType.LOCATE_OK: FLAG_MUX_TAG,
+    MsgType.ERROR: FLAG_MUX_TAG,
     # shm fabric control legs are ordinary traceable requests: the
     # exported trace shows the validate/ack hop where a DATA_* span
     # would have been.
@@ -686,14 +737,42 @@ class ErrCode(enum.IntEnum):
     MOVED = 13
 
 
+# Precompiled one-shot codecs for string-free schemas: the per-frame
+# encode/decode is the control-plane hot path (a mux channel moves
+# thousands of tiny frames per second), and compiling a struct.Struct
+# per FIELD per frame dominated it. Filled after _SCHEMAS below.
+_FIXED_CODEC: dict["MsgType", tuple[struct.Struct, tuple[str, ...]]] = {}
+
+
 def _pack_prefix(msg: Message) -> bytes:
     """Header + encoded fields ONLY (the frame length still counts
     msg.data) — shared by pack() and send_msg's scatter-gather fast path
     so the wire encoding has exactly one implementation (protocol.cc's
     pack_prefix twin)."""
-    schema = _SCHEMAS.get(msg.type)
-    if schema is None:
+    if msg.type not in _SCHEMAS:
         raise OcmProtocolError(f"no schema for {msg.type}")
+    if msg.flags & ~_valid_flags(msg.type):
+        raise OcmProtocolError(
+            f"flags {msg.flags:#x} invalid for {msg.type.name} "
+            f"(allowed mask {_valid_flags(msg.type):#x})"
+        )
+    fixed = _FIXED_CODEC.get(msg.type)
+    if fixed is not None:
+        st, names = fixed
+        f = msg.fields
+        try:
+            fields = st.pack(*(f[n] for n in names))
+        except (KeyError, struct.error) as e:
+            raise OcmProtocolError(
+                f"bad {msg.type.name} fields: {e}"
+            ) from e
+        plen = st.size + _data_len(msg.data)
+        if plen > MAX_PAYLOAD:
+            raise OcmProtocolError(f"payload {plen} exceeds cap")
+        return HEADER.pack(
+            MAGIC, VERSION, int(msg.type), msg.flags, plen
+        ) + fields
+    schema = _SCHEMAS[msg.type]
     fields = bytearray()
     for name, fmt in schema:
         v = msg.fields[name]
@@ -704,11 +783,6 @@ def _pack_prefix(msg: Message) -> bytes:
     plen = len(fields) + _data_len(msg.data)
     if plen > MAX_PAYLOAD:
         raise OcmProtocolError(f"payload {plen} exceeds cap")
-    if msg.flags & ~_valid_flags(msg.type):
-        raise OcmProtocolError(
-            f"flags {msg.flags:#x} invalid for {msg.type.name} "
-            f"(allowed mask {_valid_flags(msg.type):#x})"
-        )
     return HEADER.pack(MAGIC, VERSION, int(msg.type), msg.flags, plen) + fields
 
 
@@ -722,6 +796,16 @@ def _parse_fields(mtype: MsgType, payload) -> tuple[dict, int]:
     """Parse the schema'd fields; returns (fields, data offset). The
     payload is untrusted wire input: truncated fields and invalid UTF-8
     must surface as protocol errors, not struct/unicode internals."""
+    fixed = _FIXED_CODEC.get(mtype)
+    if fixed is not None:
+        st, names = fixed
+        try:
+            values = st.unpack_from(payload, 0)
+        except struct.error as e:
+            raise OcmProtocolError(
+                f"malformed {mtype.name} payload: {e}"
+            ) from e
+        return dict(zip(names, values)), st.size
     schema = _SCHEMAS[mtype]
     fields: dict = {}
     off = 0
@@ -828,6 +912,59 @@ def _recv_exact(sock: socket.socket, n: int, eof_ok: bool = False):
     return buf
 
 
+class BufferedSock:
+    """Read-side buffering shim over a connected socket: ``recv_into``
+    is served from an internal buffer refilled by large kernel reads —
+    one recv syscall per ~64 KiB of small frames instead of 2-3 per
+    frame (header, fields, payload). The small-op serving hot path (mux
+    channels pipeline thousands of tiny tagged requests per second onto
+    one connection) is syscall-bound without this. Bulk reads bypass the
+    buffer whenever it is empty, so large DATA_PUT payloads keep their
+    single recv-into-arena landing. The send side is untouched — pass
+    the REAL socket to send_msg."""
+
+    __slots__ = ("sock", "_buf", "_pos")
+
+    CAP = 64 << 10
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = b""
+        self._pos = 0
+
+    def buffered(self) -> int:
+        """Bytes already read off the kernel but not yet consumed — the
+        serve loop's "more requests are in hand" signal (reply batching
+        flushes only once this drains, so pipelined peers get one
+        writev per burst of replies)."""
+        return len(self._buf) - self._pos
+
+    def recv_into(self, view, nbytes: int = 0) -> int:
+        n = nbytes or len(view)
+        avail = len(self._buf) - self._pos
+        if avail > 0:
+            take = min(avail, n)
+            view[:take] = memoryview(self._buf)[self._pos:self._pos + take]
+            self._pos += take
+            return take
+        if n >= self.CAP:
+            # Bulk payload with an empty buffer: straight into the
+            # caller's destination (the zero-copy landing).
+            return self.sock.recv_into(view, n)
+        data = self.sock.recv(self.CAP)
+        if not data:
+            return 0
+        take = min(len(data), n)
+        view[:take] = memoryview(data)[:take]
+        if take < len(data):
+            self._buf = data
+            self._pos = take
+        else:
+            self._buf = b""
+            self._pos = 0
+        return take
+
+
 class RecvScratch:
     """Reusable receive buffer for the data-plane hot loops: a fresh
     bytearray per 8 MiB reply chunk costs an allocation + kernel zeroing
@@ -859,6 +996,17 @@ _FIXED_FIELD_SIZE: dict[MsgType, int] = {
     for t, schema in _SCHEMAS.items()
     if all(fmt != "s" for _, fmt in schema)
 }
+
+# One precompiled Struct + field-name tuple per string-free schema (the
+# hot-path codec _pack_prefix/_parse_fields dispatch through).
+_FIXED_CODEC.update({
+    t: (
+        struct.Struct("<" + "".join(fmt for _, fmt in schema)),
+        tuple(name for name, _ in schema),
+    )
+    for t, schema in _SCHEMAS.items()
+    if schema and all(fmt != "s" for _, fmt in schema)
+})
 
 
 def recv_msg(
@@ -990,6 +1138,46 @@ def remote_error(reply: Message) -> OcmRemoteError:
         except (OcmProtocolError, struct.error):
             pass  # rank-only tail from a terser sender
     return err
+
+
+# -- mux correlation tags (runtime/mux.py) -------------------------------
+
+_TAG = struct.Struct("<I")
+TAG_BYTES = _TAG.size  # 4
+
+
+def attach_tag(msg: Message, tag: int) -> Message:
+    """Prefix ``msg``'s data tail with a u32 correlation id and set
+    FLAG_MUX_TAG — in place; returns ``msg`` for chaining. The caller has
+    already checked the peer granted FLAG_CAP_MUX. The tag goes OUTSIDE
+    any trace-context prefix (obs/trace.attach runs first; receivers
+    strip tag, then trace). A bulk payload becomes the vectored
+    ``[tag, payload]`` form send_msg scatter-gathers — never a
+    concatenating copy of the payload."""
+    msg.flags |= FLAG_MUX_TAG
+    head = _TAG.pack(tag)
+    if isinstance(msg.data, (list, tuple)):
+        msg.data = [head, *msg.data]
+    elif len(msg.data) >= 4096:
+        msg.data = [head, msg.data]
+    else:
+        msg.data = head + bytes(msg.data) if len(msg.data) else head
+    return msg
+
+
+def split_tag(data) -> tuple[int | None, object]:
+    """Strip the u32 correlation id off a data tail. A tail shorter than
+    the tag is malformed-but-tolerated (receivers must not die on a
+    confused peer): returns (None, data) unchanged. The rest comes back
+    as a VIEW (no payload copy — this runs per tagged frame on both
+    sides); every consumer treats Message.data as a read-only buffer
+    already."""
+    if len(data) < TAG_BYTES:
+        return None, data
+    tag = _TAG.unpack_from(data, 0)[0]
+    rest = (data if isinstance(data, memoryview)
+            else memoryview(data))[TAG_BYTES:]
+    return tag, rest
 
 
 def pack_leader_tail(rank: int, host: str, port: int) -> bytes:

@@ -112,6 +112,31 @@ class OcmConfig:
         default_factory=lambda: _env_int("OCM_FABRIC_SHM_MIN_BYTES", 64 << 10)
     )
 
+    # Async multiplexed client runtime (runtime/mux.py). OCM_MUX=1 puts
+    # the CLIENT data plane on the asyncio mux core: one connection per
+    # peer daemon shared by every tenant in the process, tagged request
+    # pipelining (FLAG_CAP_MUX + u32 correlation ids), small-op
+    # batching, and heartbeats scheduled on the shared event loop
+    # instead of one thread per tenant. Unset (the default) keeps the
+    # per-request blocking client AND the wire byte-for-byte the
+    # pre-mux protocol (the capability is never offered). Peers that
+    # decline (old Python daemons, the native C++ daemon) are served
+    # lockstep over the same single connection.
+    mux: bool = field(default_factory=lambda: bool(_env_int("OCM_MUX", 0)))
+    # Per-peer in-flight window: how many tagged requests a mux channel
+    # keeps outstanding before submitters wait. Bounds daemon-side queue
+    # depth exactly like the reference's inflight_ops bounds a pipelined
+    # transfer.
+    mux_window: int = field(
+        default_factory=lambda: _env_int("OCM_MUX_WINDOW", 64)
+    )
+    # Daemon side: whether to GRANT an offered FLAG_CAP_MUX. =0 makes
+    # this daemon behave like an un-upgraded peer (decline by silence) —
+    # the interop tests' lever, the OCM_NATIVE_OBS=0 precedent.
+    mux_serve: bool = field(
+        default_factory=lambda: bool(_env_int("OCM_MUX_SERVE", 1))
+    )
+
     # Distributed tracing (obs/): offer FLAG_CAP_TRACE at CONNECT and
     # prefix requests with a 16-byte trace context once granted, so one
     # trace_id stitches client → local daemon → peer daemon spans.
@@ -293,6 +318,11 @@ class OcmConfig:
         if self.inflight_ops <= 0:
             raise ValueError(
                 f"inflight_ops must be > 0 (got {self.inflight_ops})"
+            )
+        if self.mux_window <= 0:
+            raise ValueError(
+                f"mux_window must be > 0 (got {self.mux_window}) — a "
+                "zero window never admits a request to the channel"
             )
         if self.dcn_stripes <= 0:
             raise ValueError(

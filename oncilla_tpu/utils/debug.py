@@ -33,9 +33,17 @@ if os.environ.get("OCM_VERBOSE"):
     _logger.setLevel(logging.DEBUG)
 
 
+# Cached at import like the logger config above: OCM_VERBOSE is a
+# process-start decision (debug.h:22 contract), and printd sits on hot
+# paths (one call per span close) where even logging's isEnabledFor
+# check is measurable under the mux runtime's small-op load.
+_VERBOSE = bool(os.environ.get("OCM_VERBOSE"))
+
+
 def printd(msg: str, *args) -> None:
     """Debug print, active only under ``OCM_VERBOSE`` (debug.h:22 contract)."""
-    _logger.debug(msg, *args)
+    if _VERBOSE:
+        _logger.debug(msg, *args)
 
 
 # Fixed log-spaced latency histogram bounds (seconds), +Inf implicit.
@@ -97,6 +105,70 @@ class OpStats:
         )
 
 
+class _Span:
+    """The span context manager: adopts/mints the trace context, times
+    the body, feeds the op stats + histogram + watchdog on exit. Slotted
+    and hand-rolled for the hot path (see Tracer.span)."""
+
+    __slots__ = ("tracer", "op", "nbytes", "ctx", "saved_ctx",
+                 "annotation", "journal_on", "wall0", "t0", "rec")
+
+    def __init__(self, tracer: "Tracer", op: str, nbytes: int):
+        self.tracer = tracer
+        self.op = op
+        self.nbytes = nbytes
+
+    def __enter__(self):
+        cls = _annotation_cls()
+        self.annotation = cls(f"ocm:{self.op}") if cls is not None else None
+        # Trace context: child of the ambient span (an inbound wire hop
+        # or an enclosing local span), else a fresh root — the
+        # client-side "mint a (trace_id, span_id) per logical op".
+        ctx = None
+        if _trace.enabled():
+            parent = _trace.current()
+            ctx = _trace.child(parent) if parent is not None else _trace.mint()
+        self.ctx = ctx
+        self.saved_ctx = _trace.swap(ctx) if ctx is not None else None
+        self.journal_on = _journal.enabled()
+        self.wall0 = time.time() if self.journal_on else 0.0
+        slow_us = _watchdog.threshold_us()
+        self.rec = None
+        if self.annotation is not None:
+            self.annotation.__enter__()
+        t0 = self.t0 = time.perf_counter()
+        if slow_us > 0:
+            rec = self.rec = {
+                "op": self.op, "track": self.tracer.track, "t0": t0,
+                "nbytes": self.nbytes,
+                "trace_id": ctx.trace_id if ctx else 0,
+                "span_id": ctx.span_id if ctx else 0,
+            }
+            with self.tracer._open_lock:
+                self.tracer._open[id(rec)] = rec
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dt = time.perf_counter() - self.t0
+        if self.annotation is not None:
+            self.annotation.__exit__(*exc)
+        if self.ctx is not None:
+            _trace.restore(self.saved_ctx)
+        rec = self.rec
+        if rec is not None:
+            with self.tracer._open_lock:
+                self.tracer._open.pop(id(rec), None)
+            # Slow-but-finished spans flag at close; the watchdog scan
+            # only sees the ones still open between its ticks.
+            slow_us = _watchdog.threshold_us()
+            if dt * 1e6 >= slow_us and not rec.get("flagged"):
+                rec["flagged"] = True
+                _watchdog.flag(rec, dt * 1e6)
+        self.tracer._span_close(
+            self.op, self.nbytes, dt, self.ctx, self.journal_on, self.wall0
+        )
+
+
 class Tracer:
     """Per-op timing registry. ``tracer.span("put", nbytes=...)`` wraps an op;
     ``tracer.stats("put")`` reports count / p50 latency / Gbit/s.
@@ -140,66 +212,45 @@ class Tracer:
             )
         return st
 
-    @contextmanager
-    def span(self, op: str, nbytes: int = 0):
-        cls = _annotation_cls()
-        annotation = cls(f"ocm:{op}") if cls is not None else None
-        # Trace context: child of the ambient span (an inbound wire hop or
-        # an enclosing local span), else a fresh root — the client-side
-        # "mint a (trace_id, span_id) per logical op".
-        ctx = None
-        if _trace.enabled():
-            parent = _trace.current()
-            ctx = _trace.child(parent) if parent is not None else _trace.mint()
-        journal_on = _journal.enabled()
-        wall0 = time.time() if journal_on else 0.0
-        slow_us = _watchdog.threshold_us()
-        rec = None
-        t0 = time.perf_counter()
-        if slow_us > 0:
-            rec = {
-                "op": op, "track": self.track, "t0": t0, "nbytes": nbytes,
-                "trace_id": ctx.trace_id if ctx else 0,
-                "span_id": ctx.span_id if ctx else 0,
-            }
-            with self._open_lock:
-                self._open[id(rec)] = rec
-        try:
-            with _trace.use_ctx(ctx):
-                if annotation is None:
-                    yield
-                else:
-                    with annotation:
-                        yield
-        finally:
-            dt = time.perf_counter() - t0
-            if rec is not None:
-                with self._open_lock:
-                    self._open.pop(id(rec), None)
-                # Slow-but-finished spans flag at close; the watchdog scan
-                # only sees the ones still open between its ticks.
-                if dt * 1e6 >= slow_us and not rec.get("flagged"):
-                    rec["flagged"] = True
-                    _watchdog.flag(rec, dt * 1e6)
-            with self._lock:
-                st = self._get_locked(op)
-                st.count += 1
-                st.total_s += dt
-                st.total_bytes += nbytes
-                st.samples_s.append(dt)  # deque(maxlen) evicts the oldest
-                bi = bisect.bisect_left(LATENCY_BUCKETS_S, dt)
-                st.bucket_counts[bi] += 1
-                if ctx is not None and ctx.trace_id:
-                    st.exemplars[bi] = (ctx.trace_id, dt, time.time())
-            if journal_on:
-                _journal.record(
-                    "span", op=op, track=self.track, nbytes=nbytes,
-                    t_wall=wall0, dur_us=round(dt * 1e6, 1),
-                    trace_id=ctx.trace_id if ctx else 0,
-                    span_id=ctx.span_id if ctx else 0,
-                    parent_span_id=ctx.parent_span_id if ctx else 0,
-                )
-            printd("op=%s nbytes=%d dt_us=%.1f", op, nbytes, dt * 1e6)
+    def span(self, op: str, nbytes: int = 0) -> "_Span":
+        """One timed span (a reusable slotted context manager, not a
+        generator — span sits on every data-plane op and the
+        @contextmanager machinery was a measurable slice of the mux
+        runtime's small-op budget)."""
+        return _Span(self, op, nbytes)
+
+    def _span_close(self, op: str, nbytes: int, dt: float, ctx,
+                    journal_on: bool, wall0: float) -> None:
+        with self._lock:
+            st = self._get_locked(op)
+            st.count += 1
+            st.total_s += dt
+            st.total_bytes += nbytes
+            st.samples_s.append(dt)  # deque(maxlen) evicts the oldest
+            bi = bisect.bisect_left(LATENCY_BUCKETS_S, dt)
+            st.bucket_counts[bi] += 1
+            if ctx is not None and ctx.trace_id:
+                st.exemplars[bi] = (ctx.trace_id, dt, time.time())
+        if journal_on:
+            _journal.record(
+                "span", op=op, track=self.track, nbytes=nbytes,
+                t_wall=wall0, dur_us=round(dt * 1e6, 1),
+                trace_id=ctx.trace_id if ctx else 0,
+                span_id=ctx.span_id if ctx else 0,
+                parent_span_id=ctx.parent_span_id if ctx else 0,
+            )
+        printd("op=%s nbytes=%d dt_us=%.1f", op, nbytes, dt * 1e6)
+
+    def note_span(self, op: str, nbytes: int, dt: float,
+                  ctx=None) -> None:
+        """Record a completed span measured EXTERNALLY — the async
+        client's path. Coroutines must not install the thread-local
+        ambient context across awaits (overlapping spans on one loop
+        thread un-nest non-LIFO and leak the context), so they mint
+        their ctx explicitly, thread it to the wire attach by hand, and
+        feed the same stats/histogram/journal sink here."""
+        self._span_close(op, nbytes, dt, ctx, _journal.enabled(),
+                         time.time() - dt)
 
     def stats(self, op: str) -> OpStats:
         """A consistent SNAPSHOT of the op's stats: copied under the lock,

@@ -61,6 +61,17 @@ echo "== fabric smoke =="
 # byte-exact, drain the alloctrace ledger, and leave /dev/shm clean.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.fabric --smoke || fail=1
 
+echo "== mux smoke =="
+# Async multiplexed client runtime (runtime/mux.py): the paired
+# lockstep-vs-mux sweep at smoke scale over live daemon processes —
+# byte-exactness asserted via readback + verified large cells, and the
+# fd budget pinned (the whole tenant fleet holds <= live peers + 1
+# sockets) — followed by the multi-tenant QoS soak riding mux end to
+# end (tenant fleet over one connection per daemon, quota/pressure/
+# chaos phases unchanged, footprint + p99 histograms asserted).
+JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --smoke --mux || fail=1
+JAX_PLATFORMS=cpu python -m oncilla_tpu.qos --soak --smoke --mux || fail=1
+
 echo "== qos smoke =="
 # Multi-tenant QoS proof: simulated tenants with skewed sizes/priorities
 # against an in-process cluster — quota enforcement, back-pressure BUSY,

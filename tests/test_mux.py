@@ -1,0 +1,478 @@
+"""Async multiplexed client runtime (runtime/mux.py): tagged framing,
+capability negotiation + declined-by-silence interop, wire byte-identity
+with mux unset, the sync facade, AsyncOcm, out-of-order completion, the
+fd-footprint contract, concurrent-tenant correctness under chaos, and
+the hash-placement back-pressure satellite."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.runtime import daemon as D
+from oncilla_tpu.runtime import mux as mux_rt
+from oncilla_tpu.runtime import pool as pool_mod
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+def mcfg(**over):
+    kw = dict(
+        host_arena_bytes=32 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=512 << 10,
+        heartbeat_s=0.5,
+        mux=True,
+    )
+    kw.update(over)
+    return OcmConfig(**kw)
+
+
+# -- wire helpers and protocol surface -----------------------------------
+
+
+def test_tag_attach_split_roundtrip():
+    m = P.Message(P.MsgType.STATUS, {}, b"payload")
+    P.attach_tag(m, 0xDEADBEEF)
+    assert m.flags & P.FLAG_MUX_TAG
+    tag, rest = P.split_tag(m.data)
+    assert tag == 0xDEADBEEF
+    assert bytes(rest) == b"payload"
+    # Vectored bulk form: the payload is never copied.
+    big = bytearray(8192)
+    m2 = P.Message(
+        P.MsgType.DATA_PUT,
+        {"alloc_id": 1, "offset": 0, "nbytes": len(big)},
+        memoryview(big),
+    )
+    P.attach_tag(m2, 7)
+    assert isinstance(m2.data, list) and m2.data[1] is not big
+    # Short tail: malformed-but-tolerated.
+    assert P.split_tag(b"\x01") == (None, b"\x01")
+
+
+def test_mux_flags_declared_and_daemon_handled():
+    """The PR-5/PR-6 exhaustiveness pin, extended: every tagged request
+    type declares FLAG_MUX_TAG on the wire AND the daemon claims it
+    handled; replies declare the echo; the capability bit rides only
+    CONNECT/CONNECT_CONFIRM."""
+    for t in (
+        P.MsgType.CONNECT, P.MsgType.REQ_ALLOC, P.MsgType.REQ_FREE,
+        P.MsgType.DATA_PUT, P.MsgType.DATA_GET, P.MsgType.HEARTBEAT,
+        P.MsgType.STATUS, P.MsgType.DISCONNECT, P.MsgType.REQ_LOCATE,
+    ):
+        assert P.VALID_FLAGS[t] & P.FLAG_MUX_TAG, t
+        assert D._FLAGS_HANDLED[t] & P.FLAG_MUX_TAG, t
+    for t in (
+        P.MsgType.ALLOC_RESULT, P.MsgType.FREE_OK, P.MsgType.DATA_PUT_OK,
+        P.MsgType.DATA_GET_OK, P.MsgType.HEARTBEAT_OK, P.MsgType.STATUS_OK,
+        P.MsgType.ERROR, P.MsgType.CONNECT_CONFIRM,
+    ):
+        assert P.VALID_FLAGS[t] & P.FLAG_MUX_TAG, t
+    assert P.VALID_FLAGS[P.MsgType.CONNECT] & P.FLAG_CAP_MUX
+    assert P.VALID_FLAGS[P.MsgType.CONNECT_CONFIRM] & P.FLAG_CAP_MUX
+    # A stray tag on a daemon-to-daemon type must fail at the sender.
+    with pytest.raises(ocm.OcmProtocolError, match="invalid"):
+        P.pack(P.Message(
+            P.MsgType.DO_ALLOC,
+            {"orig_rank": 0, "pid": 1, "kind": 3, "device_index": 0,
+             "nbytes": 1},
+            flags=P.FLAG_MUX_TAG,
+        ))
+
+
+def test_mux_unset_wire_is_byte_identical():
+    """Default config: CONNECT never offers FLAG_CAP_MUX and no frame
+    ever carries a tag — byte-for-byte the PR-12 wire (the replica/QoS
+    identity-pin precedent, extended)."""
+    cfg = OcmConfig()
+    assert not cfg.mux
+    connect = P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0},
+        flags=P.FLAG_CAP_TRACE if cfg.trace else 0,
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(connect[:P.HEADER.size])
+    assert not flags & (P.FLAG_CAP_MUX | P.FLAG_MUX_TAG)
+    assert plen == 16  # pid q + rank q, no tail
+    put = P.pack(P.Message(
+        P.MsgType.DATA_PUT, {"alloc_id": 1, "offset": 0, "nbytes": 4},
+        b"\x00" * 4,
+    ))
+    _, _, _, flags, plen = P.HEADER.unpack(put[:P.HEADER.size])
+    assert flags == 0 and plen == 24 + 4  # three u64 fields + payload
+
+
+# -- sync facade over an in-process cluster ------------------------------
+
+
+def test_mux_sync_client_roundtrip_and_footprint():
+    """The blocking client over OCM_MUX: byte-exact alloc/put/get/free
+    (large coalesced burst AND small single-frame ops), the whole
+    process holding at most one socket per live peer (+1 plane
+    headroom), and the daemon's mux counters moving."""
+    cfg = mcfg()
+    with local_cluster(2, config=cfg) as c:
+        client = c.client(0, heartbeat=False)
+        rng = np.random.default_rng(3)
+        h = client.alloc(4 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 4 << 20, dtype=np.uint8)
+        client.put(h, data)  # > chunk: the coalesced FLAG_MORE burst
+        np.testing.assert_array_equal(client.get(h, 4 << 20), data)
+        small = rng.integers(0, 256, 4096, dtype=np.uint8)
+        client.put(h, small, 0)
+        np.testing.assert_array_equal(client.get(h, 4096), small)
+        client.free(h)
+        fp = client.client_footprint()
+        assert fp["sockets"] <= len(c.entries) + 1
+        assert fp["mux"] is not None and fp["mux"]["ops"] > 0
+        st = client.status(rank=h.rank)
+        assert st["mux"]["conns"] >= 1
+        assert st["mux"]["tagged_ops"] > 0
+        # The transfer telemetry names the path it rode.
+        assert client.tracer.transfers()[-1]["fabric"] == "mux"
+        client.close()
+    assert mux_rt.runtime_stats() is None  # last tenant released the loop
+
+
+def test_mux_many_tenants_share_one_channel_set():
+    """The fd win in-process: many ControlPlaneClients (one per tenant)
+    over ONE shared runtime hold one socket per peer TOTAL, every
+    tenant's data stays its own (no cross-tenant reply bleed), and
+    closing tenants one by one only tears the loop down with the last."""
+    cfg = mcfg()
+    with local_cluster(2, config=cfg) as c:
+        tenants = [
+            ControlPlaneClient(c.entries, 0, config=cfg, heartbeat=False,
+                               app_id=7000 + i)
+            for i in range(12)
+        ]
+        handles = [
+            t.alloc(64 << 10, OcmKind.REMOTE_HOST) for t in tenants
+        ]
+        for i, (t, h) in enumerate(zip(tenants, handles)):
+            t.put(h, np.full(64 << 10, i, dtype=np.uint8))
+        for i, (t, h) in enumerate(zip(tenants, handles)):
+            got = np.asarray(t.get(h, 64 << 10))
+            assert got[0] == i and got[-1] == i, "cross-tenant bleed"
+        fp = tenants[0].client_footprint()
+        assert fp["sockets"] <= len(c.entries) + 1, fp
+        for t, h in zip(tenants, handles):
+            t.free(h)
+            t.close()
+    assert mux_rt.runtime_stats() is None
+
+
+def test_mux_declined_by_silence_python_peer():
+    """An un-upgraded Python daemon (OCM_MUX_SERVE=0 — the PR-11
+    OCM_NATIVE_OBS=0 lever): the channel's FLAG_CAP_MUX offer comes back
+    unset, the client serves LOCKSTEP over its single connection, no
+    frame ever carries a tag, and the roundtrip stays byte-exact."""
+    cfg = mcfg(mux_serve=False)
+    with local_cluster(2, config=cfg) as c:
+        client = c.client(0, heartbeat=False)
+        ch = client._mux.open_sync(client._ctrl_addr)
+        assert not ch.muxed and ch.counters["lockstep"] == 1
+        rng = np.random.default_rng(5)
+        h = client.alloc(2 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+        client.put(h, data)
+        np.testing.assert_array_equal(client.get(h, 2 << 20), data)
+        client.free(h)
+        # The daemon never negotiated a mux connection.
+        assert all(
+            d._mux_counters["conns"] == 0 and
+            d._mux_counters["tagged_ops"] == 0
+            for d in c.daemons
+        )
+        client.close()
+
+
+# -- AsyncOcm ------------------------------------------------------------
+
+
+def test_async_ocm_basic_roundtrip():
+    cfg = mcfg()
+
+    async def main(entries):
+        async with await AsyncOcmOpen(entries, cfg) as o:
+            h = await o.alloc(1 << 20)
+            data = np.random.default_rng(9).integers(
+                0, 256, 1 << 20, dtype=np.uint8
+            )
+            await o.put(h, data)
+            got = await o.get(h, 1 << 20)
+            np.testing.assert_array_equal(got, data)
+            st = await o.status(rank=h.rank)
+            assert st["live_allocs"] >= 1
+            assert st["client"]["sockets"] >= 1
+            await o.free(h)
+
+    async def AsyncOcmOpen(entries, cfg):
+        return await mux_rt.AsyncOcm.open(entries, 0, config=cfg,
+                                          app_id=9001, heartbeat=False)
+
+    with local_cluster(2, config=cfg) as c:
+        asyncio.run(main(c.entries))
+
+
+def test_async_device_kind_rejected():
+    cfg = mcfg()
+    with local_cluster(1, config=cfg) as c:
+        async def main():
+            o = await mux_rt.AsyncOcm.open(c.entries, 0, config=cfg,
+                                           heartbeat=False)
+            try:
+                with pytest.raises(ocm.OcmError, match="host kinds"):
+                    await o.alloc(4096, OcmKind.REMOTE_DEVICE)
+            finally:
+                await o.aclose()
+
+        asyncio.run(main())
+
+
+def test_mux_out_of_order_control_completion():
+    """A slow REQ_ALLOC (its DO_ALLOC relay leg delayed via the chaos
+    seam) must NOT block a later STATUS on the same shared channel: the
+    daemon's worker pool completes the tagged control ops out of order,
+    correlation ids route each reply to its own waiter, and the daemon's
+    ooo counter proves the overtake happened."""
+    cfg = mcfg()
+    with local_cluster(2, config=cfg) as c:
+        # Origin rank 1: REQ_ALLOC relays to the rank-0 leader through
+        # the daemon's pool — the seam the delay hook fires on.
+        leader_addr = (c.entries[0].connect_host, c.entries[0].port)
+        delayed = {"n": 0}
+
+        def slow_relay(host, port):
+            if (host, port) == leader_addr and delayed["n"] == 0:
+                delayed["n"] += 1
+                time.sleep(0.4)
+
+        async def main():
+            o = await mux_rt.AsyncOcm.open(c.entries, 1, config=cfg,
+                                           app_id=9100, heartbeat=False)
+            try:
+                pool_mod.set_chaos_hook(slow_relay)
+                t_alloc = asyncio.get_running_loop().create_task(
+                    o.alloc(64 << 10)
+                )
+                await asyncio.sleep(0.05)  # alloc is in the slow relay
+                st = await o.status()  # must complete FIRST
+                assert not t_alloc.done(), \
+                    "status should overtake the delayed alloc"
+                assert st["rank"] == 1
+                h = await t_alloc
+                await o.free(h)
+            finally:
+                pool_mod.set_chaos_hook(None)
+                await o.aclose()
+
+        asyncio.run(main())
+        assert c.daemons[1]._mux_counters["ooo"] >= 1
+        assert c.daemons[1]._mux_counters["peak_inflight"] >= 2
+
+
+def test_mux_concurrent_tenants_chaos_kill_owner():
+    """N async tenants x kill-owner mid-storm (OCM_REPLICAS=2): every
+    response matched to its correlation id — each tenant's seeded bytes
+    come back exactly its own through the failover — and the alloctrace
+    ledger drains once the fleet closes."""
+    from oncilla_tpu.analysis import alloctrace
+    from oncilla_tpu.resilience.chaos import ChaosController, ChaosSchedule
+
+    import os
+    os.environ.setdefault("OCM_ALLOCTRACE", "1")
+    alloctrace.reset()
+    cfg = mcfg(
+        replicas=2,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        lease_s=5.0,
+        heartbeat_s=0.3,
+    )
+    N = 8
+    with local_cluster(3, config=cfg) as c:
+        n = 128 << 10
+
+        async def storm(o, h, idx):
+            rng = np.random.default_rng(1000 + idx)
+            data = rng.integers(0, 256, n, dtype=np.uint8)
+            for off in range(0, n, 32 << 10):
+                await o.put(h, data[off:off + (32 << 10)], off)
+            got = np.asarray(await o.get(h, n))
+            np.testing.assert_array_equal(
+                got, data,
+                err_msg=f"tenant {idx}: reply bleed or corruption",
+            )
+            await o.free(h)
+
+        async def main(victim):
+            loop = asyncio.get_running_loop()
+            chmap = mux_rt.ChannelMap(loop, cfg)
+            schedule = ChaosSchedule.kill_at(77, victim, op=6)
+            controller = ChaosController(schedule, c.entries,
+                                         kill_fn=c.kill)
+            try:
+                # Allocate the fleet's handles FIRST (replicated chains
+                # provisioned clean), then kill the owner mid put/get
+                # storm — the scenario the failover ladder exists for.
+                ocms = await asyncio.gather(*(
+                    mux_rt.AsyncOcm.open(
+                        c.entries, 0, config=cfg, app_id=9200 + i,
+                        channels=chmap,
+                    )
+                    for i in range(N)
+                ))
+                handles = await asyncio.gather(*(
+                    o.alloc(n) for o in ocms
+                ))
+                with controller.inject():
+                    await asyncio.gather(*(
+                        storm(o, h, i)
+                        for i, (o, h) in enumerate(zip(ocms, handles))
+                    ))
+                assert not controller.pending()
+                for o in ocms:
+                    await o.aclose()
+            finally:
+                chmap.close()
+                await asyncio.sleep(0.05)
+
+        # Probe which rank owns host allocs so the kill hits an owner
+        # that tenants actually write through (never the rank-0 leader).
+        probe = c.client(0, heartbeat=False)
+        ph = probe.alloc(4096, OcmKind.REMOTE_HOST)
+        victim = ph.rank if ph.rank != 0 else (
+            ph.replica_ranks[0] if ph.replica_ranks else 1
+        )
+        probe.free(ph)
+        probe.close()
+        asyncio.run(main(victim))
+        # Ledger: nothing leaked outside the killed daemon's scopes.
+        dead_scopes = tuple(
+            s for d in c.daemons if d.rank == victim
+            for s in (d._trace_scope, d.host_arena.allocator._trace_scope)
+        )
+        leaked = [
+            r for r in alloctrace.live()
+            if not any(r.scope.startswith(s) for s in dead_scopes)
+        ]
+        assert not leaked, [r.describe() for r in leaked]
+
+
+# -- satellite: hash-placement back-pressure -----------------------------
+
+
+def test_hash_placement_backpressure_busy():
+    """OCM_PLACEMENT=hash used to skip the leader's watermark check
+    entirely (ROADMAP item 2 remaining): the origin must now answer
+    retryable BUSY — with a backoff hint — once every live rank is past
+    the high watermark, while high-priority traffic still bypasses."""
+    cfg = OcmConfig(
+        host_arena_bytes=4 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=256 << 10,
+        placement="hash",
+        arena_high_pct=50,
+        arena_low_pct=40,
+        busy_retries=0,
+        heartbeat_s=5.0,
+    )
+    with local_cluster(1, config=cfg) as c:
+        client = c.client(0, heartbeat=False)
+        held = client.alloc(5 * (1 << 19), OcmKind.REMOTE_HOST)  # ~62%
+        with pytest.raises(ocm.OcmRemoteError) as ei:
+            client.alloc(256 << 10, OcmKind.REMOTE_HOST)  # ocm-lint: allow[handle-leak-on-path]
+        assert ei.value.code == int(P.ErrCode.BUSY)
+        assert getattr(ei.value, "retry_after_ms", 0) > 0
+        # The books stay balanced: nothing was reserved for the reject.
+        live_before = c.daemons[0].host_arena.allocator.bytes_live
+        # High priority bypasses the watermark (the leader-path rule).
+        hicfg = OcmConfig(
+            host_arena_bytes=4 << 20,
+            device_arena_bytes=1 << 20,
+            chunk_bytes=256 << 10,
+            placement="hash",
+            arena_high_pct=50,
+            arena_low_pct=40,
+            priority=2,
+            heartbeat_s=5.0,
+        )
+        hi = ControlPlaneClient(c.entries, 0, config=hicfg,
+                                heartbeat=False)
+        hh = hi.alloc(256 << 10, OcmKind.REMOTE_HOST)
+        assert c.daemons[0].host_arena.allocator.bytes_live > live_before
+        hi.free(hh)
+        hi.close()
+        client.free(held)
+        client.close()
+
+
+def test_hash_backpressure_spills_to_unpressured_rank():
+    """With only SOME ranks past the watermark, hash placement must
+    spill to a rank that still admits (the leader path's least-loaded
+    behavior) rather than surface BUSY."""
+    cfg = OcmConfig(
+        host_arena_bytes=4 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=256 << 10,
+        placement="hash",
+        arena_high_pct=50,
+        arena_low_pct=40,
+        busy_retries=0,
+        heartbeat_s=5.0,
+    )
+    with local_cluster(2, config=cfg) as c:
+        # Fill rank 0 past its watermark directly.
+        c.daemons[0].host_arena.alloc(5 * (1 << 19))
+        client = c.client(0, heartbeat=False)
+        handles = []
+        for _ in range(4):
+            h = client.alloc(128 << 10, OcmKind.REMOTE_HOST)
+            assert h.rank == 1, "hash placement must spill off the full rank"
+            handles.append(h)
+        for h in handles:
+            client.free(h)
+        client.close()
+
+
+# -- window + orphan hygiene ---------------------------------------------
+
+
+def test_mux_channel_survives_abandoned_waiter():
+    """A waiter cancelled mid-request (heartbeat teardown, sync-bridge
+    timeout) must NOT desync the shared channel: the late reply is
+    discarded via the orphan set and other tenants keep working."""
+    cfg = mcfg()
+    with local_cluster(1, config=cfg) as c:
+        async def main():
+            loop = asyncio.get_running_loop()
+            chmap = mux_rt.ChannelMap(loop, cfg)
+            try:
+                addr = (c.entries[0].connect_host, c.entries[0].port)
+                ch = await chmap.channel(addr)
+                t = loop.create_task(
+                    ch.request(P.Message(P.MsgType.STATUS, {}))
+                )
+                await asyncio.sleep(0)  # frame enqueued, reply pending
+                t.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await t
+                # The orphan reply lands and is discarded; the channel
+                # stays alive and serves the next request.
+                for _ in range(3):
+                    r = await ch.request(P.Message(P.MsgType.STATUS, {}))
+                    assert r.type == P.MsgType.STATUS_OK
+                assert ch.alive
+            finally:
+                chmap.close()
+                await asyncio.sleep(0.05)
+
+        asyncio.run(main())

@@ -370,6 +370,40 @@ def test_native_qos_capability_declined_by_silence(native_cluster, rng):
     client.close()
 
 
+def test_native_mux_capability_declined_by_silence(native_cluster, rng):
+    """OCM_MUX=1 against the unmodified C++ daemon: the channel's
+    CONNECT offer of FLAG_CAP_MUX comes back flags=0 (the native codec
+    echoes only kCapsImplemented), the channel falls back to LOCKSTEP
+    over its single connection — no tag ever rides the wire — and
+    alloc/put/get/free stay byte-exact (the mux analogue of the
+    replica/QoS/fabric silence tests)."""
+    from oncilla_tpu.runtime import mux as mux_rt
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        mux=True,
+    )
+    client = ControlPlaneClient(entries, 0, config=cfg2, heartbeat=False)
+    try:
+        ch = client._mux.open_sync(client._ctrl_addr)
+        assert not ch.muxed, "native daemon must decline FLAG_CAP_MUX"
+        assert ch.caps & P.FLAG_CAP_MUX == 0
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h, data)
+        np.testing.assert_array_equal(client.get(h, 1 << 20), data)
+        client.free(h)
+        # The whole exchange held one socket per peer actually dialed.
+        assert client.client_footprint()["sockets"] <= len(entries) + 1
+    finally:
+        client.close()
+    assert mux_rt.runtime_stats() is None  # refcount released on close
+
+
 def test_native_fabric_capability_declined_by_silence(native_cluster, rng):
     """OCM_FABRIC=shm against the unmodified C++ daemon: the data-plane
     CONNECT offer of FLAG_CAP_FABRIC comes back flags=0 (the native
