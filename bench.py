@@ -709,6 +709,18 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
             errors["gups"] = f"{type(e).__name__}: {e}"
     mark("gups")
 
+    # Disaggregated serving (serving/): the flagship workload — tiered
+    # paged KV + cross-tenant prefix sharing over an in-process cluster,
+    # paired shared-vs-noshare cells + the owner-kill chaos leg. Runs in
+    # a SUBPROCESS pinned to the CPU backend: the scenario is chip-free
+    # by design (the remote tier is the DCN data plane), and isolating
+    # it keeps its jit/cluster state out of this process entirely.
+    if budgeted("serving", 150):
+        out["detail"]["serving"] = bench_serving(
+            errors, timeout_s=min(420.0, max(time_left() - 90.0, 120.0))
+        )
+    mark("serving")
+
     # Paged-KV decode tokens/s (BASELINE.md config 5): the application-level
     # number — KV pages ride the OCM data plane out and back per page.
     # LAST: its fused modes degrade per-step dispatch in later executables
@@ -789,6 +801,38 @@ def bench_dcn(errors: dict) -> dict:
         return out
     except Exception as e:  # noqa: BLE001
         errors["dcn"] = f"{type(e).__name__}: {e}"
+        return {}
+
+
+def bench_serving(errors: dict, timeout_s: float = 420.0) -> dict:
+    """Flagship serving workload (oncilla_tpu/serving/): paired
+    shared-vs-noshare cells + the owner-kill chaos leg, run in a
+    subprocess pinned to the CPU backend (the scenario is chip-free —
+    its remote tier is the DCN data plane — and the isolation keeps the
+    cluster + jit state out of the bench process). Parses the harness's
+    one-line JSON dict."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "oncilla_tpu.serving", "--bench"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        if r.returncode != 0:
+            errors["serving"] = (
+                f"rc={r.returncode}: {r.stderr.strip()[-300:]}"
+            )
+            return {}
+        line = r.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except subprocess.TimeoutExpired:
+        errors["serving"] = f"timed out after {timeout_s:.0f}s"
+        return {}
+    except Exception as e:  # noqa: BLE001 — never fail the headline
+        errors["serving"] = f"{type(e).__name__}: {e}"
         return {}
 
 

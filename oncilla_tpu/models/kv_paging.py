@@ -43,6 +43,13 @@ class PagedKVCache:
     kind: OcmKind = OcmKind.REMOTE_DEVICE
     dtype: str = "float32"
     pages: list[OcmAlloc] = field(default_factory=list)
+    # Registered receive buffer for host-kind fetches (PR-3 get(out=)):
+    # grown geometrically, reused across fetch_pages calls so the remote
+    # tier never allocates a fresh destination per fetch (a fresh array
+    # costs a page fault per 4 KiB — at GB scale most of the transfer).
+    _recvbuf: np.ndarray | None = field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def page_shape(self) -> tuple:
@@ -69,17 +76,55 @@ class PagedKVCache:
         self.pages.append(h)
         return h
 
+    def _recv_slots(self, npages: int) -> np.ndarray | None:
+        """The registered receive window for ``npages`` host-kind
+        fetches: one reusable buffer, one page-sized slot per page
+        (distinct regions, so slot i stays valid while slot i+1 lands).
+        None for device kinds — their gets stay device-resident."""
+        if self.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+            return None
+        need = self.page_bytes * npages
+        if self._recvbuf is None or self._recvbuf.nbytes < need:
+            # Geometric growth: a steadily lengthening decode re-registers
+            # O(log pages) times, not per page boundary.
+            cap = max(need, 2 * (self._recvbuf.nbytes if self._recvbuf
+                                 is not None else self.page_bytes))
+            self._recvbuf = np.empty(cap, dtype=np.uint8)
+        return self._recvbuf
+
+    def _fetch_one(self, h: OcmAlloc, out: np.ndarray | None):
+        """One page's raw bytes — through the registered-receive path
+        (``get(out=)`` / ``get_into``) when ``out`` is given."""
+        if out is None:
+            return self.backend.get(h, self.page_bytes, 0)
+        get = self.backend.get
+        try:
+            return get(h, self.page_bytes, 0, out=out)
+        except TypeError:
+            pass  # backend without an out= kwarg (e.g. a raw client)
+        get_into = getattr(self.backend, "get_into", None)
+        if get_into is not None:
+            return get_into(h, out, 0)
+        out[:] = np.asarray(get(h, self.page_bytes, 0)).view(
+            np.uint8).reshape(-1)
+        return out
+
     def fetch_pages(self) -> tuple[jax.Array, jax.Array] | None:
         """Gather every page back (one-sided gets) and concatenate along the
-        token axis: (L, B, KV, tokens_paged, Hd) x2."""
+        token axis: (L, B, KV, tokens_paged, Hd) x2. Host-kind pages land
+        in the cache's registered receive buffer (PR-3 ``get(out=)``)
+        instead of a fresh destination per fetch."""
         if not self.pages:
             return None
         ks, vs = [], []
+        slots = self._recv_slots(len(self.pages))
+        nb = self.page_bytes
         with GLOBAL_TRACER.span(
             "kv_fetch_pages", nbytes=self.page_bytes * len(self.pages)
         ):
-            for h in self.pages:
-                raw = self.backend.get(h, self.page_bytes, 0)
+            for i, h in enumerate(self.pages):
+                out = slots[i * nb:(i + 1) * nb] if slots is not None else None
+                raw = self._fetch_one(h, out)
                 # jnp.asarray: device-resident gets stay on device (a
                 # numpy round-trip here cost a sync + two transfers per
                 # page on the tunneled chip); host-arm gets upload once.

@@ -149,12 +149,38 @@ def _app_rows(rank: int, st: dict) -> list[list[str]]:
     return out
 
 
+def _serving_rows(rank: int, st: dict) -> list[list[str]]:
+    """Per-engine serving rows for one rank (the co-located engines a
+    daemon folds into its STATUS tail — serving/metrics.py): tokens by
+    phase, fast-tier hit ratio, stall time, per-tier page occupancy and
+    prefix-sharing state."""
+    srv = st.get("serving") or {}
+    out = []
+    for eng in srv.get("engines", []):
+        toks = eng.get("tokens", {})
+        tp = eng.get("tier_pages", {})
+        pref = eng.get("prefix", {})
+        out.append([
+            eng.get("engine", "engine"),
+            str(rank),
+            f"{toks.get('prefill', 0)}/{toks.get('decode', 0)}",
+            f"{100.0 * eng.get('hit_ratio', 0.0):.0f}%",
+            f"{1e3 * eng.get('stall_s', 0.0):.1f}",
+            (f"{tp.get('hbm', 0)}/{tp.get('host', 0)}"
+             f"/{tp.get('remote', 0)}"),
+            _fmt_bytes(pref.get("shared_bytes", 0)),
+            f"{pref.get('hits', 0)}/{pref.get('cow', 0)}",
+        ])
+    return out
+
+
 def _table(entries) -> int:
     cols = ["rank", "nodes", "members", "allocs", "live", "ops", "p50_us",
             "p99_us", "lat_hist", "events", "gbit/s", "leases r/x/e",
             "migr ok/ab", "mux if/pk/ops", "hb_age_s"]
     rows = []
     app_rows: list[list[str]] = []
+    serving_rows: list[list[str]] = []
     declined: list[int] = []
     any_ok = False
     for e in entries:
@@ -168,6 +194,7 @@ def _table(entries) -> int:
         if ev_note == "declined":
             declined.append(e.rank)
         app_rows.extend(_app_rows(e.rank, st))
+        serving_rows.extend(_serving_rows(e.rank, st))
         ops = (st.get("dcn") or {}).get("ops") or {}
         count = sum(v.get("count", 0) for v in ops.values())
         p50 = max((v.get("p50_us", 0.0) for v in ops.values()), default=0.0)
@@ -226,6 +253,17 @@ def _table(entries) -> int:
         print("  ".join(c.ljust(awidths[i]) for i, c in enumerate(acols)))
         for r in app_rows:
             print("  ".join(v.ljust(awidths[i]) for i, v in enumerate(r)))
+    if serving_rows:
+        scols = ["engine", "rank", "tok pf/dec", "kv_hit", "stall_ms",
+                 "pages h/w/c", "shared", "pfx hit/cow"]
+        swidths = [
+            max(len(c), *(len(r[i]) for r in serving_rows))
+            for i, c in enumerate(scols)
+        ]
+        print()
+        print("  ".join(c.ljust(swidths[i]) for i, c in enumerate(scols)))
+        for r in serving_rows:
+            print("  ".join(v.ljust(swidths[i]) for i, v in enumerate(r)))
     return 0 if any_ok else 1
 
 

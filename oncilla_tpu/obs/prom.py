@@ -143,6 +143,61 @@ class _Doc:
         return "\n".join(lines) + "\n"
 
 
+def _serving_samples(doc: "_Doc", srv: dict, rank) -> None:
+    """The ``ocm_serving_*`` / ``ocm_kv_*`` / ``ocm_prefix_*`` families
+    from one or more co-located serving engines' meta blocks
+    (``serving/metrics.py`` snapshot shape)."""
+    for eng in srv.get("engines", []):
+        name = eng.get("engine", "engine")
+        toks = eng.get("tokens", {})
+        for phase in ("prefill", "decode"):
+            doc.sample("ocm_serving_tokens_total", "counter",
+                       "Tokens processed by a co-located serving engine, "
+                       "by phase.",
+                       toks.get(phase, 0), rank=rank, engine=name,
+                       phase=phase)
+        doc.sample("ocm_kv_hit_ratio", "gauge",
+                   "Fraction of scheduled KV page lookups served from "
+                   "the fast (HBM) tier.",
+                   eng.get("hit_ratio", 0.0), rank=rank, engine=name)
+        for tier, nbytes in sorted(eng.get("tier_bytes", {}).items()):
+            doc.sample("ocm_kv_tier_bytes", "gauge",
+                       "Live KV page bytes per storage tier.",
+                       nbytes, rank=rank, engine=name, tier=tier)
+        pref = eng.get("prefix", {})
+        doc.sample("ocm_prefix_shared_bytes", "gauge",
+                   "KV bytes currently referenced through shared "
+                   "prefix-cache extents.",
+                   pref.get("shared_bytes", 0), rank=rank, engine=name)
+        doc.sample("ocm_prefix_hits_total", "counter",
+                   "Prefix-cache extent acquisitions (prompt pages NOT "
+                   "recomputed or re-stored).",
+                   pref.get("hits", 0), rank=rank, engine=name)
+        doc.sample("ocm_prefix_cow_total", "counter",
+                   "Copy-on-write page copies taken at prefix "
+                   "divergence points.",
+                   pref.get("cow", 0), rank=rank, engine=name)
+        doc.sample("ocm_prefetch_stall_seconds_total", "counter",
+                   "Decode time spent waiting on KV page fetches "
+                   "(prefetch lost the race, or a plain page fault).",
+                   eng.get("stall_s", 0.0), rank=rank, engine=name)
+        moves = eng.get("moves", {})
+        for direction in ("promote", "demote"):
+            doc.sample("ocm_kv_page_moves_total", "counter",
+                       "KV page tier relocations by direction.",
+                       moves.get(direction, 0), rank=rank, engine=name,
+                       dir=direction)
+
+
+def render_serving(srv: dict, rank: int = 0) -> str:
+    """Standalone exposition of serving metrics (what ``python -m
+    oncilla_tpu.serving --prom``-style tooling and the tests scrape
+    without a daemon in the process)."""
+    doc = _Doc()
+    _serving_samples(doc, srv, rank)
+    return doc.text()
+
+
 def render(meta: dict) -> str:
     rank = meta.get("rank", 0)
     doc = _Doc()
@@ -398,6 +453,10 @@ def render(meta: dict) -> str:
                    "allocations (pruned once the owning app goes "
                    "stale).",
                    ela.get("tombstones", 0), rank=rank)
+
+    srv = meta.get("serving")
+    if srv:
+        _serving_samples(doc, srv, rank)
 
     # The transfer ring is bounded, so ring-derived figures are gauges
     # over the recent window, never counters.

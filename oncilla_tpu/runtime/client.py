@@ -744,6 +744,12 @@ class ControlPlaneClient:
         self._note_owner(handle.rank, -1)
         for rr in handle.replica_ranks:
             self._note_owner(rr, -1)
+
+        def _restore() -> None:
+            self._note_owner(handle.rank, +1)
+            for rr in handle.replica_ranks:
+                self._note_owner(rr, +1)
+
         try:
             self._request(
                 Message(
@@ -751,11 +757,31 @@ class ControlPlaneClient:
                     {"alloc_id": handle.alloc_id, "rank": handle.rank},
                 )
             )
-        except BaseException:
-            self._note_owner(handle.rank, +1)
+        except BaseException as err:
+            # Free ladder (resilience/): a dead primary's free re-aims
+            # at the replica chain — the promoted primary serves it and
+            # fans the DO_FREE out, exactly like the data-path ladder.
+            # Non-failover errors (BAD_ALLOC_ID double free, ...) and
+            # unreplicated handles propagate unchanged.
+            if not (self._is_failover_err(err) and handle.replica_ranks):
+                _restore()
+                raise
+            last: BaseException = err
             for rr in handle.replica_ranks:
-                self._note_owner(rr, +1)
-            raise
+                try:
+                    self._request(Message(
+                        MsgType.REQ_FREE,
+                        {"alloc_id": handle.alloc_id, "rank": rr},
+                    ))
+                    break
+                except BaseException as err2:  # noqa: BLE001
+                    if not self._is_failover_err(err2):
+                        _restore()
+                        raise
+                    last = err2
+            else:
+                _restore()
+                raise last
         # Drop any cached fabric region keys for this alloc: a recycled
         # alloc_id must re-resolve its extent, never inherit a stale map.
         with self._dcn_lock:

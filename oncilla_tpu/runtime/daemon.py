@@ -2559,10 +2559,21 @@ class Daemon:
             self._do_free_local(f["alloc_id"])
         else:
             owner = self.entries[owner_rank]
-            self._peer_request(
-                owner.connect_host, owner.port,
-                Message(MsgType.DO_FREE, {"alloc_id": f["alloc_id"]}),
-            )
+            try:
+                self._peer_request(
+                    owner.connect_host, owner.port,
+                    Message(MsgType.DO_FREE, {"alloc_id": f["alloc_id"]}),
+                )
+            except (OSError, OcmConnectError):
+                # Owner unreachable mid-failover: answer RETRYABLE so
+                # the client's free ladder can re-aim at a promoted
+                # replica (a generic UNKNOWN here left clients of a
+                # killed owner unable to release replicated handles).
+                return _err(
+                    ErrCode.REPLICA_UNAVAILABLE,
+                    f"owner rank {owner_rank} unreachable for free of "
+                    f"alloc {f['alloc_id']} (retry a replica)",
+                )
         # Quota give-back at the ORIGIN daemon (idempotent: the local-
         # owner branch already released through _do_free_local).
         self.qos.release(f["alloc_id"])
@@ -4031,6 +4042,7 @@ class Daemon:
             # Arena capacities (control/): what a promoted leader's
             # whole-resync reads to rebuild placement accounting from
             # the survivors' own numbers.
+            "serving": self._serving_meta(),
             "caps": {
                 "ndevices": self.ndevices,
                 "device_arena_bytes": self.config.device_arena_bytes,
@@ -4085,6 +4097,17 @@ class Daemon:
             "counters": dict(self.fabric_counters),
         }
 
+    def _serving_meta(self) -> dict | None:
+        """Co-located serving-engine stats (serving/metrics.py): an
+        engine in THIS process publishes its counters and the daemon
+        folds them into STATUS / STATUS_PROM — the in-band, no-new-
+        MsgType observability discipline. None (omitted by render) when
+        no engine lives here. The import is stdlib-only by the metrics
+        module's contract."""
+        from oncilla_tpu.serving import metrics as serving_metrics
+
+        return serving_metrics.colocated()
+
     def _metrics_meta(self) -> dict:
         """Everything the Prometheus endpoint and the cluster CLI render:
         op counters, the transfer ring, arena occupancy, lease health."""
@@ -4112,6 +4135,7 @@ class Daemon:
             "fabric": self._fabric_meta(),
             "elastic": self._elastic_meta(),
             "mux": self._mux_meta(),
+            "serving": self._serving_meta(),
         }
 
     def _on_status_prom(self, msg: Message) -> Message:

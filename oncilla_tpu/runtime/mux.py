@@ -880,6 +880,18 @@ class MuxRuntime:
                 f"mux operation timed out after {timeout}s"
             ) from None
 
+    def submit(self, coro) -> "concurrent.futures.Future":
+        """Schedule ``coro`` on the loop WITHOUT blocking: the
+        concurrent future completes when it does. The fire-and-collect
+        half of the sync bridge — what the serving prefetcher uses to
+        overlap cold-page fetches with compute (``run`` is the blocking
+        half)."""
+        import concurrent.futures  # noqa: F401 — annotation only
+
+        if self._closed:
+            raise OcmConnectError("mux runtime is shut down")
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
     def open_sync(self, addr: Addr, rank: int = -1,
                   timeout: float = 60.0) -> MuxChannel:
         return self.run(self.channels.channel(addr, rank), timeout)
@@ -1261,16 +1273,39 @@ class AsyncOcm:
         self._note_owner(handle.rank, -1)
         for rr in handle.replica_ranks:
             self._note_owner(rr, -1)
+
+        def _restore() -> None:
+            self._note_owner(handle.rank, +1)
+            for rr in handle.replica_ranks:
+                self._note_owner(rr, +1)
+
         try:
             await self._ctrl_request(Message(
                 MsgType.REQ_FREE,
                 {"alloc_id": handle.alloc_id, "rank": handle.rank},
             ))
-        except BaseException:
-            self._note_owner(handle.rank, +1)
+        except BaseException as err:
+            # Free ladder: re-aim a dead primary's free at the replica
+            # chain (the blocking client's exact discipline).
+            if not (is_failover_err(err) and handle.replica_ranks):
+                _restore()
+                raise
+            last: BaseException = err
             for rr in handle.replica_ranks:
-                self._note_owner(rr, +1)
-            raise
+                try:
+                    await self._ctrl_request(Message(
+                        MsgType.REQ_FREE,
+                        {"alloc_id": handle.alloc_id, "rank": rr},
+                    ))
+                    break
+                except BaseException as err2:  # noqa: BLE001
+                    if not is_failover_err(err2):
+                        _restore()
+                        raise
+                    last = err2
+            else:
+                _restore()
+                raise last
         handle.freed = True
         if alloctrace.enabled():
             alloctrace.note_free(self._trace_scope, handle.alloc_id)

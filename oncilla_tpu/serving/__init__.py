@@ -1,0 +1,40 @@
+"""Disaggregated LLM serving over the OncillaMem runtime.
+
+The flagship workload (ROADMAP item 1): a continuous-batching decode
+engine (:mod:`.engine`) whose paged KV cache tiers across device HBM,
+the local host arena and remote arenas (:mod:`.tiers`), with identical
+prompt prefixes deduplicated cross-tenant into shared refcounted
+extents (:mod:`.prefix`). ``python -m oncilla_tpu.serving --smoke`` is
+the CI proof; ``--bench`` the measured cells (``bench.py`` records them
+as ``detail.serving``).
+
+Attribute access is lazy (PEP 562): :mod:`.metrics` stays importable
+from a daemon process without pulling jax or the model stack.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "ServingStats": "metrics",
+    "Tier": "tiers",
+    "TIER_PRIORITY": "tiers",
+    "Page": "tiers",
+    "TieredPageStore": "tiers",
+    "PrefixCache": "prefix",
+    "SharedExtent": "prefix",
+    "Request": "engine",
+    "SessionResult": "engine",
+    "Prefetcher": "engine",
+    "ServingEngine": "engine",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

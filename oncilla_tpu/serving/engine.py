@@ -1,0 +1,607 @@
+"""Continuous-batching decode engine over the tiered KV page store.
+
+The compute half of the serving scenario: sessions (one per tenant
+request) interleave page-granular decode turns, admissions join between
+turns (the continuous-batching shape — the batch composition changes
+continuously, it never drains), and every session's KV context lives as
+pages in the :class:`~oncilla_tpu.serving.tiers.TieredPageStore`, shared
+across tenants through the
+:class:`~oncilla_tpu.serving.prefix.PrefixCache`.
+
+Key mechanics:
+
+- **Prefill with prefix reuse** — a new request first walks the prefix
+  trie; matched extents are acquired (refcounted) and their KV is never
+  recomputed. The unmatched remainder is teacher-forced through
+  ``paged_decode_step_jit``, and every completed prompt-only page is
+  *published* back into the trie (content-hash dedup) so the next
+  tenant hits it. A matched **partial** tail extent is adopted by
+  copy-on-write: the shared page stays byte-exact for everyone else,
+  the adopter continues into its private clone.
+- **Prefetch-on-schedule** — while session *i* decodes, the engine
+  issues fetches for session *i+1*'s non-resident pages, threaded
+  (default) or as AsyncOcm coroutines on the PR-13 mux loop
+  (``OCM_MUX=1``). When the prefetch loses the race the wait is
+  recorded as page-fault stall time (``prefetch_stall`` journal event +
+  the stall counters).
+- **Determinism** — greedy decode (temperature 0) over float32-exact
+  page round-trips: the emitted token ids are a pure function of
+  (params, prompt), whatever tier a page happens to live in and however
+  a chaos schedule reshuffles the remote owners mid-decode. That is
+  what the chaos leg's byte-exactness assertion leans on.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from oncilla_tpu.core.hbm import from_bytes, to_bytes
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.serving import metrics as serving_metrics
+from oncilla_tpu.serving.metrics import ServingStats
+from oncilla_tpu.serving.prefix import PrefixCache, SharedExtent
+from oncilla_tpu.serving.tiers import Page, Tier, TieredPageStore
+from oncilla_tpu.utils.debug import printd
+
+
+@dataclass
+class Request:
+    """One tenant's generation request (greedy decode: deterministic)."""
+
+    tenant: str
+    tokens: list[int]
+    max_new_tokens: int = 16
+
+
+@dataclass
+class SessionResult:
+    tenant: str
+    prompt_len: int
+    out_tokens: list[int]
+    stall_s: float
+    prefix_tokens_reused: int
+
+
+class Prefetcher:
+    """Fetch page bytes ahead of schedule into reusable registered
+    buffers. ``workers == 0`` disables prefetch entirely (every miss is
+    a synchronous page fault — the chaos leg runs this way so the
+    logical-op chaos clock stays deterministic). With a mux-backed cold
+    client (``OCM_MUX=1``) cold-tier fetches ride
+    :class:`~oncilla_tpu.runtime.mux.AsyncOcm` coroutines on the shared
+    event loop — zero extra threads, tagged pipelining on the one
+    connection per peer."""
+
+    def __init__(self, store: TieredPageStore, workers: int = 2,
+                 stats: ServingStats | None = None):
+        self.store = store
+        self.stats = stats or store.stats
+        self.workers = workers
+        self._pool = None
+        self._aocm = None
+        self._mux_rt = None
+        self._bufs: list[np.ndarray] = []
+        self._futures: dict[int, object] = {}
+        if workers <= 0:
+            return
+        client = store.cold_backend
+        rt = getattr(client, "_mux", None) if client is not None else None
+        if rt is not None:
+            try:
+                self._open_async(client, rt)
+            except Exception as e:  # noqa: BLE001 — degrade to threads
+                printd("serving: AsyncOcm prefetch unavailable (%s); "
+                       "using threads", e)
+        if self._aocm is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="ocm-prefetch"
+            )
+
+    def _open_async(self, client, rt) -> None:
+        from oncilla_tpu.runtime.mux import AsyncOcm
+
+        self._aocm = rt.run(AsyncOcm.open(
+            client.entries, client.rank, config=client.config,
+            channels=rt.channels, heartbeat=False,
+        ))
+        self._mux_rt = rt
+
+    @property
+    def mode(self) -> str:
+        if self._aocm is not None:
+            return "async"
+        return "thread" if self._pool is not None else "off"
+
+    def _buf(self) -> np.ndarray:
+        return (self._bufs.pop() if self._bufs
+                else np.empty(self.store.page_bytes, dtype=np.uint8))
+
+    def submit(self, page: Page) -> None:
+        """Schedule a fetch of ``page`` (idempotent per page)."""
+        if self.mode == "off" or page.page_id in self._futures:
+            return
+        if self.mode == "async" and page.tier != Tier.COLD:
+            return  # warm reads are local memcpys; not worth a coroutine
+        buf = self._buf()
+        version = page.version
+        self.stats.note_prefetch()
+        if self._aocm is not None:
+            nbytes = page.nbytes
+
+            async def go():
+                await self._aocm.get(page.handle, nbytes, 0,
+                                     out=buf[:nbytes])
+                self.stats.note_remote(nbytes, inbound=True)
+                return (buf, version, True)
+
+            self._futures[page.page_id] = self._mux_rt.submit(go())
+        else:
+            def fetch():
+                ver, ok = self.store.fetch_bytes(page, buf)
+                return (buf, ver, ok)
+
+            self._futures[page.page_id] = self._pool.submit(fetch)
+
+    def take(self, page_id: int):
+        """The pending future for ``page_id`` (consumed), or None."""
+        return self._futures.pop(page_id, None)
+
+    def recycle(self, buf: np.ndarray) -> None:
+        if len(self._bufs) < max(self.workers, 2):
+            self._bufs.append(buf)
+
+    def close(self) -> None:
+        for fut in self._futures.values():
+            try:
+                fut.cancel()
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                printd("serving: prefetch cancel failed: %s", e)
+        self._futures.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        if self._aocm is not None:
+            try:
+                self._mux_rt.run(self._aocm.aclose(detach=True))
+            except Exception as e:  # noqa: BLE001 — the runtime may
+                # already be shut down by the owning client's close
+                printd("serving: AsyncOcm close failed: %s", e)
+            self._aocm = None
+
+
+@dataclass
+class _Entry:
+    """One page of a session's context."""
+
+    page: Page
+    extent: SharedExtent | None = None
+    #: True while this page's KV is still being produced in the tail
+    #: (a CoW-adopted partial): storage-side only, excluded from the
+    #: attention context.
+    pending_fill: bool = False
+    arrays: tuple | None = None   # (k, v) decode-ready, cfg dtype
+    version: int = -1             # page.version the arrays were built at
+
+
+class _Session:
+    def __init__(self, req: Request, cfg, page_tokens: int, dtype):
+        self.req = req
+        self.prompt = [int(t) for t in req.tokens]
+        self.entries: list[_Entry] = []
+        self.shared_refs: list[SharedExtent] = []
+        self.out: list[int] = []
+        self.pos = 0
+        self.prompt_consumed = 0
+        self.tail_len = 0
+        self.page_toks: list[int] = []  # token ids whose KV fills the tail
+        self.chain_parent: SharedExtent | None = None
+        self.chain_valid = True
+        self.prefix_tokens_reused = 0
+        self.stall_s = 0.0
+        self.done = False
+        self._tail_shape = (cfg.n_layers, 1, cfg.n_kv_heads, page_tokens,
+                            cfg.head_dim)
+        self._tail_dt = jnp.dtype(dtype)
+        self.tail_k = jnp.zeros(self._tail_shape, self._tail_dt)
+        self.tail_v = jnp.zeros(self._tail_shape, self._tail_dt)
+
+    def reset_tail(self) -> None:
+        # FRESH zeros every page, for two reasons: published partial
+        # pages must be deterministic byte-for-byte beyond their fill,
+        # and the decode step donates the tail buffers — a cached zeros
+        # array would be consumed by the first donation and poison every
+        # later page.
+        self.tail_k = jnp.zeros(self._tail_shape, self._tail_dt)
+        self.tail_v = jnp.zeros(self._tail_shape, self._tail_dt)
+        self.tail_len = 0
+        self.page_toks = []
+
+
+class ServingEngine:
+    """Session-interleaved continuous batching over one page store."""
+
+    def __init__(
+        self,
+        params: dict,
+        cfg,
+        store: TieredPageStore,
+        prefix: PrefixCache | None = None,
+        page_tokens: int = 16,
+        max_active: int = 4,
+        prefetch_workers: int | None = None,
+        store_dtype: str = "float32",
+        name: str = "engine",
+        share_partials: bool = True,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.store = store
+        self.prefix = prefix
+        self.page_tokens = int(page_tokens)
+        self.max_active = int(max_active)
+        self.store_dtype = store_dtype
+        self.share_partials = share_partials
+        self.stats = store.stats
+        self.stats.engine = name
+        if prefetch_workers is None:
+            prefetch_workers = int(os.environ.get("OCM_SERVE_PREFETCH", "2"))
+        self.prefetcher = Prefetcher(store, prefetch_workers, self.stats)
+        self.queue: list[Request] = []
+        self.active: list[_Session] = []
+        self.results: list[SessionResult] = []
+        self.page_shape = (2, cfg.n_layers, 1, cfg.n_kv_heads,
+                           self.page_tokens, cfg.head_dim)
+        expect = int(np.prod(self.page_shape)) * jnp.dtype(store_dtype).itemsize
+        if expect != store.page_bytes:
+            raise ValueError(
+                f"store page_bytes {store.page_bytes} != model page "
+                f"{expect} (cfg/page_tokens/store_dtype mismatch)"
+            )
+        serving_metrics.publish(self.stats)
+
+    @staticmethod
+    def page_nbytes(cfg, page_tokens: int,
+                    store_dtype: str = "float32") -> int:
+        """Size of one packed (K+V) page for ``cfg`` — what the
+        :class:`TieredPageStore` must be built with."""
+        return int(
+            2 * cfg.n_layers * 1 * cfg.n_kv_heads * page_tokens
+            * cfg.head_dim * jnp.dtype(store_dtype).itemsize
+        )
+
+    # -- submission / driving --------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.queue.append(req)
+
+    def run(self, turn_tokens: int | None = None) -> list[SessionResult]:
+        """Drive to completion: admit, interleave page-granular turns
+        with prefetch-on-schedule, collect results."""
+        turn = turn_tokens or self.page_tokens
+        while self.queue or self.active:
+            while self.queue and len(self.active) < self.max_active:
+                self.active.append(self._admit(self.queue.pop(0)))
+            order = list(self.active)
+            for i, sess in enumerate(order):
+                if sess.done:
+                    continue
+                # Prefetch-on-schedule: the NEXT session's cold pages
+                # fetch while this one computes.
+                for j in range(i + 1, len(order)):
+                    if not order[j].done:
+                        self._prefetch_for(order[j])
+                        break
+                self._turn(sess, turn)
+                if sess.done:
+                    self._finish(sess)
+            self.active = [s for s in self.active if not s.done]
+        done, self.results = self.results, []
+        return done
+
+    def close(self) -> None:
+        for sess in self.active:
+            self._finish(sess, abandon=True)
+        self.active = []
+        self.prefetcher.close()
+        serving_metrics.unpublish(self.stats)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- admission / prefill ---------------------------------------------
+
+    def _admit(self, req: Request) -> _Session:
+        # Prefix matching is INCREMENTAL (:meth:`_match_more`, probed at
+        # every page boundary), not an admission-time lookup: sessions
+        # admitted simultaneously still dedup against pages a sibling
+        # publishes one turn later.
+        return _Session(req, self.cfg, self.page_tokens, self.cfg.dtype)
+
+    def _match_more(self, sess: _Session) -> None:
+        """At a page boundary during prefill, adopt any shared extent
+        covering the next chunk of this prompt instead of recomputing
+        it. The LAST prompt token is always computed locally (its
+        logits seed generation), so a whole-remainder match turns into
+        a CoW adoption of all-but-one of its tokens."""
+        if (self.prefix is None or not sess.chain_valid
+                or sess.tail_len != 0):
+            return
+        P = self.page_tokens
+        while True:
+            pc = sess.prompt_consumed
+            rem = len(sess.prompt) - pc
+            if rem <= 1:
+                return
+            if rem > P:
+                ext = self.prefix.child(sess.chain_parent,
+                                        sess.prompt[pc:pc + P])
+                if ext is None or ext.fill != P:
+                    return
+                self.prefix.acquire(ext)
+                sess.shared_refs.append(ext)
+                sess.entries.append(_Entry(page=ext.page, extent=ext))
+                sess.chain_parent = ext
+                sess.pos += P
+                sess.prompt_consumed += P
+                sess.prefix_tokens_reused += P
+                self.stats.note_tokens(P, phase="prefill")
+                continue
+            # 2 <= rem <= P: the prompt's tail chunk. Adopt all but the
+            # final token by copy-on-write when a shared extent holds
+            # exactly these tokens (full page or partial alike).
+            ext = self.prefix.child(sess.chain_parent, sess.prompt[pc:])
+            if ext is not None and ext.fill > 1:
+                self._adopt_partial(sess, ext, upto=rem - 1)
+                sess.prompt_consumed += rem - 1
+                self.stats.note_tokens(rem - 1, phase="prefill")
+            return
+
+    def _adopt_partial(self, sess: _Session, ext: SharedExtent,
+                       upto: int) -> None:
+        """Copy-on-write adoption of a partial shared tail: the session
+        continues into a private clone, loading the first ``upto``
+        tokens' KV from the shared bytes (the divergence point). The
+        shared extent keeps its reference until the session ends."""
+        self.prefix.acquire(ext)
+        sess.shared_refs.append(ext)
+        clone = self.store.cow(ext.page)
+        data = self.store.read_page(clone)
+        packed = from_bytes(jnp.asarray(np.array(data, copy=True)),
+                            self.page_shape, self.store_dtype)
+        dt = jnp.dtype(self.cfg.dtype)
+        sess.tail_k = packed[0].astype(dt)
+        sess.tail_v = packed[1].astype(dt)
+        sess.tail_len = upto
+        sess.page_toks = list(ext.tokens[:upto])
+        sess.pos += upto
+        sess.prefix_tokens_reused += upto
+        sess.entries.append(_Entry(page=clone, pending_fill=True))
+        # Chain continuity: the completed clone page will extend the
+        # node ABOVE the partial (its full token tuple replaces the
+        # partial's).
+        sess.chain_parent = ext.parent
+
+    # -- residency / prefetch --------------------------------------------
+
+    def _unpack(self, data: np.ndarray) -> tuple:
+        packed = from_bytes(jnp.asarray(np.array(data, copy=True)),
+                            self.page_shape, self.store_dtype)
+        dt = jnp.dtype(self.cfg.dtype)
+        return (packed[0].astype(dt), packed[1].astype(dt))
+
+    def _resident(self, e: _Entry) -> bool:
+        return (e.arrays is not None and e.version == e.page.version
+                and e.page.tier == Tier.HOT)
+
+    def _prefetch_for(self, sess: _Session) -> None:
+        for e in sess.entries:
+            if (not e.pending_fill and not self._resident(e)
+                    and e.page.tier != Tier.HOT):
+                self.prefetcher.submit(e.page)
+
+    def _ensure_resident(self, sess: _Session) -> None:
+        for e in sess.entries:
+            if e.pending_fill:
+                continue
+            # Hit = the page is in the fast tier at schedule time; a
+            # miss is a real fetch from warm/cold (the stall path).
+            hot = e.page.tier == Tier.HOT
+            self.stats.note_lookup(hot)
+            if self._resident(e):
+                self.store.touch(e.page)
+                continue
+            if hot:
+                # Decode arrays lost (session cold start / page moved
+                # back up): rebuild from the fast tier — no stall.
+                data = np.array(self.store.read_page(e.page), copy=True)
+                e.arrays = self._unpack(data)
+                e.version = e.page.version
+                continue
+            data = self._obtain(sess, e.page)
+            self.store.promote(e.page, data=data[0], version=data[1])
+            e.arrays = self._unpack(data[0])
+            e.version = e.page.version
+            if data[2] is not None:
+                self.prefetcher.recycle(data[2])
+
+    def _obtain(self, sess: _Session, page: Page):
+        """Page bytes + the version they correspond to: a completed
+        prefetch is free; waiting on one (or faulting with none issued)
+        is recorded as stall time."""
+        fut = self.prefetcher.take(page.page_id)
+        if fut is not None:
+            already = fut.done()
+            t0 = time.perf_counter()
+            try:
+                buf, version, ok = fut.result(timeout=120.0)
+            except Exception as e:  # noqa: BLE001 — fall back to a fault
+                printd("serving: prefetch failed (%s); faulting", e)
+                buf, version, ok = None, -1, False
+            waited = time.perf_counter() - t0
+            if ok and version == page.version:
+                self.stats.note_prefetch(completed=True)
+                if not already:
+                    # Prefetch lost the race: the decode sat waiting.
+                    sess.stall_s += waited
+                    self.stats.note_stall(waited)
+                    obs_journal.record("prefetch_stall",
+                                       page_id=page.page_id,
+                                       wait_ms=round(waited * 1e3, 3))
+                return (buf, version, buf)
+            if buf is not None:
+                self.prefetcher.recycle(buf)
+        # Page fault: no (usable) prefetch — the whole fetch is stall.
+        t0 = time.perf_counter()
+        version = page.version
+        data = np.array(self.store.read_page(page), copy=True)
+        stall = time.perf_counter() - t0
+        sess.stall_s += stall
+        self.stats.note_stall(stall)
+        obs_journal.record("prefetch_stall", page_id=page.page_id,
+                           wait_ms=round(stall * 1e3, 3), fault=True)
+        return (data, version, None)
+
+    def _context(self, sess: _Session) -> tuple:
+        ks = [e.arrays[0] for e in sess.entries if not e.pending_fill]
+        vs = [e.arrays[1] for e in sess.entries if not e.pending_fill]
+        cfg = self.cfg
+        if not ks:
+            shape = (cfg.n_layers, 1, cfg.n_kv_heads, 0, cfg.head_dim)
+            z = jnp.zeros(shape, jnp.dtype(cfg.dtype))
+            return z, z
+        return jnp.concatenate(ks, axis=3), jnp.concatenate(vs, axis=3)
+
+    # -- decode -----------------------------------------------------------
+
+    def _turn(self, sess: _Session, budget: int) -> None:
+        from oncilla_tpu.models import paged_decode_step_jit
+
+        self._match_more(sess)
+        self._ensure_resident(sess)
+        k_ctx, v_ctx = self._context(sess)
+        for _ in range(budget):
+            if sess.prompt_consumed < len(sess.prompt):
+                tok = sess.prompt[sess.prompt_consumed]
+                sess.prompt_consumed += 1
+                prefill = True
+                self.stats.note_tokens(1, phase="prefill")
+            else:
+                tok = sess.out[-1] if sess.out else sess.prompt[-1]
+                prefill = False
+            meta = jnp.asarray([sess.pos, sess.tail_len, 0], jnp.int32)
+            logits, sess.tail_k, sess.tail_v = paged_decode_step_jit(
+                self.params, jnp.asarray([tok], jnp.int32), meta,
+                k_ctx, v_ctx, sess.tail_k, sess.tail_v, self.cfg,
+            )
+            sess.pos += 1
+            sess.tail_len += 1
+            sess.page_toks.append(int(tok))
+            emit = (not prefill
+                    or sess.prompt_consumed == len(sess.prompt))
+            if emit:
+                sess.out.append(int(jnp.argmax(logits[0])))
+                if not prefill:
+                    self.stats.note_tokens(1)
+            if sess.tail_len == self.page_tokens:
+                self._ship(sess)
+                # Page boundary: a sibling may have published the next
+                # chunk of this prompt since the last probe.
+                self._match_more(sess)
+                self._ensure_resident(sess)
+                k_ctx, v_ctx = self._context(sess)
+            elif (self.share_partials and prefill
+                  and sess.prompt_consumed == len(sess.prompt)):
+                self._publish_partial(sess)
+            if len(sess.out) > sess.req.max_new_tokens:
+                raise AssertionError("overran max_new_tokens")
+            if len(sess.out) == sess.req.max_new_tokens:
+                sess.done = True
+                return
+
+    def _ship(self, sess: _Session) -> None:
+        """Page boundary: the full tail becomes a stored page — the
+        pending CoW clone when one is open, a published shared extent
+        for prompt-only pages, a private page otherwise."""
+        packed = jnp.stack([sess.tail_k, sess.tail_v]).astype(
+            jnp.dtype(self.store_dtype)
+        )
+        raw = np.asarray(to_bytes(packed))
+        arrays = (sess.tail_k, sess.tail_v)
+        prompt_only = sess.pos <= len(sess.prompt)
+        pending = next((e for e in sess.entries if e.pending_fill), None)
+        if pending is not None:
+            self.store.write_page(pending.page, raw)
+            entry = pending
+            entry.pending_fill = False
+        else:
+            page = self.store.alloc_page(raw)
+            entry = _Entry(page=page)
+            sess.entries.append(entry)
+        if (self.prefix is not None and prompt_only and sess.chain_valid
+                and not entry.page.shared):
+            ext = self.prefix.publish(
+                sess.chain_parent, tuple(sess.page_toks), entry.page
+            )
+            entry.page = ext.page  # dedup may have swapped in the winner
+            entry.extent = ext
+            self.prefix.acquire(ext)
+            sess.shared_refs.append(ext)
+            sess.chain_parent = ext
+        elif not prompt_only:
+            sess.chain_valid = False  # generated content: never publish
+        entry.arrays = arrays
+        entry.version = entry.page.version
+        sess.reset_tail()
+
+    def _publish_partial(self, sess: _Session) -> None:
+        """End of prefill mid-page: publish the prompt's partial tail as
+        a shareable extent (retention-only — this session's own copy
+        stays in its tail buffers)."""
+        if (self.prefix is None or not sess.chain_valid
+                or sess.tail_len == 0):
+            return
+        prompt_toks = sess.page_toks[:sess.tail_len]
+        if sess.pos > len(sess.prompt):
+            return
+        packed = jnp.stack([sess.tail_k, sess.tail_v]).astype(
+            jnp.dtype(self.store_dtype)
+        )
+        raw = np.asarray(to_bytes(packed))
+        page = self.store.alloc_page(raw)
+        self.prefix.publish(sess.chain_parent, tuple(prompt_toks), page)
+
+    def _finish(self, sess: _Session, abandon: bool = False) -> None:
+        for ext in sess.shared_refs:
+            self.prefix.release(ext)
+        sess.shared_refs = []
+        for e in sess.entries:
+            if e.extent is None and not e.page.shared and not e.page.freed:
+                self.store.free_page(e.page)
+        sess.entries = []
+        if not abandon:
+            self.results.append(SessionResult(
+                tenant=sess.req.tenant,
+                prompt_len=len(sess.prompt),
+                out_tokens=list(sess.out),
+                stall_s=round(sess.stall_s, 6),
+                prefix_tokens_reused=sess.prefix_tokens_reused,
+            ))
+
+    # -- introspection ----------------------------------------------------
+
+    def metrics_meta(self) -> dict:
+        meta = self.stats.snapshot()
+        meta["prefetch"]["mode"] = self.prefetcher.mode
+        if self.prefix is not None:
+            meta["prefix"]["shared_bytes_live"] = self.prefix.shared_bytes()
+        meta["cold_sim"] = self.store.cold_sim
+        return meta

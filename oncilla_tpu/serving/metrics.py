@@ -1,0 +1,197 @@
+"""Serving-side metrics: counters + the co-located publication registry.
+
+Stdlib-only BY CONTRACT (the same rule as ``obs/``): the runtime daemon
+imports this module from ``_on_status`` to pick up a co-located engine's
+stats, and that import must never pull jax or the model stack into a
+daemon process that serves no model at all.
+
+The serving engine is an *application* (a client of the runtime), so its
+metrics cannot ride a daemon's own counters the way qos/elastic state
+does. Instead every live :class:`ServingStats` registers itself here;
+a daemon **in the same process** (the TPU-VM deployment shape, and every
+``local_cluster`` harness) folds :func:`colocated` into its STATUS /
+STATUS_PROM tails, which is how the obs cluster table and the
+``ocm_serving_*`` Prometheus families light up with zero new MsgTypes —
+the PR-9 discipline (observability stays in-band and filesystem/process
+-side, never a new wire surface).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_published: dict[str, "ServingStats"] = {}
+
+
+class ServingStats:
+    """Thread-safe counter block for one serving engine.
+
+    All mutation goes through the ``note_*`` methods; :meth:`snapshot`
+    returns the plain-dict meta that STATUS tails, ``obs/prom.py`` and
+    the cluster table render. Byte figures are *live* occupancy (gauges);
+    token/stall/move figures are lifetime counters.
+    """
+
+    def __init__(self, engine: str = "engine") -> None:
+        self.engine = engine
+        self._mu = threading.Lock()
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        # Page-residency lookups at schedule time: hit = the page was
+        # already decode-resident (hot tier), miss = a fetch was needed.
+        self.lookups = 0
+        self.hits = 0
+        self.promotes = 0
+        self.demotes = 0
+        self.cow_copies = 0
+        # Prefix sharing.
+        self.prefix_hits = 0
+        self.prefix_shared_bytes = 0
+        self.prefix_extents = 0
+        # Prefetch / stall.
+        self.prefetch_issued = 0
+        self.prefetch_completed = 0
+        self.stalls = 0
+        self.stall_s = 0.0
+        # Live per-tier occupancy (set absolutely by the page store).
+        self.tier_bytes: dict[str, int] = {}
+        self.tier_pages: dict[str, int] = {}
+        # Cold-tier (remote) data-plane traffic.
+        self.remote_bytes_in = 0
+        self.remote_bytes_out = 0
+
+    # -- mutation ---------------------------------------------------------
+
+    def note_tokens(self, n: int, phase: str = "decode") -> None:
+        with self._mu:
+            if phase == "prefill":
+                self.prefill_tokens += n
+            else:
+                self.decode_tokens += n
+
+    def note_lookup(self, hit: bool) -> None:
+        with self._mu:
+            self.lookups += 1
+            if hit:
+                self.hits += 1
+
+    def note_move(self, promote: bool) -> None:
+        with self._mu:
+            if promote:
+                self.promotes += 1
+            else:
+                self.demotes += 1
+
+    def note_cow(self) -> None:
+        with self._mu:
+            self.cow_copies += 1
+
+    def note_prefix_hit(self, shared_bytes: int) -> None:
+        with self._mu:
+            self.prefix_hits += 1
+            self.prefix_shared_bytes += shared_bytes
+
+    def note_prefix_release(self, shared_bytes: int) -> None:
+        with self._mu:
+            self.prefix_shared_bytes -= shared_bytes
+
+    def note_extents(self, delta: int) -> None:
+        with self._mu:
+            self.prefix_extents += delta
+
+    def note_prefetch(self, completed: bool = False) -> None:
+        with self._mu:
+            if completed:
+                self.prefetch_completed += 1
+            else:
+                self.prefetch_issued += 1
+
+    def note_stall(self, seconds: float) -> None:
+        with self._mu:
+            self.stalls += 1
+            self.stall_s += seconds
+
+    def note_remote(self, nbytes: int, inbound: bool) -> None:
+        with self._mu:
+            if inbound:
+                self.remote_bytes_in += nbytes
+            else:
+                self.remote_bytes_out += nbytes
+
+    def set_occupancy(self, tier_pages: dict[str, int],
+                      tier_bytes: dict[str, int]) -> None:
+        with self._mu:
+            self.tier_pages = dict(tier_pages)
+            self.tier_bytes = dict(tier_bytes)
+
+    # -- export -----------------------------------------------------------
+
+    @property
+    def hit_ratio(self) -> float:
+        with self._mu:
+            return self.hits / self.lookups if self.lookups else 0.0
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            lookups, hits = self.lookups, self.hits
+            return {
+                "engine": self.engine,
+                "tokens": {
+                    "prefill": self.prefill_tokens,
+                    "decode": self.decode_tokens,
+                },
+                "lookups": lookups,
+                "hits": hits,
+                "hit_ratio": round(hits / lookups, 4) if lookups else 0.0,
+                "tier_bytes": dict(self.tier_bytes),
+                "tier_pages": dict(self.tier_pages),
+                "prefix": {
+                    "hits": self.prefix_hits,
+                    "shared_bytes": max(self.prefix_shared_bytes, 0),
+                    "extents": self.prefix_extents,
+                    "cow": self.cow_copies,
+                },
+                "stalls": self.stalls,
+                "stall_s": round(self.stall_s, 6),
+                "prefetch": {
+                    "issued": self.prefetch_issued,
+                    "completed": self.prefetch_completed,
+                },
+                "moves": {
+                    "promote": self.promotes,
+                    "demote": self.demotes,
+                },
+                "remote_bytes": {
+                    "in": self.remote_bytes_in,
+                    "out": self.remote_bytes_out,
+                },
+            }
+
+
+# -- co-located publication -------------------------------------------------
+
+
+def publish(stats: ServingStats) -> None:
+    """Register a live engine's stats for same-process daemons to fold
+    into their STATUS tails. Idempotent per engine name (latest wins —
+    a restarted engine under the same name replaces the stale block)."""
+    with _lock:
+        _published[stats.engine] = stats
+
+
+def unpublish(stats: ServingStats) -> None:
+    with _lock:
+        cur = _published.get(stats.engine)
+        if cur is stats:
+            del _published[stats.engine]
+
+
+def colocated() -> dict | None:
+    """Snapshot every published engine's meta: the ``serving`` STATUS /
+    prom tail, or None when no engine lives in this process."""
+    with _lock:
+        stats = list(_published.values())
+    if not stats:
+        return None
+    return {"engines": [s.snapshot() for s in stats]}

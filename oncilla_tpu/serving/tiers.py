@@ -1,0 +1,436 @@
+"""Tiered KV page store: HBM -> local host arena -> remote arenas.
+
+The storage half of the serving scenario (ROADMAP item 1): fixed-size KV
+pages live in exactly one of three tiers —
+
+- ``HOT``  — device HBM extents (``core/hbm.py``'s DeviceArena through an
+  :class:`~oncilla_tpu.core.context.Ocm` LOCAL_DEVICE handle). Lit
+  opportunistically: on the CPU fallback the arena is a jax CPU buffer
+  and the tier stays byte-faithful (BENCH r03-r05: the TPU tunnel stays
+  wedged in this container); if the device arena cannot take a page the
+  store degrades that allocation to WARM instead of failing.
+- ``WARM`` — this host's DRAM arena (``core/hostmem.py``, LOCAL_HOST).
+- ``COLD`` — remote arenas over the existing striped/fabric/mux data
+  plane (REMOTE_HOST through a ``ControlPlaneClient`` — or, when the
+  store runs without a control plane, a LOCAL_HOST stand-in flagged
+  ``cold_sim`` so a benchmark can never mistake loopback for DCN).
+
+Movement is **watermark-driven**: each bounded tier demotes LRU pages to
+the next tier down when occupancy crosses its high watermark, down to
+its low watermark — the same high/low discipline as the daemon reaper's
+``_pressure_evict``. Promotion reads through the PR-3 registered-
+receive-buffer path (``get(out=)`` / ``get_into``): the store keeps one
+page-sized staging buffer and every fetch lands in it, never in a fresh
+allocation.
+
+The QoS mapping (PR 6): tiers correspond to priority classes —
+``TIER_PRIORITY`` maps HOT/WARM/COLD onto PRIO_HIGH/PRIO_NORMAL/
+PRIO_LOW. A deployment gives the cold-tier client a PRIO_LOW profile at
+CONNECT, so when a remote owner runs hot the daemon-side evictor and
+this store agree on who goes first: cold serving pages are the
+preferred victims everywhere. Within the store the serving-side evictor
+enforces the matching invariant — a **shared** extent (prefix-cache
+page with live references) is never victimized while referenced, just
+as ``_pressure_evict`` never takes an active above-low entry.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from oncilla_tpu.core.errors import OcmError, OcmInvalidHandle
+from oncilla_tpu.core.handle import OcmAlloc
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.qos.policy import PRIO_HIGH, PRIO_LOW, PRIO_NORMAL
+from oncilla_tpu.serving.metrics import ServingStats
+from oncilla_tpu.utils.debug import printd
+
+
+class Tier(enum.Enum):
+    HOT = "hbm"
+    WARM = "host"
+    COLD = "remote"
+
+
+#: The PR-6 QoS mapping: what priority class each tier's allocations
+#: should declare at CONNECT, so daemon-side pressure eviction and the
+#: serving-side evictor enforce one policy.
+TIER_PRIORITY = {
+    Tier.HOT: PRIO_HIGH,
+    Tier.WARM: PRIO_NORMAL,
+    Tier.COLD: PRIO_LOW,
+}
+
+_ORDER = (Tier.HOT, Tier.WARM, Tier.COLD)
+
+
+@dataclass
+class Page:
+    """One KV page: fixed-size bytes living in exactly one tier."""
+
+    page_id: int
+    nbytes: int
+    tier: Tier
+    handle: OcmAlloc
+    last_use: int = 0
+    pins: int = 0
+    #: Prefix-cache references (cross-tenant sharing). A page with
+    #: ``shared`` set and ``refs > 0`` is immutable and unevictable.
+    shared: bool = False
+    refs: int = 0
+    #: Bumped on every rewrite; stale prefetched bytes are discarded on
+    #: version mismatch.
+    version: int = 0
+    freed: bool = field(default=False, compare=False)
+
+
+class TieredPageStore:
+    """Fixed-page-size store over three tiers with watermark demotion.
+
+    Single-writer discipline: all tier *mutation* (alloc/promote/demote/
+    free) happens on the engine thread; prefetch workers only ever fetch
+    bytes (:meth:`fetch_bytes` is read-only and thread-safe), and the
+    engine installs the result. ``stats`` mutation is internally locked.
+    """
+
+    def __init__(
+        self,
+        ctx,
+        page_bytes: int,
+        hot_capacity: int = 8,
+        warm_capacity: int = 16,
+        cold_backend=None,
+        high_pct: int = 90,
+        low_pct: int = 70,
+        stats: ServingStats | None = None,
+    ):
+        self.ctx = ctx
+        self.page_bytes = int(page_bytes)
+        self.capacity = {Tier.HOT: int(hot_capacity),
+                         Tier.WARM: int(warm_capacity),
+                         Tier.COLD: 1 << 30}
+        self.high_pct = high_pct
+        self.low_pct = low_pct
+        self.cold_backend = cold_backend
+        #: True when COLD is simulated in the local host arena (no
+        #: control plane attached): benchmarks must label the cell.
+        self.cold_sim = cold_backend is None
+        self.stats = stats or ServingStats()
+        self.pages: dict[int, Page] = {}
+        self._ids = itertools.count(1)
+        self._clock = itertools.count(1)
+        # The registered receive buffer for tier moves (PR-3 get(out=)):
+        # one page-sized staging window reused by every engine-thread
+        # fetch. Prefetch workers bring their own (serving/engine.py).
+        self._recvbuf = np.empty(self.page_bytes, dtype=np.uint8)
+        self._mu = threading.Lock()
+
+    # -- tier backends ----------------------------------------------------
+
+    def _alloc_in(self, tier: Tier) -> OcmAlloc:
+        if tier == Tier.HOT:
+            return self.ctx.alloc(self.page_bytes, OcmKind.LOCAL_DEVICE)
+        if tier == Tier.WARM:
+            return self.ctx.alloc(self.page_bytes, OcmKind.LOCAL_HOST)
+        if self.cold_backend is not None:
+            return self.cold_backend.alloc(self.page_bytes,
+                                           OcmKind.REMOTE_HOST)
+        return self.ctx.alloc(self.page_bytes, OcmKind.LOCAL_HOST)
+
+    def _free_handle(self, tier: Tier, handle: OcmAlloc) -> None:
+        if tier == Tier.COLD and self.cold_backend is not None:
+            self.cold_backend.free(handle)
+        else:
+            self.ctx.free(handle)
+
+    def _put(self, tier: Tier, handle: OcmAlloc, data: np.ndarray) -> None:
+        if tier == Tier.COLD and self.cold_backend is not None:
+            self.cold_backend.put(handle, data, 0)
+            self.stats.note_remote(data.nbytes, inbound=False)
+        else:
+            self.ctx.put(handle, data, 0)
+
+    def _get(self, tier: Tier, handle: OcmAlloc, nbytes: int,
+             out: np.ndarray | None):
+        """Read a page's bytes, landing in ``out`` when given (the
+        registered-receive path: ``get_into`` on the DCN leg, ``get(out=)``
+        through the context)."""
+        if tier == Tier.COLD and self.cold_backend is not None:
+            if out is not None:
+                get_into = getattr(self.cold_backend, "get_into", None)
+                if get_into is not None:
+                    res = get_into(handle, out[:nbytes], 0)
+                else:
+                    res = out
+                    out[:nbytes] = np.asarray(
+                        self.cold_backend.get(handle, nbytes, 0)
+                    ).view(np.uint8).reshape(-1)
+            else:
+                res = self.cold_backend.get(handle, nbytes, 0)
+            self.stats.note_remote(nbytes, inbound=True)
+            return np.asarray(res).view(np.uint8).reshape(-1)[:nbytes]
+        if out is not None:
+            return np.asarray(
+                self.ctx.get(handle, out=out[:nbytes])
+            ).reshape(-1)
+        raw = self.ctx.get(handle, nbytes, 0)
+        return np.asarray(raw).view(np.uint8).reshape(-1)[:nbytes]
+
+    # -- occupancy --------------------------------------------------------
+
+    def _live(self, tier: Tier) -> list[Page]:
+        return [p for p in self.pages.values() if p.tier == tier]
+
+    def occupancy(self) -> dict:
+        out = {}
+        for t in _ORDER:
+            live = self._live(t)
+            out[t.value] = {"pages": len(live),
+                            "bytes": sum(p.nbytes for p in live)}
+        return out
+
+    def _sync_stats(self) -> None:
+        occ = self.occupancy()
+        self.stats.set_occupancy(
+            {k: v["pages"] for k, v in occ.items()},
+            {k: v["bytes"] for k, v in occ.items()},
+        )
+
+    # -- page lifecycle ---------------------------------------------------
+
+    def touch(self, page: Page) -> None:
+        page.last_use = next(self._clock)
+
+    def _check_live(self, page: Page) -> None:
+        if page.freed or page.page_id not in self.pages:
+            raise OcmInvalidHandle(f"use of freed page {page.page_id}")
+
+    def alloc_page(self, data, shared: bool = False,
+                   prefer: Tier = Tier.HOT) -> Page:
+        """Store one page of bytes, preferring ``prefer`` and degrading
+        down-tier when the preferred arena is full (HBM lit
+        opportunistically), then enforce watermarks."""
+        raw = np.ascontiguousarray(np.asarray(data)).view(
+            np.uint8).reshape(-1)
+        if raw.nbytes != self.page_bytes:
+            raise ValueError(
+                f"page is {raw.nbytes} B, store built for {self.page_bytes}"
+            )
+        start = _ORDER.index(prefer)
+        last_err: Exception | None = None
+        for tier in _ORDER[start:]:
+            # LRU residents demote to make room for the newcomer; if
+            # nothing is demotable (all pinned / referenced-shared) the
+            # newcomer degrades a tier instead — never the residents.
+            self._make_room(tier)
+            if len(self._live(tier)) >= self.capacity[tier]:
+                continue
+            try:
+                handle = self._alloc_in(tier)
+            except OcmError as e:  # arena full / remote BUSY: degrade a tier
+                last_err = e
+                printd("serving: %s tier alloc degraded: %s", tier.value, e)
+                continue
+            self._put(tier, handle, raw)
+            page = Page(next(self._ids), self.page_bytes, tier, handle,
+                        shared=shared)
+            self.touch(page)
+            self.pages[page.page_id] = page
+            self.enforce_watermarks()
+            self._sync_stats()
+            return page
+        raise OcmError(
+            f"no tier can take a page (last error: {last_err})"
+        )
+
+    def read_page(self, page: Page, out: np.ndarray | None = None
+                  ) -> np.ndarray:
+        """The page's bytes (registered-receive into ``out`` when given;
+        else into the store's staging buffer for non-hot tiers)."""
+        self._check_live(page)
+        self.touch(page)
+        if out is None and page.tier != Tier.HOT:
+            out = self._recvbuf
+        return self._get(page.tier, page.handle, page.nbytes, out)
+
+    def write_page(self, page: Page, data) -> None:
+        """Rewrite a page in place. Forbidden on a referenced shared
+        page — that is what :meth:`cow` is for (a write would corrupt
+        every other tenant's context)."""
+        self._check_live(page)
+        if page.shared and page.refs > 0:
+            raise OcmInvalidHandle(
+                f"write to shared page {page.page_id} with {page.refs} "
+                "live reference(s); copy-on-write first"
+            )
+        raw = np.ascontiguousarray(np.asarray(data)).view(
+            np.uint8).reshape(-1)
+        if raw.nbytes != page.nbytes:
+            raise ValueError(f"page write of {raw.nbytes} B into "
+                             f"{page.nbytes} B page")
+        self._put(page.tier, page.handle, raw)
+        page.version += 1
+        self.touch(page)
+
+    def cow(self, page: Page) -> Page:
+        """Copy-on-write: a private copy of a (typically shared) page,
+        placed by the normal tier policy. The original — and every other
+        tenant's view of it — is untouched."""
+        self._check_live(page)
+        data = self.read_page(page)
+        clone = self.alloc_page(np.array(data, copy=True), shared=False)
+        self.stats.note_cow()
+        obs_journal.record("page_cow", src=page.page_id,
+                           dst=clone.page_id, nbytes=page.nbytes)
+        return clone
+
+    def free_page(self, page: Page) -> None:
+        if page.freed:
+            return
+        if page.shared and page.refs > 0:
+            raise OcmInvalidHandle(
+                f"free of shared page {page.page_id} with {page.refs} "
+                "live reference(s)"
+            )
+        del self.pages[page.page_id]
+        page.freed = True
+        self._free_handle(page.tier, page.handle)
+        self._sync_stats()
+
+    def close(self) -> None:
+        """Free every live page (shared ones included: teardown)."""
+        for page in list(self.pages.values()):
+            page.refs = 0
+            self.free_page(page)
+
+    # -- movement ---------------------------------------------------------
+
+    def _move(self, page: Page, to: Tier,
+              data: np.ndarray | None = None) -> None:
+        """Relocate a page's bytes between tiers. ``data`` short-cuts
+        the read when the caller already fetched the current version
+        (prefetch); it must be version-checked by the caller."""
+        if page.tier == to:
+            return
+        if data is None:
+            data = self.read_page(page)
+        try:
+            new_handle = self._alloc_in(to)
+        except OcmError as e:
+            # Opportunistic tier: a full target arena cancels the move,
+            # never the page.
+            printd("serving: move of page %d to %s declined: %s",
+                   page.page_id, to.value, e)
+            return
+        self._put(to, new_handle, np.asarray(data))
+        with self._mu:
+            old_tier, old_handle = page.tier, page.handle
+            page.tier, page.handle = to, new_handle
+            # Any relocation invalidates in-flight prefetched bytes: a
+            # worker mid-read of the OLD extent (freed and scrubbed
+            # below) must see its version check fail at install time.
+            page.version += 1
+        self._free_handle(old_tier, old_handle)
+        promote = _ORDER.index(to) < _ORDER.index(old_tier)
+        self.stats.note_move(promote)
+        obs_journal.record(
+            "page_promote" if promote else "page_demote",
+            page_id=page.page_id, src=old_tier.value, dst=to.value,
+            nbytes=page.nbytes, shared=page.shared, refs=page.refs,
+        )
+        self._sync_stats()
+
+    def promote(self, page: Page, to: Tier = Tier.HOT,
+                data: np.ndarray | None = None,
+                version: int | None = None) -> None:
+        """Move a page up-tier (the page-fault / prefetch-install path).
+        ``data``+``version`` come from a prefetch worker; a version
+        mismatch (the page was rewritten since the fetch was issued)
+        discards the stale bytes and re-reads."""
+        self._check_live(page)
+        if version is not None and version != page.version:
+            data = None
+        if _ORDER.index(to) >= _ORDER.index(page.tier):
+            return
+        # Make room FIRST so the promotion itself cannot bounce off a
+        # full target tier.
+        self._make_room(to)
+        self._move(page, to, data=data)
+        self.touch(page)
+        self.enforce_watermarks()
+
+    def demote(self, page: Page, to: Tier) -> None:
+        self._check_live(page)
+        if _ORDER.index(to) <= _ORDER.index(page.tier):
+            return
+        self._move(page, to)
+
+    def pin(self, page: Page) -> None:
+        page.pins += 1
+
+    def unpin(self, page: Page) -> None:
+        page.pins = max(0, page.pins - 1)
+
+    # -- watermark eviction ----------------------------------------------
+
+    def _victims(self, tier: Tier) -> list[Page]:
+        """Demotion candidates, LRU-first. NEVER a pinned page, and
+        NEVER a shared extent while referenced — the serving-side twin
+        of the reaper's never-an-active-above-low guarantee."""
+        return sorted(
+            (p for p in self._live(tier)
+             if p.pins == 0 and not (p.shared and p.refs > 0)),
+            key=lambda p: p.last_use,
+        )
+
+    def _make_room(self, tier: Tier) -> None:
+        """Demote until ``tier`` has a free slot (promotion headroom)."""
+        nxt = {Tier.HOT: Tier.WARM, Tier.WARM: Tier.COLD}.get(tier)
+        if nxt is None:
+            return
+        while len(self._live(tier)) >= self.capacity[tier]:
+            victims = self._victims(tier)
+            if not victims:
+                return  # everything pinned/referenced: overshoot allowed
+            self._make_room(nxt)
+            self._move(victims[0], nxt)
+
+    def enforce_watermarks(self) -> None:
+        """High/low watermark demotion per bounded tier, exactly the
+        daemon reaper's ``_pressure_evict`` shape: past high, demote
+        LRU victims down to low."""
+        for tier, nxt in ((Tier.HOT, Tier.WARM), (Tier.WARM, Tier.COLD)):
+            cap = self.capacity[tier]
+            # Floor at one page: integer watermark math on a tiny tier
+            # must never read "demote everything, always".
+            high = max(cap * self.high_pct // 100, 1)
+            low = max(cap * self.low_pct // 100, 1)
+            if len(self._live(tier)) <= high:
+                continue
+            for victim in self._victims(tier):
+                if len(self._live(tier)) <= low:
+                    break
+                self._move(victim, nxt)
+
+    # -- prefetch support -------------------------------------------------
+
+    def fetch_bytes(self, page: Page, out: np.ndarray) -> tuple[int, bool]:
+        """Thread-safe read of a page's bytes into the caller's
+        registered buffer (prefetch workers): returns (version, ok).
+        Read-only — tier installation happens on the engine thread via
+        :meth:`promote`."""
+        with self._mu:
+            if page.freed:
+                return (page.version, False)
+            tier, handle, version = page.tier, page.handle, page.version
+        try:
+            self._get(tier, handle, page.nbytes, out)
+        except OcmError:
+            return (version, False)
+        return (version, True)

@@ -103,6 +103,17 @@ echo "== leader chaos smoke =="
 # audit including the leader-unique and placement-agreement invariants.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --leader-smoke || fail=1
 
+echo "== serving smoke =="
+# Flagship serving workload (serving/): paired shared-vs-noshare decode
+# cells over a 3-daemon cluster (outputs must be byte-identical, sharing
+# must show prefix hits + a CoW adoption + strictly fewer remote bytes),
+# the AsyncOcm prefetch leg under OCM_MUX, and the chaos leg — kill the
+# cold-page owner mid-decode with OCM_REPLICAS=2, decode byte-exact
+# through failover, twice with identical interleavings, wrapped in the
+# flight-recorder invariant audit; alloctrace ledger drained on every
+# surviving rank. CPU-only.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.serving --smoke || fail=1
+
 echo "== obs audit smoke =="
 # Flight recorder + cross-rank invariant auditor, end to end through
 # the CLI: re-run the kill-owner chaos scenario with OCM_FLIGHTREC

@@ -405,8 +405,12 @@ def test_hash_placement_backpressure_busy():
             priority=2,
             heartbeat_s=5.0,
         )
+        # Distinct tenant identity: with the default app_id (the OS
+        # pid) BOTH clients are one app to the daemon, and hi.close()'s
+        # DISCONNECT reclamation races — and sometimes wins against —
+        # the other tenant's free of `held` (flaky BAD_ALLOC_ID).
         hi = ControlPlaneClient(c.entries, 0, config=hicfg,
-                                heartbeat=False)
+                                heartbeat=False, app_id=0x5eed)
         hh = hi.alloc(256 << 10, OcmKind.REMOTE_HOST)
         assert c.daemons[0].host_arena.allocator.bytes_live > live_before
         hi.free(hh)
