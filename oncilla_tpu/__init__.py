@@ -34,8 +34,10 @@ from oncilla_tpu.core.context import (
 from oncilla_tpu.core.errors import (
     OcmAdmissionDenied,
     OcmBoundsError,
+    OcmBreakerOpen,
     OcmBusy,
     OcmConnectError,
+    OcmDeadlineExceeded,
     OcmError,
     OcmInvalidHandle,
     OcmMoved,
@@ -61,9 +63,11 @@ __all__ = [
     "OcmAdmissionDenied",
     "OcmAlloc",
     "OcmBoundsError",
+    "OcmBreakerOpen",
     "OcmBusy",
     "OcmConfig",
     "OcmConnectError",
+    "OcmDeadlineExceeded",
     "OcmError",
     "OcmInvalidHandle",
     "OcmKind",

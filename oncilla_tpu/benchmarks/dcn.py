@@ -572,6 +572,92 @@ def dcn_mux_sweep(
     return out
 
 
+def dcn_hedge_sweep(nbytes: int = 256 << 10, rounds: int = 40,
+                    delay_ms: float = 20.0, hedge_ms: int = 5) -> dict:
+    """Paired hedged-vs-unhedged replicated-read cells ("The Tail at
+    Scale"): a 3-daemon in-process cluster with OCM_REPLICAS=2 and an
+    ARTIFICIALLY SLOW primary chain member (every DATA_GET it serves is
+    stalled ``delay_ms``), read ``rounds`` times by two clients over
+    the same handle — one plain, one with ``OCM_HEDGE_MS=hedge_ms`` so
+    a second read fires at the healthy replica after the hedge delay
+    and the first answer wins. Records per-arm p50/p99 and asserts
+    BOTH arms byte-exact and the hedged p99 strictly below the
+    unhedged one (the loser's extra read is the price; measured on the
+    1-core container — the PR-3 caveat — where both arms also share
+    one core with the serving daemons)."""
+    import dataclasses
+
+    from oncilla_tpu.runtime.cluster import local_cluster
+    from oncilla_tpu.runtime.protocol import MsgType
+
+    base = OcmConfig(
+        host_arena_bytes=8 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=256 << 10,
+        dcn_stripes=1,
+        replicas=2,
+        hedge_ms=0,
+    )
+    data = _bench_data(nbytes)
+
+    def percentiles(lat_s: list[float]) -> dict:
+        s = sorted(lat_s)
+        return {
+            "p50_ms": round(s[len(s) // 2] * 1e3, 3),
+            "p99_ms": round(s[min(len(s) - 1,
+                                  int(len(s) * 0.99))] * 1e3, 3),
+        }
+
+    out: dict = {"nbytes": nbytes, "rounds": rounds,
+                 "slow_primary_delay_ms": delay_ms,
+                 "hedge_ms": hedge_ms}
+    with local_cluster(3, config=base) as cl:
+        seed_client = cl.client(0, heartbeat=False)
+        h = seed_client.alloc(nbytes, OcmKind.REMOTE_HOST)
+        try:
+            if not h.replica_ranks:
+                raise AssertionError("k=2 placement assigned no replica")
+            seed_client.put(h, data)
+            # The slow chain member is the PRIMARY: unhedged reads must
+            # eat its stall in full, hedged ones escape to the healthy
+            # replica.
+            slow = cl.daemons[h.rank]
+            slow.serve_delay_types = frozenset({MsgType.DATA_GET})
+            slow.serve_delay_s = delay_ms / 1e3
+            for arm, hedge in (("unhedged", 0), ("hedged", hedge_ms)):
+                cfg = dataclasses.replace(base, hedge_ms=hedge)
+                client = ControlPlaneClient(cl.entries, 0, config=cfg,
+                                            heartbeat=False)
+                try:
+                    lats = []
+                    for _ in range(rounds):
+                        t0 = time.perf_counter()
+                        got = client.get(h, nbytes)
+                        lats.append(time.perf_counter() - t0)
+                        if not np.array_equal(got, data):
+                            raise AssertionError(
+                                f"{arm} replicated get not byte-exact"
+                            )
+                finally:
+                    client.close(detach=True)
+                out[arm] = percentiles(lats)
+            slow.serve_delay_s = 0.0
+            slow.serve_delay_types = frozenset()
+        finally:
+            seed_client.free(h)
+    if out["hedged"]["p99_ms"] >= out["unhedged"]["p99_ms"]:
+        raise AssertionError(
+            f"hedged p99 {out['hedged']['p99_ms']} ms not strictly "
+            f"below unhedged {out['unhedged']['p99_ms']} ms"
+        )
+    out["note"] = (
+        "1-core container: both arms and the daemons share one core "
+        "(PR-3 caveat); the delta tracks the injected primary stall"
+    )
+    out["verified"] = True
+    return out
+
+
 def smoke(nbytes: int = 4 << 20) -> dict:
     """Seconds-scale loopback DCN smoke for CI (scripts/check.sh): a tiny
     striped put/get roundtrip through an in-process 2-daemon cluster,
@@ -722,6 +808,10 @@ def main(argv=None) -> int:
                          "byte-exactness and the fd budget")
     ap.add_argument("--tenants", type=int, default=None,
                     help="tenant count for the --mux sweep (default 64)")
+    ap.add_argument("--hedge", action="store_true",
+                    help="paired hedged-vs-unhedged replicated-read "
+                         "cells with one artificially slow primary "
+                         "chain member (resilience/timebudget.py)")
     ap.add_argument("--daemon", choices=["python", "native", "both"],
                     default=None,
                     help="which daemon serves: the Python reference, the "
@@ -732,7 +822,12 @@ def main(argv=None) -> int:
                     help="deprecated alias for --daemon python")
     args = ap.parse_args(argv)
     daemon = args.daemon or ("python" if args.python_daemons else None)
-    if args.mux:
+    if args.hedge:
+        out = dcn_hedge_sweep(
+            nbytes=args.nbytes or (256 << 10),
+            rounds=12 if args.smoke else 40,
+        )
+    elif args.mux:
         out = dcn_mux_sweep(
             tenants=args.tenants or (8 if args.smoke else 64),
             smoke=args.smoke,

@@ -155,6 +155,7 @@ class Ocm:
         kind: OcmKind = OcmKind.LOCAL_HOST,
         device_index: int = 0,
         local_nbytes: int | None = None,
+        deadline_ms: int | None = None,
     ) -> OcmAlloc:
         """``ocm_alloc`` (/root/reference/src/lib.c:175). ``local_nbytes``
         (remote kinds only) sizes the app-side staging window smaller than
@@ -187,7 +188,9 @@ class Ocm:
                     origin_rank=0,
                 )
             else:
-                h = self._remote_or_raise(kind).alloc(nbytes, kind)
+                kw = ({} if deadline_ms is None
+                      else {"deadline_ms": deadline_ms})
+                h = self._remote_or_raise(kind).alloc(nbytes, kind, **kw)
                 h.local_nbytes = local_nbytes
             with self._lock:
                 self._allocs[h.alloc_id] = h
@@ -226,15 +229,25 @@ class Ocm:
         if handle.freed:
             raise OcmInvalidHandle(f"use of freed alloc {handle.alloc_id}")
 
-    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+    def put(self, handle: OcmAlloc, data, offset: int = 0,
+            deadline_ms: int | None = None) -> None:
         """One-sided write (``ocm_copy_onesided`` op_flag=1,
-        /root/reference/src/lib.c:670)."""
+        /root/reference/src/lib.c:670). ``deadline_ms`` bounds the op's
+        total time (resilience/timebudget.py): retry/failover ladders
+        clamp to it and an exhausted budget surfaces as typed
+        :class:`OcmDeadlineExceeded`. Local arms are a memcpy and
+        ignore it."""
         self._check_live(handle)
         data = _coerce_bytes(data)
         raw_n = _nbytes_of(data)
+        # Pass the deadline only when set: fake/minimal RemoteBackend
+        # implementations (tests, adapters) keep their old signature.
+        kw = {} if deadline_ms is None else {"deadline_ms": deadline_ms}
         with self.tracer.span("put", nbytes=raw_n):
             if handle.daemon_owned:
-                self._remote_or_raise(handle.kind).put(handle, data, offset)
+                self._remote_or_raise(handle.kind).put(
+                    handle, data, offset, **kw
+                )
             elif handle.kind == OcmKind.LOCAL_HOST:
                 self.host_arena.write(handle.extent, _to_numpy(data), offset)
             elif handle.kind == OcmKind.LOCAL_DEVICE:
@@ -242,10 +255,12 @@ class Ocm:
                     handle.extent, data, offset
                 )
             else:
-                self._remote_or_raise(handle.kind).put(handle, data, offset)
+                self._remote_or_raise(handle.kind).put(
+                    handle, data, offset, **kw
+                )
 
     def get(self, handle: OcmAlloc, nbytes: int | None = None, offset: int = 0,
-            out=None):
+            out=None, deadline_ms: int | None = None):
         """One-sided read (``ocm_copy_onesided`` op_flag=0). Returns uint8
         bytes: numpy for host arms, jax.Array for device arms.
 
@@ -254,12 +269,17 @@ class Ocm:
         buffer (sized by ``out``; via zero-copy ``recv_into`` on the DCN
         path, a fallback copy elsewhere) and ``out`` is returned — a
         fresh destination array per get costs a page fault per 4 KiB,
-        which at GB scale is most of the transfer time."""
+        which at GB scale is most of the transfer time.
+
+        ``deadline_ms`` bounds the op's total time (see :meth:`put`);
+        reads on a replicated handle under an armed ``OCM_HEDGE_MS``
+        may additionally be hedged against the replica chain."""
         self._check_live(handle)
         if out is not None:
             nbytes = out.nbytes
         elif nbytes is None:
             nbytes = handle.nbytes - offset
+        kw = {} if deadline_ms is None else {"deadline_ms": deadline_ms}
         with self.tracer.span("get", nbytes=nbytes):
             if out is not None:
                 backend = (
@@ -271,9 +291,9 @@ class Ocm:
                 if get_into is not None and handle.kind in (
                     OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST
                 ):
-                    return get_into(handle, out, offset)
+                    return get_into(handle, out, offset, **kw)
                 res = (
-                    backend.get(handle, nbytes, offset)
+                    backend.get(handle, nbytes, offset, **kw)
                     if backend is not None
                     else self.get(handle, nbytes, offset)
                 )
@@ -282,7 +302,7 @@ class Ocm:
                 return out
             if handle.daemon_owned:
                 return self._remote_or_raise(handle.kind).get(
-                    handle, nbytes, offset
+                    handle, nbytes, offset, **kw
                 )
             if handle.kind == OcmKind.LOCAL_HOST:
                 return self.host_arena.read(handle.extent, nbytes, offset)
@@ -290,7 +310,9 @@ class Ocm:
                 return self.device_arenas[handle.device_index].read(
                     handle.extent, nbytes, offset
                 )
-            return self._remote_or_raise(handle.kind).get(handle, nbytes, offset)
+            return self._remote_or_raise(handle.kind).get(
+                handle, nbytes, offset, **kw
+            )
 
     def get_as(self, handle: OcmAlloc, shape, dtype, offset: int = 0):
         """Typed one-sided read."""

@@ -80,6 +80,24 @@ class OcmMoved(OcmError):
         self.rank = int(rank)
 
 
+class OcmDeadlineExceeded(OcmError):
+    """The op's time budget ran out (resilience/timebudget.py) — locally
+    (a retry ladder clamped to zero remaining) or remotely (a daemon
+    refused already-expired work; wire: ErrCode.DEADLINE_EXCEEDED). Not
+    retryable: the budget is the caller's own contract, and surfacing it
+    typed is the whole point — a decode step that misses its token
+    budget sheds instead of hanging the batch."""
+
+
+class OcmBreakerOpen(OcmConnectError):
+    """A per-peer circuit breaker is OPEN (resilience/timebudget.py):
+    consecutive transport/deadline failures flipped the peer and this
+    attempt failed FAST instead of eating the op's budget. A subclass of
+    OcmConnectError on purpose — failover ladders treat it exactly like
+    an unreachable peer and walk to the next candidate; half-open probes
+    re-admit the peer once it answers again."""
+
+
 class OcmBusy(OcmError):
     """Back-pressure: the arena(s) crossed the high watermark and the
     daemon asks the client to retry later (wire: ErrCode.BUSY, retryable;

@@ -353,6 +353,38 @@ def _check_fenced(tl: Timeline) -> list[AuditFinding]:
     return out
 
 
+@invariant("cancel-ack-order")
+def _check_cancel_acks(tl: Timeline) -> list[AuditFinding]:
+    """No ack after a binding cancel-ack (resilience/timebudget.py):
+    once a daemon answered CANCEL with revoked=1 for a (conn, tag), the
+    op's reply was promised suppressed — a later ``mux_reply`` for the
+    same (track, conn, tag) means the client was told "revoked" and
+    then acked anyway, the double-outcome the revocation lock exists to
+    prevent. Walks single-process seq order only (the daemon records
+    both events), so clock skew cannot forge a violation; client-side
+    tag reuse within one connection would need 2^32 ops between the
+    cancel and the reuse."""
+    out = []
+    for jid, evs in tl.streams.items():
+        revoked_at: dict[tuple, dict] = {}
+        for e in evs:
+            ev = e.get("ev")
+            if ev not in ("cancel_ack", "mux_reply"):
+                continue
+            key = (e.get("track"), e.get("conn"), e.get("tag"))
+            if ev == "cancel_ack" and e.get("revoked"):
+                revoked_at.setdefault(key, e)
+            elif ev == "mux_reply" and key in revoked_at:
+                out.append(AuditFinding(
+                    rule="cancel-ack-order", rank=_rank_of(e),
+                    message=f"tagged reply for conn {e.get('conn')} tag "
+                            f"{e.get('tag')} sent AFTER its revoked "
+                            "cancel-ack (double outcome)",
+                    events=(_ref(revoked_at[key]), _ref(e)),
+                ))
+    return out
+
+
 @invariant("leader-unique")
 def _check_leader_unique(tl: Timeline) -> list[AuditFinding]:
     """At most one unfenced leader per epoch (control/): every

@@ -454,6 +454,31 @@ def render(meta: dict) -> str:
                    "stale).",
                    ela.get("tombstones", 0), rank=rank)
 
+    tb = meta.get("timebudget", {})
+    if tb:
+        doc.sample("ocm_deadline_exceeded_total", "counter",
+                   "Requests refused (or abandoned mid-dispatch) typed "
+                   "DEADLINE_EXCEEDED because their propagated time "
+                   "budget ran out.",
+                   tb.get("deadline_exceeded", 0), rank=rank)
+        doc.sample("ocm_cancels_total", "counter",
+                   "CANCEL requests served, by whether a queued/"
+                   "completed op was actually revoked.",
+                   tb.get("cancels_revoked", 0),
+                   rank=rank, outcome="revoked")
+        doc.sample("ocm_cancels_total", "counter",
+                   "CANCEL requests served, by whether a queued/"
+                   "completed op was actually revoked.",
+                   max(tb.get("cancels", 0)
+                       - tb.get("cancels_revoked", 0), 0),
+                   rank=rank, outcome="noop")
+        doc.sample("ocm_cancel_drops_total", "counter",
+                   "Replies suppressed after a binding cancel (queued "
+                   "ops skipped + completed ops dropped; completed "
+                   "REQ_ALLOCs additionally unwound via the free "
+                   "path).",
+                   tb.get("cancel_drops", 0), rank=rank)
+
     srv = meta.get("serving")
     if srv:
         _serving_samples(doc, srv, rank)

@@ -14,6 +14,9 @@ shape FaRM/RAMCloud proved out for disaggregated memory:
 - :mod:`chaos` — a seeded, deterministic fault-injection harness hooked
   into the connection-pool seam, so ``local_cluster`` tests replay
   identical failure interleavings from one integer seed.
+- :mod:`timebudget` — the time-bounded data plane ("The Tail at
+  Scale"): propagated per-op deadlines, budget-clamped retry backoffs,
+  per-peer circuit breakers, and the hedged-read delay policy.
 
 ``python -m oncilla_tpu.resilience --smoke`` runs the
 kill-the-owner-mid-workload scenario end to end, twice, and asserts the
@@ -32,3 +35,8 @@ from oncilla_tpu.resilience.detector import (  # noqa: F401
     probe,
 )
 from oncilla_tpu.resilience.failover import FailoverCoordinator  # noqa: F401
+from oncilla_tpu.resilience.timebudget import (  # noqa: F401
+    Budget,
+    CircuitBreaker,
+    backoff_sleep,
+)

@@ -468,6 +468,371 @@ _LEADER_SCENARIOS = (
 )
 
 
+# -- deadline chaos smoke (resilience/timebudget.py): budgets hold under
+# -- turbulence, hedges survive an owner kill, breakers open and recover,
+# -- cancels revoke server-side --------------------------------------------
+
+
+def _deadline_cfg():
+    from oncilla_tpu.utils.config import OcmConfig
+
+    return OcmConfig(
+        host_arena_bytes=32 << 20,
+        device_arena_bytes=8 << 20,
+        heartbeat_s=0.05,
+        lease_s=5.0,
+        replicas=2,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        dcn_stripes=1,
+        chunk_bytes=256 << 10,
+        failover_wait_s=10.0,
+        # The time-bounded plane under test: a 2 s default budget arms
+        # FLAG_CAP_DEADLINE on every CONNECT, 20 ms hedged replica
+        # reads, and a 2-strike breaker probing every 150 ms.
+        deadline_ms=2000,
+        hedge_ms=20,
+        breaker_threshold=2,
+        breaker_probe_ms=150,
+    )
+
+
+def run_deadline_scenario(seed: int, verbose: bool = False) -> dict:
+    """One full time-bounded-data-plane drill on a 3-daemon k=2
+    cluster; returns the replay record and raises on any failed check.
+
+    Four phases, all inside one seeded chaos controller (scheduled
+    faults are delay-only — the delay-heavy schedule — and every
+    placement-sensitive fault fires at a PROGRAM POINT via
+    ``controller.force`` with the deterministic op=-1 sentinel, so
+    lease-count jitter inside retry ladders can never shift the log):
+
+    1. budget bounds: every budgeted op resolves — success or typed
+       DEADLINE_EXCEEDED — within 1.5x its budget, through scheduled
+       delays, a serve-side stall that expires an alloc BEFORE its
+       quota is reserved, and a partitioned owner that expires a put.
+    2. hedged reads: a slow primary makes the hedge fire and win
+       byte-exact; a forced owner kill keeps every subsequent hedged
+       get byte-exact through failover.
+    3. breaker: a partitioned (sick-but-not-DEAD) rank flips OPEN after
+       two transfer failures, fails fast while open, and half-open
+       recovers after the heal.
+    4. cancel storm: an AsyncOcm tenant abandons slow allocs under
+       asyncio timeouts; the daemon revokes them server-side (cancel
+       counters move, completed allocs are unwound through the free
+       path) and every rank's registry drains.
+    """
+    import asyncio
+    import numpy as np
+
+    from oncilla_tpu.core.errors import (
+        OcmDeadlineExceeded,
+        OcmRemoteError,
+    )
+    from oncilla_tpu.core.kinds import OcmKind
+    from oncilla_tpu.obs import journal as obs_journal
+    from oncilla_tpu.runtime.cluster import local_cluster
+    from oncilla_tpu.runtime.protocol import ErrCode, MsgType
+
+    cfg = _deadline_cfg()
+    rng = np.random.default_rng(seed)
+    bounds: list[tuple[str, str]] = []  # (what, outcome) per budgeted op
+
+    def budgeted(what: str, budget_ms: int, fn) -> str:
+        """Run one budgeted op; record outcome; enforce the 1.5x
+        resolution bound (with a 100 ms floor for scheduler jitter on
+        the 1-core container)."""
+        t0 = time.monotonic()
+        try:
+            fn()
+            outcome = "ok"
+        except OcmDeadlineExceeded:
+            outcome = "deadline"
+        except OcmRemoteError as e:
+            if e.code != int(ErrCode.DEADLINE_EXCEEDED):
+                raise
+            outcome = "deadline"
+        dt_ms = (time.monotonic() - t0) * 1e3
+        limit = max(1.5 * budget_ms, budget_ms + 100.0)
+        assert dt_ms <= limit, (
+            f"{what}: resolved in {dt_ms:.0f} ms, past 1.5x its "
+            f"{budget_ms} ms budget"
+        )
+        bounds.append((what, outcome))
+        return outcome
+
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0)
+        schedule = ChaosSchedule.generate(
+            seed, 3, nfaults=4, span=10, actions=("delay",), protect=(),
+        )
+        controller = ChaosController(schedule, cl.entries,
+                                     kill_fn=cl.kill)
+        total = 1 << 20
+        data = rng.integers(0, 256, total, dtype=np.uint8)
+        with controller.inject():
+            # -- phase 1: budget bounds under a delay-heavy schedule --
+            h1 = client.alloc(total, OcmKind.REMOTE_HOST)
+            assert h1.replica_ranks, "k=2 placement assigned no replica"
+            owner = h1.rank
+            budgeted("calm put", 600,
+                     lambda: client.put(h1, data, 0, deadline_ms=600))
+            step = 256 << 10
+            for off in range(0, total, step):
+                budgeted(
+                    f"delayed put@{off}", 600,
+                    lambda off=off: client.put(
+                        h1, data[off:off + step], off, deadline_ms=600
+                    ),
+                )
+            # A daemon-side stall longer than the budget: the alloc is
+            # refused typed BEFORE admission can reserve quota.
+            live_before = sum(d.registry.live_count() for d in cl.daemons)
+            cl.daemons[0].serve_delay_types = frozenset(
+                {MsgType.REQ_ALLOC}
+            )
+            cl.daemons[0].serve_delay_s = 0.25
+            out = budgeted(
+                "expired alloc", 220,
+                lambda: client.alloc(64 << 10, OcmKind.REMOTE_HOST,
+                                     deadline_ms=220),
+            )
+            assert out == "deadline", "stalled alloc was not refused typed"
+            cl.daemons[0].serve_delay_s = 0.0
+            cl.daemons[0].serve_delay_types = frozenset()
+            assert sum(
+                d.registry.live_count() for d in cl.daemons
+            ) == live_before, "an expired alloc leaked into a registry"
+            # A partitioned owner (sick at the pool seam, NOT dead —
+            # probes bypass the pool) expires a put typed: the replica
+            # keeps refusing NOT_PRIMARY, the ladder clamps to the
+            # budget, nothing lands anywhere.
+            controller.force("partition", owner)
+            out = budgeted(
+                "partitioned put", 600,
+                lambda: client.put(h1, (data + 1).astype(np.uint8), 0,
+                                   deadline_ms=600),
+            )
+            assert out == "deadline", (
+                "put against a partitioned owner did not expire typed"
+            )
+            controller.force("heal", owner)
+            # The doomed put's repeated transport failures opened the
+            # owner's breaker (by design); wait out the probe window so
+            # the next get IS the half-open probe — it succeeds at the
+            # healed owner, closes the breaker, and the handle keeps
+            # its chain (no spurious repoint before the hedge phase).
+            time.sleep(cfg.breaker_probe_ms / 1e3 + 0.05)
+            got = client.get(h1, total, deadline_ms=2000)
+            assert bytes(got) == data.tobytes(), (
+                "data changed across an expired partitioned put"
+            )
+            assert h1.rank == owner and h1.replica_ranks, (
+                "handle repointed during the partition window"
+            )
+
+            # -- phase 2: hedged reads, then byte-exact through a kill --
+            cl.daemons[owner].serve_delay_types = frozenset(
+                {MsgType.DATA_GET}
+            )
+            cl.daemons[owner].serve_delay_s = 0.08
+            got = client.get(h1, total, deadline_ms=2000)
+            assert bytes(got) == data.tobytes(), "hedged get not byte-exact"
+            cl.daemons[owner].serve_delay_s = 0.0
+            cl.daemons[owner].serve_delay_types = frozenset()
+            hedge_evs = [e for e in obs_journal.events()
+                         if e.get("ev") == "hedge_fired"]
+            assert hedge_evs, (
+                "slow primary never fired a hedge (OCM_HEDGE_MS armed)"
+            )
+            controller.force("kill", owner)
+            for _ in range(2):
+                got = client.get(h1, total, deadline_ms=4000)
+                assert bytes(got) == data.tobytes(), (
+                    "hedged get not byte-exact through the owner kill"
+                )
+            # Hedged reads ride probe clones and never repoint the
+            # shared handle; the WRITE ladder is the authoritative
+            # failover. Wait the verdict (also bars the corpse from
+            # phase 3's placements), write, and assert the repoint.
+            from oncilla_tpu.resilience.detector import PeerState
+
+            _wait(
+                lambda: cl.daemons[0].detector.state(owner)
+                == PeerState.DEAD,
+                10.0, "the killed owner's DEAD verdict",
+            )
+            client.put(h1, data, 0, deadline_ms=4000)
+            promoted = h1.rank
+            assert promoted != owner, "handle never failed over"
+            got = client.get(h1, total, deadline_ms=4000)
+            assert bytes(got) == data.tobytes()
+
+            # -- phase 3: breaker opens on a sick peer, half-open
+            # -- recovers after the heal --
+            survivors = [r for r in range(3) if r != owner]
+            sick = next(r for r in survivors if r != 0) \
+                if any(r != 0 for r in survivors) else survivors[0]
+            sick_handles = []
+            guard = 0
+            while len(sick_handles) < 4 and guard < 40:
+                guard += 1
+                d = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+                h = client.alloc(d.nbytes, OcmKind.REMOTE_HOST)
+                client.put(h, d, 0)
+                if h.rank == sick:
+                    sick_handles.append((h, d))
+            assert len(sick_handles) >= 4, (
+                f"placement never sited 4 primaries on rank {sick}"
+            )
+            e_sick = cl.entries[sick]
+            key = (e_sick.connect_host, e_sick.port)
+            controller.force("partition", sick)
+            for h, d in sick_handles[:3]:
+                got = client.get(h, d.nbytes, deadline_ms=2000)
+                assert bytes(got) == d.tobytes(), (
+                    "replica read under an open breaker not byte-exact"
+                )
+            assert client._breaker.state(key) == "open", (
+                f"breaker never opened for {key}: "
+                f"{client._breaker.snapshot()}"
+            )
+            assert client._breaker.counters["fast_fails"] >= 1, (
+                "an OPEN breaker never failed an attempt fast"
+            )
+            controller.force("heal", sick)
+            time.sleep(cfg.breaker_probe_ms / 1e3 + 0.05)
+            h, d = sick_handles[3]
+            got = client.get(h, d.nbytes, deadline_ms=2000)
+            assert bytes(got) == d.tobytes()
+            assert client._breaker.state(key) == "closed", (
+                "half-open probe never closed the breaker after the heal"
+            )
+            evs = obs_journal.events()
+            assert any(e.get("ev") == "breaker_open" for e in evs)
+            assert any(e.get("ev") == "breaker_close" for e in evs)
+
+        assert not controller.pending(), (
+            f"workload too short for schedule: {controller.pending()}"
+        )
+
+        # -- phase 4: cancel storm (AsyncOcm tenant, outside the chaos
+        # -- controller — no scheduled faults left to misplace) --
+        live_before = sum(d.registry.live_count() for d in cl.daemons)
+        victim = cl.daemons[0]
+
+        async def cancel_storm() -> int:
+            from oncilla_tpu.runtime.mux import AsyncOcm
+
+            abandoned = 0
+            ocm = await AsyncOcm.open(cl.entries, rank=0, config=cfg,
+                                      app_id=77001)
+            try:
+                victim.serve_delay_types = frozenset({MsgType.REQ_ALLOC})
+                victim.serve_delay_s = 0.12
+                for _ in range(4):
+                    try:
+                        await asyncio.wait_for(
+                            ocm.alloc(64 << 10), timeout=0.03
+                        )
+                    except asyncio.TimeoutError:
+                        abandoned += 1
+                victim.serve_delay_s = 0.0
+                victim.serve_delay_types = frozenset()
+                # Let the CANCELs land, the suppressed completions be
+                # unwound through the free path, and the cancel-acks
+                # reclaim the orphan tombstones.
+                await asyncio.sleep(0.5)
+                chans = ocm.channels.live_channels()
+                assert chans, "tenant lost its mux channel"
+                assert all(len(c._orphans) == 0 for c in chans), (
+                    "revoked cancel-acks never reclaimed the orphan "
+                    f"tags: {[dict(c._orphans) for c in chans]}"
+                )
+            finally:
+                victim.serve_delay_s = 0.0
+                victim.serve_delay_types = frozenset()
+                await ocm.aclose()
+            return abandoned
+
+        abandoned = asyncio.run(cancel_storm())
+        assert abandoned >= 3, (
+            f"cancel storm abandoned only {abandoned}/4 allocs"
+        )
+        assert victim.tb_counters["cancels"] >= 3, (
+            f"daemon served {victim.tb_counters['cancels']} CANCELs "
+            "for >=3 abandoned ops"
+        )
+        assert victim.tb_counters["cancels_revoked"] >= 1, (
+            "no CANCEL actually revoked an in-flight op"
+        )
+        # Every revoked-but-completed alloc was unwound through the
+        # free path: the registries drain back to the pre-storm count.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if sum(
+                d.registry.live_count() for d in cl.daemons
+            ) <= live_before:
+                break
+            time.sleep(0.05)
+        live_after = sum(d.registry.live_count() for d in cl.daemons)
+        assert live_after <= live_before, (
+            f"cancelled allocs leaked: {live_after} live vs "
+            f"{live_before} before the storm"
+        )
+        tb = {r: dict(cl.daemons[r].tb_counters) for r in range(3)}
+    return {
+        "seed": seed,
+        "schedule": schedule,
+        "log": list(controller.log),
+        "outcomes": [o for _, o in bounds],
+        "owner": owner,
+        "promoted": promoted,
+        "sick": sick,
+        "abandoned": abandoned,
+        "tb": tb,
+    }
+
+
+def deadline_smoke(seed: int, verbose: bool = False) -> int:
+    """Run the time-bounded-data-plane drill TWICE under the flight
+    recorder: identical schedules and chaos logs across the replay,
+    identical budgeted-op outcomes, and a clean invariant audit — the
+    new no-ack-after-cancel-ack invariant armed — on both timelines."""
+    from oncilla_tpu.obs import audit as obs_audit
+
+    print(f"deadline smoke: seed={seed} run 1/2 ...")
+    with obs_audit.recorded("deadline-run1") as rec1:
+        r1 = run_deadline_scenario(seed, verbose=verbose)
+    print(f"  flight recorder: {rec1.summary()}")
+    print(f"  chaos log: {r1['log']}")
+    print(f"  outcomes: {r1['outcomes']} (owner {r1['owner']} -> "
+          f"promoted {r1['promoted']}, breaker rank {r1['sick']}, "
+          f"{r1['abandoned']} allocs cancelled)")
+    print(f"deadline smoke: seed={seed} run 2/2 (replay) ...")
+    with obs_audit.recorded("deadline-run2") as rec2:
+        r2 = run_deadline_scenario(seed, verbose=verbose)
+    print(f"  flight recorder: {rec2.summary()}")
+    print(f"  chaos log: {r2['log']}")
+    if r1["schedule"] != r2["schedule"] or r1["log"] != r2["log"]:
+        print("deadline smoke: FAIL — fault interleavings differ: "
+              f"{r1['log']} vs {r2['log']}")
+        return 1
+    if r1["outcomes"] != r2["outcomes"]:
+        print("deadline smoke: FAIL — budgeted-op outcomes differ: "
+              f"{r1['outcomes']} vs {r2['outcomes']}")
+        return 1
+    print("deadline smoke: OK — budgets held within 1.5x under delays/"
+          "partition (typed DEADLINE_EXCEEDED, nothing reserved), "
+          "hedged reads byte-exact through an owner kill, breaker "
+          "opened and half-open-recovered, cancels revoked server-side "
+          "with registries drained, replays identical, invariant audit "
+          "clean (no-ack-after-cancel-ack armed)")
+    return 0
+
+
 def leader_smoke(seed: int, verbose: bool = False) -> int:
     """Run every leader chaos scenario TWICE under the flight recorder:
     each replay must fire the identical fault interleaving, converge to
@@ -520,6 +885,12 @@ def main(argv=None) -> int:
                          "partition, leader+owner double kill) twice "
                          "each with deterministic replay + invariant "
                          "audit")
+    ap.add_argument("--deadline-smoke", action="store_true",
+                    help="run the time-bounded-data-plane drill twice "
+                         "(budget bounds under delays/partition, hedged "
+                         "reads through an owner kill, breaker open/"
+                         "half-open-recover, server-side cancel storm) "
+                         "with deterministic replay + invariant audit")
     ap.add_argument("--plan", action="store_true",
                     help="print the generated random schedule for --seed")
     ap.add_argument("--seed", type=int, default=1234)
@@ -543,6 +914,8 @@ def main(argv=None) -> int:
         return smoke(args.seed, verbose=args.verbose)
     if args.leader_smoke:
         return leader_smoke(args.seed, verbose=args.verbose)
+    if args.deadline_smoke:
+        return deadline_smoke(args.seed, verbose=args.verbose)
     ap.print_help()
     return 2
 

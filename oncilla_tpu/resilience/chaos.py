@@ -215,6 +215,38 @@ class ChaosController:
                 f"chaos: partitioned from rank {dest} ({host}:{port})"
             )
 
+    def force(self, action: str, rank: int = -1,
+              delay_s: float = 0.0) -> None:
+        """Fire one fault NOW, at a program point instead of a lease
+        index — for scenarios whose fault placement must not depend on
+        how many leases a retry ladder burns (the deadline smoke's
+        partition/heal windows, forced kills between phases). Logged
+        with the sentinel op index -1, so the replay-identity check
+        stays exact: program-point faults land at the same position in
+        the log on every run regardless of lease-count jitter."""
+        if action not in ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}")
+        with self._lock:
+            self.log.append((-1, action, rank))
+            if action == "partition":
+                self._blocked.add(rank)
+            elif action == "heal":
+                self._blocked.discard(rank)
+        obs_journal.record("chaos_fault", op=-1, action=action, rank=rank)
+        if action == "kill":
+            self.victim_rings[rank] = obs_journal.events()
+            obs_journal.spill_ring(label=f"chaos-kill-r{rank}")
+            if self.kill_fn is not None:
+                self.kill_fn(rank)
+        elif action == "delay":
+            time.sleep(delay_s)
+        elif action == "isolate":
+            if self.isolate_fn is not None:
+                self.isolate_fn(rank, True)
+        elif action == "heal_isolate":
+            if self.isolate_fn is not None:
+                self.isolate_fn(rank, False)
+
     # -- lifecycle -------------------------------------------------------
 
     def inject(self):

@@ -28,6 +28,7 @@ from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import Extent
 from oncilla_tpu.core.errors import (
     OcmConnectError,
+    OcmDeadlineExceeded,
     OcmError,
     OcmInvalidHandle,
     OcmProtocolError,
@@ -39,6 +40,7 @@ from oncilla_tpu.fabric import attach_peer
 from oncilla_tpu.fabric import tcp as tcp_fabric
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
+from oncilla_tpu.resilience import timebudget
 from oncilla_tpu.runtime.membership import NodeEntry
 from oncilla_tpu.runtime.pool import PeerPool
 from oncilla_tpu.runtime import mux as mux_rt
@@ -46,10 +48,12 @@ from oncilla_tpu.qos.policy import pack_profile
 from oncilla_tpu.runtime.protocol import (
     ErrCode,
     FLAG_CAP_COALESCE,
+    FLAG_CAP_DEADLINE,
     FLAG_CAP_FABRIC,
     FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
+    FLAG_DEADLINE,
     FLAG_QOS_TAIL,
     FLAG_REPLICAS,
     FLAG_TRACE_CTX,
@@ -66,13 +70,15 @@ from oncilla_tpu.utils.config import OcmConfig
 from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
 
 
-def backoff_sleep(step_s: float) -> None:
+def backoff_sleep(step_s: float, budget: timebudget.Budget | None = None,
+                  ) -> float:
     """One capped-backoff pause with jitter (uniform in [0.5, 1.0] of the
-    step) — shared by the CONNECT retry ladder and the QoS BUSY retry so
-    a herd of clients never re-dials a saturated daemon in lockstep."""
-    import random
-
-    time.sleep(step_s * (0.5 + random.random() / 2))
+    step) — shared by the CONNECT retry ladder, the QoS BUSY retry and
+    the failover ladders so a herd of clients never re-dials a saturated
+    daemon in lockstep. With a ``budget`` the sleep is CLAMPED to the
+    op's remaining time (resilience/timebudget.py): a ladder may never
+    sleep past its own deadline. Returns the seconds actually slept."""
+    return timebudget.backoff_sleep(step_s, budget)
 
 
 class _PlaneServer:
@@ -284,6 +290,11 @@ class ControlPlaneClient:
         # same handle must repoint it (and fix owner accounting) exactly
         # once (resilience/).
         self._fo_lock = make_lock("client._fo_lock")
+        # Per-peer circuit breaker (resilience/timebudget.py): a no-op
+        # unless OCM_BREAKER_THRESHOLD arms it. Wired into the transfer
+        # path so a sick-but-not-DEAD peer fails FAST instead of eating
+        # every op's budget on full connect/transfer timeouts.
+        self._breaker = timebudget.breaker_from(self.config)
         # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132), offering
         # the trace capability — and, when OCM_REPLICAS > 1, the replica
         # capability (never offered at k=1, so the default wire is
@@ -294,7 +305,7 @@ class ControlPlaneClient:
         self._ctrl_caps = 0
         offer = (FLAG_CAP_TRACE if self.config.trace else 0) | (
             FLAG_CAP_REPLICA if self.config.replicas > 1 else 0
-        )
+        ) | (FLAG_CAP_DEADLINE if self.config.deadline_offer else 0)
         # QoS profile declaration (qos/): only a NON-default profile is
         # worth a capability offer — priority/quota unset keeps this
         # frame byte-for-byte the pre-QoS CONNECT. The profile rides the
@@ -316,6 +327,7 @@ class ControlPlaneClient:
             raise OcmConnectError(f"bad handshake reply {r.type.name}")
         self._ctrl_caps = r.flags & (
             FLAG_CAP_TRACE | FLAG_CAP_REPLICA | FLAG_CAP_QOS
+            | FLAG_CAP_DEADLINE
         )
         self.nnodes = r.fields["nnodes"]
         self._plane_server: _PlaneServer | None = None
@@ -465,12 +477,29 @@ class ControlPlaneClient:
             )))
         return msgs
 
-    def _request(self, msg: Message) -> Message:
+    def _request(self, msg: Message,
+                 budget: timebudget.Budget | None = None) -> Message:
         # Mux path: the runtime captures the ambient trace context and
         # the channel attaches it (peer-grant-gated) — exactly the
-        # discipline below, one hop later.
+        # discipline below, one hop later. The budget rides explicitly.
         if self._mux is not None:
-            return self._mux.request_sync(self._ctrl_addr, msg)
+            return self._mux.request_sync(self._ctrl_addr, msg,
+                                          budget=budget)
+        # Time budget (resilience/timebudget.py): the op's REMAINING
+        # milliseconds ride as the INNERMOST data-tail prefix (receivers
+        # strip tag, then trace, then deadline) — only after the daemon
+        # granted FLAG_CAP_DEADLINE at CONNECT. Expired budgets are the
+        # caller's problem (its ladder raises typed); an expired tail
+        # encodes as 0 and the daemon refuses it.
+        if (
+            budget is not None
+            and self._ctrl_caps & FLAG_CAP_DEADLINE
+            and VALID_FLAGS.get(msg.type, 0) & FLAG_DEADLINE
+        ):
+            msg = timebudget.attach(
+                Message(msg.type, msg.fields, msg.data, msg.flags),
+                budget, FLAG_DEADLINE,
+            )
         # Trace propagation: an ambient span context (Ocm.put/get/alloc
         # wrap ops in Tracer.span) rides the request as a 16-byte data
         # prefix — only on types the wire declares traceable and only
@@ -621,7 +650,9 @@ class ControlPlaneClient:
 
     # -- RemoteBackend: alloc / free ------------------------------------
 
-    def alloc(self, nbytes: int, kind: OcmKind) -> OcmAlloc:
+    def alloc(self, nbytes: int, kind: OcmKind,
+              deadline_ms: int | None = None) -> OcmAlloc:
+        budget = timebudget.budget_from(deadline_ms, self.config)
         req = Message(
             MsgType.REQ_ALLOC,
             {
@@ -643,7 +674,7 @@ class ControlPlaneClient:
         ):
             req.flags |= FLAG_REPLICAS
             req.data = bytes([self.config.replicas])
-        r = self._alloc_request(req)
+        r = self._alloc_request(req, budget)
         f = r.fields
         placed_kind = OcmKind(WIRE_KIND_INV[f["kind"]])
         fabric = (
@@ -701,18 +732,24 @@ class ControlPlaneClient:
                     scrub(h)
         return h
 
-    def _alloc_request(self, req: Message) -> Message:
+    def _alloc_request(self, req: Message,
+                       budget: timebudget.Budget | None = None) -> Message:
         """REQ_ALLOC with back-pressure compliance (qos/): a retryable
         BUSY rejection is honored with capped jittered backoff — seeded
         by the server's suggested delay when the reply carries one —
         rather than surfaced to the app. Every other error (including
         QUOTA_EXCEEDED, which only the app freeing can fix) propagates
-        unchanged, as does BUSY once the retry budget is spent."""
+        unchanged, as does BUSY once the retry budget is spent. With a
+        time budget the ladder sleeps are CLAMPED to the remainder and
+        an exhausted budget surfaces typed instead of burning more
+        attempts."""
         cfg = self.config
         delay = max(cfg.busy_backoff_ms, 1) / 1e3
         for attempt in range(cfg.busy_retries + 1):
+            if budget is not None:
+                budget.check(f"alloc of {req.fields.get('nbytes', 0)} B")
             try:
-                return self._request(req)
+                return self._request(req, budget)
             except OcmRemoteError as e:
                 if (
                     e.code != int(ErrCode.BUSY)
@@ -730,11 +767,13 @@ class ControlPlaneClient:
                 )
                 printd("client rank %d: BUSY, backing off %.0f ms "
                        "(attempt %d)", self.rank, step * 1e3, attempt + 1)
-                backoff_sleep(step)
+                backoff_sleep(step, budget)
                 delay *= 2
         raise AssertionError("unreachable")  # loop returns or raises
 
-    def free(self, handle: OcmAlloc) -> None:
+    def free(self, handle: OcmAlloc,
+             deadline_ms: int | None = None) -> None:
+        budget = timebudget.budget_from(deadline_ms, self.config)
         # Leave the owner set BEFORE the round trip (restored on
         # failure): a heartbeat racing the free would otherwise ship a
         # stale owners list for the whole free RPC and trigger a relay
@@ -755,7 +794,8 @@ class ControlPlaneClient:
                 Message(
                     MsgType.REQ_FREE,
                     {"alloc_id": handle.alloc_id, "rank": handle.rank},
-                )
+                ),
+                budget,
             )
         except BaseException as err:
             # Free ladder (resilience/): a dead primary's free re-aims
@@ -772,7 +812,7 @@ class ControlPlaneClient:
                     self._request(Message(
                         MsgType.REQ_FREE,
                         {"alloc_id": handle.alloc_id, "rank": rr},
-                    ))
+                    ), budget)
                     break
                 except BaseException as err2:  # noqa: BLE001
                     if not self._is_failover_err(err2):
@@ -798,7 +838,8 @@ class ControlPlaneClient:
     # rides the DCN path to the owner daemon, which relays to the
     # registered plane endpoint (PLANE_PUT/PLANE_GET). Host arms always
     # ride the DCN path.
-    def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+    def put(self, handle: OcmAlloc, data, offset: int = 0,
+            deadline_ms: int | None = None) -> None:
         if (
             handle.kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE)
             and self.ici_plane is not None
@@ -806,15 +847,19 @@ class ControlPlaneClient:
             self.ici_plane.put(handle, data, offset)
             return
         raw = np.ascontiguousarray(np.asarray(data)).view(np.uint8).reshape(-1)
-        self._dcn_put(handle, raw, offset)
+        self._dcn_put(handle, raw, offset,
+                      timebudget.budget_from(deadline_ms, self.config))
 
-    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0):
+    def get(self, handle: OcmAlloc, nbytes: int, offset: int = 0,
+            deadline_ms: int | None = None):
         if (
             handle.kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE)
             and self.ici_plane is not None
         ):
             return self.ici_plane.get(handle, nbytes, offset)
-        return self._dcn_get(handle, nbytes, offset)
+        return self._dcn_get(handle, nbytes, offset,
+                             timebudget.budget_from(deadline_ms,
+                                                    self.config))
 
     # DCN path: chunked, pipelined DATA_PUT/GET straight to the owner
     # daemon (extoll.c:47-173 scheme over TCP), STRIPED across parallel
@@ -984,6 +1029,138 @@ class ControlPlaneClient:
         self, handle: OcmAlloc, total: int, offset: int,
         put_mv: memoryview | None = None,
         get_arr: np.ndarray | None = None,
+        budget: timebudget.Budget | None = None,
+    ) -> dict:
+        """Move ``total`` bytes at handle-relative ``offset``. Reads on
+        a REPLICATED handle may be hedged (OCM_HEDGE_MS): after the
+        hedge delay with no primary answer, a second read fires at the
+        next chain member and the first answer wins — never writes
+        (hedging a put would double-apply side effects). Everything
+        else goes straight to the engine."""
+        if (
+            get_arr is not None
+            and handle.replica_ranks
+            and self.config.hedge_ms != 0
+        ):
+            delay = timebudget.hedge_delay_s(self.config, self.tracer)
+            if delay > 0:
+                return self._hedged_get(
+                    handle, total, offset, get_arr, budget, delay
+                )
+        return self._dcn_transfer_once(
+            handle, total, offset, put_mv, get_arr, budget
+        )
+
+    def _hedged_get(
+        self, handle: OcmAlloc, total: int, offset: int,
+        get_arr: np.ndarray, budget: timebudget.Budget | None,
+        delay: float,
+    ) -> dict:
+        """Tail-at-Scale hedged read: the primary attempt runs in a
+        worker thread into a PRIVATE buffer; if it has not answered
+        within ``delay``, a second read fires at the next chain member
+        (replicas serve client DATA_GET — every acked write is on the
+        whole chain pre-ack, so the hedge is as fresh as the primary).
+        First success wins and is copied into the caller's buffer; the
+        loser finishes into its own buffer and is discarded (on the mux
+        path an abandoned loser's tags are CANCELed server-side by the
+        channel's orphan reap). Both attempts failing re-raises the
+        primary's error."""
+        import copy
+        import queue
+
+        results: "queue.Queue" = queue.Queue()
+
+        def attempt(idx: int) -> None:
+            buf = np.empty(total, dtype=np.uint8)
+            try:
+                if idx == 0:
+                    # The primary rides a PRIVATE handle clone: a losing
+                    # attempt keeps running after the hedge returns, and
+                    # its ladder must never repoint (or re-account) the
+                    # caller's handle under a concurrent op. The next op
+                    # on the real handle walks its own ladder if the
+                    # primary truly died.
+                    probe = copy.copy(handle)
+                    probe._hedge_probe = True
+                    st = self._dcn_transfer_once(
+                        probe, total, offset, None, buf, budget
+                    )
+                else:
+                    st = {"retries": [0], "window": [0], "chunk": [0],
+                          "coalesced": [False], "stripes": 1}
+                    rr = handle.replica_ranks[0]
+                    cand = self._rank_addr(rr)
+                    if cand is None:
+                        raise OcmConnectError(
+                            f"hedge target rank {rr} has no address"
+                        )
+                    self._stripe_once(handle, 0, total, offset, None,
+                                      buf, cand, None, st, 0)
+            except BaseException as e:  # noqa: BLE001 — reported via queue
+                results.put((idx, None, None, e))
+            else:
+                results.put((idx, buf, st, None))
+
+        threading.Thread(
+            target=attempt, args=(0,), daemon=True, name="ocm-hedge-p",
+        ).start()
+        started = 1
+        fired = False
+        first_err: BaseException | None = None
+        timeout = delay
+        while True:
+            try:
+                idx, buf, st, err = results.get(timeout=timeout)
+            except queue.Empty:
+                if not fired and started == 1:
+                    # Primary silent past the hedge delay: fire the
+                    # hedge at the next chain member.
+                    fired = True
+                    started = 2
+                    obs_journal.record(
+                        "hedge_fired", alloc_id=handle.alloc_id,
+                        nbytes=total, delay_ms=round(delay * 1e3, 3),
+                        target_rank=handle.replica_ranks[0],
+                    )
+                    threading.Thread(
+                        target=attempt, args=(1,), daemon=True,
+                        name="ocm-hedge-s",
+                    ).start()
+                    timeout = (budget.remaining_s() if budget is not None
+                               else None)
+                    continue
+                if budget is not None:
+                    budget.check(f"hedged get of alloc {handle.alloc_id}")
+                    timeout = max(budget.remaining_s(), 0.01)
+                continue
+            if err is not None:
+                if first_err is None:
+                    first_err = err
+                started -= 1
+                if started == 0 and not fired:
+                    raise err
+                if started == 0:
+                    raise first_err
+                timeout = (budget.remaining_s() if budget is not None
+                           else None)
+                continue
+            flat = get_arr if get_arr.ndim == 1 else get_arr.reshape(-1)
+            flat[:total] = buf
+            if fired:
+                obs_journal.record(
+                    "hedge_won" if idx == 1 else "hedge_lost",
+                    alloc_id=handle.alloc_id, nbytes=total,
+                )
+                st = dict(st)
+                st["hedged"] = True
+            return st
+
+    def _dcn_transfer_once(
+        self, handle: OcmAlloc, total: int, offset: int,
+        put_mv: memoryview | None = None,
+        get_arr: np.ndarray | None = None,
+        budget: timebudget.Budget | None = None,
     ) -> dict:
         """Move ``total`` bytes at handle-relative ``offset``: the striped
         engine behind put (``put_mv`` = source view) and get (``get_arr``
@@ -1022,7 +1199,7 @@ class ControlPlaneClient:
         }
         if nstripes == 1:
             self._stripe_run(handle, 0, total, offset, put_mv, get_arr,
-                             addr, None, stats, 0)
+                             addr, None, stats, 0, budget)
             stats["stripes"] = 1
             return stats
         try:
@@ -1040,7 +1217,8 @@ class ControlPlaneClient:
                     continue
                 printd("leasing stripe set via rank %d at %s:%d",
                        rank_i, cand[0], cand[1])
-                self._failover_handle(handle, rank_i, cand)
+                self._failover_handle(handle, rank_i, cand,
+                                      keep_old=put_mv is None)
                 addr = cand
                 break
             if entries is None:
@@ -1070,7 +1248,8 @@ class ControlPlaneClient:
             try:
                 with obs_trace.use_ctx(tctx):
                     self._stripe_run(handle, s0, ln, offset, put_mv,
-                                     get_arr, addr, entries[i], stats, i)
+                                     get_arr, addr, entries[i], stats, i,
+                                     budget)
             except BaseException as exc:  # noqa: BLE001 — re-raised below
                 errors[i] = exc
 
@@ -1133,25 +1312,33 @@ class ControlPlaneClient:
         return out
 
     def _locate_at(
-        self, addr: tuple[str, int] | None, handle: OcmAlloc
+        self, addr: tuple[str, int] | None, handle: OcmAlloc,
+        budget: timebudget.Budget | None = None,
     ) -> tuple[int, tuple[str, int]] | None:
         """One REQ_LOCATE against ``addr``: the reply names the current
         primary's rank AND address explicitly — the only way to reach an
         owner whose rank postdates this client's boot membership
-        (elastic/)."""
+        (elastic/). Budgeted callers bound the exchange: a locate is a
+        BACKSTOP, and a peer that relays it into a frozen rank must not
+        eat the op's whole budget."""
         if addr is None:
             return None
+        timeout = None
+        if budget is not None:
+            timeout = min(2.0, max(budget.remaining_s(), 1e-3))
         try:
             r = self._pool.request(
                 addr[0], addr[1],
                 Message(MsgType.REQ_LOCATE, {"alloc_id": handle.alloc_id}),
+                timeout=timeout,
             )
         except (OSError, OcmError):
             return None
         return (r.fields["rank"], (r.fields["host"], r.fields["port"]))
 
     def _locate_candidates(
-        self, handle: OcmAlloc, last_err: BaseException | None
+        self, handle: OcmAlloc, last_err: BaseException | None,
+        budget: timebudget.Budget | None = None,
     ) -> list[tuple[int, tuple[str, int]]]:
         """The ladder's locate backstops, in preference order: the
         daemon that just answered MOVED (its tombstone knows the target,
@@ -1166,11 +1353,12 @@ class ControlPlaneClient:
         out = []
         moved = getattr(last_err, "moved_to_rank", None)
         if moved is not None and self._rank_addr(moved) is None:
-            loc = self._locate_at(self._owner_addr(handle), handle)
+            loc = self._locate_at(self._owner_addr(handle), handle,
+                                  budget)
             if loc is not None:
                 out.append(loc)
         for r in range(len(self.entries)):
-            loc = self._locate_at(self._rank_addr(r), handle)
+            loc = self._locate_at(self._rank_addr(r), handle, budget)
             if loc is not None and loc not in out:
                 out.append(loc)
                 if len(out) >= 2:
@@ -1178,12 +1366,33 @@ class ControlPlaneClient:
         return out
 
     def _failover_handle(
-        self, handle: OcmAlloc, new_rank: int, addr: tuple[str, int]
+        self, handle: OcmAlloc, new_rank: int, addr: tuple[str, int],
+        keep_old: bool = False,
     ) -> None:
         """Repoint a handle at the rank that just served it. Once-only
         under a lock (concurrent stripes race here): the dead old owner
         leaves the heartbeat/reclaim owner set exactly once; the promoted
-        rank was already counted as a replica owner at alloc time."""
+        rank was already counted as a replica owner at alloc time.
+
+        ``keep_old=True`` (READ-ladder repoints): the rank that just
+        served may be a replica of a merely-slow primary (replicas serve
+        client DATA_GET now), so the old primary stays in the handle's
+        candidate chain — a later WRITE bounced NOT_PRIMARY can walk
+        back to it instead of dead-ending on a read-only replica.
+
+        A hedge PROBE (the private clone a hedged get's primary attempt
+        rides) repoints its own fields only — never the owner
+        accounting, never the journal: the real handle was not failed
+        over, and the loser may still be running when the caller moves
+        on."""
+        if getattr(handle, "_hedge_probe", False):
+            with self._fo_lock:
+                handle.rank = new_rank
+                handle.owner_addr = addr
+                handle.replica_ranks = tuple(
+                    r for r in handle.replica_ranks if r != new_rank
+                )
+            return
         with self._fo_lock:
             old = handle.rank
             old_addr = handle.owner_addr
@@ -1193,9 +1402,11 @@ class ControlPlaneClient:
             was_known = new_rank in handle.replica_ranks
             handle.rank = new_rank
             handle.owner_addr = addr
-            handle.replica_ranks = tuple(
-                r for r in handle.replica_ranks if r != new_rank
+            rest = tuple(
+                r for r in handle.replica_ranks
+                if r not in (new_rank, old)
             )
+            handle.replica_ranks = ((old,) + rest) if keep_old else rest
         if not was_known:
             # Live-migration repoint (elastic/): the new owner was never
             # in the replica chain, so unlike a promoted replica it was
@@ -1212,11 +1423,15 @@ class ControlPlaneClient:
             self._invalidate_fabric(tuple(old_addr))
         obs_journal.record(
             "client_failover", alloc_id=handle.alloc_id,
-            old_rank=old, new_rank=new_rank,
+            old_rank=old, new_rank=new_rank, kept_old=int(keep_old),
         )
         printd("handle %d failed over: owner rank %d -> %d",
                handle.alloc_id, old, new_rank)
-        self._note_owner(old, -1)
+        if not keep_old:
+            # keep_old: the old rank stays in the candidate chain (it
+            # may be a live primary we merely read around), so its
+            # lease keeps renewing via the owner set too.
+            self._note_owner(old, -1)
 
     # Retryable wire rejections: a fenced stale owner (STALE_EPOCH), a
     # replica still waiting for its primary's death verdict (NOT_PRIMARY),
@@ -1245,6 +1460,7 @@ class ControlPlaneClient:
     def _stripe_run(
         self, handle: OcmAlloc, start: int, length: int, offset: int,
         put_mv, get_arr, addr, entry, stats: dict, idx: int,
+        budget: timebudget.Budget | None = None,
     ) -> None:
         """One stripe with the idempotent-retry contract: DATA_PUT/DATA_GET
         carry absolute offsets (same bytes, same places), so a retryable
@@ -1260,16 +1476,28 @@ class ControlPlaneClient:
         destination views stay intact."""
         try:
             self._stripe_once(handle, start, length, offset, put_mv,
-                              get_arr, addr, entry, stats, idx)
+                              get_arr, addr, entry, stats, idx, budget)
             return
         except BaseException as err:
             if not self._is_failover_err(err):
                 raise
             last: BaseException = err
+        # The ladder window is the failure-detection latency — but a
+        # time-budgeted op may not ride it past its own deadline: the
+        # window CLAMPS to the remaining budget and expiry surfaces
+        # typed (never the stale transport error).
         deadline = time.monotonic() + self.config.failover_wait_s
+        if budget is not None:
+            deadline = min(deadline, budget.deadline)
         while True:
             cands = self._failover_candidates(handle, last)
-            for loc in self._locate_candidates(handle, last):
+            if budget is not None and budget.expired:
+                raise OcmDeadlineExceeded(
+                    f"transfer of alloc {handle.alloc_id}: "
+                    f"{budget.total_ms} ms budget exhausted during "
+                    f"failover (last: {type(last).__name__}: {last})"
+                ) from last
+            for loc in self._locate_candidates(handle, last, budget):
                 if loc not in cands:
                     cands.append(loc)
             for rank_i, cand in cands:
@@ -1283,14 +1511,26 @@ class ControlPlaneClient:
                        idx, rank_i, cand[0], cand[1])
                 try:
                     self._stripe_once(handle, start, length, offset, put_mv,
-                                      get_arr, cand, None, stats, idx)
+                                      get_arr, cand, None, stats, idx,
+                                      budget)
                 except BaseException as err:
                     if not self._is_failover_err(err):
                         raise
                     last = err
                     continue
-                self._failover_handle(handle, rank_i, cand)
+                # Reads may have been served by a live primary's
+                # replica: keep the old rank as a candidate so a later
+                # write can walk back (writes repoint authoritatively —
+                # only an acting/true primary ever serves them).
+                self._failover_handle(handle, rank_i, cand,
+                                      keep_old=put_mv is None)
                 return
+            if budget is not None and budget.expired:
+                raise OcmDeadlineExceeded(
+                    f"transfer of alloc {handle.alloc_id}: "
+                    f"{budget.total_ms} ms budget exhausted during "
+                    f"failover (last: {type(last).__name__}: {last})"
+                ) from last
             if time.monotonic() >= deadline:
                 raise last
             time.sleep(0.05)  # let the detector/promotion window close
@@ -1298,6 +1538,30 @@ class ControlPlaneClient:
     def _stripe_once(
         self, handle: OcmAlloc, start: int, length: int, offset: int,
         put_mv, get_arr, addr, entry, stats: dict, idx: int,
+        budget: timebudget.Budget | None = None,
+    ) -> None:
+        """One stripe attempt behind the per-peer circuit breaker: an
+        OPEN breaker fails fast (typed OcmBreakerOpen — an
+        OcmConnectError, so the surrounding ladder walks on), transport
+        and deadline failures feed the breaker, successes close it."""
+        key = (addr[0], addr[1])
+        self._breaker.check(key)
+        try:
+            self._stripe_attempt(handle, start, length, offset, put_mv,
+                                 get_arr, addr, entry, stats, idx, budget)
+        except BaseException as err:
+            if isinstance(err, (OSError, OcmConnectError)) or (
+                isinstance(err, OcmRemoteError)
+                and err.code == int(ErrCode.DEADLINE_EXCEEDED)
+            ):
+                self._breaker.fail(key)
+            raise
+        self._breaker.ok(key)
+
+    def _stripe_attempt(
+        self, handle: OcmAlloc, start: int, length: int, offset: int,
+        put_mv, get_arr, addr, entry, stats: dict, idx: int,
+        budget: timebudget.Budget | None = None,
     ) -> None:
         if self._mux is not None:
             # The whole range rides the peer's mux channel (plan_stripes
@@ -1307,7 +1571,7 @@ class ControlPlaneClient:
             # back as the same typed exceptions the pool path raises.
             st = self._mux.transfer_sync(
                 (addr[0], addr[1]), handle, start, length, offset,
-                put_mv, get_arr,
+                put_mv, get_arr, budget=budget,
             )
             stats["window"][idx] = st.get("window", 0)
             stats["chunk"][idx] = st.get("chunk", 0)
@@ -1325,6 +1589,15 @@ class ControlPlaneClient:
             # not leak (same contract as the pipeline body below).
             self._pool.discard(host, port, entry)
             raise
+        if budget is not None:
+            # A budgeted transfer may not sit in a blocked recv past its
+            # deadline (a FROZEN peer — stopped, wedged — never closes
+            # the socket, so the ladder's between-attempt clamp alone
+            # cannot bound it). socket.timeout is an OSError: the
+            # connection is discarded and the ladder walks on, expiring
+            # typed at the loop bottom. Cleared before release so the
+            # pooled socket goes back blocking.
+            s.settimeout(max(budget.remaining_s(), 1e-3))
         tuner = self._tuner_for(addr)
         chunk, window = tuner.plan()
         stats["window"][idx] = window
@@ -1353,6 +1626,8 @@ class ControlPlaneClient:
         except OcmRemoteError:
             # Typed peer rejection, raised only AFTER the reply stream was
             # fully drained — the connection is still in sync, keep it.
+            if budget is not None:
+                s.settimeout(None)
             self._pool.release(host, port, entry)
             raise
         except BaseException:
@@ -1361,6 +1636,8 @@ class ControlPlaneClient:
             # the lease must not leak.
             self._pool.discard(host, port, entry)
             raise
+        if budget is not None:
+            s.settimeout(None)
         self._pool.release(host, port, entry)
         dt = time.perf_counter() - t0
         if dt > 0:
@@ -1370,16 +1647,19 @@ class ControlPlaneClient:
     # (stripe_put_coalesced / stripe_windowed moved to fabric/tcp.py —
     # the tcp backend of the fabric layer; see _stripe_once.)
 
-    def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int) -> None:
+    def _dcn_put(self, handle: OcmAlloc, raw: np.ndarray, offset: int,
+                 budget: timebudget.Budget | None = None) -> None:
         mv = memoryview(raw)  # stripes/chunks stay zero-copy views;
         # send_msg scatter-gathers them onto the wire without concatenation
         t0 = time.perf_counter()
         with self.tracer.span("dcn_put", nbytes=raw.nbytes):
-            stats = self._dcn_transfer(handle, raw.nbytes, offset, put_mv=mv)
+            stats = self._dcn_transfer(handle, raw.nbytes, offset,
+                                       put_mv=mv, budget=budget)
         self._note_dcn(stats, "put", raw.nbytes, time.perf_counter() - t0)
 
     def get_into(self, handle: OcmAlloc, out: np.ndarray,
-                 offset: int = 0) -> np.ndarray:
+                 offset: int = 0,
+                 deadline_ms: int | None = None) -> np.ndarray:
         """One-sided get landing in a CALLER-OWNED buffer: the registered-
         receive-buffer idiom (the reference posts recvs into pre-registered
         NIC buffers; a fresh destination array per get costs one page
@@ -1395,19 +1675,24 @@ class ControlPlaneClient:
             raise ValueError("out must be a writable C-contiguous uint8 array")
         # reshape(-1) of a C-contiguous array is a VIEW — stripes index a
         # flat byte range of the caller's buffer.
-        self._dcn_get_into(handle, out.reshape(-1), out.nbytes, offset)
+        self._dcn_get_into(handle, out.reshape(-1), out.nbytes, offset,
+                           timebudget.budget_from(deadline_ms,
+                                                  self.config))
         return out
 
-    def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int) -> np.ndarray:
+    def _dcn_get(self, handle: OcmAlloc, nbytes: int, offset: int,
+                 budget: timebudget.Budget | None = None) -> np.ndarray:
         out = np.empty(nbytes, dtype=np.uint8)
-        self._dcn_get_into(handle, out, nbytes, offset)
+        self._dcn_get_into(handle, out, nbytes, offset, budget)
         return out
 
     def _dcn_get_into(self, handle: OcmAlloc, out: np.ndarray, nbytes: int,
-                      offset: int) -> None:
+                      offset: int,
+                      budget: timebudget.Budget | None = None) -> None:
         t0 = time.perf_counter()
         with self.tracer.span("dcn_get", nbytes=nbytes):
-            stats = self._dcn_transfer(handle, nbytes, offset, get_arr=out)
+            stats = self._dcn_transfer(handle, nbytes, offset, get_arr=out,
+                                       budget=budget)
         self._note_dcn(stats, "get", nbytes, time.perf_counter() - t0)
 
     def _note_dcn(self, stats: dict, op: str, nbytes: int, dt: float) -> None:
@@ -1505,4 +1790,6 @@ class ControlPlaneClient:
             "sockets": sockets,
             "threads": threading.active_count(),
             "mux": mux,
+            "breaker": (self._breaker.snapshot()
+                        if self._breaker.enabled else None),
         }

@@ -34,6 +34,7 @@ from oncilla_tpu.core.errors import (
     OcmBoundsError,
     OcmBusy,
     OcmConnectError,
+    OcmDeadlineExceeded,
     OcmError,
     OcmInvalidHandle,
     OcmMoved,
@@ -75,13 +76,16 @@ from oncilla_tpu.resilience.detector import (
     probe,
 )
 from oncilla_tpu.resilience.failover import FailoverCoordinator
+from oncilla_tpu.resilience import timebudget
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_DEADLINE,
     FLAG_CAP_FABRIC,
     FLAG_CAP_MUX,
     FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
+    FLAG_DEADLINE,
     FLAG_FANOUT,
     FLAG_MORE,
     FLAG_HB_FWD,
@@ -119,24 +123,48 @@ from oncilla_tpu.utils.debug import Tracer, printd
 # must ride out) — size like the native daemon's data pool.
 _MUX_POOL_WORKERS = min(8, max(2, os.cpu_count() or 2))
 
+# Process-wide connection ids for the cancel/ack journal events: mux
+# correlation tags are per-connection, so the audit invariant scopes
+# them by (daemon track, conn, tag).
+_conn_id_counter = 0
+_conn_id_lock = make_lock("daemon._conn_id_lock")
+
+
+def _next_conn_id() -> int:
+    global _conn_id_counter
+    with _conn_id_lock:
+        _conn_id_counter += 1
+        return _conn_id_counter
+
 
 class _ConnMuxState:
     """Per-connection arrival bookkeeping for tagged control ops: which
     sequence numbers are still in flight, so a completion can tell
     whether it overtook an earlier arrival (the ``ooo`` counter — proof
-    the out-of-order contract is actually exercised)."""
+    the out-of-order contract is actually exercised) — plus the
+    server-side cancellation state: which tags are still open on the
+    worker pool and which of those a CANCEL has revoked. ``cancel`` and
+    ``finish_tag`` race under ONE lock, so exactly one of two outcomes
+    holds per tag: the cancel wins (revoked=1 acked, the worker's reply
+    suppressed — never an ack after a revoked cancel-ack, the audit
+    invariant) or the completion wins (revoked=0, the ordinary reply
+    stands and the client's orphan discard absorbs it)."""
 
-    __slots__ = ("_lock", "_seq", "_inflight")
+    __slots__ = ("_lock", "_seq", "_inflight", "_open_tags", "_cancelled")
 
     def __init__(self) -> None:
         self._lock = make_lock("daemon._conn_mux_state")
         self._seq = 0
         self._inflight: set[int] = set()
+        self._open_tags: set[int] = set()
+        self._cancelled: set[int] = set()
 
-    def note_start(self) -> int:
+    def note_start(self, tag: int | None = None) -> int:
         with self._lock:
             self._seq += 1
             self._inflight.add(self._seq)
+            if tag is not None:
+                self._open_tags.add(tag)
             return self._seq
 
     def note_done(self, seq: int) -> bool:
@@ -145,6 +173,38 @@ class _ConnMuxState:
         with self._lock:
             self._inflight.discard(seq)
             return any(s < seq for s in self._inflight)
+
+    def cancel(self, tag: int) -> bool:
+        """Revoke ``tag`` if it is still open on the pool; True = the
+        revocation binds (the worker's reply WILL be suppressed), False
+        = nothing to revoke (unknown tag, already answered, or an
+        inline data leg past the point of no return)."""
+        with self._lock:
+            if tag in self._open_tags and tag not in self._cancelled:
+                self._cancelled.add(tag)
+                return True
+            return False
+
+    def take_if_cancelled(self, tag: int) -> bool:
+        """Pre-dispatch check: True when a binding cancel already
+        revoked ``tag`` (the tag state is consumed — the op must not
+        run, and no reply may be sent)."""
+        with self._lock:
+            if tag in self._cancelled:
+                self._cancelled.discard(tag)
+                self._open_tags.discard(tag)
+                return True
+            return False
+
+    def finish_tag(self, tag: int) -> bool:
+        """Retire ``tag`` at completion; True = send the reply, False =
+        a binding cancel got there first (suppress it)."""
+        with self._lock:
+            self._open_tags.discard(tag)
+            if tag in self._cancelled:
+                self._cancelled.discard(tag)
+                return False
+            return True
 
 
 class Daemon:
@@ -378,6 +438,26 @@ class Daemon:
             "ooo": 0,            # replies sent out of arrival order
         }
         self._mux_ctr_lock = make_lock("daemon._mux_ctr_lock")
+        # Time-bounded data plane (resilience/timebudget.py): budget and
+        # cancellation accounting. Plain int bumps under the GIL (the
+        # res_counters discipline); last_budget_ms is the most recent
+        # FLAG_DEADLINE tail received — what the cross-hop decrement
+        # test reads to prove a relayed budget arrived strictly smaller.
+        self.tb_counters = {
+            "deadline_exceeded": 0,  # expired work refused typed
+            "cancels": 0,            # CANCEL requests served
+            "cancels_revoked": 0,    # ... that actually revoked an op
+            "cancel_drops": 0,       # replies suppressed post-cancel
+            "cancel_frees": 0,       # completed-then-cancelled allocs
+            #                          unwound through the free path
+            "last_budget_ms": -1,
+        }
+        # Testability hook (bench/tests, never config): artificial serve
+        # delay for the named message types — how a "slow replica" is
+        # built for the hedged-read cells and how a cancel storm gets a
+        # deterministic window to land in.
+        self.serve_delay_s = 0.0
+        self.serve_delay_types: frozenset = frozenset()
         self.detector = (
             FailureDetector(
                 len(entries), rank,
@@ -1226,6 +1306,10 @@ class Daemon:
         rsock = BufferedSock(conn)
         wlock = make_lock("daemon.conn_wlock")
         cstate = _ConnMuxState()
+        # Connection identity for the cancel/ack journal events: tags
+        # are per-channel, so the audit invariant scopes them by
+        # (daemon track, conn, tag). A plain process-wide counter.
+        conn_id = _next_conn_id()
         burst_nbytes = 0        # DATA_PUT_OK bytes accumulated this burst
         burst_err: Message | None = None  # first failure, reported once
         burst_open = False
@@ -1288,6 +1372,20 @@ class Daemon:
                     if tctx is not None:
                         msg.data = rest
                         msg.flags &= ~FLAG_TRACE_CTX
+                # Propagated time budget (resilience/timebudget.py): a
+                # FLAG_DEADLINE request carries its REMAINING budget as
+                # a u32-ms prefix (after tag and trace). Re-anchored on
+                # THIS host's monotonic clock and installed around
+                # dispatch, so expired work is refused typed and every
+                # forwarded hop re-attaches the decremented remainder.
+                budget = None
+                if msg.flags & FLAG_DEADLINE:
+                    bud_ms, rest = timebudget.split(msg.data)
+                    if bud_ms is not None:
+                        msg.data = rest
+                        msg.flags &= ~FLAG_DEADLINE
+                        budget = timebudget.Budget.from_ms(bud_ms)
+                        self.tb_counters["last_budget_ms"] = bud_ms
                 is_put = msg.type == MsgType.DATA_PUT
                 if burst_open and not is_put:
                     # A sender may not interleave other requests inside an
@@ -1296,7 +1394,21 @@ class Daemon:
                     self._send_reply(conn, wlock, _err(
                         ErrCode.BAD_MSG,
                         f"{msg.type.name} inside an open DATA_PUT burst",
-                    ), mux_tag)
+                    ), mux_tag, conn_id)
+                    continue
+                if msg.type == MsgType.CANCEL and mux_tag is not None:
+                    # Server-side cancellation: served INLINE on the
+                    # serve thread (never the pool — a cancel queued
+                    # behind the op it revokes would be useless), keyed
+                    # by the victim's correlation tag on this same
+                    # connection.
+                    flush_replies()
+                    self._send_reply(
+                        conn, wlock,
+                        self._cancel_tag(msg.fields["tag"], cstate,
+                                         conn_id),
+                        mux_tag, conn_id,
+                    )
                     continue
                 if (
                     mux_tag is not None
@@ -1305,11 +1417,12 @@ class Daemon:
                 ):
                     # Out-of-order completion for tagged control ops.
                     if self._serve_tagged_async(conn, wlock, msg, tctx,
-                                                mux_tag, cstate):
+                                                mux_tag, cstate, budget,
+                                                conn_id):
                         continue
                     # Pool unavailable (daemon stopping): fall through to
                     # the inline path — still correct, just FIFO.
-                reply = self._dispatch_guarded(msg, tctx)
+                reply = self._dispatch_guarded(msg, tctx, budget)
                 more = is_put and bool(msg.flags & FLAG_MORE)
                 if is_put and (more or burst_open):
                     if not burst_open:
@@ -1335,6 +1448,10 @@ class Daemon:
                     and rsock.buffered()
                     and _data_len_of(reply.data) < 4096
                 ):
+                    obs_journal.record(
+                        "mux_reply", track=self.tracer.track,
+                        conn=conn_id, tag=mux_tag,
+                    )
                     pending_out.append(pack(attach_tag(
                         Message(reply.type, reply.fields, reply.data,
                                 reply.flags),
@@ -1342,7 +1459,7 @@ class Daemon:
                     )))
                     continue
                 flush_replies()
-                self._send_reply(conn, wlock, reply, mux_tag)
+                self._send_reply(conn, wlock, reply, mux_tag, conn_id)
         except OSError:
             pass
         finally:
@@ -1353,27 +1470,47 @@ class Daemon:
             except OSError:
                 pass
 
-    def _dispatch_guarded(self, msg: Message, tctx) -> Message:
+    def _dispatch_guarded(self, msg: Message, tctx,
+                          budget: timebudget.Budget | None = None
+                          ) -> Message:
         """Dispatch plus the typed-error mapping: every handler failure
         becomes a typed ERROR frame (never a dropped connection). Shared
         by the inline serve loop and the mux worker pool, so the two
-        completion paths cannot drift on error semantics."""
+        completion paths cannot drift on error semantics.
+
+        ``budget`` is the request's propagated time budget: expired
+        work is refused typed BEFORE the handler runs (in particular
+        before REQ_ALLOC's quota admission can reserve anything), and
+        the budget is ambient during dispatch so forwarded hops carry
+        the decremented remainder."""
+        if self.serve_delay_s > 0 and msg.type in self.serve_delay_types:
+            # Testability hook: the artificially slow daemon the hedge
+            # bench and the cancel-storm smoke are built on.
+            time.sleep(self.serve_delay_s)
+        if budget is not None and budget.expired:
+            return self._deadline_err(
+                f"{msg.type.name} arrived with its "
+                f"{budget.total_ms} ms budget already spent"
+            )
         try:
             if msg.type in (MsgType.DATA_PUT, MsgType.DATA_GET):
                 op = ("dcn_put_srv" if msg.type == MsgType.DATA_PUT
                       else "dcn_get_srv")
-                with obs_trace.use_ctx(tctx), \
+                with timebudget.use(budget), obs_trace.use_ctx(tctx), \
                         self.tracer.span(op, nbytes=msg.fields["nbytes"]):
                     return self._dispatch(msg)
-            elif tctx is not None:
+            elif tctx is not None or budget is not None:
                 # A traced control op gets a serve-side span so the
                 # exported trace shows the daemon hop, not just the
-                # client's view of the round-trip.
-                with obs_trace.use_ctx(tctx), \
+                # client's view of the round-trip; a budgeted one keeps
+                # its remainder ambient for the hops it forwards.
+                with timebudget.use(budget), obs_trace.use_ctx(tctx), \
                         self.tracer.span("srv_" + msg.type.name.lower()):
                     return self._dispatch(msg)
             else:
                 return self._dispatch(msg)
+        except OcmDeadlineExceeded as e:
+            return self._deadline_err(str(e))
         except OcmOutOfMemory as e:
             return _err(ErrCode.OOM, str(e))
         except OcmQuotaExceeded as e:
@@ -1426,12 +1563,28 @@ class Daemon:
             # typed ERROR frame rather than killing the connection.
             return _err(ErrCode.UNKNOWN, f"{type(e).__name__}: {e}")
 
+    def _deadline_err(self, detail: str) -> Message:
+        """The typed DEADLINE_EXCEEDED rejection + its accounting (one
+        place, so the pre-dispatch refusal and the mid-dispatch raise
+        cannot drift on counters or journal shape)."""
+        self.tb_counters["deadline_exceeded"] += 1
+        obs_journal.record(
+            "deadline_exceeded", track=self.tracer.track, detail=detail,
+        )
+        return _err(ErrCode.DEADLINE_EXCEEDED, detail)
+
     def _send_reply(self, conn: socket.socket, wlock, reply: Message,
-                    tag: int | None) -> None:
+                    tag: int | None, conn_id: int = -1) -> None:
         """One reply frame, tag echoed, whole under the connection's
         write lock (the mux pool's out-of-order completions share the
-        socket with the serve loop)."""
+        socket with the serve loop). Tagged replies journal a
+        ``mux_reply`` event — the evidence stream the
+        no-ack-after-cancel-ack audit invariant walks."""
         if tag is not None:
+            obs_journal.record(
+                "mux_reply", track=self.tracer.track, conn=conn_id,
+                tag=tag,
+            )
             reply = attach_tag(
                 Message(reply.type, reply.fields, reply.data, reply.flags),
                 tag,
@@ -1452,7 +1605,8 @@ class Daemon:
             return self._mux_pool
 
     def _serve_tagged_async(self, conn, wlock, msg: Message, tctx,
-                            tag: int, cstate) -> bool:
+                            tag: int, cstate, budget=None,
+                            conn_id: int = -1) -> bool:
         """Queue one tagged control op on the mux worker pool. Returns
         False when the pool cannot take it (daemon stopping) — the
         caller serves inline instead."""
@@ -1463,7 +1617,7 @@ class Daemon:
             # Detach from the connection's RecvScratch: the serve loop
             # recvs the NEXT frame while the worker still reads this one.
             msg.data = bytes(msg.data)
-        seq = cstate.note_start()
+        seq = cstate.note_start(tag)
         with self._mux_ctr_lock:
             self._mux_counters["inflight"] += 1
             self._mux_counters["peak_inflight"] = max(
@@ -1473,29 +1627,101 @@ class Daemon:
         try:
             pool.submit(
                 self._serve_tagged, conn, wlock, msg, tctx, tag, cstate,
-                seq,
+                seq, budget, conn_id,
             )
         except RuntimeError:  # pool shut down between check and submit
             cstate.note_done(seq)
+            cstate.finish_tag(tag)
             with self._mux_ctr_lock:
                 self._mux_counters["inflight"] -= 1
             return False
         return True
 
     def _serve_tagged(self, conn, wlock, msg: Message, tctx, tag: int,
-                      cstate, seq: int) -> None:
+                      cstate, seq: int, budget=None,
+                      conn_id: int = -1) -> None:
+        # A cancel that landed while this op sat QUEUED revokes it
+        # before any side effect: nothing dispatched, nothing reserved,
+        # no reply (the client already tombstoned the tag).
+        if cstate.take_if_cancelled(tag):
+            ooo = cstate.note_done(seq)
+            with self._mux_ctr_lock:
+                self._mux_counters["inflight"] -= 1
+                if ooo:
+                    self._mux_counters["ooo"] += 1
+            self.tb_counters["cancel_drops"] += 1
+            obs_journal.record(
+                "cancel_drop", track=self.tracer.track, conn=conn_id,
+                tag=tag, stage="queued",
+            )
+            return
         try:
-            reply = self._dispatch_guarded(msg, tctx)
+            reply = self._dispatch_guarded(msg, tctx, budget)
         finally:
             ooo = cstate.note_done(seq)
             with self._mux_ctr_lock:
                 self._mux_counters["inflight"] -= 1
                 if ooo:
                     self._mux_counters["ooo"] += 1
+        if not cstate.finish_tag(tag):
+            # A binding cancel won the race mid-dispatch: suppress the
+            # reply — the cancel-ack already told the client "revoked",
+            # so an ack here would be the exact violation the
+            # no-ack-after-cancel-ack invariant audits. A completed
+            # REQ_ALLOC is unwound through the ordinary free path so
+            # the reserve -> commit accounting drains.
+            self.tb_counters["cancel_drops"] += 1
+            obs_journal.record(
+                "cancel_drop", track=self.tracer.track, conn=conn_id,
+                tag=tag, stage="completed",
+            )
+            if reply.type == MsgType.ALLOC_RESULT:
+                self.tb_counters["cancel_frees"] += 1
+                self._dispatch_guarded(Message(
+                    MsgType.REQ_FREE,
+                    {"alloc_id": reply.fields["alloc_id"],
+                     "rank": reply.fields["rank"]},
+                ), None)
+            return
         try:
-            self._send_reply(conn, wlock, reply, tag)
+            self._send_reply(conn, wlock, reply, tag, conn_id)
         except OSError:
             pass  # connection died; the serve loop's own path closes it
+
+    def _cancel_tag(self, victim: int, cstate: _ConnMuxState,
+                    conn_id: int) -> Message:
+        """Serve one CANCEL: revoke the victim tag on this connection's
+        worker-pool state and ack with the outcome. The ``cancel_ack``
+        journal event (recorded BEFORE the ack leaves) is the anchor of
+        the no-ack-after-cancel-ack audit invariant; in-flight DATA
+        legs are inline on the serve thread — they drained to their
+        chunk boundary before this CANCEL could even be read, which is
+        exactly the drain contract."""
+        revoked = cstate.cancel(victim)
+        self.tb_counters["cancels"] += 1
+        if revoked:
+            self.tb_counters["cancels_revoked"] += 1
+        obs_journal.record(
+            "cancel", track=self.tracer.track, conn=conn_id,
+            tag=victim, revoked=int(revoked),
+        )
+        obs_journal.record(
+            "cancel_ack", track=self.tracer.track, conn=conn_id,
+            tag=victim, revoked=int(revoked),
+        )
+        return Message(
+            MsgType.CANCEL_OK, {"tag": victim, "revoked": int(revoked)}
+        )
+
+    def _on_cancel(self, msg: Message) -> Message:
+        """CANCEL outside a mux channel (a lockstep or untagged sender):
+        with one request in flight per connection there is nothing to
+        revoke — answer honestly. The real path is the serve loop's
+        inline branch, which owns the connection's tag state."""
+        self.tb_counters["cancels"] += 1
+        return Message(
+            MsgType.CANCEL_OK, {"tag": msg.fields["tag"], "revoked": 0}
+        )
 
     def _mux_meta(self) -> dict:
         """Mux serving counters for STATUS / STATUS_PROM / the obs
@@ -1800,7 +2026,7 @@ class Daemon:
             return caps
         import os as _os
 
-        offer = FLAG_CAP_TRACE | FLAG_CAP_QOS
+        offer = FLAG_CAP_TRACE | FLAG_CAP_QOS | FLAG_CAP_DEADLINE
         try:
             r = self.peers.request(host, port, Message(
                 MsgType.CONNECT,
@@ -1818,16 +2044,37 @@ class Daemon:
         return caps
 
     def _peer_request(self, host: str, port: int, msg: Message) -> Message:
-        """peers.request plus trace propagation: when a trace context is
-        ambient (this request relays a traced serve) and the peer granted
-        FLAG_CAP_TRACE, the context rides the forwarded message — the hop
-        that stitches client span → local daemon span → peer daemon span.
-        Attaches to a shallow copy: relay loops reuse one Message for
-        several peers."""
+        """peers.request plus trace/budget propagation: when a trace
+        context is ambient (this request relays a traced serve) and the
+        peer granted FLAG_CAP_TRACE, the context rides the forwarded
+        message — the hop that stitches client span → local daemon span
+        → peer daemon span. When a time budget is ambient (this serve
+        arrived with FLAG_DEADLINE) the REMAINING budget rides too —
+        decremented by this hop's observed elapsed time, since the
+        remainder is computed at send time — and an already-expired
+        budget refuses the relay outright instead of burning a round
+        trip on work the origin has given up on. Attaches to a shallow
+        copy: relay loops reuse one Message for several peers."""
+        valid = VALID_FLAGS.get(msg.type, 0)
+        # Budget FIRST (it is the innermost prefix: receivers strip tag,
+        # then trace, then deadline), trace second, so the wire layout
+        # matches the strip order.
+        bud = timebudget.current()
+        if bud is not None and valid & FLAG_DEADLINE:
+            if bud.expired:
+                raise OcmDeadlineExceeded(
+                    f"relay of {msg.type.name} to {host}:{port}: "
+                    f"{bud.total_ms} ms budget exhausted before the hop"
+                )
+            if self._peer_caps_for(host, port) & FLAG_CAP_DEADLINE:
+                msg = timebudget.attach(
+                    Message(msg.type, msg.fields, msg.data, msg.flags),
+                    bud, FLAG_DEADLINE,
+                )
         ctx = obs_trace.current()
         if (
             ctx is not None
-            and VALID_FLAGS.get(msg.type, 0) & FLAG_TRACE_CTX
+            and valid & FLAG_TRACE_CTX
             and self._peer_caps_for(host, port) & FLAG_CAP_TRACE
         ):
             msg = obs_trace.attach(
@@ -1881,7 +2128,7 @@ class Daemon:
             },
             flags=msg.flags
             & (FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
-               | FLAG_CAP_QOS
+               | FLAG_CAP_QOS | FLAG_CAP_DEADLINE
                | (FLAG_CAP_MUX if self.config.mux_serve else 0)),
         )
         if reply.flags & FLAG_CAP_MUX:
@@ -2768,6 +3015,14 @@ class Daemon:
                 f"alloc {e.alloc_id}; retry"
             )
         if e.is_primary(self.rank):
+            return
+        if msg.type == MsgType.DATA_GET:
+            # Replica holders SERVE client reads (hedged replica reads,
+            # Tail-at-Scale): every acked write already landed on the
+            # whole chain before its ack (the pre-ack fan-out), so a
+            # replica read is exactly as fresh as the client's acked
+            # state — reads cannot fork copies, only writes can, and
+            # those keep the NOT_PRIMARY discipline below.
             return
         primary = e.chain[0]
         if not self._believed_dead(primary):
@@ -4039,6 +4294,7 @@ class Daemon:
             "fabric": self._fabric_meta(),
             "elastic": self._elastic_meta(),
             "mux": self._mux_meta(),
+            "timebudget": dict(self.tb_counters),
             # Arena capacities (control/): what a promoted leader's
             # whole-resync reads to rebuild placement accounting from
             # the survivors' own numbers.
@@ -4135,6 +4391,7 @@ class Daemon:
             "fabric": self._fabric_meta(),
             "elastic": self._elastic_meta(),
             "mux": self._mux_meta(),
+            "timebudget": dict(self.tb_counters),
             "serving": self._serving_meta(),
         }
 
@@ -4258,28 +4515,35 @@ _FLAGS_HANDLED = {
     # stripped GENERICALLY in _serve_conn (before the trace prefix) and
     # echoed on the reply — the same generic-strip discipline as
     # FLAG_TRACE_CTX, so it appears on every client-facing request type.
+    # FLAG_CAP_DEADLINE: granted in _on_connect; FLAG_DEADLINE (the u32
+    # remaining-budget prefix) is stripped GENERICALLY in _serve_conn —
+    # the FLAG_TRACE_CTX discipline — re-anchored on this host's clock,
+    # refused typed when expired (before any handler side effect), and
+    # re-attached decremented on forwarded hops via _peer_request.
     MsgType.CONNECT: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
         | FLAG_CAP_QOS | FLAG_QOS_TAIL | FLAG_CAP_FABRIC
-        | FLAG_CAP_MUX | FLAG_MUX_TAG
+        | FLAG_CAP_MUX | FLAG_MUX_TAG | FLAG_CAP_DEADLINE
     ),
     # FLAG_FANOUT: replica-chain role discipline in _check_data_role /
     # _route_put_payload (fan-out legs land, clients need primary role).
     MsgType.DATA_PUT: (
         FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT | FLAG_MUX_TAG
+        | FLAG_DEADLINE
     ),
-    MsgType.DATA_GET: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.DATA_GET: FLAG_TRACE_CTX | FLAG_MUX_TAG | FLAG_DEADLINE,
     # FLAG_REPLICAS: the data tail's u8 copy count, read in _place_alloc.
     MsgType.REQ_ALLOC: (
         FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL | FLAG_MUX_TAG
+        | FLAG_DEADLINE
     ),
-    MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
-    MsgType.DO_REPLICA: FLAG_QOS_TAIL,
+    MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL | FLAG_DEADLINE,
+    MsgType.DO_REPLICA: FLAG_QOS_TAIL | FLAG_DEADLINE,
     # FLAG_QOS_TAIL: the migrated copy inherits the allocation's QoS
     # class — parsed in _on_migrate_begin (elastic/).
-    MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL,
-    MsgType.REQ_FREE: FLAG_TRACE_CTX | FLAG_MUX_TAG,
-    MsgType.DO_FREE: FLAG_TRACE_CTX,
+    MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL | FLAG_DEADLINE,
+    MsgType.REQ_FREE: FLAG_TRACE_CTX | FLAG_MUX_TAG | FLAG_DEADLINE,
+    MsgType.DO_FREE: FLAG_TRACE_CTX | FLAG_DEADLINE,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
     MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
     MsgType.NOTE_FREE: FLAG_TRACE_CTX,
@@ -4293,6 +4557,10 @@ _FLAGS_HANDLED = {
     # requests (generic tag strip + echo, handlers unchanged).
     MsgType.DISCONNECT: FLAG_MUX_TAG,
     MsgType.REQ_LOCATE: FLAG_MUX_TAG,
+    # CANCEL: served inline in _serve_conn's cancel branch (keyed by
+    # the victim tag on the SAME connection); _on_cancel covers the
+    # lockstep/untagged sender honestly (nothing in flight to revoke).
+    MsgType.CANCEL: FLAG_MUX_TAG,
     # shm fabric control legs (fabric/): validated in _shm_entry; the
     # FLAG_CAP_FABRIC offer itself is handled in _on_connect (echo +
     # descriptor tail).
@@ -4372,6 +4640,7 @@ _HANDLERS = {
     MsgType.MIGRATE_BEGIN: Daemon._on_migrate_begin,
     MsgType.REQ_LOCATE: Daemon._on_req_locate,
     MsgType.REQ_EXTENTS: Daemon._on_req_extents,
+    MsgType.CANCEL: Daemon._on_cancel,
     MsgType.MASTER_STATE: Daemon._on_master_state,
     MsgType.LEADER_UPDATE: Daemon._on_leader_update,
     MsgType.LEADER_HANDOFF: Daemon._on_leader_handoff,

@@ -52,6 +52,7 @@ from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import Extent
 from oncilla_tpu.core.errors import (
     OcmConnectError,
+    OcmDeadlineExceeded,
     OcmError,
     OcmProtocolError,
     OcmRemoteError,
@@ -60,13 +61,16 @@ from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import Fabric, OcmKind
 from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.obs import trace as obs_trace
+from oncilla_tpu.resilience import timebudget
 from oncilla_tpu.runtime import pool as peer_pool
 from oncilla_tpu.runtime.protocol import (
     FLAG_CAP_COALESCE,
+    FLAG_CAP_DEADLINE,
     FLAG_CAP_MUX,
     FLAG_CAP_QOS,
     FLAG_CAP_REPLICA,
     FLAG_CAP_TRACE,
+    FLAG_DEADLINE,
     FLAG_MORE,
     FLAG_MUX_TAG,
     FLAG_QOS_TAIL,
@@ -95,7 +99,16 @@ Addr = tuple[str, int]
 
 # Capability bits a tenant-level CONNECT may carry back (the same mask
 # the blocking client stores as _ctrl_caps).
-TENANT_CAPS = FLAG_CAP_TRACE | FLAG_CAP_REPLICA | FLAG_CAP_QOS
+TENANT_CAPS = (FLAG_CAP_TRACE | FLAG_CAP_REPLICA | FLAG_CAP_QOS
+               | FLAG_CAP_DEADLINE)
+
+# Bound on the orphan-tag tombstone set: a SILENT peer (one that never
+# answers, never errors, never closes) used to grow _orphans by one tag
+# per abandoned waiter forever. Past the cap the OLDEST tombstone is
+# dropped — if that peer later answers a tag this old, the demux treats
+# it as unmatched and tears the channel down, which is the correct
+# outcome for a connection thousands of replies behind.
+ORPHAN_CAP = 1024
 
 
 def _chaos_gate(addr: Addr) -> None:
@@ -208,7 +221,15 @@ class MuxChannel:
         # sync bridge) before the reply arrived: the demux must DISCARD
         # the orphan reply once instead of treating it as unmatched —
         # which would tear the shared channel down for every tenant.
-        self._orphans: set[int] = set()
+        # A dict-as-ordered-set, BOUNDED at ORPHAN_CAP (a mute peer must
+        # not grow it forever) and reclaimed when the peer acks the
+        # CANCEL we send for each abandoned tag (a revoked op's reply
+        # is suppressed server-side, so the tombstone has nothing left
+        # to absorb).
+        self._orphans: dict[int, None] = {}
+        # Peer answered CANCEL with typed BAD_MSG (an un-upgraded or
+        # native daemon): stop sending cancels on this channel.
+        self._no_cancel = False
         # In-flight window as a raw credit counter: an asyncio.Semaphore
         # costs a few µs per acquire/release even uncontended, and this
         # sits on every tagged request. Waiters queue only at saturation.
@@ -228,6 +249,7 @@ class MuxChannel:
         self.counters = {
             "ops": 0, "batches": 0, "frames": 0,
             "inflight": 0, "peak_inflight": 0, "lockstep": 0,
+            "cancels": 0, "cancels_revoked": 0, "orphans_dropped": 0,
         }
 
     # -- lifecycle -------------------------------------------------------
@@ -253,7 +275,9 @@ class MuxChannel:
         # channel runs lockstep.
         offer = FLAG_CAP_MUX | (
             FLAG_CAP_COALESCE if config.dcn_coalesce else 0
-        ) | (FLAG_CAP_TRACE if config.trace else 0)
+        ) | (FLAG_CAP_TRACE if config.trace else 0) | (
+            FLAG_CAP_DEADLINE if config.deadline_offer else 0
+        )
         try:
             reply = await ch._request_lockstep(Message(
                 MsgType.CONNECT, {"pid": pid, "rank": rank}, flags=offer,
@@ -332,7 +356,7 @@ class MuxChannel:
         fut = self._pending.pop(tag, None)
         if fut is None:
             if tag in self._orphans:
-                self._orphans.discard(tag)
+                self._orphans.pop(tag, None)
                 return  # abandoned waiter's late reply
             self._fail(OcmProtocolError(
                 f"mux demux: unmatched reply {msg.type.name} (tag {tag})"
@@ -412,8 +436,24 @@ class MuxChannel:
             )
         return msg
 
+    def _budget_wrap(self, msg: Message, budget) -> Message:
+        """Attach the remaining time budget to a shallow copy when the
+        peer granted FLAG_CAP_DEADLINE and the type is budgetable. Runs
+        BEFORE _trace_wrap: the budget is the innermost data-tail prefix
+        (receivers strip tag, then trace, then deadline)."""
+        if (
+            budget is not None
+            and self.caps & FLAG_CAP_DEADLINE
+            and VALID_FLAGS.get(msg.type, 0) & FLAG_DEADLINE
+        ):
+            return timebudget.attach(
+                Message(msg.type, msg.fields, msg.data, msg.flags),
+                budget, FLAG_DEADLINE,
+            )
+        return msg
+
     async def request(self, msg: Message, tctx=None,
-                      owned: bool = False) -> Message:
+                      owned: bool = False, budget=None) -> Message:
         """One round trip. Muxed: tagged, pipelined, window-bounded, and
         completion-order independent. Lockstep (peer declined): plain
         frames, one at a time — the pre-mux protocol byte-for-byte.
@@ -427,7 +467,7 @@ class MuxChannel:
                 f"mux channel to {self.addr[0]}:{self.addr[1]} is down: "
                 f"{self._dead}"
             )
-        msg = self._trace_wrap(msg, tctx)
+        msg = self._trace_wrap(self._budget_wrap(msg, budget), tctx)
         if not self.muxed:
             return await self._request_lockstep(msg)
         await self._take_credit()
@@ -461,9 +501,77 @@ class MuxChannel:
         """End a tagged exchange. If the reply never arrived (the waiter
         was cancelled or timed out) the tag becomes an orphan the demux
         discards on arrival, keeping the channel in sync for everyone
-        else."""
+        else — AND a CANCEL is sent so the daemon revokes the op
+        server-side instead of serving it into the void. The orphan set
+        is bounded (ORPHAN_CAP, oldest dropped) so a mute peer cannot
+        grow it without bound, and a cancel-ack reclaims its tag
+        eagerly (a revoked op's reply is suppressed at the server)."""
         if self._pending.pop(tag, None) is not None and self.alive:
-            self._orphans.add(tag)
+            self._orphan_add(tag)
+            self._send_cancel(tag)
+
+    def _orphan_add(self, tag: int) -> None:
+        self._orphans[tag] = None
+        while len(self._orphans) > ORPHAN_CAP:
+            self._orphans.pop(next(iter(self._orphans)))
+            self.counters["orphans_dropped"] += 1
+
+    def _send_cancel(self, victim: int) -> None:
+        """Fire-and-collect server-side revocation of an abandoned tag:
+        its own tagged CANCEL exchange (no credit taken — cancels must
+        flow exactly when the window is saturated), processed by a loop
+        task. A revoked ack reclaims the orphan tombstone; a typed
+        BAD_MSG (un-upgraded peer, native daemon) disables further
+        cancels on this channel."""
+        if not self.alive or not self.muxed or self._no_cancel:
+            return
+        tag = self._next_tag()
+        fut = self._loop.create_future()
+        self._pending[tag] = fut
+        self.counters["cancels"] += 1
+        obs_journal.record(
+            "cancel_sent", host=self.addr[0], port=self.addr[1],
+            tag=victim,
+        )
+        try:
+            self._enqueue(_frame_parts(attach_tag(
+                Message(MsgType.CANCEL, {"tag": victim}), tag
+            )))
+        except (OSError, RuntimeError):
+            self._pending.pop(tag, None)
+            return
+
+        async def collect() -> None:
+            try:
+                # Bounded wait: a MUTE peer must not grow _pending by
+                # one never-resolving cancel future per abandoned op —
+                # on timeout the cancel's own tag just joins the
+                # bounded orphan set (never recursively re-cancelled).
+                reply = await asyncio.wait_for(fut, 30.0)
+            except asyncio.TimeoutError:
+                if self._pending.pop(tag, None) is not None and self.alive:
+                    self._orphan_add(tag)
+                return
+            except OcmError:
+                return  # channel died; nothing left to reclaim
+            finally:
+                self._pending.pop(tag, None)
+            if (
+                reply.type == MsgType.ERROR
+                and reply.fields.get("code") == int(ErrCode.BAD_MSG)
+            ):
+                self._no_cancel = True
+                return
+            if (
+                reply.type == MsgType.CANCEL_OK
+                and reply.fields.get("revoked")
+            ):
+                # The server suppressed the op's reply: the orphan
+                # tombstone has nothing left to absorb.
+                self.counters["cancels_revoked"] += 1
+                self._orphans.pop(victim, None)
+
+        self._loop.create_task(collect())
 
     async def _request_lockstep(self, msg: Message,
                                 raw: bool = False) -> Message:
@@ -490,7 +598,8 @@ class MuxChannel:
     # -- data plane ------------------------------------------------------
 
     async def put_range(self, handle: OcmAlloc, mv, start: int,
-                        length: int, offset: int, tctx=None) -> dict:
+                        length: int, offset: int, tctx=None,
+                        budget=None) -> dict:
         """Write [start, start+length) of ``mv`` at handle-relative
         ``offset+start``. Absolute offsets per chunk, so a failed range
         is idempotently re-runnable by the caller's ladder."""
@@ -506,7 +615,7 @@ class MuxChannel:
                 {"alloc_id": handle.alloc_id, "offset": base,
                  "nbytes": length},
                 mv[start:end],
-            ), tctx, owned=True)
+            ), tctx, owned=True, budget=budget)
             if r.type != MsgType.DATA_PUT_OK or r.fields["nbytes"] != length:
                 raise OcmProtocolError(
                     f"mux put ack mismatch: {r.type.name} "
@@ -520,7 +629,8 @@ class MuxChannel:
             and length > chunk
         )
         if coalesced:
-            await self._put_burst(handle, mv, start, end, base, chunk, tctx)
+            await self._put_burst(handle, mv, start, end, base, chunk,
+                                  tctx, budget)
         else:
             # Windowed tagged chunks when muxed (independent requests,
             # replies matched by tag — no FIFO assumption), sequential
@@ -533,7 +643,8 @@ class MuxChannel:
                     mv[pos:pos + n],
                 )
                 if self.muxed:
-                    r = await self.request(m, tctx, owned=True)
+                    r = await self.request(m, tctx, owned=True,
+                                           budget=budget)
                 else:
                     r = await self._request_lockstep(
                         self._trace_wrap(m, tctx)
@@ -552,7 +663,8 @@ class MuxChannel:
                 "coalesced": coalesced}
 
     async def _put_burst(self, handle: OcmAlloc, mv, start: int, end: int,
-                         base: int, chunk: int, tctx=None) -> None:
+                         base: int, chunk: int, tctx=None,
+                         budget=None) -> None:
         """Coalesced FLAG_MORE burst as ONE atomic send-queue item: the
         whole burst's frames are enqueued in one synchronous step, so no
         other sender's frame can interleave inside the open burst (the
@@ -575,7 +687,7 @@ class MuxChannel:
                 flags=0 if last else FLAG_MORE,
             )
             if last:
-                m = self._trace_wrap(m, tctx)
+                m = self._trace_wrap(self._budget_wrap(m, budget), tctx)
                 attach_tag(m, tag)
             parts.extend(_frame_parts(m))
             pos += n
@@ -605,7 +717,8 @@ class MuxChannel:
             )
 
     async def get_range(self, handle: OcmAlloc, out_mv, start: int,
-                        length: int, offset: int, tctx=None) -> dict:
+                        length: int, offset: int, tctx=None,
+                        budget=None) -> dict:
         """Read [start, start+length) into the matching view of
         ``out_mv``. Muxed gets pipeline chunked tagged requests; each
         reply lands by tag into its disjoint destination slice."""
@@ -619,7 +732,7 @@ class MuxChannel:
                 MsgType.DATA_GET,
                 {"alloc_id": handle.alloc_id, "offset": base,
                  "nbytes": length},
-            ), tctx, owned=True)
+            ), tctx, owned=True, budget=budget)
             if len(r.data) != length:
                 raise OcmProtocolError(
                     f"mux get reply length {len(r.data)} != {length}"
@@ -635,7 +748,7 @@ class MuxChannel:
                  "offset": base + (pos - start), "nbytes": n},
             )
             if self.muxed:
-                r = await self.request(m, tctx, owned=True)
+                r = await self.request(m, tctx, owned=True, budget=budget)
             else:
                 r = await self._request_lockstep(self._trace_wrap(m, tctx))
             if len(r.data) != n:
@@ -897,33 +1010,42 @@ class MuxRuntime:
         return self.run(self.channels.channel(addr, rank), timeout)
 
     def request_sync(self, addr: Addr, msg: Message,
-                     timeout: float = 120.0) -> Message:
+                     timeout: float = 120.0, budget=None) -> Message:
         tctx = obs_trace.current()
+        if budget is not None:
+            # The sync bridge must give up when the budget does (plus
+            # slack for the typed refusal to travel back), or a timed-out
+            # bridge would mask the typed DEADLINE_EXCEEDED.
+            timeout = min(timeout, budget.remaining_s() + 5.0)
 
         async def go():
             ch = await self.channels.channel(addr)
-            return await ch.request(msg, tctx)
+            return await ch.request(msg, tctx, budget=budget)
 
         return self.run(go(), timeout)
 
     def transfer_sync(self, addr: Addr, handle: OcmAlloc, start: int,
                       length: int, offset: int, put_mv=None,
-                      get_arr=None, timeout: float = 600.0) -> dict:
+                      get_arr=None, timeout: float = 600.0,
+                      budget=None) -> dict:
         """One stripe-range transfer for the sync engine's ladder. On
         transport failure the channel is dropped so the ladder's next
         attempt re-dials (the PeerPool.discard discipline)."""
         tctx = obs_trace.current()
+        if budget is not None:
+            timeout = min(timeout, budget.remaining_s() + 5.0)
 
         async def go():
             ch = await self.channels.channel(addr)
             try:
                 if put_mv is not None:
                     return await ch.put_range(
-                        handle, put_mv, start, length, offset, tctx
+                        handle, put_mv, start, length, offset, tctx,
+                        budget,
                     )
                 return await ch.get_range(
                     handle, memoryview(get_arr), start, length, offset,
-                    tctx,
+                    tctx, budget,
                 )
             except (OSError, OcmConnectError, asyncio.IncompleteReadError):
                 self.channels.drop(addr)
@@ -1070,6 +1192,9 @@ class AsyncOcm:
         self._owner_ranks: dict[int, int] = {}
         self._closed = False
         self._trace_scope = f"actx-{self.pid}"
+        # Per-peer circuit breaker (resilience/timebudget.py): no-op
+        # unless OCM_BREAKER_THRESHOLD arms it.
+        self._breaker = timebudget.breaker_from(config)
 
     @classmethod
     async def open(cls, entries, rank: int, config=None,
@@ -1198,9 +1323,9 @@ class AsyncOcm:
         else:
             self._owner_ranks.pop(rank, None)
 
-    async def _ctrl_request(self, msg: Message) -> Message:
+    async def _ctrl_request(self, msg: Message, budget=None) -> Message:
         ch = await self.channels.channel(self._ctrl_addr)
-        return await ch.request(msg, obs_trace.current())
+        return await ch.request(msg, obs_trace.current(), budget=budget)
 
     def _owner_addr(self, handle: OcmAlloc) -> Addr:
         addr = getattr(handle, "owner_addr", None)
@@ -1212,12 +1337,14 @@ class AsyncOcm:
     # -- API -------------------------------------------------------------
 
     async def alloc(self, nbytes: int,
-                    kind: OcmKind = OcmKind.REMOTE_HOST) -> OcmAlloc:
+                    kind: OcmKind = OcmKind.REMOTE_HOST,
+                    deadline_ms: int | None = None) -> OcmAlloc:
         if kind in (OcmKind.REMOTE_DEVICE, OcmKind.LOCAL_DEVICE):
             raise OcmError(
                 "AsyncOcm serves host kinds; device arms need the SPMD "
                 "plane (use the blocking client)"
             )
+        budget = timebudget.budget_from(deadline_ms, self.config)
         req = Message(
             MsgType.REQ_ALLOC,
             {"orig_rank": self.rank, "pid": self.pid,
@@ -1230,7 +1357,7 @@ class AsyncOcm:
         ):
             req.flags |= FLAG_REPLICAS
             req.data = bytes([self.config.replicas])
-        r = await self._busy_absorbing(req)
+        r = await self._busy_absorbing(req, budget)
         h = handle_from_alloc_result(r, nbytes, self.rank)
         self._note_owner(h.rank, +1)
         for rr in h.replica_ranks:
@@ -1241,17 +1368,21 @@ class AsyncOcm:
             )
         return h
 
-    async def _busy_absorbing(self, req: Message) -> Message:
+    async def _busy_absorbing(self, req: Message, budget=None) -> Message:
         """REQ_ALLOC with the QoS BUSY retry contract — async twin of the
         blocking client's _alloc_request (capped jittered backoff seeded
-        by the server's hint)."""
+        by the server's hint, CLAMPED to any remaining time budget)."""
         import random
 
         cfg = self.config
         delay = max(cfg.busy_backoff_ms, 1) / 1e3
         for attempt in range(cfg.busy_retries + 1):
+            if budget is not None:
+                budget.check(
+                    f"alloc of {req.fields.get('nbytes', 0)} B"
+                )
             try:
-                return await self._ctrl_request(req)
+                return await self._ctrl_request(req, budget)
             except OcmRemoteError as e:
                 if (
                     e.code != int(ErrCode.BUSY)
@@ -1265,11 +1396,16 @@ class AsyncOcm:
                     wait_s=round(step, 4),
                     nbytes=req.fields.get("nbytes", 0),
                 )
-                await asyncio.sleep(step * (0.5 + random.random() / 2))
+                dur = step * (0.5 + random.random() / 2)
+                if budget is not None:
+                    dur = min(dur, budget.remaining_s())
+                await asyncio.sleep(dur)
                 delay *= 2
         raise AssertionError("unreachable")
 
-    async def free(self, handle: OcmAlloc) -> None:
+    async def free(self, handle: OcmAlloc,
+                   deadline_ms: int | None = None) -> None:
+        budget = timebudget.budget_from(deadline_ms, self.config)
         self._note_owner(handle.rank, -1)
         for rr in handle.replica_ranks:
             self._note_owner(rr, -1)
@@ -1283,7 +1419,7 @@ class AsyncOcm:
             await self._ctrl_request(Message(
                 MsgType.REQ_FREE,
                 {"alloc_id": handle.alloc_id, "rank": handle.rank},
-            ))
+            ), budget)
         except BaseException as err:
             # Free ladder: re-aim a dead primary's free at the replica
             # chain (the blocking client's exact discipline).
@@ -1296,7 +1432,7 @@ class AsyncOcm:
                     await self._ctrl_request(Message(
                         MsgType.REQ_FREE,
                         {"alloc_id": handle.alloc_id, "rank": rr},
-                    ))
+                    ), budget)
                     break
                 except BaseException as err2:  # noqa: BLE001
                     if not is_failover_err(err2):
@@ -1310,7 +1446,8 @@ class AsyncOcm:
         if alloctrace.enabled():
             alloctrace.note_free(self._trace_scope, handle.alloc_id)
 
-    async def put(self, handle: OcmAlloc, data, offset: int = 0) -> None:
+    async def put(self, handle: OcmAlloc, data, offset: int = 0,
+                  deadline_ms: int | None = None) -> None:
         import numpy as np
 
         if (
@@ -1326,29 +1463,141 @@ class AsyncOcm:
             ).view(np.uint8).reshape(-1)
         mv = memoryview(raw)
         ctx = _mint_op_ctx()
+        budget = timebudget.budget_from(deadline_ms, self.config)
         t0 = time.perf_counter()
         stats = await self._transfer(
-            handle, raw.nbytes, offset, put_mv=mv, tctx=ctx
+            handle, raw.nbytes, offset, put_mv=mv, tctx=ctx,
+            budget=budget,
         )
         dt = time.perf_counter() - t0
         self.tracer.note_span("dcn_put", raw.nbytes, dt, ctx)
         self._note(stats, "put", raw.nbytes, dt)
 
     async def get(self, handle: OcmAlloc, nbytes: int | None = None,
-                  offset: int = 0, out=None):
+                  offset: int = 0, out=None,
+                  deadline_ms: int | None = None):
         import numpy as np
 
         n = handle.nbytes if nbytes is None else nbytes
         dest = np.empty(n, dtype=np.uint8) if out is None else out
         flat = dest if dest.ndim == 1 else dest.reshape(-1)
         ctx = _mint_op_ctx()
+        budget = timebudget.budget_from(deadline_ms, self.config)
         t0 = time.perf_counter()
-        stats = await self._transfer(handle, n, offset, get_arr=flat,
-                                     tctx=ctx)
+        delay = (timebudget.hedge_delay_s(self.config, self.tracer)
+                 if handle.replica_ranks and self.config.hedge_ms != 0
+                 else 0.0)
+        if delay > 0:
+            stats = await self._hedged_get(handle, n, offset, flat, ctx,
+                                           budget, delay)
+        else:
+            stats = await self._transfer(handle, n, offset, get_arr=flat,
+                                         tctx=ctx, budget=budget)
         dt = time.perf_counter() - t0
         self.tracer.note_span("dcn_get", n, dt, ctx)
         self._note(stats, "get", n, dt)
         return dest
+
+    async def _hedged_get(self, handle: OcmAlloc, n: int, offset: int,
+                          flat, ctx, budget, delay: float) -> dict:
+        """Tail-at-Scale hedged read on the async client: the primary
+        attempt runs as a task into a private buffer; past ``delay``
+        with no answer, a second read fires DIRECTLY at the next chain
+        member (replicas serve client DATA_GET). First success wins and
+        is copied into the destination; the LOSER task is cancelled —
+        which on a mux channel tombstones its tags and sends CANCEL, so
+        the daemon drops the abandoned work server-side."""
+        import copy
+
+        import numpy as np
+
+        buf_a = np.empty(n, dtype=np.uint8)
+        # The primary rides a PRIVATE handle clone: a losing attempt is
+        # cancelled, but until the cancellation lands its ladder must
+        # never repoint (or re-account) the caller's handle under a
+        # concurrent op.
+        probe = copy.copy(handle)
+        probe._hedge_probe = True
+        primary = asyncio.ensure_future(self._transfer(
+            probe, n, offset, get_arr=buf_a, tctx=ctx, budget=budget,
+        ))
+        done, _ = await asyncio.wait((primary,), timeout=delay)
+        if done:
+            stats = primary.result()  # raises the primary's error as-is
+            flat[:n] = buf_a
+            return stats
+
+        async def hedge_attempt():
+            rr = handle.replica_ranks[0]
+            if 0 <= rr < len(self.entries) and self.entries[rr].port:
+                e = self.entries[rr]
+            else:
+                raise OcmConnectError(f"hedge target rank {rr} unknown")
+            buf = np.empty(n, dtype=np.uint8)
+            ch = await self.channels.channel((e.connect_host, e.port))
+            await ch.get_range(handle, memoryview(buf), 0, n, offset,
+                               ctx, budget)
+            return buf
+
+        obs_journal.record(
+            "hedge_fired", alloc_id=handle.alloc_id, nbytes=n,
+            delay_ms=round(delay * 1e3, 3),
+            target_rank=handle.replica_ranks[0],
+        )
+        hedge = asyncio.ensure_future(hedge_attempt())
+        pending = {primary, hedge}
+        first_err = None
+        try:
+            while pending:
+                timeout = (max(budget.remaining_s(), 0.01)
+                           if budget is not None else None)
+                done, pending = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    budget.check(
+                        f"hedged get of alloc {handle.alloc_id}"
+                    )
+                    continue
+                for t in done:
+                    err = t.exception()
+                    if err is not None:
+                        if first_err is None:
+                            first_err = err
+                        continue
+                    if t is primary:
+                        stats = t.result()
+                        flat[:n] = buf_a
+                        obs_journal.record(
+                            "hedge_lost", alloc_id=handle.alloc_id,
+                            nbytes=n,
+                        )
+                    else:
+                        flat[:n] = t.result()
+                        stats = {"window": self.config.mux_window,
+                                 "chunk": self.config.chunk_bytes,
+                                 "coalesced": False}
+                        obs_journal.record(
+                            "hedge_won", alloc_id=handle.alloc_id,
+                            nbytes=n,
+                        )
+                    stats = dict(stats)
+                    stats["hedged"] = True
+                    return stats
+            raise first_err
+        finally:
+            # Cancel the loser (and on error paths, every survivor):
+            # an abandoned mux exchange tombstones its tag and sends
+            # CANCEL — the server-side revocation contract. The done
+            # callback retrieves a loser's late exception so asyncio
+            # never logs it as unretrieved.
+            for t in (primary, hedge):
+                if not t.done():
+                    t.cancel()
+                t.add_done_callback(
+                    lambda t: None if t.cancelled() else t.exception()
+                )
 
     async def status(self, rank: int | None = None) -> dict:
         if rank is None or rank == self.rank:
@@ -1372,47 +1621,56 @@ class AsyncOcm:
         return f
 
     async def _transfer(self, handle: OcmAlloc, total: int, offset: int,
-                        put_mv=None, get_arr=None, tctx=None) -> dict:
+                        put_mv=None, get_arr=None, tctx=None,
+                        budget=None) -> dict:
         """One whole transfer with the failover ladder: first the cached
         owner address, then — on retryable failure — the MOVED redirect /
         membership / replica-chain candidates, re-walked with a short
         pause until failover_wait_s elapses (the window IS the failure-
-        detection latency). ``tctx`` is threaded EXPLICITLY (never the
+        detection latency) — CLAMPED to any remaining time budget, which
+        expires typed. ``tctx`` is threaded EXPLICITLY (never the
         thread-local ambient: coroutines must not install it across
         awaits)."""
         addr = self._owner_addr(handle)
-        # First attempt inline (no per-op closure): the hot path.
+
+        async def attempt(a: Addr):
+            self._breaker.check(a)
+            try:
+                ch = await self.channels.channel(a)
+                if put_mv is not None:
+                    r = await ch.put_range(
+                        handle, put_mv, 0, total, offset, tctx, budget
+                    )
+                else:
+                    r = await ch.get_range(
+                        handle, memoryview(get_arr), 0, total, offset,
+                        tctx, budget,
+                    )
+            except BaseException as err:
+                if isinstance(err, (OSError, OcmConnectError,
+                                    asyncio.IncompleteReadError)):
+                    self.channels.drop(a)
+                    self._breaker.fail(a)
+                elif (
+                    isinstance(err, OcmRemoteError)
+                    and err.code == int(ErrCode.DEADLINE_EXCEEDED)
+                ):
+                    self._breaker.fail(a)
+                raise
+            self._breaker.ok(a)
+            return r
+
+        # First attempt inline (no candidate walk): the hot path.
         try:
-            ch = await self.channels.channel(addr)
-            if put_mv is not None:
-                return await ch.put_range(
-                    handle, put_mv, 0, total, offset, tctx
-                )
-            return await ch.get_range(
-                handle, memoryview(get_arr), 0, total, offset, tctx
-            )
+            return await attempt(addr)
         except BaseException as err:
-            if isinstance(err, (OSError, OcmConnectError)):
-                self.channels.drop(addr)
             if not is_failover_err(err):
                 raise
             last = err
 
-        async def attempt(a: Addr):
-            ch = await self.channels.channel(a)
-            try:
-                if put_mv is not None:
-                    return await ch.put_range(
-                        handle, put_mv, 0, total, offset, tctx
-                    )
-                return await ch.get_range(
-                    handle, memoryview(get_arr), 0, total, offset, tctx
-                )
-            except (OSError, OcmConnectError, asyncio.IncompleteReadError):
-                self.channels.drop(a)
-                raise
-
         deadline = time.monotonic() + self.config.failover_wait_s
+        if budget is not None:
+            deadline = min(deadline, budget.deadline)
         while True:
             for rank_i, cand in failover_candidates(
                 self.entries, handle, last
@@ -1430,15 +1688,35 @@ class AsyncOcm:
                     last = err
                     continue
                 if handle.rank != rank_i:
-                    self._note_owner(rank_i, +1)
-                    self._note_owner(handle.rank, -1)
-                    handle.replica_ranks = tuple(
-                        r for r in handle.replica_ranks if r != rank_i
+                    # Reads may have been served by a live primary's
+                    # replica (replicas serve client DATA_GET): keep
+                    # the old rank in the candidate chain — a later
+                    # write bounced NOT_PRIMARY walks back to it. A
+                    # hedge probe repoints its own clone only — never
+                    # the tenant's owner accounting.
+                    keep_old = get_arr is not None
+                    old = handle.rank
+                    if not getattr(handle, "_hedge_probe", False):
+                        self._note_owner(rank_i, +1)
+                        if not keep_old:
+                            self._note_owner(old, -1)
+                    rest = tuple(
+                        r for r in handle.replica_ranks
+                        if r not in (rank_i, old)
+                    )
+                    handle.replica_ranks = (
+                        ((old,) + rest) if keep_old else rest
                     )
                     handle.rank = rank_i
                 handle.owner_addr = cand
                 stats["retries"] = 1
                 return stats
+            if budget is not None and budget.expired:
+                raise OcmDeadlineExceeded(
+                    f"transfer of alloc {handle.alloc_id}: "
+                    f"{budget.total_ms} ms budget exhausted during "
+                    f"failover (last: {type(last).__name__}: {last})"
+                ) from last
             if time.monotonic() >= deadline:
                 raise last
             await asyncio.sleep(0.05)

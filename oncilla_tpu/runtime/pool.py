@@ -229,12 +229,22 @@ class PeerPool:
         with self._cond:
             self._cond.notify_all()
 
-    def request(self, host: str, port: int, msg: Message) -> Message:
-        """One request/reply. No resend on failure (see module docstring)."""
+    def request(self, host: str, port: int, msg: Message,
+                timeout: float | None = None) -> Message:
+        """One request/reply. No resend on failure (see module
+        docstring). ``timeout`` bounds the whole exchange
+        (resilience/timebudget.py: a budgeted caller may not sit in a
+        blocked recv against a frozen peer) — a timed-out connection is
+        discarded like any transport failure, and a bounded exchange
+        that succeeds goes back to the pool blocking."""
         entry = self.lease(host, port)
+        if timeout is not None:
+            entry.sock.settimeout(timeout)
         try:
             reply = request(entry.sock, msg)
         except OcmRemoteError:
+            if timeout is not None:
+                entry.sock.settimeout(None)
             self.release(host, port, entry)
             raise  # connection still in sync
         except (OSError, OcmProtocolError) as e:
@@ -247,6 +257,8 @@ class PeerPool:
             # half-read connection.
             self.discard(host, port, entry)
             raise
+        if timeout is not None:
+            entry.sock.settimeout(None)
         self.release(host, port, entry)
         return reply
 

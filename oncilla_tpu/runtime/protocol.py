@@ -143,6 +143,26 @@ FLAG_CAP_MUX = 0x0800
 # burst tags only its CLOSING chunk: body chunks produce no reply and
 # stay eligible for the zero-copy recv-into-arena landing.
 FLAG_MUX_TAG = 0x1000
+# FLAG_CAP_DEADLINE on CONNECT offers time-budget propagation
+# (resilience/timebudget.py): once granted, requests may carry a
+# FLAG_DEADLINE remaining-budget tail and the daemon refuses
+# already-expired work with typed DEADLINE_EXCEEDED instead of serving
+# it into the void. Same offer/echo dance as every capability: a
+# flags=0 reply (un-upgraded v2 Python daemon, the native C++ daemon)
+# declines by silence and the sender ships plain frames — budgets then
+# only clamp the CLIENT's own ladders. With OCM_DEADLINE_MS unset the
+# bit is never offered, so the default wire is byte-for-byte the
+# pre-deadline protocol.
+FLAG_CAP_DEADLINE = 0x2000
+# FLAG_DEADLINE: a u32 data-tail prefix — the op's REMAINING time
+# budget in milliseconds, measured by the SENDER at send time (each hop
+# re-attaches the remainder on forwarded legs, so the budget decrements
+# by observed elapsed time as it crosses the cluster; no clock sync
+# needed, only monotonic local clocks). Strip order on receive is tag,
+# then trace, then deadline, then payload — handlers see the same
+# payload bytes they always did. Only ever set toward a peer that
+# granted FLAG_CAP_DEADLINE.
+FLAG_DEADLINE = 0x4000
 
 # Which flag bits each message type may carry on the wire. pack() rejects
 # undeclared bits (a typo'd flag must fail at the sender, not surface as
@@ -273,6 +293,18 @@ class MsgType(enum.IntEnum):
     LEADER_HANDOFF = 93     # old leader -> successor: voluntary transfer
     #                       (final master state rides the data tail; a
     #                       CRC-failing tail REFUSES the handoff)
+    # Server-side cancellation (resilience/timebudget.py + runtime/mux.py):
+    # revoke a tagged in-flight op by its mux correlation id. A tenant
+    # whose awaitable times out (or is cancelled) sends CANCEL instead of
+    # only tombstoning the tag client-side; the daemon marks the tag
+    # revoked — a queued op never dispatches, a completed op's reply is
+    # suppressed (and a completed REQ_ALLOC's reservation is unwound
+    # through the ordinary free path) — and answers CANCEL_OK with
+    # whether anything was actually revoked. Only ever sent on a channel
+    # that granted FLAG_CAP_MUX; the native C++ daemon answers typed
+    # BAD_MSG with the stream in sync (the PR-8 unknown-type contract).
+    CANCEL = 94
+    CANCEL_OK = 95
     # failure
     ERROR = 99
 
@@ -295,11 +327,12 @@ VALID_FLAGS.update({
     MsgType.CONNECT: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
         | FLAG_CAP_QOS | FLAG_QOS_TAIL | FLAG_CAP_FABRIC
-        | FLAG_CAP_MUX | FLAG_MUX_TAG
+        | FLAG_CAP_MUX | FLAG_MUX_TAG | FLAG_CAP_DEADLINE
     ),
     MsgType.CONNECT_CONFIRM: (
         FLAG_CAP_COALESCE | FLAG_CAP_TRACE | FLAG_CAP_REPLICA
         | FLAG_CAP_QOS | FLAG_CAP_FABRIC | FLAG_CAP_MUX | FLAG_MUX_TAG
+        | FLAG_CAP_DEADLINE
     ),
     # Requests that may carry a trace-context prefix once the peer
     # granted FLAG_CAP_TRACE. DATA_PUT also keeps the coalesced-burst
@@ -308,21 +341,29 @@ VALID_FLAGS.update({
     # FLAG_MUX_TAG marks the client-facing request set a mux channel
     # interleaves (the same discipline: a burst tags only its closing
     # chunk).
+    # FLAG_DEADLINE (the u32 remaining-budget prefix) rides the
+    # budgetable op set: the client-facing data/alloc/free requests and
+    # every hop they forward onto — the REQ_ALLOC leader relay, the
+    # DO_ALLOC/DO_REPLICA provisioning legs, and the MIGRATE_BEGIN
+    # migration leg — so an expiring budget is refused at whichever hop
+    # it dies on, not served into the void.
     MsgType.DATA_PUT: (
         FLAG_MORE | FLAG_TRACE_CTX | FLAG_FANOUT | FLAG_MUX_TAG
+        | FLAG_DEADLINE
     ),
-    MsgType.DATA_GET: FLAG_TRACE_CTX | FLAG_MUX_TAG,
+    MsgType.DATA_GET: FLAG_TRACE_CTX | FLAG_MUX_TAG | FLAG_DEADLINE,
     MsgType.REQ_ALLOC: (
         FLAG_TRACE_CTX | FLAG_REPLICAS | FLAG_QOS_TAIL | FLAG_MUX_TAG
+        | FLAG_DEADLINE
     ),
-    MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL,
-    MsgType.DO_REPLICA: FLAG_QOS_TAIL,
+    MsgType.DO_ALLOC: FLAG_TRACE_CTX | FLAG_QOS_TAIL | FLAG_DEADLINE,
+    MsgType.DO_REPLICA: FLAG_QOS_TAIL | FLAG_DEADLINE,
     # A migration-provisioned copy inherits the allocation's QoS class
     # (elastic/): non-default priorities ride the same u8 tail DO_REPLICA
     # carries; default-class migrations ship unchanged frames.
-    MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL,
-    MsgType.REQ_FREE: FLAG_TRACE_CTX | FLAG_MUX_TAG,
-    MsgType.DO_FREE: FLAG_TRACE_CTX,
+    MsgType.MIGRATE_BEGIN: FLAG_QOS_TAIL | FLAG_DEADLINE,
+    MsgType.REQ_FREE: FLAG_TRACE_CTX | FLAG_MUX_TAG | FLAG_DEADLINE,
+    MsgType.DO_FREE: FLAG_TRACE_CTX | FLAG_DEADLINE,
     MsgType.RECLAIM_APP: FLAG_TRACE_CTX,
     MsgType.NOTE_ALLOC: FLAG_TRACE_CTX,
     MsgType.NOTE_FREE: FLAG_TRACE_CTX,
@@ -336,6 +377,11 @@ VALID_FLAGS.update({
     # runs over the channel too.
     MsgType.DISCONNECT: FLAG_MUX_TAG,
     MsgType.REQ_LOCATE: FLAG_MUX_TAG,
+    # CANCEL rides the mux channel as an ordinary tagged request (its
+    # OWN tag; the victim tag is a payload field) so its ack demuxes
+    # like any reply.
+    MsgType.CANCEL: FLAG_MUX_TAG,
+    MsgType.CANCEL_OK: FLAG_MUX_TAG,
     # Replies: a request that arrived tagged is answered tagged — the
     # echo is what lets the demultiplexer match out-of-order
     # completions. ERROR included: typed rejections (BUSY, MOVED,
@@ -680,6 +726,13 @@ _SCHEMAS: dict[MsgType, list[tuple[str, str]]] = {
         ("from_rank", "q"),
         ("inc", "Q"),
     ],
+    # Server-side cancellation: "tag" is the VICTIM op's mux correlation
+    # id on this same connection. "revoked" on the ack: 1 when the
+    # daemon actually revoked something (queued op skipped, or a
+    # completed op's reply suppressed), 0 when the tag was unknown /
+    # already answered / an inline data leg past the point of no return.
+    MsgType.CANCEL: [("tag", "I")],
+    MsgType.CANCEL_OK: [("tag", "I"), ("revoked", "B")],
     MsgType.ERROR: [("code", "I"), ("detail", "s")],
 }
 
@@ -735,6 +788,12 @@ class ErrCode(enum.IntEnum):
     # definition — the client repoints its handle at the named rank and
     # re-runs, exactly the failover-ladder contract.
     MOVED = 13
+    # Time budget (resilience/timebudget.py): the op's propagated
+    # deadline expired before (or while) this daemon could serve it —
+    # "The Tail at Scale"'s fail-fast contract. NOT retryable: the
+    # budget is the caller's own clock, and every retry ladder must
+    # surface it typed instead of burning the remaining window.
+    DEADLINE_EXCEEDED = 14
 
 
 # Precompiled one-shot codecs for string-free schemas: the per-frame

@@ -238,6 +238,7 @@ class ServingEngine:
         store_dtype: str = "float32",
         name: str = "engine",
         share_partials: bool = True,
+        step_budget_ms: int | None = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -252,6 +253,19 @@ class ServingEngine:
         if prefetch_workers is None:
             prefetch_workers = int(os.environ.get("OCM_SERVE_PREFETCH", "2"))
         self.prefetcher = Prefetcher(store, prefetch_workers, self.stats)
+        # Per-decode-step time budget (resilience/timebudget.py,
+        # OCM_STEP_BUDGET_MS): bounds how long one session turn may sit
+        # on a straggling PREFETCH — past the budget the wait is
+        # abandoned and the page faults synchronously with the wait
+        # accounted as stall, so one slow cold fetch degrades to
+        # stall-accounting instead of wedging the whole interleave
+        # schedule. 0/None = the unbudgeted pre-existing behavior.
+        if step_budget_ms is None:
+            step_budget_ms = int(
+                os.environ.get("OCM_STEP_BUDGET_MS", "0") or 0
+            )
+        self.step_budget_ms = max(0, int(step_budget_ms))
+        self._step_budget = None
         self.queue: list[Request] = []
         self.active: list[_Session] = []
         self.results: list[SessionResult] = []
@@ -297,6 +311,12 @@ class ServingEngine:
                     if not order[j].done:
                         self._prefetch_for(order[j])
                         break
+                if self.step_budget_ms:
+                    from oncilla_tpu.resilience import timebudget
+
+                    self._step_budget = timebudget.Budget.from_ms(
+                        self.step_budget_ms
+                    )
                 self._turn(sess, turn)
                 if sess.done:
                     self._finish(sess)
@@ -433,6 +453,16 @@ class ServingEngine:
             if data[2] is not None:
                 self.prefetcher.recycle(data[2])
 
+    def _recycle_late(self, fut) -> None:
+        """A prefetch abandoned past the step budget eventually lands:
+        return its buffer to the pool instead of leaking it."""
+        try:
+            buf, _version, _ok = fut.result(timeout=0)
+        except Exception:  # noqa: BLE001 — a failed late fetch has no buffer
+            return
+        if buf is not None:
+            self.prefetcher.recycle(buf)
+
     def _obtain(self, sess: _Session, page: Page):
         """Page bytes + the version they correspond to: a completed
         prefetch is free; waiting on one (or faulting with none issued)
@@ -441,8 +471,32 @@ class ServingEngine:
         if fut is not None:
             already = fut.done()
             t0 = time.perf_counter()
+            # A straggling prefetch is waited on at most the remaining
+            # step budget (unbudgeted: the old 120 s backstop): past it
+            # the wait degrades to a synchronous fault below — pure
+            # stall accounting, never a wedged decode step. The
+            # abandoned future recycles its buffer when it finally
+            # lands.
+            wait_s = 120.0
+            bud = self._step_budget
+            if bud is not None:
+                wait_s = min(wait_s, max(bud.remaining_s(), 1e-3))
+            import concurrent.futures as _cf
+
             try:
-                buf, version, ok = fut.result(timeout=120.0)
+                buf, version, ok = fut.result(timeout=wait_s)
+            except (_cf.TimeoutError, TimeoutError):
+                waited = time.perf_counter() - t0
+                sess.stall_s += waited
+                self.stats.note_stall(waited)
+                obs_journal.record(
+                    "prefetch_stall", page_id=page.page_id,
+                    wait_ms=round(waited * 1e3, 3), degraded=True,
+                )
+                fut.add_done_callback(
+                    lambda f: self._recycle_late(f)
+                )
+                buf, version, ok = None, -1, False
             except Exception as e:  # noqa: BLE001 — fall back to a fault
                 printd("serving: prefetch failed (%s); faulting", e)
                 buf, version, ok = None, -1, False

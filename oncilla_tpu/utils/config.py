@@ -230,6 +230,35 @@ class OcmConfig:
         default_factory=lambda: _env_int("OCM_PROBE_TIMEOUT_MS", 1000) / 1e3
     )
 
+    # Time-bounded data plane (resilience/timebudget.py). OCM_DEADLINE_MS
+    # is the DEFAULT per-op time budget: > 0 arms deadline propagation —
+    # the client offers FLAG_CAP_DEADLINE at CONNECT, ops carry their
+    # remaining budget as a u32 tail on every hop, daemons refuse
+    # already-expired work with typed DEADLINE_EXCEEDED, and every retry
+    # ladder clamps its sleeps to the remainder. 0 (the default) keeps
+    # the wire byte-for-byte the pre-deadline protocol (per-op
+    # deadline_ms arguments still clamp the CLIENT's own ladders).
+    deadline_ms: int = field(
+        default_factory=lambda: _env_int("OCM_DEADLINE_MS", 0)
+    )
+    # Hedged replica reads: after this delay with no primary answer, a
+    # replicated get() fires a second read at the next chain member and
+    # the first answer wins (losers are cancelled where the channel
+    # supports it). 0 disables; -1 derives the delay from this client's
+    # own observed dcn_get p99 (hedge only the tail). Never applies to
+    # writes.
+    hedge_ms: int = field(default_factory=lambda: _env_int("OCM_HEDGE_MS", 0))
+    # Per-peer circuit breaker: this many CONSECUTIVE transport/deadline
+    # failures flip the peer OPEN (fail-fast typed OcmBreakerOpen); a
+    # half-open probe is admitted every breaker_probe_ms and a success
+    # closes it. 0 (the default) disables the breaker entirely.
+    breaker_threshold: int = field(
+        default_factory=lambda: _env_int("OCM_BREAKER_THRESHOLD", 0)
+    )
+    breaker_probe_ms: int = field(
+        default_factory=lambda: _env_int("OCM_BREAKER_PROBE_MS", 1000)
+    )
+
     # Decentralized control plane (control/). OCM_STANDBY_MASTERS = k
     # replicates the leader's coordination state (placement accounting,
     # member view, dead set — JSON + CRC32, the snapshot-v2 discipline)
@@ -395,6 +424,23 @@ class OcmConfig:
                 "fabric_shm_min_bytes must be >= 0 "
                 f"(got {self.fabric_shm_min_bytes})"
             )
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"deadline_ms must be >= 0 (got {self.deadline_ms}); "
+                "0 disables the default per-op budget"
+            )
+        if self.hedge_ms < -1:
+            raise ValueError(
+                f"hedge_ms must be >= -1 (got {self.hedge_ms}); 0 "
+                "disables hedging, -1 derives the delay from the "
+                "observed dcn_get p99"
+            )
+        if self.breaker_threshold < 0 or self.breaker_probe_ms <= 0:
+            raise ValueError(
+                "need breaker_threshold >= 0 (0 disables) and "
+                f"breaker_probe_ms > 0 (got {self.breaker_threshold}/"
+                f"{self.breaker_probe_ms})"
+            )
         # Same u8/short-csv bound as replica chains: standbys beyond a
         # handful add replication traffic for no availability win.
         if not 0 <= self.standby_masters <= 8:
@@ -423,6 +469,13 @@ class OcmConfig:
         side). OCM_FABRIC unset/"tcp" keeps the wire byte-for-byte the
         pre-fabric protocol."""
         return self.fabric in ("shm", "auto")
+
+    @property
+    def deadline_offer(self) -> bool:
+        """Whether this client offers FLAG_CAP_DEADLINE at CONNECT — a
+        default budget must be armed; unset keeps the wire byte-for-byte
+        the pre-deadline protocol."""
+        return self.deadline_ms > 0
 
     @property
     def qos_offer(self) -> bool:

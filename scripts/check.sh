@@ -103,6 +103,20 @@ echo "== leader chaos smoke =="
 # audit including the leader-unique and placement-agreement invariants.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --leader-smoke || fail=1
 
+echo "== deadline chaos smoke =="
+# Time-bounded data plane proof (resilience/timebudget.py): under a
+# seeded delay/partition schedule every budgeted op resolves — success
+# or typed DEADLINE_EXCEEDED, nothing reserved for expired work —
+# within 1.5x its budget; hedged replica reads stay byte-exact through
+# an owner kill; the per-peer breaker opens on a sick-but-not-DEAD rank
+# and half-open recovers after the heal; an AsyncOcm cancel storm is
+# revoked server-side with every registry drained. Twice, identical
+# interleavings, audited with the no-ack-after-cancel-ack invariant.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --deadline-smoke || fail=1
+# Paired hedged-vs-unhedged replicated-read cells with one slow primary
+# chain member: strictly lower hedged p99 at equal byte-exactness.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --hedge --smoke || fail=1
+
 echo "== serving smoke =="
 # Flagship serving workload (serving/): paired shared-vs-noshare decode
 # cells over a 3-daemon cluster (outputs must be byte-identical, sharing

@@ -516,3 +516,65 @@ def test_native_lease_reaping(binary, tmp_path):
     finally:
         for p in procs:
             p.kill()
+
+
+def test_native_deadline_capability_declined_by_silence(native_cluster, rng):
+    """OCM_DEADLINE_MS against the unmodified C++ daemon: the CONNECT
+    offer of FLAG_CAP_DEADLINE comes back flags=0 (declined by
+    silence), so no budget tail ever rides the wire toward it —
+    budgets still clamp the CLIENT's own ladders — and transfers stay
+    byte-exact (the deadline analogue of the replica/QoS/mux silence
+    tests)."""
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    cfg2 = OcmConfig(
+        host_arena_bytes=cfg.host_arena_bytes,
+        device_arena_bytes=cfg.device_arena_bytes,
+        chunk_bytes=64 << 10,
+        deadline_ms=5000,
+    )
+    assert cfg2.deadline_offer
+    client = ControlPlaneClient(entries, 0, config=cfg2)
+    try:
+        assert client._ctrl_caps & P.FLAG_CAP_DEADLINE == 0
+        h = client.alloc(1 << 20, OcmKind.REMOTE_HOST, deadline_ms=5000)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        client.put(h, data, deadline_ms=5000)
+        np.testing.assert_array_equal(
+            client.get(h, 1 << 20, deadline_ms=5000), data
+        )
+        client.free(h)
+    finally:
+        client.close()
+
+
+def test_native_cancel_answers_typed_bad_msg(native_cluster, rng):
+    """CANCEL against the unmodified C++ daemon lands in its dispatch
+    default arm as a typed BAD_MSG ERROR with the stream in sync (the
+    PR-8 unknown-type contract) — and ordinary traffic afterwards is
+    byte-exact."""
+    from oncilla_tpu.core.errors import OcmRemoteError
+    from oncilla_tpu.runtime import protocol as P
+
+    entries, cfg = native_cluster
+    s = socket.create_connection(
+        (entries[0].host, entries[0].port), timeout=5.0
+    )
+    try:
+        with pytest.raises(OcmRemoteError) as ei:
+            P.request(s, P.Message(P.MsgType.CANCEL, {"tag": 7}))
+        assert ei.value.code == int(P.ErrCode.BAD_MSG)
+        # Stream still in sync on the same connection.
+        assert P.request(
+            s, P.Message(P.MsgType.STATUS, {})
+        ).fields["live_allocs"] >= 0
+    finally:
+        s.close()
+    client = ControlPlaneClient(entries, 0, config=cfg)
+    h = client.alloc(128 << 10, OcmKind.REMOTE_HOST)
+    data = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+    client.put(h, data)
+    np.testing.assert_array_equal(client.get(h, 128 << 10), data)
+    client.free(h)
+    client.close()

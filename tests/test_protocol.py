@@ -34,6 +34,8 @@ def test_roundtrip_all_schemas():
         "moved": 3, "src_rank": 1,
         # leadership family (MASTER_STATE/LEADER_UPDATE/LEADER_HANDOFF)
         "seq": 17, "leader": 1, "from_rank": 0,
+        # time-budget family (CANCEL/CANCEL_OK)
+        "tag": 0xDEAD0042, "revoked": 1,
     }
     for mtype, schema in P._SCHEMAS.items():
         msg = P.Message(mtype, {k: samples[k] for k, _ in schema})

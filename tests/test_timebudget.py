@@ -1,0 +1,610 @@
+"""Time-bounded data plane (resilience/timebudget.py): deadline
+propagation + typed expiry, budget-clamped backoffs, server-side
+cancellation, hedged replica reads, and per-peer circuit breakers."""
+
+import asyncio
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu.core.errors import (
+    OcmBreakerOpen,
+    OcmDeadlineExceeded,
+    OcmRemoteError,
+)
+from oncilla_tpu.core.kinds import OcmKind
+from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.resilience import timebudget
+from oncilla_tpu.runtime import daemon as D
+from oncilla_tpu.runtime import mux as mux_rt
+from oncilla_tpu.runtime import protocol as P
+from oncilla_tpu.runtime.client import ControlPlaneClient, backoff_sleep
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260804)
+
+
+@pytest.fixture
+def journaled():
+    prev = obs_journal.enabled()
+    obs_journal.set_enabled(True)
+    obs_journal.clear()
+    yield
+    obs_journal.set_enabled(prev)
+
+
+def fast_cfg(**kw):
+    d = dict(
+        host_arena_bytes=16 << 20,
+        device_arena_bytes=4 << 20,
+        chunk_bytes=128 << 10,
+        heartbeat_s=0.05,
+        lease_s=5.0,
+        detect_interval_s=0.05,
+        suspect_after=1,
+        dead_after=2,
+        probe_timeout_s=0.25,
+        dcn_stripes=1,
+        failover_wait_s=5.0,
+    )
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+# -- Budget / wire helpers (unit) ----------------------------------------
+
+
+def test_budget_remaining_decrements():
+    b = timebudget.Budget.from_ms(200)
+    r0 = b.remaining_ms()
+    assert 0 < r0 <= 200
+    time.sleep(0.05)
+    assert b.remaining_ms() < r0
+    assert not b.expired
+    b2 = timebudget.Budget.from_ms(0)
+    assert b2.expired
+    with pytest.raises(OcmDeadlineExceeded):
+        b2.check("unit")
+
+
+def test_budget_wire_roundtrip():
+    b = timebudget.Budget.from_ms(5000)
+    msg = P.Message(P.MsgType.DATA_GET,
+                    {"alloc_id": 1, "offset": 0, "nbytes": 8})
+    timebudget.attach(msg, b, P.FLAG_DEADLINE)
+    assert msg.flags & P.FLAG_DEADLINE
+    ms, rest = timebudget.split(msg.data)
+    assert ms is not None and 0 < ms <= 5000
+    assert len(rest) == 0
+    # Bulk payloads become the vectored [tail, payload] form — never a
+    # concatenating copy.
+    payload = bytes(8192)
+    msg2 = P.Message(P.MsgType.DATA_PUT,
+                     {"alloc_id": 1, "offset": 0, "nbytes": len(payload)},
+                     payload)
+    timebudget.attach(msg2, b, P.FLAG_DEADLINE)
+    assert isinstance(msg2.data, list) and msg2.data[1] is payload
+    # A short tail is tolerated, never a crash.
+    assert timebudget.split(b"\x01")[0] is None
+
+
+def test_backoff_sleep_jitter_and_clamp_bounds():
+    # Unbudgeted: uniform in [0.5, 1.0] x step.
+    for _ in range(5):
+        t0 = time.monotonic()
+        slept = backoff_sleep(0.02)
+        dt = time.monotonic() - t0
+        assert 0.01 <= slept <= 0.02 + 1e-9
+        assert dt >= slept * 0.9
+    # Budget smaller than the jittered step: the sleep CLAMPS to the
+    # remainder instead of overshooting the deadline.
+    b = timebudget.Budget.from_ms(15)
+    t0 = time.monotonic()
+    slept = backoff_sleep(10.0, b)
+    dt = time.monotonic() - t0
+    assert slept <= 0.016
+    assert dt < 0.5
+    # Expired budget: no sleep at all.
+    b2 = timebudget.Budget.from_ms(0)
+    t0 = time.monotonic()
+    assert backoff_sleep(10.0, b2) == 0.0
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_circuit_breaker_state_machine(journaled):
+    br = timebudget.CircuitBreaker(threshold=2, probe_ms=40)
+    key = ("10.0.0.1", 17980)
+    br.check(key)  # closed: free pass
+    br.fail(key)
+    br.check(key)  # one strike: still closed
+    br.fail(key)
+    assert br.state(key) == "open"
+    with pytest.raises(OcmBreakerOpen):
+        br.check(key)
+    assert br.counters["fast_fails"] == 1
+    # Probe window elapses: exactly one caller is admitted half-open,
+    # the next still fails fast.
+    time.sleep(0.05)
+    br.check(key)  # the probe
+    with pytest.raises(OcmBreakerOpen):
+        br.check(key)
+    # Failed probe re-opens the window...
+    br.fail(key)
+    assert br.state(key) == "open"
+    with pytest.raises(OcmBreakerOpen):
+        br.check(key)
+    # ... and a successful one closes the breaker for good.
+    time.sleep(0.05)
+    br.check(key)
+    br.ok(key)
+    assert br.state(key) == "closed"
+    br.check(key)
+    evs = [e["ev"] for e in obs_journal.events()
+           if e["ev"].startswith("breaker_")]
+    assert "breaker_open" in evs and "breaker_close" in evs
+    # threshold=0 disables the whole machine.
+    off = timebudget.CircuitBreaker(threshold=0)
+    for _ in range(10):
+        off.fail(key)
+    off.check(key)
+    assert not off.enabled
+
+
+# -- protocol surface pins -----------------------------------------------
+
+
+def test_deadline_protocol_surface():
+    assert P.VALID_FLAGS[P.MsgType.CONNECT] & P.FLAG_CAP_DEADLINE
+    assert P.VALID_FLAGS[P.MsgType.CONNECT_CONFIRM] & P.FLAG_CAP_DEADLINE
+    for t in (P.MsgType.DATA_PUT, P.MsgType.DATA_GET, P.MsgType.REQ_ALLOC,
+              P.MsgType.DO_ALLOC, P.MsgType.DO_REPLICA, P.MsgType.REQ_FREE,
+              P.MsgType.DO_FREE, P.MsgType.MIGRATE_BEGIN):
+        assert P.VALID_FLAGS[t] & P.FLAG_DEADLINE, t
+        assert D._FLAGS_HANDLED[t] & P.FLAG_DEADLINE, t
+    assert P.MsgType.CANCEL in D._HANDLERS
+    assert P.VALID_FLAGS[P.MsgType.CANCEL] & P.FLAG_MUX_TAG
+    assert int(P.ErrCode.DEADLINE_EXCEEDED) == 14
+
+
+def test_deadline_unset_wire_is_byte_identical():
+    """OCM_DEADLINE_MS unset: CONNECT never offers FLAG_CAP_DEADLINE
+    and no budget tail ever rides — byte-for-byte the PR-14 frames."""
+    cfg = OcmConfig()
+    assert not cfg.deadline_offer
+    connect = P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0},
+        flags=P.FLAG_CAP_TRACE if cfg.trace else 0,
+    ))
+    offer = (P.FLAG_CAP_TRACE if cfg.trace else 0) | (
+        P.FLAG_CAP_DEADLINE if cfg.deadline_offer else 0
+    )
+    assert P.pack(P.Message(
+        P.MsgType.CONNECT, {"pid": 7, "rank": 0}, flags=offer,
+    )) == connect
+    req = P.Message(P.MsgType.REQ_ALLOC, {
+        "orig_rank": 0, "pid": 7, "kind": 3, "nbytes": 4096,
+    })
+    packed = P.pack(req)
+    b = timebudget.budget_from(None, cfg)
+    assert b is None
+    assert P.pack(req) == packed  # nothing attached, nothing mutated
+
+
+# -- cross-hop decrement + expired-before-reserve ------------------------
+
+
+def test_cross_hop_budget_decrement_and_expired_refusal(rng):
+    """A relayed REQ_ALLOC through a stalled origin arrives at the
+    leader with a STRICTLY smaller budget tail (each hop re-attaches
+    its remainder), and a budget that dies inside the stall is refused
+    typed BEFORE placement reserves anything — the QoS ledger and
+    registries stay untouched."""
+    cfg = fast_cfg(deadline_ms=2000, quota_bytes=8 << 20)
+    with local_cluster(2, config=cfg) as cl:
+        client = cl.client(1)  # non-leader origin: REQ_ALLOC relays
+        assert client._ctrl_caps & P.FLAG_CAP_DEADLINE
+        origin, leader = cl.daemons[1], cl.daemons[0]
+        origin.serve_delay_types = frozenset({P.MsgType.REQ_ALLOC})
+        origin.serve_delay_s = 0.05
+        h = client.alloc(64 << 10, OcmKind.REMOTE_HOST, deadline_ms=800)
+        sent = 800
+        at_origin = origin.tb_counters["last_budget_ms"]
+        at_leader = leader.tb_counters["last_budget_ms"]
+        assert 0 < at_origin <= sent
+        # The chaos-free stall is 50 ms: the leader's tail must have
+        # lost at least most of it relative to the origin's.
+        assert at_leader <= at_origin - 40, (at_origin, at_leader)
+        client.free(h)
+        # Expired inside the stall: typed refusal, nothing reserved.
+        live_before = sum(d.registry.live_count() for d in cl.daemons)
+        exceeded_before = origin.tb_counters["deadline_exceeded"]
+        with pytest.raises((OcmDeadlineExceeded, OcmRemoteError)) as ei:
+            client.alloc(64 << 10, OcmKind.REMOTE_HOST, deadline_ms=30)
+        if isinstance(ei.value, OcmRemoteError):
+            assert ei.value.code == int(P.ErrCode.DEADLINE_EXCEEDED)
+        origin.serve_delay_s = 0.0
+        origin.serve_delay_types = frozenset()
+        assert origin.tb_counters["deadline_exceeded"] > exceeded_before
+        assert sum(
+            d.registry.live_count() for d in cl.daemons
+        ) == live_before, "expired alloc leaked into a registry"
+
+
+# -- server-side cancellation --------------------------------------------
+
+
+def test_cancel_revokes_server_side_out_of_order(rng, journaled):
+    """An AsyncOcm tenant abandons a slow tagged REQ_ALLOC (asyncio
+    timeout): the channel sends CANCEL, the daemon's cancel counter
+    moves, the revoked op's reply is suppressed (and the completed
+    allocation unwound through the free path — ledger drained), and
+    the cancel-ack reclaims the client-side orphan tombstone. The
+    cancel overtakes the op it revokes on the worker pool — the
+    out-of-order contract."""
+    cfg = fast_cfg(deadline_ms=5000)
+    with local_cluster(2, config=cfg) as cl:
+        victim = cl.daemons[0]
+        live_before = sum(d.registry.live_count() for d in cl.daemons)
+
+        async def storm() -> int:
+            from oncilla_tpu.runtime.mux import AsyncOcm
+
+            abandoned = 0
+            a = await AsyncOcm.open(cl.entries, rank=0, config=cfg,
+                                    app_id=88001)
+            try:
+                victim.serve_delay_types = frozenset(
+                    {P.MsgType.REQ_ALLOC}
+                )
+                victim.serve_delay_s = 0.15
+                for _ in range(3):
+                    try:
+                        await asyncio.wait_for(a.alloc(64 << 10),
+                                               timeout=0.03)
+                    except asyncio.TimeoutError:
+                        abandoned += 1
+                victim.serve_delay_s = 0.0
+                victim.serve_delay_types = frozenset()
+                await asyncio.sleep(0.6)
+                chans = a.channels.live_channels()
+                assert chans
+                assert all(len(c._orphans) == 0 for c in chans), (
+                    "cancel-acks never reclaimed the orphan tags"
+                )
+                assert sum(
+                    c.counters["cancels"] for c in chans
+                ) >= abandoned
+            finally:
+                victim.serve_delay_s = 0.0
+                victim.serve_delay_types = frozenset()
+                await a.aclose()
+            return abandoned
+
+        abandoned = asyncio.run(storm())
+        assert abandoned >= 2
+        assert victim.tb_counters["cancels"] >= abandoned
+        assert victim.tb_counters["cancels_revoked"] >= 1
+        assert victim.tb_counters["cancel_drops"] >= 1
+        # Every revoked-but-completed alloc was unwound: the registries
+        # drain back to the pre-storm count.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and sum(
+            d.registry.live_count() for d in cl.daemons
+        ) > live_before:
+            time.sleep(0.05)
+        assert sum(
+            d.registry.live_count() for d in cl.daemons
+        ) <= live_before
+        # The audit evidence is in the journal: a revoked cancel_ack
+        # with NO later mux_reply for its (conn, tag).
+        evs = obs_journal.events()
+        acks = [e for e in evs
+                if e.get("ev") == "cancel_ack" and e.get("revoked")]
+        assert acks, "no revoked cancel_ack journaled"
+        for ack in acks:
+            later = [
+                e for e in evs
+                if e.get("ev") == "mux_reply"
+                and e.get("conn") == ack.get("conn")
+                and e.get("tag") == ack.get("tag")
+                and e.get("seq", 0) > ack.get("seq", 0)
+            ]
+            assert not later, f"ack after cancel-ack: {later}"
+
+
+def test_cancel_from_lockstep_peer_is_honest_noop():
+    """CANCEL outside a mux channel: one request in flight per
+    connection means nothing can be revoked — the daemon answers
+    CANCEL_OK revoked=0 with the stream in sync."""
+    cfg = fast_cfg()
+    with local_cluster(1, config=cfg) as cl:
+        e = cl.entries[0]
+        s = socket.create_connection((e.connect_host, e.port), timeout=5)
+        try:
+            r = P.request(s, P.Message(P.MsgType.CANCEL, {"tag": 42}))
+            assert r.type == P.MsgType.CANCEL_OK
+            assert r.fields == {"tag": 42, "revoked": 0}
+            # Stream still in sync.
+            assert P.request(
+                s, P.Message(P.MsgType.STATUS, {})
+            ).type == P.MsgType.STATUS_OK
+        finally:
+            s.close()
+
+
+# -- orphan-tag bound (mute peer) ----------------------------------------
+
+
+def test_mux_orphans_bounded_against_mute_peer(monkeypatch):
+    """A peer that NEVER replies used to grow the orphan-tag set by one
+    tombstone per abandoned waiter forever; it is now capped (oldest
+    dropped) and the cancel futures it spawns are bounded too."""
+    monkeypatch.setattr(mux_rt, "ORPHAN_CAP", 16)
+    cfg = fast_cfg()
+
+    class MuteTransport:
+        def writelines(self, parts):
+            pass
+
+        def close(self):
+            pass
+
+    async def drive():
+        loop = asyncio.get_running_loop()
+        ch = mux_rt.MuxChannel(loop, ("mute", 1), cfg)
+        ch.caps = P.FLAG_CAP_MUX
+        ch._transport = MuteTransport()
+        for _ in range(50):
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(ch.request(P.Message(
+                    P.MsgType.STATUS, {}
+                )), timeout=0.001)
+        # Let the cancel-collect tasks run a beat.
+        await asyncio.sleep(0.01)
+        assert len(ch._orphans) <= 16
+        assert ch.counters["orphans_dropped"] > 0
+        # Outstanding state is bounded: at most one pending cancel per
+        # live orphan slot plus the in-flight window, never one per
+        # abandoned op.
+        assert len(ch._pending) <= 50 + 16
+        ch.close()
+        assert not ch._orphans and not ch._pending
+
+    asyncio.run(drive())
+
+
+# -- hedged reads ---------------------------------------------------------
+
+
+def test_hedged_get_escapes_slow_primary(rng, journaled):
+    """A slow primary chain member: the hedge fires after OCM_HEDGE_MS,
+    the healthy replica answers first, the read is byte-exact and far
+    faster than the stall — and writes are NEVER hedged."""
+    cfg = fast_cfg(replicas=2, hedge_ms=10)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0, heartbeat=False)
+        data = rng.integers(0, 256, 256 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        assert h.replica_ranks
+        client.put(h, data)
+        slow = cl.daemons[h.rank]
+        slow.serve_delay_types = frozenset({P.MsgType.DATA_GET})
+        slow.serve_delay_s = 0.12
+        t0 = time.monotonic()
+        got = client.get(h, data.nbytes)
+        dt = time.monotonic() - t0
+        np.testing.assert_array_equal(got, data)
+        assert dt < 0.1, f"hedge never escaped the 120 ms stall ({dt})"
+        evs = [e["ev"] for e in obs_journal.events()
+               if e["ev"].startswith("hedge_")]
+        assert "hedge_fired" in evs and "hedge_won" in evs
+        # Writes never hedge: a put with the primary slow on DATA_PUT
+        # eats the stall in full (single code path, no second writer).
+        slow.serve_delay_types = frozenset({P.MsgType.DATA_PUT})
+        before = [e["ev"] for e in obs_journal.events()].count(
+            "hedge_fired"
+        )
+        client.put(h, data)
+        after = [e["ev"] for e in obs_journal.events()].count(
+            "hedge_fired"
+        )
+        assert after == before, "a WRITE fired a hedge"
+        slow.serve_delay_s = 0.0
+        slow.serve_delay_types = frozenset()
+        client.free(h)
+
+
+def test_hedge_loser_never_mutates_shared_handle(rng, journaled):
+    """The losing primary attempt of a hedged get keeps running after
+    the hedge wins — it must never repoint (or re-account) the CALLER's
+    handle: a concurrent/subsequent write still targets the true
+    primary (the bug the cross-process verify drive caught: a loser's
+    ladder repointed the shared handle onto a read-only replica and a
+    later put dead-ended)."""
+    cfg = fast_cfg(replicas=2, hedge_ms=10, failover_wait_s=2.0)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0, heartbeat=False)
+        data = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data)
+        owner, reps = h.rank, h.replica_ranks
+        slow = cl.daemons[owner]
+        slow.serve_delay_types = frozenset({P.MsgType.DATA_GET})
+        slow.serve_delay_s = 0.15
+        got = client.get(h, data.nbytes)  # hedge wins via the replica
+        np.testing.assert_array_equal(got, data)
+        # Let the losing primary attempt finish its stall + ladder.
+        time.sleep(0.4)
+        assert (h.rank, h.replica_ranks) == (owner, reps), (
+            "hedge loser repointed the shared handle"
+        )
+        slow.serve_delay_s = 0.0
+        slow.serve_delay_types = frozenset()
+        # The handle still writes through the true primary.
+        data2 = rng.integers(0, 256, 128 << 10, dtype=np.uint8)
+        client.put(h, data2)
+        np.testing.assert_array_equal(client.get(h, data2.nbytes), data2)
+        assert h.rank == owner
+        client.free(h)
+
+
+def test_replica_serves_client_reads_while_primary_alive(rng):
+    """Hedge prerequisite: a replica holder serves client DATA_GET even
+    while it believes the primary alive (every acked write is on the
+    whole chain pre-ack, so the read is as fresh as the client's acked
+    state); writes keep the NOT_PRIMARY fork discipline."""
+    cfg = fast_cfg(replicas=2)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0, heartbeat=False)
+        data = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data)
+        rep = cl.entries[h.replica_ranks[0]]
+        s = socket.create_connection((rep.connect_host, rep.port),
+                                     timeout=5)
+        try:
+            r = P.request(s, P.Message(P.MsgType.DATA_GET, {
+                "alloc_id": h.alloc_id, "offset": 0,
+                "nbytes": data.nbytes,
+            }))
+            assert bytes(r.data) == data.tobytes()
+            with pytest.raises(OcmRemoteError) as ei:
+                P.request(s, P.Message(P.MsgType.DATA_PUT, {
+                    "alloc_id": h.alloc_id, "offset": 0, "nbytes": 16,
+                }, b"\x00" * 16))
+            assert ei.value.code == int(P.ErrCode.NOT_PRIMARY)
+        finally:
+            s.close()
+        client.free(h)
+
+
+# -- breaker wired into the transfer ladder ------------------------------
+
+
+def test_breaker_opens_in_transfer_ladder_and_recovers(rng):
+    """Consecutive transport failures toward one peer flip its breaker
+    OPEN inside the client's transfer path (attempts then fail fast and
+    the ladder serves from the replica); once the peer heals, the
+    half-open probe closes it."""
+    from oncilla_tpu.resilience.chaos import (
+        ChaosController,
+        ChaosSchedule,
+    )
+
+    cfg = fast_cfg(replicas=2, breaker_threshold=2, breaker_probe_ms=80)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0, heartbeat=False)
+        handles = []
+        guard = 0
+        sick = None
+        while guard < 60 and len(handles) < 4:
+            guard += 1
+            d = rng.integers(0, 256, 32 << 10, dtype=np.uint8)
+            h = client.alloc(d.nbytes, OcmKind.REMOTE_HOST)
+            client.put(h, d, 0)
+            if h.rank != 0 and (sick is None or h.rank == sick):
+                sick = h.rank
+                handles.append((h, d))
+        assert len(handles) >= 4, "placement never concentrated on one rank"
+        e_sick = cl.entries[sick]
+        key = (e_sick.connect_host, e_sick.port)
+        controller = ChaosController(
+            ChaosSchedule(seed=1, faults=()), cl.entries,
+        )
+        with controller.inject():
+            controller.force("partition", sick)
+            for h, d in handles[:3]:
+                got = client.get(h, d.nbytes)
+                assert bytes(got) == d.tobytes()
+            assert client._breaker.state(key) == "open"
+            assert client._breaker.counters["fast_fails"] >= 1
+            controller.force("heal", sick)
+            time.sleep(0.12)
+            h, d = handles[3]
+            got = client.get(h, d.nbytes)
+            assert bytes(got) == d.tobytes()
+            assert client._breaker.state(key) == "closed"
+
+
+# -- ladder clamps --------------------------------------------------------
+
+
+def test_transfer_ladder_clamps_to_budget(rng):
+    """A put whose owner is unreachable (and whose replica refuses
+    NOT_PRIMARY) must resolve typed DEADLINE_EXCEEDED in ~its budget,
+    never ride the full failover window."""
+    from oncilla_tpu.resilience.chaos import (
+        ChaosController,
+        ChaosSchedule,
+    )
+
+    cfg = fast_cfg(replicas=2, failover_wait_s=30.0, deadline_ms=0)
+    with local_cluster(3, config=cfg) as cl:
+        client = cl.client(0, heartbeat=False)
+        data = rng.integers(0, 256, 64 << 10, dtype=np.uint8)
+        h = client.alloc(data.nbytes, OcmKind.REMOTE_HOST)
+        client.put(h, data)
+        controller = ChaosController(
+            ChaosSchedule(seed=1, faults=()), cl.entries,
+        )
+        with controller.inject():
+            controller.force("partition", h.rank)
+            t0 = time.monotonic()
+            with pytest.raises(OcmDeadlineExceeded):
+                client.put(h, data, 0, deadline_ms=400)
+            dt = time.monotonic() - t0
+            assert dt < 2.0, (
+                f"ladder ran {dt:.1f}s past its 0.4s budget"
+            )
+            controller.force("heal", h.rank)
+
+
+def test_ocm_context_passes_deadline_through(rng):
+    """Ocm.put/get/alloc accept deadline_ms and forward it to the
+    remote backend only when set (fake backends keep working)."""
+    cfg = fast_cfg()
+    with local_cluster(2, config=cfg) as cl:
+        ctx = cl.context(0, heartbeat=False)
+        data = rng.integers(0, 256, 32 << 10, dtype=np.uint8)
+        h = ctx.alloc(data.nbytes, OcmKind.REMOTE_HOST, deadline_ms=5000)
+        ctx.put(h, data, deadline_ms=5000)
+        got = ctx.get(h, data.nbytes, deadline_ms=5000)
+        assert bytes(np.asarray(got)) == data.tobytes()
+        out = np.empty(data.nbytes, dtype=np.uint8)
+        ctx.get(h, out=out, deadline_ms=5000)
+        np.testing.assert_array_equal(out, data)
+        ctx.free(h)
+        ctx.tini()
+
+
+def test_audit_catches_ack_after_cancel_ack():
+    """The new invariant: a mux_reply AFTER a revoked cancel_ack for
+    the same (track, conn, tag) is a finding; benign orders are not."""
+    from oncilla_tpu.obs import audit
+
+    def ev(seq, ev_name, **f):
+        return {"ev": ev_name, "jid": "j1", "seq": seq, "ts": seq / 1e3,
+                "track": "daemon-r0", "pid": 1, **f}
+
+    bad = [
+        ev(1, "cancel_ack", conn=5, tag=9, revoked=1),
+        ev(2, "mux_reply", conn=5, tag=9),
+    ]
+    findings, _ = audit.audit_events(bad)
+    assert any(f.rule == "cancel-ack-order" for f in findings)
+    ok = [
+        ev(1, "mux_reply", conn=5, tag=9),
+        ev(2, "cancel_ack", conn=5, tag=9, revoked=0),
+        ev(3, "cancel_ack", conn=5, tag=11, revoked=1),
+        ev(4, "mux_reply", conn=5, tag=12),   # different tag
+        ev(5, "mux_reply", conn=6, tag=11),   # different conn
+    ]
+    findings, _ = audit.audit_events(ok)
+    assert not [f for f in findings if f.rule == "cancel-ack-order"]
