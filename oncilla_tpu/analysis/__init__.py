@@ -21,17 +21,30 @@ zero tooling to catch them. The native daemon gets ThreadSanitizer coverage
 - :mod:`~oncilla_tpu.analysis.lockwatch` — a runtime lock-order watchdog
   (``OCM_LOCKWATCH=1``): records the cross-thread lock acquisition-order
   graph, reports cycles (potential deadlocks) and over-threshold holds.
+- :mod:`~oncilla_tpu.analysis.conformance` — cross-language wire
+  conformance: extracts the full protocol surface from BOTH
+  implementations (Python ``protocol.py``/``daemon.py`` and the native
+  ``protocol.hh/.cc``/``daemon.cc``), checks enum/schema/flag/dispatch
+  parity, fencing completeness, data-tail strip order, and the audit↔
+  journal event cross-reference; generates the capability/parity matrix
+  in docs/ARCHITECTURE.md with a drift check.
+- :mod:`~oncilla_tpu.analysis.asyncsafety` — asyncio lint over the mux
+  runtime and everything on its loop: blocking calls inside coroutines,
+  locks or thread-local installs held across ``await``, untracked
+  ``create_task``.
 
 CLI: ``python -m oncilla_tpu.analysis`` — exits nonzero on findings not
 covered by the checked-in baseline (``analysis_baseline.json``). See
 docs/ANALYSIS.md.
 """
 
+from oncilla_tpu.analysis.asyncsafety import scan_async
+from oncilla_tpu.analysis.conformance import check_conformance
 from oncilla_tpu.analysis.lifecycle import analyze_source, scan_lifecycle
 from oncilla_tpu.analysis.lint import Finding, scan_paths
 from oncilla_tpu.analysis.project import check_protocol
 
 __all__ = [
     "Finding", "scan_paths", "check_protocol", "scan_lifecycle",
-    "analyze_source",
+    "analyze_source", "scan_async", "check_conformance",
 ]

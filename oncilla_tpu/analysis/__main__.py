@@ -1,17 +1,25 @@
 """``python -m oncilla_tpu.analysis`` — the static-analysis gate.
 
-Scans the package (and ``tests/`` when present) with both analysis
-families — the concurrency lint (:mod:`~.lint`) and the handle-lifecycle
-dataflow pass (:mod:`~.lifecycle`) — runs the protocol exhaustiveness/
-roundtrip checks, subtracts the checked-in baseline, and exits nonzero on
-anything new. The summary line carries per-family counts so CI logs show
-which gate tripped; baseline entries whose symbol no longer produces a
-finding are reported as stale (fix: re-run ``--write-baseline``).
+Scans the package (and ``tests/`` when present) with the analysis
+families — the concurrency lint (:mod:`~.lint`), the handle-lifecycle
+dataflow pass (:mod:`~.lifecycle`), the asyncio-safety lint
+(:mod:`~.asyncsafety`), and on default scans the protocol
+exhaustiveness/roundtrip checks plus the cross-language wire-conformance
+family (:mod:`~.conformance`) — subtracts the checked-in baseline, and
+exits nonzero on anything new. Info-level findings (dead-telemetry
+reports like ``journal-event-unchecked``) are printed for visibility but
+never affect the exit code. The summary line carries per-family counts
+so CI logs show which gate tripped; baseline entries whose symbol no
+longer produces a finding are reported as stale (fix: re-run
+``--write-baseline``).
 
 Usage::
 
     python -m oncilla_tpu.analysis                  # gate the whole tree
     python -m oncilla_tpu.analysis path/to/file.py  # scan specific paths
+    python -m oncilla_tpu.analysis --families conformance,asyncsafety
+    python -m oncilla_tpu.analysis --json           # CI artifact report
+    python -m oncilla_tpu.analysis --write-matrix   # regen ARCHITECTURE.md
     python -m oncilla_tpu.analysis --write-baseline # adopt current findings
 
 The baseline (``analysis_baseline.json`` at the repo root) makes the gate
@@ -30,6 +38,13 @@ import os
 import sys
 from collections import Counter
 
+from oncilla_tpu.analysis import conformance
+from oncilla_tpu.analysis.asyncsafety import ASYNC_RULES, scan_async
+from oncilla_tpu.analysis.conformance import (
+    CONFORMANCE_RULES,
+    INFO_RULES,
+    check_conformance,
+)
 from oncilla_tpu.analysis.lifecycle import LIFECYCLE_RULES, scan_lifecycle
 from oncilla_tpu.analysis.lint import Finding, scan_paths
 from oncilla_tpu.analysis.project import check_protocol
@@ -38,14 +53,22 @@ PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROOT = os.path.dirname(PKG_DIR)
 DEFAULT_BASELINE = os.path.join(ROOT, "analysis_baseline.json")
 
+FAMILIES = ("concurrency", "lifecycle", "asyncsafety", "conformance")
+
 
 def family(rule: str) -> str:
     """Which analysis family a rule belongs to (for the summary line)."""
-    return "lifecycle" if rule in LIFECYCLE_RULES else "concurrency"
+    if rule in LIFECYCLE_RULES:
+        return "lifecycle"
+    if rule in ASYNC_RULES:
+        return "asyncsafety"
+    if rule in CONFORMANCE_RULES:
+        return "conformance"
+    return "concurrency"
 
 
 def family_counts(findings: list[Finding]) -> Counter:
-    counts = Counter({"concurrency": 0, "lifecycle": 0})
+    counts = Counter({f: 0 for f in FAMILIES})
     counts.update(family(f.rule) for f in findings)
     return counts
 
@@ -77,7 +100,7 @@ def apply_baseline(
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m oncilla_tpu.analysis",
-        description="oncilla-tpu project lint + protocol checks",
+        description="oncilla-tpu project lint + protocol/conformance checks",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to scan (default: the package + tests)")
@@ -88,8 +111,30 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore any baseline file")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings on stdout")
+                    help="machine-readable per-family findings on stdout")
+    ap.add_argument("--families", default=None, metavar="A,B",
+                    help="comma-separated subset of families to run "
+                         f"(default: all of {','.join(FAMILIES)})")
+    ap.add_argument("--write-matrix", action="store_true",
+                    help="regenerate the capability/parity matrix block "
+                         "in docs/ARCHITECTURE.md and exit")
     args = ap.parse_args(argv)
+
+    if args.write_matrix:
+        changed = conformance.write_matrix(ROOT)
+        print("capability matrix: "
+              + ("regenerated in docs/ARCHITECTURE.md" if changed
+                 else "already up to date"))
+        return 0
+
+    if args.families:
+        fams = set(args.families.split(","))
+        unknown = fams - set(FAMILIES)
+        if unknown:
+            ap.error(f"unknown families: {', '.join(sorted(unknown))} "
+                     f"(valid: {', '.join(FAMILIES)})")
+    else:
+        fams = set(FAMILIES)
 
     default_scan = not args.paths
     if default_scan:
@@ -100,12 +145,24 @@ def main(argv: list[str] | None = None) -> int:
     else:
         paths = args.paths
 
-    findings = scan_paths(paths, rel_to=ROOT)
-    findings.extend(scan_lifecycle(paths, rel_to=ROOT))
+    findings: list[Finding] = []
+    if "concurrency" in fams:
+        findings.extend(scan_paths(paths, rel_to=ROOT))
+    if "lifecycle" in fams:
+        findings.extend(scan_lifecycle(paths, rel_to=ROOT))
+    if "asyncsafety" in fams:
+        findings.extend(scan_async(paths, rel_to=ROOT))
     if default_scan:
-        # Exhaustiveness/roundtrip needs the real modules; explicit-path
+        # These need the real modules + the whole tree; explicit-path
         # scans (fixtures, pre-commit on a file) stay hermetic.
-        findings.extend(check_protocol())
+        if "concurrency" in fams:
+            findings.extend(check_protocol())
+        if "conformance" in fams:
+            findings.extend(check_conformance(ROOT))
+
+    # Info-level findings are reported, never fatal, never baselined.
+    info = [f for f in findings if f.rule in INFO_RULES]
+    findings = [f for f in findings if f.rule not in INFO_RULES]
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
@@ -127,19 +184,37 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     if args.as_json:
-        json.dump(
-            [f.__dict__ for f in findings], sys.stdout, indent=2
-        )
+        def row(f: Finding) -> dict:
+            return {"family": family(f.rule), **f.__dict__}
+
+        report = {
+            "findings": [row(f) for f in findings],
+            "info": [row(f) for f in info],
+            "stale_baseline": stale,
+            "baselined": suppressed,
+            "summary": dict(sorted(family_counts(findings).items())),
+        }
+        if default_scan and "conformance" in fams:
+            report["matrix"] = conformance.matrix_data(
+                conformance.extract_python(ROOT), conformance.extract_native()
+            )
+        json.dump(report, sys.stdout, indent=2)
         print()
     else:
         for f in findings:
             print(f.render())
+        for f in info:
+            print(f"info: {f.render()}")
         for key in stale:
             print(f"analysis: stale baseline entry (symbol no longer "
                   f"present): {key}")
-        fams = family_counts(findings)
-        per_family = ", ".join(f"{k} {v}" for k, v in sorted(fams.items()))
+        fams_c = family_counts(findings)
+        per_family = ", ".join(
+            f"{k} {v}" for k, v in sorted(fams_c.items()) if k in fams
+        )
         tail = f" ({suppressed} baselined)" if suppressed else ""
+        if info:
+            tail += f" ({len(info)} info)"
         if findings:
             print(f"analysis: {len(findings)} finding(s) "
                   f"({per_family}){tail}")

@@ -4601,6 +4601,15 @@ _FENCED_REJECT = frozenset({
     MsgType.SHM_MAP,
     MsgType.SHM_PUT,
     MsgType.SHM_GET,
+    # The device plane rides the same contract as DATA_*: a fenced owner
+    # relaying PLANE_PUT/PLANE_GET would move bytes for extents a newer
+    # epoch already re-homed, and a fenced master must not accept plane
+    # endpoint registrations (the ADD_NODE rule). Found by the
+    # fenced-reject-gap conformance check.
+    MsgType.PLANE_SERVE,
+    MsgType.PLANE_PUT,
+    MsgType.PLANE_GET,
+    MsgType.PLANE_SCRUB,
 })
 
 _HANDLERS = {

@@ -230,6 +230,10 @@ class MuxChannel:
         # Peer answered CANCEL with typed BAD_MSG (an un-upgraded or
         # native daemon): stop sending cancels on this channel.
         self._no_cancel = False
+        # Strong refs to in-flight cancel-collect tasks: the loop keeps
+        # only a weak reference, so an unreferenced task can be GC'd
+        # mid-flight and the revocation silently dropped.
+        self._cancel_tasks: set[asyncio.Task] = set()
         # In-flight window as a raw credit counter: an asyncio.Semaphore
         # costs a few µs per acquire/release even uncontended, and this
         # sits on every tagged request. Waiters queue only at saturation.
@@ -571,14 +575,18 @@ class MuxChannel:
                 self.counters["cancels_revoked"] += 1
                 self._orphans.pop(victim, None)
 
-        self._loop.create_task(collect())
+        task = self._loop.create_task(collect())
+        self._cancel_tasks.add(task)
+        task.add_done_callback(self._cancel_tasks.discard)
 
     async def _request_lockstep(self, msg: Message,
                                 raw: bool = False) -> Message:
         """One request, one reply, nothing else in flight — the pre-mux
         protocol against a declining peer (and the CONNECT probe itself,
         ``raw=True``: the reply is returned even when it is an ERROR)."""
-        async with self._lockstep_mu:
+        # Holding the mutex across the awaited reply IS lockstep mode:
+        # exactly one exchange in flight.
+        async with self._lockstep_mu:  # ocm-lint: allow[async-lock-held-across-await]
             if self._dead is not None:
                 raise OcmConnectError(
                     f"mux channel to {self.addr[0]}:{self.addr[1]} is "

@@ -35,6 +35,14 @@ summary=$(grep -E '^analysis: ' "$alog" | tail -1 || true)
 echo "check.sh: findings by family: ${summary#analysis: }"
 rm -f "$alog"
 
+echo "== wire conformance + async safety =="
+# The cross-language conformance family (Python vs native wire surface,
+# fencing, strip order, audit<->journal cross-reference, and the
+# capability-matrix drift check against docs/ARCHITECTURE.md) plus the
+# asyncio lint, run in isolation so CI logs pin which family tripped.
+# Drift fix: `python -m oncilla_tpu.analysis --write-matrix`.
+python -m oncilla_tpu.analysis --families conformance,asyncsafety || fail=1
+
 echo "== obs smoke =="
 # End-to-end observability proof: a put/get over an in-process cluster
 # under OCM_EVENTS=1, exported to a merged Perfetto/Chrome trace, which

@@ -1,0 +1,58 @@
+"""Seeded violation: synchronous blocking calls inside coroutines.
+
+Scanned explicitly by tests/test_asyncsafety.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks (lint.iter_py_files skips
+``fixtures`` directories). Every construct here must fire
+``async-blocking-call`` (or prove a documented non-finding).
+"""
+
+import asyncio
+import socket
+import time
+
+
+async def sleep_on_loop():
+    time.sleep(0.5)  # FINDING: freezes every task on this loop
+
+
+async def dial_on_loop():
+    socket.create_connection(("127.0.0.1", 1))  # FINDING: sync dial
+
+
+async def wire_roundtrip_on_loop(sock, msg, request):
+    request(sock, msg)  # FINDING: project blocking wire helper
+    sock.recv(4096)     # FINDING: sync socket recv
+
+
+async def sync_pool_on_loop(peer_pool, addr):
+    with peer_pool.lease(addr):  # FINDING: sync PeerPool on the loop
+        pass
+
+
+async def file_on_loop(path):
+    with open(path) as fh:  # FINDING: sync file I/O on the loop
+        return fh.read()
+
+
+async def ok_awaited():
+    await asyncio.sleep(0.5)  # NOT a finding: the asyncio equivalent
+
+
+async def ok_coroutine_wrapped(ch, msg):
+    # NOT findings: .request here is a coroutine being constructed for a
+    # wrapper, not a sync call executing inline.
+    t = asyncio.get_running_loop().create_task(ch.request(msg))
+    await asyncio.wait_for(ch.request(msg), timeout=1.0)
+    return await t
+
+
+async def ok_executor(loop, fn):
+    return await loop.run_in_executor(None, fn)  # NOT a finding
+
+
+def ok_sync_context(sock):
+    sock.recv(1)  # NOT a finding: not a coroutine (lint's jurisdiction)
+
+
+async def ok_suppressed():
+    time.sleep(0.01)  # ocm-lint: allow[async-blocking-call]

@@ -1,0 +1,40 @@
+"""Seeded violation: locks held across ``await``.
+
+Scanned explicitly by tests/test_asyncsafety.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. Every construct here must fire
+``async-lock-held-across-await`` (or prove a documented non-finding).
+"""
+
+import asyncio
+import threading
+
+_mu = asyncio.Lock()
+_thread_mu = threading.Lock()
+
+
+async def asyncio_lock_across_await(fetch):
+    async with _mu:
+        return await fetch()  # FINDING: every tenant queues behind this
+
+
+async def thread_lock_across_await(fetch):
+    with _thread_mu:
+        return await fetch()  # FINDING: can deadlock the loop outright
+
+
+async def ok_lock_released_first(fetch):
+    async with _mu:
+        payload = b"x"  # NOT a finding: no await inside the critical section
+    return await fetch(payload)
+
+
+async def ok_nested_def(fetch):
+    async with _mu:
+        async def later():
+            await fetch()  # NOT a finding: runs after the lock is dropped
+        return later
+
+
+async def ok_suppressed(fetch):
+    async with _mu:  # ocm-lint: allow[async-lock-held-across-await]
+        return await fetch()

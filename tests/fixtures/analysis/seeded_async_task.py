@@ -1,0 +1,40 @@
+"""Seeded violation: ``create_task``/``ensure_future`` results discarded.
+
+Scanned explicitly by tests/test_asyncsafety.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. Every construct here must fire
+``async-untracked-task`` (or prove a documented non-finding). The loop
+holds only a weak reference to running tasks, so an unreferenced task
+can be garbage-collected mid-flight.
+"""
+
+import asyncio
+
+
+async def fire_and_forget(work):
+    asyncio.get_running_loop().create_task(work())  # FINDING: GC-able
+
+
+async def ensure_and_forget(work):
+    asyncio.ensure_future(work())  # FINDING: same shape, older spelling
+
+
+def sync_spawn(loop, work):
+    loop.create_task(work())  # FINDING: sync spawn sites count too
+
+
+async def ok_stored(work, tasks: set):
+    t = asyncio.get_running_loop().create_task(work())
+    tasks.add(t)  # NOT a finding: strong reference kept
+    t.add_done_callback(tasks.discard)
+
+
+async def ok_awaited(work):
+    await asyncio.get_running_loop().create_task(work())  # NOT a finding
+
+
+def ok_returned(loop, work):
+    return loop.create_task(work())  # NOT a finding: caller owns it
+
+
+async def ok_suppressed(work):
+    asyncio.ensure_future(work())  # ocm-lint: allow[async-untracked-task]

@@ -1,0 +1,42 @@
+"""Seeded violation: thread-local context installed across ``await`` —
+the exact PR-13 ``Tracer`` bug shape (one tenant's trace context stamped
+onto another tenant's frames after a task switch).
+
+Scanned explicitly by tests/test_asyncsafety.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. Every construct here must fire
+``async-tls-install-across-await`` (or prove a documented non-finding).
+"""
+
+from oncilla_tpu.obs import trace as obs_trace
+
+
+async def install_in_coroutine(ctx, fetch):
+    prev = obs_trace.install(ctx)  # FINDING: TLS does not follow the task
+    try:
+        return await fetch()
+    finally:
+        obs_trace.restore(prev)
+
+
+async def installed_cm_across_await(ctx, fetch):
+    with obs_trace.installed(ctx):  # FINDING: the PR-13 shape verbatim
+        return await fetch()
+
+
+async def ok_explicit_threading(ctx, fetch):
+    return await fetch(tctx=ctx)  # NOT a finding: context threaded by hand
+
+
+async def ok_installed_no_await(ctx, compute):
+    with obs_trace.installed(ctx):
+        return compute()  # NOT a finding: no suspension point inside
+
+
+def ok_sync_install(ctx):
+    prev = obs_trace.install(ctx)  # NOT a finding: sync code owns its thread
+    obs_trace.restore(prev)
+
+
+async def ok_suppressed(ctx, fetch):
+    with obs_trace.installed(ctx):  # ocm-lint: allow[async-tls-install-across-await]
+        return await fetch()

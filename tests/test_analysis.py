@@ -132,6 +132,45 @@ def test_cli_clean_on_tree(capsys):
     assert rc == 0, capsys.readouterr().out
 
 
+def test_cli_json_report_shape(capsys):
+    """--json emits the per-family CI artifact: typed findings, the
+    info channel, the summary, and (on default scans) the capability
+    matrix — with exit-code semantics unchanged."""
+    rc = analysis_main([str(FIXTURES / "seeded_swallow.py"), "--json"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in report["findings"]} == {"swallowed-exception"}
+    assert all(f["family"] == "concurrency" for f in report["findings"])
+    assert {"family", "rule", "path", "line", "symbol", "message"} <= set(
+        report["findings"][0]
+    )
+    assert "matrix" not in report  # explicit-path scans stay hermetic
+
+    rc = analysis_main(["--json"])
+    assert rc == 0  # info-level findings never affect the exit code
+    report = json.loads(capsys.readouterr().out)
+    assert report["findings"] == []
+    assert set(report["summary"]) == {
+        "concurrency", "lifecycle", "asyncsafety", "conformance",
+    }
+    assert all(f["rule"] == "journal-event-unchecked" for f in report["info"])
+    m = report["matrix"]
+    assert m["capabilities"]["FLAG_CAP_COALESCE"]["native"] == "granted"
+    assert m["requests"]["CANCEL"]["native"] == "typed `BAD_MSG`"
+
+
+def test_cli_families_filter(capsys):
+    # A concurrency-only fixture produces nothing under the async family.
+    rc = analysis_main([str(FIXTURES / "seeded_swallow.py"),
+                        "--families", "asyncsafety"])
+    assert rc == 0
+    # ...and fires under its own.
+    rc = analysis_main([str(FIXTURES / "seeded_async_task.py"),
+                        "--families", "asyncsafety"])
+    assert rc == 1
+    assert "async-untracked-task" in capsys.readouterr().out
+
+
 def test_cli_baseline_suppresses_known_findings(tmp_path, capsys):
     fixture = str(FIXTURES / "seeded_swallow.py")
     baseline = tmp_path / "baseline.json"
