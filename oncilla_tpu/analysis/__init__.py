@@ -32,6 +32,16 @@ zero tooling to catch them. The native daemon gets ThreadSanitizer coverage
   runtime and everything on its loop: blocking calls inside coroutines,
   locks or thread-local installs held across ``await``, untracked
   ``create_task``.
+- :mod:`~oncilla_tpu.analysis.rpcgraph` — the distributed wait-graph
+  pass: extracts per-handler outbound RPCs plus the resources held at
+  each call site into a typed wait-graph and checks it for relay
+  cycles, pool-stratification deadlocks, locks held across peer dials,
+  and unbounded network waits on budgeted paths; generates the RPC
+  topology appendix in docs/ARCHITECTURE.md with a drift check.
+- :mod:`~oncilla_tpu.analysis.waitwatch` — the rpcgraph pass's runtime
+  twin (``OCM_WAITWATCH=1``): fuses locks, pool slots, worker-pool
+  admission, and RPC round-trips into one wait-for graph, asserted
+  acyclic in the stress suites.
 
 CLI: ``python -m oncilla_tpu.analysis`` — exits nonzero on findings not
 covered by the checked-in baseline (``analysis_baseline.json``). See
@@ -43,8 +53,10 @@ from oncilla_tpu.analysis.conformance import check_conformance
 from oncilla_tpu.analysis.lifecycle import analyze_source, scan_lifecycle
 from oncilla_tpu.analysis.lint import Finding, scan_paths
 from oncilla_tpu.analysis.project import check_protocol
+from oncilla_tpu.analysis.rpcgraph import check_rpcgraph, scan_rpcgraph
 
 __all__ = [
     "Finding", "scan_paths", "check_protocol", "scan_lifecycle",
     "analyze_source", "scan_async", "check_conformance",
+    "scan_rpcgraph", "check_rpcgraph",
 ]

@@ -3,7 +3,8 @@
 Scans the package (and ``tests/`` when present) with the analysis
 families — the concurrency lint (:mod:`~.lint`), the handle-lifecycle
 dataflow pass (:mod:`~.lifecycle`), the asyncio-safety lint
-(:mod:`~.asyncsafety`), and on default scans the protocol
+(:mod:`~.asyncsafety`), the distributed wait-graph pass
+(:mod:`~.rpcgraph`), and on default scans the protocol
 exhaustiveness/roundtrip checks plus the cross-language wire-conformance
 family (:mod:`~.conformance`) — subtracts the checked-in baseline, and
 exits nonzero on anything new. Info-level findings (dead-telemetry
@@ -20,6 +21,7 @@ Usage::
     python -m oncilla_tpu.analysis --families conformance,asyncsafety
     python -m oncilla_tpu.analysis --json           # CI artifact report
     python -m oncilla_tpu.analysis --write-matrix   # regen ARCHITECTURE.md
+    python -m oncilla_tpu.analysis --write-topology # regen RPC topology
     python -m oncilla_tpu.analysis --write-baseline # adopt current findings
 
 The baseline (``analysis_baseline.json`` at the repo root) makes the gate
@@ -38,7 +40,7 @@ import os
 import sys
 from collections import Counter
 
-from oncilla_tpu.analysis import conformance
+from oncilla_tpu.analysis import conformance, rpcgraph
 from oncilla_tpu.analysis.asyncsafety import ASYNC_RULES, scan_async
 from oncilla_tpu.analysis.conformance import (
     CONFORMANCE_RULES,
@@ -48,12 +50,19 @@ from oncilla_tpu.analysis.conformance import (
 from oncilla_tpu.analysis.lifecycle import LIFECYCLE_RULES, scan_lifecycle
 from oncilla_tpu.analysis.lint import Finding, scan_paths
 from oncilla_tpu.analysis.project import check_protocol
+from oncilla_tpu.analysis.rpcgraph import (
+    RPCGRAPH_RULES,
+    check_rpcgraph,
+    scan_rpcgraph,
+)
 
 PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROOT = os.path.dirname(PKG_DIR)
 DEFAULT_BASELINE = os.path.join(ROOT, "analysis_baseline.json")
 
-FAMILIES = ("concurrency", "lifecycle", "asyncsafety", "conformance")
+FAMILIES = (
+    "concurrency", "lifecycle", "asyncsafety", "conformance", "rpcgraph",
+)
 
 
 def family(rule: str) -> str:
@@ -64,6 +73,8 @@ def family(rule: str) -> str:
         return "asyncsafety"
     if rule in CONFORMANCE_RULES:
         return "conformance"
+    if rule in RPCGRAPH_RULES:
+        return "rpcgraph"
     return "concurrency"
 
 
@@ -118,11 +129,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-matrix", action="store_true",
                     help="regenerate the capability/parity matrix block "
                          "in docs/ARCHITECTURE.md and exit")
+    ap.add_argument("--write-topology", action="store_true",
+                    help="regenerate the RPC-topology appendix in "
+                         "docs/ARCHITECTURE.md and exit")
     args = ap.parse_args(argv)
 
     if args.write_matrix:
         changed = conformance.write_matrix(ROOT)
         print("capability matrix: "
+              + ("regenerated in docs/ARCHITECTURE.md" if changed
+                 else "already up to date"))
+        return 0
+
+    if args.write_topology:
+        changed = rpcgraph.write_topology(ROOT)
+        print("rpc topology: "
               + ("regenerated in docs/ARCHITECTURE.md" if changed
                  else "already up to date"))
         return 0
@@ -145,20 +166,34 @@ def main(argv: list[str] | None = None) -> int:
     else:
         paths = args.paths
 
-    findings: list[Finding] = []
-    if "concurrency" in fams:
-        findings.extend(scan_paths(paths, rel_to=ROOT))
-    if "lifecycle" in fams:
-        findings.extend(scan_lifecycle(paths, rel_to=ROOT))
-    if "asyncsafety" in fams:
-        findings.extend(scan_async(paths, rel_to=ROOT))
-    if default_scan:
-        # These need the real modules + the whole tree; explicit-path
-        # scans (fixtures, pre-commit on a file) stay hermetic.
+    def collect() -> list[Finding]:
+        out: list[Finding] = []
         if "concurrency" in fams:
-            findings.extend(check_protocol())
-        if "conformance" in fams:
-            findings.extend(check_conformance(ROOT))
+            out.extend(scan_paths(paths, rel_to=ROOT))
+        if "lifecycle" in fams:
+            out.extend(scan_lifecycle(paths, rel_to=ROOT))
+        if "asyncsafety" in fams:
+            out.extend(scan_async(paths, rel_to=ROOT))
+        if "rpcgraph" in fams:
+            out.extend(scan_rpcgraph(paths, rel_to=ROOT))
+        if default_scan:
+            # These need the real modules + the whole tree;
+            # explicit-path scans (fixtures, pre-commit on a file)
+            # stay hermetic.
+            if "concurrency" in fams:
+                out.extend(check_protocol())
+            if "conformance" in fams:
+                out.extend(check_conformance(ROOT))
+            if "rpcgraph" in fams:
+                out.extend(check_rpcgraph(ROOT))
+        # One global deterministic order regardless of family mix: the
+        # --json report is a CI artifact and must be byte-identical for
+        # identical trees.
+        out.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol,
+                                f.message))
+        return out
+
+    findings = collect()
 
     # Info-level findings are reported, never fatal, never baselined.
     info = [f for f in findings if f.rule in INFO_RULES]
@@ -167,12 +202,24 @@ def main(argv: list[str] | None = None) -> int:
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
         counts = Counter(f.key() for f in findings)
+        # A finding that does not reproduce on an immediate re-scan is
+        # transient (a racing editor save, a half-written generated
+        # file) — baking it in would hide the next REAL occurrence, so
+        # refuse it and say so.
+        second = Counter(
+            f.key() for f in collect() if f.rule not in INFO_RULES
+        )
+        dropped = counts - (counts & second)
+        counts &= second
         with open(baseline_path, "w", encoding="utf-8") as fh:
             json.dump(
                 {"version": 1, "findings": dict(sorted(counts.items()))},
                 fh, indent=2,
             )
             fh.write("\n")
+        for key in sorted(dropped):
+            print(f"analysis: refusing transient finding (did not "
+                  f"reproduce on re-scan): {key}")
         print(f"wrote {sum(counts.values())} allowance(s) to {baseline_path}")
         return 0
 
@@ -198,6 +245,8 @@ def main(argv: list[str] | None = None) -> int:
             report["matrix"] = conformance.matrix_data(
                 conformance.extract_python(ROOT), conformance.extract_native()
             )
+        if default_scan and "rpcgraph" in fams:
+            report["topology"] = rpcgraph.topology_data(ROOT)
         json.dump(report, sys.stdout, indent=2)
         print()
     else:
@@ -206,8 +255,11 @@ def main(argv: list[str] | None = None) -> int:
         for f in info:
             print(f"info: {f.render()}")
         for key in stale:
-            print(f"analysis: stale baseline entry (symbol no longer "
-                  f"present): {key}")
+            # The rule prefix of the key identifies the family, so the
+            # log says which gate's baseline needs the refresh.
+            fam = family(key.split(":", 1)[0])
+            print(f"analysis: stale {fam} baseline entry (symbol no "
+                  f"longer present): {key}")
         fams_c = family_counts(findings)
         per_family = ", ".join(
             f"{k} {v}" for k, v in sorted(fams_c.items()) if k in fams

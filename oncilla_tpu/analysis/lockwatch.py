@@ -42,7 +42,13 @@ __all__ = [
 
 
 def enabled() -> bool:
-    return os.environ.get("OCM_LOCKWATCH", "") not in ("", "0")
+    # OCM_WAITWATCH implies lock instrumentation: the unified wait-for
+    # graph (analysis/waitwatch.py) fuses pool slots and RPC edges into
+    # this module's GRAPH, and those edges are only meaningful if lock
+    # holds land on the same per-thread stack.
+    env = os.environ
+    return (env.get("OCM_LOCKWATCH", "") not in ("", "0")
+            or env.get("OCM_WAITWATCH", "") not in ("", "0"))
 
 
 def _hold_threshold_s() -> float:

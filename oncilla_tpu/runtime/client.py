@@ -519,9 +519,14 @@ class ControlPlaneClient:
         # serialized resource (one framed request/reply stream to the
         # local daemon), and _ctrl_lock's only job is that framing. It is
         # a leaf lock — nothing is acquired under it — so it cannot take
-        # part in an ordering cycle (lockwatch verifies this).
+        # part in an ordering cycle (lockwatch verifies this), and the
+        # rpc:daemon order edge it forms is one-way for the same reason.
+        # The wait stays unbounded by design: the peer is the LOCAL
+        # daemon (same host, no network partition to ride out), bounding
+        # it would need ctrl-socket reconnect machinery, and the daemon
+        # refuses expired budgets server-side on every relayed hop.
         with self._ctrl_lock:
-            return request(self._ctrl, msg)  # ocm-lint: allow[blocking-call-under-lock]
+            return request(self._ctrl, msg)  # ocm-lint: allow[blocking-call-under-lock] ocm-lint: allow[lock-across-rpc] ocm-lint: allow[unbounded-blocking]
 
     def _owners_field(self) -> str:
         with self._owner_lock:

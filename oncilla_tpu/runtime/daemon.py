@@ -26,7 +26,7 @@ import struct
 import threading
 import time
 
-from oncilla_tpu.analysis import alloctrace
+from oncilla_tpu.analysis import alloctrace, waitwatch
 from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 from oncilla_tpu.core.errors import (
@@ -864,7 +864,17 @@ class Daemon:
             )
             resync = not self._adopt_master_state()
             if resync:
-                self._rebuild_master_state()
+                # Deliberately dialed under _elect_lock: the adoption
+                # check, whole-cluster resync, and epoch bump must be
+                # atomic w.r.t. the handoff/update handlers or a
+                # concurrent LEADER_HANDOFF could interleave half-built
+                # master state. The cross-process hazard stays open-
+                # ended only in theory: the resync legs are STATUS
+                # (leaf handlers — no back-dial), so the reverse
+                # rpc:daemon -> _elect_lock edge cannot complete a
+                # cycle through them; OCM_WAITWATCH=1 watches the
+                # dynamic graph for regressions.
+                self._rebuild_master_state()  # ocm-lint: allow[lock-across-rpc]
             self.leader_rank = self.rank
             epoch = self.bump_epoch()
             self.leader_epoch = epoch
@@ -1493,22 +1503,30 @@ class Daemon:
                 f"{budget.total_ms} ms budget already spent"
             )
         try:
-            if msg.type in (MsgType.DATA_PUT, MsgType.DATA_GET):
-                op = ("dcn_put_srv" if msg.type == MsgType.DATA_PUT
-                      else "dcn_get_srv")
-                with timebudget.use(budget), obs_trace.use_ctx(tctx), \
-                        self.tracer.span(op, nbytes=msg.fields["nbytes"]):
+            # OCM_WAITWATCH: the whole dispatch HOLDS the rpc:daemon
+            # serve slot, so an outbound dial from a handler shows up
+            # as rpc:daemon -> rpc:daemon-adjacent edges — the dynamic
+            # twin of the static relay/lock-across-rpc rules.
+            with waitwatch.slot(waitwatch.RPC_DAEMON):
+                if msg.type in (MsgType.DATA_PUT, MsgType.DATA_GET):
+                    op = ("dcn_put_srv" if msg.type == MsgType.DATA_PUT
+                          else "dcn_get_srv")
+                    with timebudget.use(budget), obs_trace.use_ctx(tctx), \
+                            self.tracer.span(
+                                op, nbytes=msg.fields["nbytes"]):
+                        return self._dispatch(msg)
+                elif tctx is not None or budget is not None:
+                    # A traced control op gets a serve-side span so the
+                    # exported trace shows the daemon hop, not just the
+                    # client's view of the round-trip; a budgeted one
+                    # keeps its remainder ambient for the hops it
+                    # forwards.
+                    with timebudget.use(budget), obs_trace.use_ctx(tctx), \
+                            self.tracer.span(
+                                "srv_" + msg.type.name.lower()):
+                        return self._dispatch(msg)
+                else:
                     return self._dispatch(msg)
-            elif tctx is not None or budget is not None:
-                # A traced control op gets a serve-side span so the
-                # exported trace shows the daemon hop, not just the
-                # client's view of the round-trip; a budgeted one keeps
-                # its remainder ambient for the hops it forwards.
-                with timebudget.use(budget), obs_trace.use_ctx(tctx), \
-                        self.tracer.span("srv_" + msg.type.name.lower()):
-                    return self._dispatch(msg)
-            else:
-                return self._dispatch(msg)
         except OcmDeadlineExceeded as e:
             return self._deadline_err(str(e))
         except OcmOutOfMemory as e:
@@ -1656,7 +1674,11 @@ class Daemon:
             )
             return
         try:
-            reply = self._dispatch_guarded(msg, tctx, budget)
+            # OCM_WAITWATCH: this thread occupies a bounded mux-pool
+            # slot for the dispatch — the resource the static
+            # pool-stratification rule strata-checks.
+            with waitwatch.slot(waitwatch.MUX_SLOT):
+                reply = self._dispatch_guarded(msg, tctx, budget)
         finally:
             ooo = cstate.note_done(seq)
             with self._mux_ctr_lock:
@@ -2060,12 +2082,22 @@ class Daemon:
         # then trace, then deadline), trace second, so the wire layout
         # matches the strip order.
         bud = timebudget.current()
+        timeout: float | None = None
         if bud is not None and valid & FLAG_DEADLINE:
             if bud.expired:
                 raise OcmDeadlineExceeded(
                     f"relay of {msg.type.name} to {host}:{port}: "
                     f"{bud.total_ms} ms budget exhausted before the hop"
                 )
+            # The remainder bounds the WHOLE exchange, not just the wire
+            # attach: without it a relay against a SIGSTOPped peer sat
+            # in a blocked recv until the pool's transport default,
+            # long past the origin's deadline (the PR-15 bug class the
+            # unbounded-blocking analysis now gates).
+            # Floor of 1 ms: remaining_s() can hit 0.0 in the race
+            # window after the expired check, and settimeout(0) would
+            # flip the socket non-blocking instead of timing out.
+            timeout = max(bud.remaining_s(), 0.001)
             if self._peer_caps_for(host, port) & FLAG_CAP_DEADLINE:
                 msg = timebudget.attach(
                     Message(msg.type, msg.fields, msg.data, msg.flags),
@@ -2081,7 +2113,14 @@ class Daemon:
                 Message(msg.type, msg.fields, msg.data, msg.flags),
                 ctx, FLAG_TRACE_CTX,
             )
-        return self.peers.request(host, port, msg)
+        if timeout is not None:
+            return self.peers.request(host, port, msg, timeout=timeout)
+        # No ambient budget => no deadline to thread; the pool's
+        # transport default bounds the exchange. Kept as a separate
+        # call (not timeout=None) so test seams that wrap
+        # peers.request with a (host, port, msg) signature keep
+        # working on un-budgeted paths.
+        return self.peers.request(host, port, msg)  # ocm-lint: allow[unbounded-blocking]
 
     # -- dispatch --------------------------------------------------------
 
@@ -2847,9 +2886,13 @@ class Daemon:
             if 0 <= target < len(self.entries):
                 pe = self.entries[target]
                 try:
+                    # Not an amplification loop: the tombstone was popped
+                    # from _moved above, so a bounced DO_FREE can take
+                    # this branch at most once per migration record —
+                    # the re-send drains state instead of regenerating it.
                     self._peer_request(
                         pe.connect_host, pe.port,
-                        Message(MsgType.DO_FREE, {"alloc_id": alloc_id}),
+                        Message(MsgType.DO_FREE, {"alloc_id": alloc_id}),  # ocm-lint: allow[relay-cycle]
                     )
                 except (OSError, OcmError):
                     printd("daemon %d: forwarded free of migrated alloc "
@@ -2905,9 +2948,13 @@ class Daemon:
                 continue
             pe = self.entries[rr]
             try:
+                # State-bounded, not cyclic: registry.remove() succeeded
+                # above, so a replica bouncing DO_FREE back finds no
+                # entry here (OcmInvalidHandle with no _moved tombstone)
+                # and the chain dies after one hop.
                 self._peer_request(
                     pe.connect_host, pe.port,
-                    Message(MsgType.DO_FREE, {"alloc_id": e.alloc_id}),
+                    Message(MsgType.DO_FREE, {"alloc_id": e.alloc_id}),  # ocm-lint: allow[relay-cycle]
                 )
             except (OSError, OcmError):
                 printd("daemon %d: replica free of %d on rank %d failed "
@@ -3311,9 +3358,13 @@ class Daemon:
                 continue
             e = self.entries[r]
             try:
+                # relay:1 marks the leg terminal: _on_plane_serve only
+                # re-arms its own gossip for relay:0 (client-originated)
+                # announcements, so a relayed endpoint cannot re-trigger
+                # this sender — one hop, then the type dead-ends.
                 self.peers.request(
                     e.connect_host, e.port,
-                    Message(MsgType.PLANE_SERVE,
+                    Message(MsgType.PLANE_SERVE,  # ocm-lint: allow[relay-cycle]
                             {"host": host, "port": port, "relay": 1}),
                 )
                 with self._plane_sync_lock:

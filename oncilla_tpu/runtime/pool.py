@@ -30,6 +30,7 @@ from __future__ import annotations
 import socket
 import threading
 
+from oncilla_tpu.analysis import waitwatch
 from oncilla_tpu.analysis.lockwatch import make_lock
 from oncilla_tpu.core.errors import (
     OcmConnectError,
@@ -132,6 +133,9 @@ class PeerPool:
                 # At the cap: wait until ANY lease to this peer ends
                 # (release or discard notifies); the timeout is a
                 # belt-and-braces rescan, not the wakeup mechanism.
+                # Blocking on pool admission is the wait-graph edge the
+                # pool-stratification rule reasons about — record it.
+                waitwatch.note_wait(waitwatch.POOL_SLOT)
                 self._cond.wait(timeout=1.0)
         return self._dial(key)
 
@@ -237,6 +241,15 @@ class PeerPool:
         blocked recv against a frozen peer) — a timed-out connection is
         discarded like any transport failure, and a bounded exchange
         that succeeds goes back to the pool blocking."""
+        # The exchange blocks on the peer daemon while this thread may
+        # hold locks — exactly the held-across-RPC edge lock-across-rpc
+        # lints for. Recorded BEFORE the lease on purpose: the
+        # per-connection pool.entry lease is try-acquire-or-fresh
+        # (never an ordering resource), and counting it as held here
+        # would report the by-construction-safe pool.entry ->
+        # rpc:daemon -> pool.entry cycle on every daemon that both
+        # serves and dials.
+        waitwatch.note_wait(waitwatch.RPC_DAEMON)
         entry = self.lease(host, port)
         if timeout is not None:
             entry.sock.settimeout(timeout)

@@ -43,6 +43,24 @@ echo "== wire conformance + async safety =="
 # Drift fix: `python -m oncilla_tpu.analysis --write-matrix`.
 python -m oncilla_tpu.analysis --families conformance,asyncsafety || fail=1
 
+echo "== rpc wait-graph =="
+# Distributed wait-graph family (analysis/rpcgraph.py): every daemon
+# handler's outbound RPCs fused with the resources held at each call
+# site — relay cycles, pool stratification (native OCM_NATIVE_WORKERS
+# pool included), locks held across peer dials, unbounded waits on
+# budgeted paths, and the RPC-topology appendix drift check against
+# docs/ARCHITECTURE.md (fix: --write-topology). The live tree must
+# scan clean AND the analyzer must still catch the seeded relay-cycle
+# fixture — a silent no-op analyzer fails the second leg.
+python -m oncilla_tpu.analysis --families rpcgraph || fail=1
+if python -m oncilla_tpu.analysis --families rpcgraph --no-baseline \
+        tests/fixtures/analysis/seeded_rpc_relay_cycle.py >/dev/null; then
+    echo "check.sh: rpc wait-graph analyzer missed the seeded relay cycle"
+    fail=1
+else
+    echo "check.sh: seeded relay-cycle fixture caught - OK"
+fi
+
 echo "== obs smoke =="
 # End-to-end observability proof: a put/get over an in-process cluster
 # under OCM_EVENTS=1, exported to a merged Perfetto/Chrome trace, which

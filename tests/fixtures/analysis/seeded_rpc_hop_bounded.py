@@ -1,0 +1,32 @@
+"""Seeded NON-violation: self-relay bounded by a hop decrement.
+
+Scanned explicitly by tests/test_rpcgraph.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. The FLOOD handler re-sends
+its own type but decrements an explicit hop counter and stops at zero
+— the other accepted way (besides a terminal flag) to bound a relay.
+The rpcgraph scan of this file must be CLEAN.
+"""
+
+
+class MsgType:
+    FLOOD = 1
+    FLOOD_OK = 2
+
+
+def Message(msgtype, fields, flags=0):
+    return (msgtype, fields, flags)
+
+
+def _on_flood(msg, peers, host, port):
+    hops = msg.fields["hops"] - 1  # the hop decrement the rule accepts
+    if hops <= 0:
+        return Message(MsgType.FLOOD_OK, {})
+    peers.request(
+        host, port, Message(MsgType.FLOOD, {"hops": hops})
+    )  # NOT a finding: hop-bounded above
+    return Message(MsgType.FLOOD_OK, {})
+
+
+_HANDLERS = {
+    MsgType.FLOOD: _on_flood,
+}

@@ -1,0 +1,29 @@
+"""Seeded violation: a ``make_lock`` lock held across a peer dial
+(rpcgraph ``lock-across-rpc``).
+
+Scanned explicitly by tests/test_rpcgraph.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. The round-trip happens inside
+the ``with _mu:`` scope, so the lock-order edge ``fixture.rpc._mu ->
+rpc:daemon`` closes a cross-process cycle with any handler that takes
+the same lock. Exactly ONE ``lock-across-rpc`` finding (with
+``--families rpcgraph``; the concurrency lint flags the same line
+through its own blocking-call rule).
+"""
+
+from oncilla_tpu.analysis.lockwatch import make_lock
+
+
+class MsgType:
+    PING = 1
+
+
+def Message(msgtype, fields, flags=0):
+    return (msgtype, fields, flags)
+
+
+_mu = make_lock("fixture.rpc._mu")
+
+
+def refresh(peers, host, port):
+    with _mu:
+        return peers.request(host, port, Message(MsgType.PING, {}))  # FINDING

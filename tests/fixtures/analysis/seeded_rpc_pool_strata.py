@@ -1,0 +1,26 @@
+"""Seeded violation: bounded-pool wait cycle (``pool-stratification``).
+
+Scanned explicitly by tests/test_rpcgraph.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. ``_serve`` runs ON a slot of
+the bounded ``_ctrl_pool`` and synchronously waits for ANOTHER task on
+the SAME pool: with ``max_workers`` requests in flight every slot is
+waiting for a task that can never be scheduled — the PR-10 deadlock
+class as a self-edge. Exactly ONE ``pool-stratification`` finding.
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+
+_ctrl_pool = ThreadPoolExecutor(max_workers=4)
+
+
+def _helper(x):
+    return x + 1
+
+
+def _serve(x):
+    # Submit-and-wait against the pool this function itself runs on.
+    return _ctrl_pool.submit(_helper, x).result()  # FINDING
+
+
+def handle(x):
+    return _ctrl_pool.submit(_serve, x)

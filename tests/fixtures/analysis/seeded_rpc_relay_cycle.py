@@ -1,0 +1,29 @@
+"""Seeded violation: unbounded relay cycle (rpcgraph ``relay-cycle``).
+
+Scanned explicitly by tests/test_rpcgraph.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks (lint.iter_py_files skips
+``fixtures`` directories). The GOSSIP handler re-sends its own type to a
+peer with no terminal-flag guard and no hop decrement — the PR-8
+heartbeat-amplification shape. Exactly ONE ``relay-cycle`` finding.
+"""
+
+
+class MsgType:
+    GOSSIP = 1
+    GOSSIP_OK = 2
+
+
+def Message(msgtype, fields, flags=0):
+    return (msgtype, fields, flags)
+
+
+def _on_gossip(msg, peers, host, port):
+    # Forwards its own type verbatim-equivalent with nothing to stop a
+    # peer's handler doing the same right back: GOSSIP -> GOSSIP.
+    peers.request(host, port, Message(MsgType.GOSSIP, {"seq": 1}))  # FINDING
+    return Message(MsgType.GOSSIP_OK, {})
+
+
+_HANDLERS = {
+    MsgType.GOSSIP: _on_gossip,
+}

@@ -1,0 +1,37 @@
+"""Seeded NON-violation: self-relay bounded by a terminal flag guard.
+
+Scanned explicitly by tests/test_rpcgraph.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. The GOSSIP handler re-sends
+its own type, but every forwarded copy carries FLAG_GOSSIP_FWD and the
+handler returns early on flagged input (the FLAG_HB_FWD shape the PR-8
+fix introduced) — so a relayed copy can never re-relay. The rpcgraph
+scan of this file must be CLEAN; tests/test_rpcgraph.py also deletes
+the guard to prove the mutation is caught.
+"""
+
+
+class MsgType:
+    GOSSIP = 1
+    GOSSIP_OK = 2
+
+
+FLAG_GOSSIP_FWD = 1 << 0
+
+
+def Message(msgtype, fields, flags=0):
+    return (msgtype, fields, flags)
+
+
+def _on_gossip(msg, peers, host, port):
+    if msg.flags & FLAG_GOSSIP_FWD:
+        return Message(MsgType.GOSSIP_OK, {})  # terminal: no re-relay
+    peers.request(
+        host, port,
+        Message(MsgType.GOSSIP, {"seq": 1}, flags=FLAG_GOSSIP_FWD),
+    )  # NOT a finding: the relayed copy is flag-terminated above
+    return Message(MsgType.GOSSIP_OK, {})
+
+
+_HANDLERS = {
+    MsgType.GOSSIP: _on_gossip,
+}

@@ -1,0 +1,21 @@
+"""Seeded violation: unbounded network wait on a budgeted path
+(rpcgraph ``unbounded-blocking``).
+
+Scanned explicitly by tests/test_rpcgraph.py — excluded from default
+``python -m oncilla_tpu.analysis`` walks. The function reads the
+ambient timebudget (so it is ON a deadline-carrying path) but then
+performs the wire round-trip with no timeout: against a stalled peer
+it blocks arbitrarily past its own deadline — the PR-15 class.
+Exactly ONE ``unbounded-blocking`` finding.
+"""
+
+from oncilla_tpu.resilience import timebudget
+from oncilla_tpu.runtime.protocol import request
+
+
+def fetch(sock, msg):
+    bud = timebudget.current()
+    if bud is not None and bud.expired:
+        raise TimeoutError("budget already spent")
+    # Checked the budget, then ignored it for the wait itself.
+    return request(sock, msg)  # FINDING: no timeout threaded

@@ -152,6 +152,7 @@ def test_cli_json_report_shape(capsys):
     assert report["findings"] == []
     assert set(report["summary"]) == {
         "concurrency", "lifecycle", "asyncsafety", "conformance",
+        "rpcgraph",
     }
     assert all(f["rule"] == "journal-event-unchecked" for f in report["info"])
     m = report["matrix"]

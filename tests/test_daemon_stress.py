@@ -11,7 +11,7 @@ import pytest
 
 import oncilla_tpu as ocm
 from oncilla_tpu import OcmKind
-from oncilla_tpu.analysis import alloctrace, lockwatch
+from oncilla_tpu.analysis import alloctrace, lockwatch, waitwatch
 from oncilla_tpu.runtime.cluster import local_cluster
 from oncilla_tpu.utils.config import OcmConfig
 
@@ -24,13 +24,18 @@ def _watchdogs(monkeypatch):
     fails the test), and OCM_ALLOCTRACE=1 records every alloc/free into
     the allocation ledger, which must drain to empty once the workload
     has freed everything (the dynamic twin of the static lifecycle
-    pass's leak rule)."""
+    pass's leak rule). OCM_WAITWATCH=1 widens the same graph to the
+    unified wait-for graph — pool slots, mux worker-pool admission, and
+    rpc:daemon round-trip edges fused with the locks — so the acyclicity
+    assertion below covers the cross-resource deadlocks the static
+    rpcgraph family models, under real load."""
     monkeypatch.setenv("OCM_LOCKWATCH", "1")
+    monkeypatch.setenv("OCM_WAITWATCH", "1")
     monkeypatch.setenv("OCM_ALLOCTRACE", "1")
     lockwatch.reset()
     alloctrace.reset()
     yield
-    lockwatch.assert_acyclic()
+    waitwatch.assert_acyclic()  # the unified graph, locks included
     leaked = alloctrace.live()
     assert not leaked, (
         f"allocation ledger not clean after stress: "

@@ -182,7 +182,7 @@ def test_stale_baseline_entry_reported(tmp_path, capsys):
     rc = analysis_main([LIFECYCLE_FIXTURE, "--baseline", str(baseline)])
     out = capsys.readouterr().out
     assert rc == 0  # stale allowances warn, they don't fail the gate
-    assert "stale baseline entry" in out
+    assert "stale lifecycle baseline entry" in out
     assert stale_key in out
 
 
