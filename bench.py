@@ -711,10 +711,14 @@ def _run(out: dict, errors: dict, deadline: float) -> None:
 
     # Disaggregated serving (serving/): the flagship workload — tiered
     # paged KV + cross-tenant prefix sharing over an in-process cluster,
-    # paired shared-vs-noshare cells + the owner-kill chaos leg. Runs in
-    # a SUBPROCESS pinned to the CPU backend: the scenario is chip-free
-    # by design (the remote tier is the DCN data plane), and isolating
-    # it keeps its jit/cluster state out of this process entirely.
+    # paired shared-vs-noshare cells + the owner-kill chaos leg, plus
+    # the batched-vs-interleaved paired sweep (detail.serving
+    # .batched_sweep: tokens/s at batch 1/2/4/8 on the same seeded
+    # workload — host-process numbers; see its `note` for the 1-core
+    # caveat). Runs in a SUBPROCESS pinned to the CPU backend: the
+    # scenario is chip-free by design (the remote tier is the DCN data
+    # plane), and isolating it keeps its jit/cluster state out of this
+    # process entirely.
     if budgeted("serving", 150):
         out["detail"]["serving"] = bench_serving(
             errors, timeout_s=min(420.0, max(time_left() - 90.0, 120.0))
@@ -806,11 +810,12 @@ def bench_dcn(errors: dict) -> dict:
 
 def bench_serving(errors: dict, timeout_s: float = 420.0) -> dict:
     """Flagship serving workload (oncilla_tpu/serving/): paired
-    shared-vs-noshare cells + the owner-kill chaos leg, run in a
-    subprocess pinned to the CPU backend (the scenario is chip-free —
-    its remote tier is the DCN data plane — and the isolation keeps the
-    cluster + jit state out of the bench process). Parses the harness's
-    one-line JSON dict."""
+    shared-vs-noshare cells, the owner-kill chaos leg, and the
+    batched-vs-interleaved tokens/s sweep (``batched_sweep`` key), run
+    in a subprocess pinned to the CPU backend (the scenario is
+    chip-free — its remote tier is the DCN data plane — and the
+    isolation keeps the cluster + jit state out of the bench process).
+    Parses the harness's one-line JSON dict."""
     import os
     import subprocess
     import sys

@@ -33,6 +33,7 @@ _EXPORTS = {
     "BucketedPagedDecoder": "kv_paging",
     "paged_decode_step": "kv_paging",
     "paged_decode_step_jit": "kv_paging",
+    "paged_decode_batch_step_jit": "kv_paging",
     "paged_decode_page_jit": "kv_paging",
     "paged_generate_page_jit": "kv_paging",
 }

@@ -315,6 +315,121 @@ def _paged_token(params, token, pos, tail_len, ctx_start, k_ctx, v_ctx,
 @partial(
     jax.jit,
     static_argnames=("cfg", "layer_params_fn", "mlp_of"),
+    donate_argnums=(6, 7),
+)
+def paged_decode_batch_step_jit(
+    params: dict,
+    tokens: jax.Array,     # (B,) current token ids, one per session
+    meta: jax.Array,       # (B, 4) int32 [pos, tail_len, ctx_len, ctx_start]
+    pool_k: jax.Array,     # (N, L, KV, P, Hd) resident page pool
+    pool_v: jax.Array,
+    table: jax.Array,      # (B, MP) int32 pool row per context page
+    tail_k: jax.Array,     # (L, B, KV, P, Hd) per-session tails (donated)
+    tail_v: jax.Array,
+    cfg: LlamaConfig,
+    layer_params_fn=None,
+    mlp_of=None,
+):
+    """ONE fused decode step for a whole batch of paged sessions — the
+    true-batched serving formulation (ROADMAP item 1): instead of one
+    batch-of-1 :func:`paged_decode_step_jit` dispatch per session per
+    step, every runnable session advances one token in a single compiled
+    program.
+
+    The paged context rides a **block table**: ``pool_k``/``pool_v``
+    stack every distinct resident page ONCE (a prefix page shared by k
+    sessions occupies one pool row, not k copies), and ``table[b]``
+    lists session *b*'s pages in context order, 0-padded past its
+    ``ctx_len``/page count. The gather (``pool[table]``) happens inside
+    the jit, so the host hands over O(B·MP) int32 indices per step, not
+    O(B·C·model) floats.
+
+    Per-session ``meta`` rows carry [pos, tail_len, ctx_len, ctx_start]:
+    validity is masked per row (padded context slots and empty tail
+    slots attend to nothing), positions/rope are per row, and the tail
+    insertion scatters each session's new K/V at its own ``tail_len``.
+    Sessions shorter than the padded shapes see extra masked keys whose
+    softmax weight is exactly 0 — the emitted logits are bitwise those
+    of the batch-of-1 step (the paired byte-exact gate leans on this).
+
+    Callers bucket B, MP and N to powers of two so compilations stay
+    O(log batch · log pages), never O(tokens) (the
+    :class:`~oncilla_tpu.serving.engine.ServingEngine` policy).
+    Returns (logits (B, vocab), new_tail_k, new_tail_v).
+    """
+    from oncilla_tpu.models import llama
+
+    lp_fn = layer_params_fn or llama.layer_params
+    pos, tail_len = meta[:, 0], meta[:, 1]
+    ctx_len, ctx_start = meta[:, 2], meta[:, 3]
+    P = tail_k.shape[3]
+    B = tokens.shape[0]
+    MP = table.shape[1]
+    C = MP * P
+
+    # (B, MP) rows -> (L, B, KV, C, Hd) gathered context. Padded table
+    # slots gather pool row 0; they are masked out below via ctx_len.
+    gk = jnp.take(pool_k, table, axis=0)  # (B, MP, L, KV, P, Hd)
+    gv = jnp.take(pool_v, table, axis=0)
+    k_ctx = gk.transpose(2, 0, 3, 1, 4, 5).reshape(
+        pool_k.shape[1], B, pool_k.shape[2], C, pool_k.shape[4]
+    )
+    v_ctx = gv.transpose(2, 0, 3, 1, 4, 5).reshape(
+        pool_v.shape[1], B, pool_v.shape[2], C, pool_v.shape[4]
+    )
+
+    x = params["embed"][tokens][:, None, :].astype(jnp.dtype(cfg.dtype))
+    positions = pos[:, None]  # (B, 1): per-session rope
+    valid = jnp.concatenate(
+        [
+            jnp.arange(C)[None, :] < ctx_len[:, None],
+            jnp.arange(P)[None, :] <= tail_len[:, None],
+        ],
+        axis=1,
+    )  # (B, C + P)
+    if cfg.window is not None:
+        gpos = jnp.concatenate(
+            [
+                ctx_start[:, None] + jnp.arange(C)[None, :],
+                (pos - tail_len)[:, None] + jnp.arange(P)[None, :],
+            ],
+            axis=1,
+        )
+        valid &= gpos > (pos[:, None] - cfg.window)
+    mask = valid[:, None, :]  # (B, Sq=1, C+P)
+    # Per-session tail insertion at each row's own tail_len (the batched
+    # twin of the step path's dynamic_update_slice).
+    slot = jnp.arange(P)[None, :] == tail_len[:, None]  # (B, P)
+    slot4 = slot[:, None, :, None]
+
+    for i in range(cfg.n_layers):
+        state = {}
+
+        def attend(q, kn, vn, i=i, state=state):
+            tk = jnp.where(slot4, kn.astype(tail_k.dtype), tail_k[i])
+            tv = jnp.where(slot4, vn.astype(tail_v.dtype), tail_v[i])
+            state["tk"], state["tv"] = tk, tv
+            k_all = jnp.concatenate(
+                [k_ctx[i].astype(q.dtype), tk.astype(q.dtype)], axis=2
+            )
+            v_all = jnp.concatenate(
+                [v_ctx[i].astype(q.dtype), tv.astype(q.dtype)], axis=2
+            )
+            return llama.grouped_attention(q, k_all, v_all, mask)
+
+        lp = lp_fn(params, i)
+        x = llama.block(cfg, x, lp, positions, attend,
+                        mlp=mlp_of(lp) if mlp_of else None)
+        tail_k = tail_k.at[i].set(state["tk"])
+        tail_v = tail_v.at[i].set(state["tv"])
+
+    logits = llama.final_logits(params, x, cfg)
+    return logits[:, 0], tail_k, tail_v
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "layer_params_fn", "mlp_of"),
     donate_argnums=(5, 6),
 )
 def paged_decode_page_jit(

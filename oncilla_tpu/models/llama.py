@@ -173,7 +173,9 @@ def grouped_attention(q, k, v, mask=None):
     """Dense attention with unexpanded GQA K/V, fp32 softmax.
 
     q: (B, H, Sq, D); k/v: (B, KV, Sk, D) with KV dividing H;
-    mask: (Sq, Sk) bool or None. Returns (B, H, Sq, D) in q's dtype."""
+    mask: (Sq, Sk) bool, (B, Sq, Sk) bool (per-sequence validity — the
+    batched-serving path, where each row carries its own padded-context
+    mask), or None. Returns (B, H, Sq, D) in q's dtype."""
     B, H, Sq, D = q.shape
     KV = k.shape[1]
     q5 = q.reshape(B, KV, H // KV, Sq, D)
@@ -182,7 +184,8 @@ def grouped_attention(q, k, v, mask=None):
         "bkgqd,bksd->bkgqs", q5, k, preferred_element_type=jnp.float32
     ) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None, None], s, -1e30)
+        m = mask[:, None, None] if mask.ndim == 3 else mask[None, None, None]
+        s = jnp.where(m, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum(
         "bkgqs,bksd->bkgqd", p, v.astype(jnp.float32),

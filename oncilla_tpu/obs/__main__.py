@@ -160,6 +160,9 @@ def _serving_rows(rank: int, st: dict) -> list[list[str]]:
         toks = eng.get("tokens", {})
         tp = eng.get("tier_pages", {})
         pref = eng.get("prefix", {})
+        batch = eng.get("batch") or {}
+        steps = batch.get("steps", 0)
+        mean = batch.get("size_sum", 0) / steps if steps else 0.0
         out.append([
             eng.get("engine", "engine"),
             str(rank),
@@ -170,6 +173,8 @@ def _serving_rows(rank: int, st: dict) -> list[list[str]]:
              f"/{tp.get('remote', 0)}"),
             _fmt_bytes(pref.get("shared_bytes", 0)),
             f"{pref.get('hits', 0)}/{pref.get('cow', 0)}",
+            # mean fused-batch size / max (0/0 = interleaved engine)
+            f"{mean:.1f}/{batch.get('size_max', 0)}",
         ])
     return out
 
@@ -255,7 +260,7 @@ def _table(entries) -> int:
             print("  ".join(v.ljust(awidths[i]) for i, v in enumerate(r)))
     if serving_rows:
         scols = ["engine", "rank", "tok pf/dec", "kv_hit", "stall_ms",
-                 "pages h/w/c", "shared", "pfx hit/cow"]
+                 "pages h/w/c", "shared", "pfx hit/cow", "batch avg/max"]
         swidths = [
             max(len(c), *(len(r[i]) for r in serving_rows))
             for i, c in enumerate(scols)

@@ -187,6 +187,52 @@ def _serving_samples(doc: "_Doc", srv: dict, rank) -> None:
                        "KV page tier relocations by direction.",
                        moves.get(direction, 0), rank=rank, engine=name,
                        dir=direction)
+        batch = eng.get("batch")
+        if batch:
+            # size_hist/step_s_hist arrive already cumulative
+            # (serving/metrics.py counts every bucket >= the observation)
+            # so they render directly as prom histograms.
+            steps = batch.get("steps", 0)
+            fam = "ocm_serving_batch_size"
+            help_ = ("Sessions fused per batched decode step "
+                     "(cumulative histogram; _count = fused steps).")
+            for le, n in sorted(batch.get("size_hist", {}).items()):
+                doc.sample(fam, "histogram", help_, n,
+                           name=fam + "_bucket", rank=rank, engine=name,
+                           le=_num(le))
+            doc.sample(fam, "histogram", help_, steps,
+                       name=fam + "_bucket", rank=rank, engine=name,
+                       le="+Inf")
+            doc.sample(fam, "histogram", help_,
+                       batch.get("size_sum", 0), name=fam + "_sum",
+                       rank=rank, engine=name)
+            doc.sample(fam, "histogram", help_, steps,
+                       name=fam + "_count", rank=rank, engine=name)
+            fam = "ocm_serving_step_seconds"
+            help_ = ("Wall time of one fused batched decode step "
+                     "(cumulative histogram).")
+            for le, n in sorted(batch.get("step_s_hist", {}).items()):
+                doc.sample(fam, "histogram", help_, n,
+                           name=fam + "_bucket", rank=rank, engine=name,
+                           le=_num(le))
+            doc.sample(fam, "histogram", help_, steps,
+                       name=fam + "_bucket", rank=rank, engine=name,
+                       le="+Inf")
+            doc.sample(fam, "histogram", help_, batch.get("step_s", 0.0),
+                       name=fam + "_sum", rank=rank, engine=name)
+            doc.sample(fam, "histogram", help_, steps,
+                       name=fam + "_count", rank=rank, engine=name)
+            doc.sample("ocm_serving_prefill_chunks_total", "counter",
+                       "Page-sized chunked-prefill slices dispatched "
+                       "between batched decode steps.",
+                       batch.get("prefill_chunks", 0), rank=rank,
+                       engine=name)
+        for reason, n in sorted(eng.get("preempts", {}).items()):
+            doc.sample("ocm_serving_preempts_total", "counter",
+                       "Batch-slot preemptions by reason (slot = lost "
+                       "priority contention; cold_page = yielded while "
+                       "pages prefetch).",
+                       n, rank=rank, engine=name, reason=reason)
 
 
 def render_serving(srv: dict, rank: int = 0) -> str:

@@ -41,7 +41,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from oncilla_tpu.core.hbm import from_bytes, to_bytes
+from oncilla_tpu.models import (
+    paged_decode_batch_step_jit,
+    paged_decode_page_jit,
+    paged_decode_step_jit,
+)
 from oncilla_tpu.obs import journal as obs_journal
+from oncilla_tpu.qos.policy import PRIO_NORMAL
 from oncilla_tpu.serving import metrics as serving_metrics
 from oncilla_tpu.serving.metrics import ServingStats
 from oncilla_tpu.serving.prefix import PrefixCache, SharedExtent
@@ -49,13 +55,22 @@ from oncilla_tpu.serving.tiers import Page, Tier, TieredPageStore
 from oncilla_tpu.utils.debug import printd
 
 
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (shape-bucket policy: padded batch /
+    page-table dims snap up so XLA compiles O(log) programs)."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
 @dataclass
 class Request:
-    """One tenant's generation request (greedy decode: deterministic)."""
+    """One tenant's generation request (greedy decode: deterministic).
+    ``priority`` is a PR-6 QoS class (PRIO_LOW/NORMAL/HIGH): the batched
+    scheduler admits and seats higher classes first under contention."""
 
     tenant: str
     tokens: list[int]
     max_new_tokens: int = 16
+    priority: int = PRIO_NORMAL
 
 
 @dataclass
@@ -153,6 +168,17 @@ class Prefetcher:
         """The pending future for ``page_id`` (consumed), or None."""
         return self._futures.pop(page_id, None)
 
+    def pending(self, page_id: int) -> bool:
+        """True while a submitted fetch for ``page_id`` has not landed —
+        the batched scheduler's yield-on-cold probe (a session whose
+        fetches are still in flight gives up its slot instead of making
+        the whole batch wait)."""
+        fut = self._futures.get(page_id)
+        if fut is None:
+            return False
+        done = getattr(fut, "done", None)
+        return not done() if done is not None else False
+
     def recycle(self, buf: np.ndarray) -> None:
         if len(self._bufs) < max(self.workers, 2):
             self._bufs.append(buf)
@@ -205,6 +231,7 @@ class _Session:
         self.prefix_tokens_reused = 0
         self.stall_s = 0.0
         self.done = False
+        self.priority = int(getattr(req, "priority", PRIO_NORMAL))
         self._tail_shape = (cfg.n_layers, 1, cfg.n_kv_heads, page_tokens,
                             cfg.head_dim)
         self._tail_dt = jnp.dtype(dtype)
@@ -239,6 +266,8 @@ class ServingEngine:
         name: str = "engine",
         share_partials: bool = True,
         step_budget_ms: int | None = None,
+        batched: bool | None = None,
+        max_batch: int | None = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -266,6 +295,26 @@ class ServingEngine:
             )
         self.step_budget_ms = max(0, int(step_budget_ms))
         self._step_budget = None
+        # True-batched decode (default): every runnable session advances
+        # one token per tick in ONE fused paged_decode_batch_step_jit
+        # dispatch. OCM_SERVING_BATCH=0 keeps the session-interleaved
+        # batch-of-1 loop (the paired byte-exact gate's reference).
+        if batched is None:
+            batched = os.environ.get("OCM_SERVING_BATCH", "1") != "0"
+        self.batched = bool(batched)
+        if max_batch is None:
+            max_batch = int(os.environ.get("OCM_SERVING_MAX_BATCH", "8"))
+        self.max_batch = max(1, int(max_batch))
+        # Per-tick page-pool stacking cache: (key, pool_k, pool_v) —
+        # rebuilt only when the resident page set changes (page
+        # boundaries), not every token.
+        self._pool_cache: tuple = (None, None, None)
+        # Steady-state fused-step fast path: the kernel's stacked tail
+        # outputs feed the next step directly while batch membership is
+        # unchanged; per-session slices materialize lazily (ship /
+        # publish / membership change). See _batch_step.
+        self._tail_stack: tuple | None = None
+        self._tab_cache: tuple = (None, None)
         self.queue: list[Request] = []
         self.active: list[_Session] = []
         self.results: list[SessionResult] = []
@@ -296,7 +345,11 @@ class ServingEngine:
 
     def run(self, turn_tokens: int | None = None) -> list[SessionResult]:
         """Drive to completion: admit, interleave page-granular turns
-        with prefetch-on-schedule, collect results."""
+        with prefetch-on-schedule, collect results. With ``batched``
+        the loop is tick-based instead (:meth:`_run_batched`): one fused
+        jit step per tick over every admitted session."""
+        if self.batched:
+            return self._run_batched()
         turn = turn_tokens or self.page_tokens
         while self.queue or self.active:
             while self.queue and len(self.active) < self.max_active:
@@ -537,8 +590,6 @@ class ServingEngine:
     # -- decode -----------------------------------------------------------
 
     def _turn(self, sess: _Session, budget: int) -> None:
-        from oncilla_tpu.models import paged_decode_step_jit
-
         self._match_more(sess)
         self._ensure_resident(sess)
         k_ctx, v_ctx = self._context(sess)
@@ -580,6 +631,323 @@ class ServingEngine:
             if len(sess.out) == sess.req.max_new_tokens:
                 sess.done = True
                 return
+
+    # -- batched decode ----------------------------------------------------
+
+    def _run_batched(self) -> list[SessionResult]:
+        """Tick-driven continuous batching: per tick — priority-ordered
+        admission, one chunked-prefill slice per bulk-prefilling
+        session, then ONE fused :func:`paged_decode_batch_step_jit`
+        dispatch advancing every seated session by one token."""
+        while self.queue or self.active:
+            self._tick()
+        done, self.results = self.results, []
+        return done
+
+    def _tick(self) -> None:
+        # Admission is priority-aware: PRIO_HIGH requests seat first
+        # when the queue outruns max_active (stable within a class, so
+        # equal-priority arrival order is preserved).
+        if self.queue and len(self.active) < self.max_active:
+            self.queue.sort(
+                key=lambda r: -getattr(r, "priority", PRIO_NORMAL)
+            )
+            while self.queue and len(self.active) < self.max_active:
+                self.active.append(self._admit(self.queue.pop(0)))
+        if self.step_budget_ms:
+            from oncilla_tpu.resilience import timebudget
+
+            self._step_budget = timebudget.Budget.from_ms(
+                self.step_budget_ms
+            )
+        prefetch_on = self.prefetcher.mode != "off"
+        for sess in self.active:
+            self._match_more(sess)
+            if prefetch_on:
+                self._prefetch_for(sess)
+        # Chunked prefill: a long prompt admits one page-sized slice per
+        # tick (one paged_decode_page_jit dispatch) instead of streaming
+        # its tokens through the shared batch — the batch never stalls
+        # behind a prompt, and the slice is bitwise the token-wise path.
+        chunked = False
+        for sess in self.active:
+            if not self._bulk_prefill(sess):
+                continue
+            # Re-probe the prefix cache first: a session earlier in this
+            # same tick may have shipped (and registered) exactly the
+            # page this one is about to compute — matching here is what
+            # lets identical prompts converge on shared pages (and CoW
+            # partial adoption) instead of prefilling in lockstep.
+            self._match_more(sess)
+            if self._bulk_prefill(sess):
+                self._prefill_chunk(sess)
+                chunked = True
+        batch = self._select_batch(allow_force=not chunked)
+        if batch:
+            self._batch_step(batch)
+        for sess in self.active:
+            if sess.done:
+                self._finish(sess)
+        self.active = [s for s in self.active if not s.done]
+
+    def _bulk_prefill(self, sess: _Session) -> bool:
+        """True while >= one whole page of prompt remains and the tail is
+        page-aligned — the state chunked prefill consumes."""
+        return (not sess.done and sess.tail_len == 0
+                and len(sess.prompt) - sess.prompt_consumed
+                >= self.page_tokens)
+
+    def _prefill_chunk(self, sess: _Session) -> None:
+        """Teacher-force one full page of prompt in one fused dispatch,
+        ship it, and emit the seed token when the prompt completes."""
+        P = self.page_tokens
+        self._ensure_resident(sess)
+        k_ctx, v_ctx = self._context(sess)
+        pc = sess.prompt_consumed
+        chunk = sess.prompt[pc:pc + P]
+        meta = jnp.asarray([sess.pos, 0], jnp.int32)
+        logits, sess.tail_k, sess.tail_v = paged_decode_page_jit(
+            self.params, jnp.asarray([chunk], jnp.int32), meta,
+            k_ctx, v_ctx, sess.tail_k, sess.tail_v, self.cfg,
+        )
+        sess.pos += P
+        sess.tail_len = P
+        sess.page_toks = list(chunk)
+        sess.prompt_consumed += P
+        self.stats.note_tokens(P, phase="prefill")
+        self.stats.note_prefill_chunk()
+        obs_journal.record("prefill_chunk", tenant=sess.req.tenant,
+                           tokens=P, pos=sess.pos)
+        if sess.prompt_consumed == len(sess.prompt):
+            sess.out.append(int(jnp.argmax(logits[0, -1])))
+            if len(sess.out) == sess.req.max_new_tokens:
+                sess.done = True
+        self._ship(sess)
+        self._match_more(sess)
+
+    def _yields_cold(self, sess: _Session) -> bool:
+        """True when a seat should be given up this tick: some context
+        page is off the hot tier with its prefetch still in flight."""
+        if self.prefetcher.mode == "off":
+            return False  # nothing is ever in flight: faults are sync
+        for e in sess.entries:
+            if (not e.pending_fill and not self._resident(e)
+                    and e.page.tier != Tier.HOT
+                    and self.prefetcher.pending(e.page.page_id)):
+                return True
+        return False
+
+    def _select_batch(self, allow_force: bool) -> list[_Session]:
+        """Admission-aware seating for one fused step: cold sessions
+        yield (their prefetch finishes off-batch), the rest seat in
+        priority order up to ``max_batch``; losers of either contention
+        are counted as preempts. ``allow_force`` guarantees progress —
+        when nothing else ran this tick the best yielded session is
+        seated anyway and takes its fault synchronously."""
+        runnable = [s for s in self.active
+                    if not s.done and not self._bulk_prefill(s)]
+        ready, yielded = [], []
+        for sess in runnable:
+            if self._yields_cold(sess):
+                yielded.append(sess)
+                self.stats.note_preempt("cold_page")
+            else:
+                ready.append(sess)
+        if not ready and yielded and allow_force:
+            yielded.sort(key=lambda s: -s.priority)
+            ready = [yielded[0]]
+        ready.sort(key=lambda s: -s.priority)
+        for sess in ready[self.max_batch:]:
+            self.stats.note_preempt("slot")
+        return ready[:self.max_batch]
+
+    def _ensure_resident_batch(self, batch: list[_Session]) -> None:
+        """Residency for one fused tick: every miss's bytes are obtained
+        first, then all promotions install under ONE watermark sweep
+        (:meth:`TieredPageStore.promote_many`) — B sessions' faults
+        cannot thrash each other's freshly promoted pages mid-build."""
+        items, installs = [], []
+        seen: dict[int, tuple] = {}
+        for sess in batch:
+            for e in sess.entries:
+                if e.pending_fill:
+                    continue
+                hot = e.page.tier == Tier.HOT
+                self.stats.note_lookup(hot)
+                if self._resident(e):
+                    self.store.touch(e.page)
+                    continue
+                if hot:
+                    data = np.array(self.store.read_page(e.page),
+                                    copy=True)
+                    e.arrays = self._unpack(data)
+                    e.version = e.page.version
+                    continue
+                pid = e.page.page_id
+                if pid not in seen:
+                    got = self._obtain(sess, e.page)
+                    seen[pid] = got
+                    items.append((e.page, got[0], got[1]))
+                installs.append((e, seen[pid]))
+        if items:
+            self.store.promote_many(items)
+        for e, got in installs:
+            e.arrays = self._unpack(got[0])
+            e.version = e.page.version
+        for got in seen.values():
+            if got[2] is not None:
+                self.prefetcher.recycle(got[2])
+
+    def _batch_pool(self, batch: list[_Session]):
+        """The tick's page pool + per-session block table: every distinct
+        resident page stacked ONCE as a (N_pad, L, KV, P, Hd) pool (a
+        shared prefix page is one row however many sessions reference
+        it), table[b] listing session b's rows. N/MP snap to power-of-
+        two buckets; the stacked pool is cached across ticks on the
+        (page_id, version) set, so steady-state decode restacks nothing
+        until a page boundary."""
+        index: dict[tuple, int] = {}
+        rows = []
+        tables = []
+        for sess in batch:
+            trow = []
+            for e in sess.entries:
+                if e.pending_fill:
+                    continue
+                key = (e.page.page_id, e.version)
+                if key not in index:
+                    index[key] = len(rows)
+                    rows.append(e.arrays)
+                trow.append(index[key])
+            tables.append(trow)
+        max_pages = max((len(t) for t in tables), default=0)
+        mp = _pow2(max_pages) if max_pages else 0
+        n_pad = _pow2(len(rows)) if rows else 1
+        cache_key = (tuple(index), n_pad)
+        if self._pool_cache[0] == cache_key:
+            pool_k, pool_v = self._pool_cache[1], self._pool_cache[2]
+        else:
+            cfg = self.cfg
+            zrow = jnp.zeros(
+                (cfg.n_layers, cfg.n_kv_heads, self.page_tokens,
+                 cfg.head_dim), jnp.dtype(cfg.dtype))
+            krows = [a[0][:, 0] for a in rows]
+            vrows = [a[1][:, 0] for a in rows]
+            pad = n_pad - len(rows)
+            pool_k = jnp.stack(krows + [zrow] * pad)
+            pool_v = jnp.stack(vrows + [zrow] * pad)
+            self._pool_cache = (cache_key, pool_k, pool_v)
+        table = np.zeros((len(batch), mp), np.int32)
+        for b, trow in enumerate(tables):
+            table[b, :len(trow)] = trow
+        return pool_k, pool_v, table, tables
+
+    def _batch_step(self, batch: list[_Session]) -> None:
+        """ONE fused jit dispatch advancing every seated session by one
+        token, then per-session scatter of logits/tails/bookkeeping —
+        bitwise the interleaved per-session step."""
+        t0 = time.perf_counter()
+        self._ensure_resident_batch(batch)
+        P = self.page_tokens
+        cfg = self.cfg
+        pool_k, pool_v, table, tables = self._batch_pool(batch)
+        b_pad = _pow2(len(batch))
+        toks, metas, prefills = [], [], []
+        for sess, trow in zip(batch, tables):
+            if sess.prompt_consumed < len(sess.prompt):
+                tok = sess.prompt[sess.prompt_consumed]
+                sess.prompt_consumed += 1
+                prefill = True
+                self.stats.note_tokens(1, phase="prefill")
+            else:
+                tok = sess.out[-1] if sess.out else sess.prompt[-1]
+                prefill = False
+            toks.append(tok)
+            prefills.append(prefill)
+            metas.append([sess.pos, sess.tail_len, len(trow) * P, 0])
+        pad_b = b_pad - len(batch)
+        toks += [0] * pad_b
+        metas += [[0, 0, 0, 0]] * pad_b
+        st = self._tail_stack
+        if (st is not None and st[0] == batch
+                and all(s.tail_k is None for s in batch)):
+            # Same seated sessions as last step and nobody shipped: the
+            # previous step's stacked tails ARE this step's inputs —
+            # no per-session slices, no concat (they get donated).
+            tail_k, tail_v = st[1], st[2]
+            self._tail_stack = None
+        else:
+            self._flush_tail_stack()
+            tshape = (cfg.n_layers, 1, cfg.n_kv_heads, P, cfg.head_dim)
+            ztail = jnp.zeros(tshape, jnp.dtype(cfg.dtype))
+            tail_k = jnp.concatenate(
+                [s.tail_k for s in batch] + [ztail] * pad_b, axis=1)
+            tail_v = jnp.concatenate(
+                [s.tail_v for s in batch] + [ztail] * pad_b, axis=1)
+        tab = np.zeros((b_pad, table.shape[1]), np.int32)
+        tab[:len(batch)] = table
+        tab_key = (tab.shape, tab.tobytes())
+        if self._tab_cache[0] != tab_key:
+            self._tab_cache = (tab_key, jnp.asarray(tab))
+        logits, ntk, ntv = paged_decode_batch_step_jit(
+            self.params, jnp.asarray(toks, jnp.int32),
+            jnp.asarray(metas, jnp.int32), pool_k, pool_v,
+            self._tab_cache[1], tail_k, tail_v, cfg,
+        )
+        # One fused greedy argmax + host transfer for the whole batch
+        # (row b is bitwise jnp.argmax(logits[b]) — same bits, same
+        # first-max tie-break); doubles as the step's device sync.
+        best = np.asarray(jnp.argmax(logits, axis=-1))
+        dt = time.perf_counter() - t0
+        self.stats.note_batch_step(len(batch), dt)
+        obs_journal.record(
+            "batch_step", size=len(batch), pad=b_pad,
+            pages=int(tab.shape[1]), ms=round(dt * 1e3, 3),
+        )
+        self._tail_stack = (list(batch), ntk, ntv)
+        for b, (sess, tok, prefill) in enumerate(
+                zip(batch, toks, prefills)):
+            # Tails stay stacked (see _tail_stack); a session only pays
+            # for its two slices when something reads them this tick.
+            sess.tail_k = None
+            sess.tail_v = None
+            sess.pos += 1
+            sess.tail_len += 1
+            sess.page_toks.append(int(tok))
+            emit = (not prefill
+                    or sess.prompt_consumed == len(sess.prompt))
+            if emit:
+                sess.out.append(int(best[b]))
+                if not prefill:
+                    self.stats.note_tokens(1)
+            if sess.tail_len == P:
+                sess.tail_k = ntk[:, b:b + 1]
+                sess.tail_v = ntv[:, b:b + 1]
+                self._ship(sess)
+                self._match_more(sess)
+            elif (self.share_partials and prefill
+                  and sess.prompt_consumed == len(sess.prompt)):
+                sess.tail_k = ntk[:, b:b + 1]
+                sess.tail_v = ntv[:, b:b + 1]
+                self._publish_partial(sess)
+            if len(sess.out) > sess.req.max_new_tokens:
+                raise AssertionError("overran max_new_tokens")
+            if len(sess.out) == sess.req.max_new_tokens:
+                sess.done = True
+
+    def _flush_tail_stack(self) -> None:
+        """Materialize the deferred per-session tail slices out of the
+        last fused step's stacked outputs (membership changed, or a
+        session needs its tail outside the steady state)."""
+        st = self._tail_stack
+        if st is None:
+            return
+        self._tail_stack = None
+        sessions, ntk, ntv = st
+        for b, sess in enumerate(sessions):
+            if sess.tail_k is None:
+                sess.tail_k = ntk[:, b:b + 1]
+                sess.tail_v = ntv[:, b:b + 1]
 
     def _ship(self, sess: _Session) -> None:
         """Page boundary: the full tail becomes a stored page — the

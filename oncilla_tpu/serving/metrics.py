@@ -60,6 +60,22 @@ class ServingStats:
         # Cold-tier (remote) data-plane traffic.
         self.remote_bytes_in = 0
         self.remote_bytes_out = 0
+        # True-batched decode: per-tick fused-step accounting. size_hist
+        # and step_s_hist are cumulative prom-style bucket counts
+        # (bucket upper bound -> observations <= bound) so obs/prom.py
+        # can render real histograms from a stdlib-only snapshot.
+        self.batch_steps = 0
+        self.batch_size_sum = 0
+        self.batch_size_last = 0
+        self.batch_size_max = 0
+        self.batch_size_hist = {b: 0 for b in self.BATCH_BUCKETS}
+        self.step_s_sum = 0.0
+        self.step_s_hist = {b: 0 for b in self.STEP_BUCKETS}
+        self.prefill_chunks = 0
+        self.preempts: dict[str, int] = {}
+
+    BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
+    STEP_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5)
 
     # -- mutation ---------------------------------------------------------
 
@@ -119,6 +135,33 @@ class ServingStats:
             else:
                 self.remote_bytes_out += nbytes
 
+    def note_batch_step(self, size: int, seconds: float) -> None:
+        """One fused batched decode tick: ``size`` sessions advanced one
+        token in one jit dispatch taking ``seconds``."""
+        with self._mu:
+            self.batch_steps += 1
+            self.batch_size_sum += size
+            self.batch_size_last = size
+            self.batch_size_max = max(self.batch_size_max, size)
+            self.step_s_sum += seconds
+            for b in self.BATCH_BUCKETS:
+                if size <= b:
+                    self.batch_size_hist[b] += 1
+            for b in self.STEP_BUCKETS:
+                if seconds <= b:
+                    self.step_s_hist[b] += 1
+
+    def note_preempt(self, reason: str) -> None:
+        """A session lost (or yielded) its batch slot this tick:
+        ``slot`` = lost priority-ordered slot contention, ``cold_page``
+        = yielded because its pages had not prefetched yet."""
+        with self._mu:
+            self.preempts[reason] = self.preempts.get(reason, 0) + 1
+
+    def note_prefill_chunk(self) -> None:
+        with self._mu:
+            self.prefill_chunks += 1
+
     def set_occupancy(self, tier_pages: dict[str, int],
                       tier_bytes: dict[str, int]) -> None:
         with self._mu:
@@ -166,6 +209,17 @@ class ServingStats:
                     "in": self.remote_bytes_in,
                     "out": self.remote_bytes_out,
                 },
+                "batch": {
+                    "steps": self.batch_steps,
+                    "size_sum": self.batch_size_sum,
+                    "size_last": self.batch_size_last,
+                    "size_max": self.batch_size_max,
+                    "size_hist": dict(self.batch_size_hist),
+                    "step_s": round(self.step_s_sum, 6),
+                    "step_s_hist": dict(self.step_s_hist),
+                    "prefill_chunks": self.prefill_chunks,
+                },
+                "preempts": dict(self.preempts),
             }
 
 

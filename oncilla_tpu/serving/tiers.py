@@ -365,6 +365,27 @@ class TieredPageStore:
         self.touch(page)
         self.enforce_watermarks()
 
+    def promote_many(self, items, to: Tier = Tier.HOT) -> None:
+        """Batched promotion for one fused decode tick: ``items`` is an
+        iterable of ``(page, data, version)`` (data/version as in
+        :meth:`promote`, None for a plain fault). Room is made and pages
+        move one at a time (the single-writer discipline is unchanged),
+        but the watermark sweep runs ONCE at the end instead of once per
+        page — a B-session batch build does O(1) sweeps, not O(B)."""
+        moved = False
+        for page, data, version in items:
+            self._check_live(page)
+            if version is not None and version != page.version:
+                data = None
+            if _ORDER.index(to) >= _ORDER.index(page.tier):
+                continue
+            self._make_room(to)
+            self._move(page, to, data=data)
+            self.touch(page)
+            moved = True
+        if moved:
+            self.enforce_watermarks()
+
     def demote(self, page: Page, to: Tier) -> None:
         self._check_live(page)
         if _ORDER.index(to) <= _ORDER.index(page.tier):

@@ -147,11 +147,13 @@ echo "== serving smoke =="
 # Flagship serving workload (serving/): paired shared-vs-noshare decode
 # cells over a 3-daemon cluster (outputs must be byte-identical, sharing
 # must show prefix hits + a CoW adoption + strictly fewer remote bytes),
-# the AsyncOcm prefetch leg under OCM_MUX, and the chaos leg — kill the
-# cold-page owner mid-decode with OCM_REPLICAS=2, decode byte-exact
-# through failover, twice with identical interleavings, wrapped in the
-# flight-recorder invariant audit; alloctrace ledger drained on every
-# surviving rank. CPU-only.
+# the batched-vs-interleaved leg (one fused jit step per tick + chunked
+# prefill: outputs byte-identical to the interleaved engine, fused
+# batches actually formed), the AsyncOcm prefetch leg under OCM_MUX,
+# and the chaos leg — kill the cold-page owner mid-decode with
+# OCM_REPLICAS=2, decode byte-exact through failover, twice with
+# identical interleavings, wrapped in the flight-recorder invariant
+# audit; alloctrace ledger drained on every surviving rank. CPU-only.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.serving --smoke || fail=1
 
 echo "== obs audit smoke =="

@@ -318,7 +318,9 @@ def test_serving_prom_families_validate(tiny_model, shared_prompts):
                 "ocm_kv_tier_bytes", "ocm_prefix_shared_bytes",
                 "ocm_prefix_hits_total", "ocm_prefix_cow_total",
                 "ocm_prefetch_stall_seconds_total",
-                "ocm_kv_page_moves_total"):
+                "ocm_kv_page_moves_total",
+                "ocm_serving_batch_size", "ocm_serving_step_seconds",
+                "ocm_serving_prefill_chunks_total"):
         assert fam in fams, fam
     # And through the daemon-side render() path (colocated meta).
     full = prom.render({"rank": 0, "serving": {"engines": [meta]}})
@@ -353,9 +355,11 @@ def test_obs_table_serving_rows():
     st.note_lookup(True)
     st.set_occupancy({"hbm": 1, "host": 2, "remote": 3},
                      {"hbm": PB, "host": 2 * PB, "remote": 3 * PB})
+    st.note_batch_step(3, 0.002)
+    st.note_batch_step(1, 0.001)
     rows = _serving_rows(1, {"serving": {"engines": [st.snapshot()]}})
     assert rows == [["rowtest", "1", "5/7", "100%", "0.0", "1/2/3",
-                     "0B", "0/0"]]
+                     "0B", "0/0", "2.0/3"]]
     assert _serving_rows(0, {}) == []
 
 
