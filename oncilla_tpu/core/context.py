@@ -472,6 +472,24 @@ class Ocm:
         backend = self._remote_or_raise("status")
         return backend.status(rank)
 
+    def fetch_prom(self, rank: int | None = None) -> str:
+        """A rank's Prometheus text exposition (STATUS_PROM), fetched
+        over the ordinary in-band control path."""
+        return self._remote_or_raise("fetch_prom").fetch_prom(rank)
+
+    def start_slo(self, interval_s: float | None = None):
+        """Arm the in-process SLO watcher (obs/slo.py) over this
+        context's control plane: background STATUS_PROM scrapes feed the
+        metrics history, the burn-rate engine evaluates the ``OCM_SLO``
+        objectives, and verdicts surface in ``status()["slo"]``.
+        Returns the runner, or None when ``OCM_SLO`` disables it."""
+        return self._remote_or_raise("start_slo").start_slo(interval_s)
+
+    def stop_slo(self) -> None:
+        backend = self._remote
+        if backend is not None:
+            backend.stop_slo()
+
     def export_trace(self, path: str, cluster: bool = True) -> dict:
         """Write a Perfetto/Chrome-trace JSON merging this process's
         event journal (``OCM_EVENTS=1``) with — when ``cluster`` and a

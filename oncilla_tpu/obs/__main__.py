@@ -21,7 +21,17 @@ no extra listener) and renders:
 - ``audit <dir>``: the post-mortem subcommand — merge the flight
   recorder's segments (``OCM_FLIGHTREC``) and run the cross-rank
   invariant checks of :mod:`~oncilla_tpu.obs.audit` over the timeline,
-  exiting nonzero on any finding.
+  exiting nonzero on any finding;
+- ``slo``: poll every rank's STATUS_PROM into the in-process metrics
+  history (:mod:`~oncilla_tpu.obs.scrape`) and print the burn-rate
+  verdict table of :mod:`~oncilla_tpu.obs.slo` (``--watch N`` for a
+  live view; ``--selftest`` runs the self-contained healthy-green +
+  seeded-burn CI fixture on an in-process cluster);
+- ``critpath <sources...>``: join spans from flight-recorder dirs /
+  ``.seg`` files / journal JSONL dumps into cross-rank op trees and
+  print per-phase critical-path latency attribution
+  (:mod:`~oncilla_tpu.obs.critpath`), with ``--min-attrib`` /
+  ``--require-cross-rank`` gates for CI.
 
 Membership comes from ``--nodefile`` or ``$OCM_NODEFILE`` (the same file
 the daemons were started with).
@@ -441,10 +451,282 @@ def _audit_cmd(argv: list[str]) -> int:
     return 1 if total else 0
 
 
+def _critpath_cmd(argv: list[str]) -> int:
+    """``python -m oncilla_tpu.obs critpath <sources...>`` — critical
+    -path latency attribution over merged spans, with the CI gates the
+    check.sh obs stage leans on."""
+    from oncilla_tpu.obs import critpath
+
+    ap = argparse.ArgumentParser(
+        prog="python -m oncilla_tpu.obs critpath",
+        description="critical-path latency attribution over merged "
+                    "journal spans",
+    )
+    ap.add_argument("sources", nargs="+",
+                    help="flight-recorder dir(s), .seg file(s) and/or "
+                         "journal JSONL dump(s)")
+    ap.add_argument("--top", type=int, default=3, metavar="N",
+                    help="print the N slowest trees' critical paths")
+    ap.add_argument("--min-attrib", type=float, default=0.0,
+                    metavar="FRAC", dest="min_attrib",
+                    help="exit nonzero unless >=1 qualifying tree "
+                         "attributes at least FRAC of its wall time to "
+                         "named phases")
+    ap.add_argument("--require-cross-rank", action="store_true",
+                    dest="cross_rank",
+                    help="only trees spanning >1 track qualify (and "
+                         ">=1 must exist)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable trees + phase table on stdout")
+    args = ap.parse_args(argv)
+    try:
+        events = critpath.load_events(args.sources)
+    except OSError as e:
+        print(f"critpath: {e}", file=sys.stderr)
+        return 2
+    trees = critpath.assemble(events)
+    if args.as_json:
+        json.dump({"trees": trees, "phases": critpath.phase_table(trees)},
+                  sys.stdout, indent=2, default=str)
+        print()
+    else:
+        sys.stdout.write(critpath.render_report(trees, top=args.top))
+    if not trees:
+        print("critpath: no op trees (need span events with trace ids)",
+              file=sys.stderr)
+        return 1
+    pool = ([t for t in trees if len(t["tracks"]) > 1]
+            if args.cross_rank else trees)
+    if not pool:
+        print("critpath: no cross-rank tree in the stream",
+              file=sys.stderr)
+        return 1
+    best = max(t["attributed_frac"] for t in pool)
+    if best < args.min_attrib:
+        print(f"critpath: best qualifying attribution {best * 100:.1f}% "
+              f"< required {args.min_attrib * 100:.1f}%", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _slo_table(result: dict, history_meta: dict) -> None:
+    cols = ["objective", "kind", "prio", "target", "ok", "active",
+            "burn_fast", "burn_slow", "err_fast", "n_fast"]
+    rows = []
+    for v in result["objectives"]:
+        rows.append([
+            v["objective"], v["kind"], v["priority"] or "-",
+            f"{v['target']:g}",
+            "ok" if v["ok"] else "BURN",
+            "yes" if v["active"] else "idle",
+            f"{v['burn_fast']:.2f}", f"{v['burn_slow']:.2f}",
+            f"{v['error_fast']:.4f}", f"{v['n_fast']:.0f}",
+        ])
+    widths = [
+        max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+        for i, c in enumerate(cols)
+    ]
+    print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
+    for r in rows:
+        print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    burning = [v["objective"] for v in result["objectives"] if not v["ok"]]
+    verdict = ("OK" if not burning
+               else "BURNING: " + ",".join(burning))
+    print(f"slo: {verdict}  (windows {result['fast_s']:g}s/"
+          f"{result['slow_s']:g}s, threshold {result['burn_threshold']:g}x, "
+          f"{history_meta.get('series', 0)} series over "
+          f"{history_meta.get('scrapes', 0)} scrape(s), "
+          f"{history_meta.get('errors', 0)} fetch error(s))")
+
+
+def _slo_selftest() -> int:
+    """Self-contained SLO proof on an in-process cluster, the check.sh
+    obs stage: a healthy put/get run must evaluate green with >=1 active
+    objective and a validating ``ocm_slo_*`` exposition, then a seeded
+    slow handler (``handler_delay_s`` — inside the serve span, so the
+    latency histograms see it) must trip the burn-rate alert and leave
+    an ``slo_burn`` journal event."""
+    import numpy as np
+
+    from oncilla_tpu.core.kinds import OcmKind
+    from oncilla_tpu.obs import journal
+    from oncilla_tpu.obs import prom as obs_prom
+    from oncilla_tpu.obs import slo as obs_slo
+    from oncilla_tpu.runtime.cluster import local_cluster
+    from oncilla_tpu.runtime.protocol import MsgType
+    from oncilla_tpu.utils.config import OcmConfig
+
+    was_journaling = journal.enabled()
+    journal.set_enabled(True)
+    cfg = OcmConfig(
+        host_arena_bytes=8 << 20, device_arena_bytes=1 << 20,
+        chunk_bytes=256 << 10, heartbeat_s=5.0,
+    )
+    try:
+        with local_cluster(2, config=cfg) as c:
+            ctx = c.context(0, heartbeat=False)
+            # Budget 0.2 s: latency_high's bound is 0.1 s, so the seeded
+            # 0.15 s handler delay breaches exactly that objective while
+            # the healthy sub-millisecond ops stay far inside every one.
+            runner = obs_slo.SloRunner(
+                ctx.fetch_prom, range(2),
+                objectives=obs_slo.default_objectives(budget_s=0.2),
+                interval_s=60.0, fast_s=8.0, slow_s=16.0,
+            )
+            data = np.arange(64 << 10, dtype=np.uint8)
+
+            def burst(n: int) -> None:
+                for _ in range(n):
+                    h = ctx.alloc(len(data), OcmKind.REMOTE_HOST)
+                    try:
+                        ctx.put(h, data)
+                        np.asarray(ctx.get(h))
+                    finally:
+                        ctx.free(h)
+
+            burst(6)
+            runner.tick()
+            time.sleep(0.2)
+            burst(6)
+            healthy = runner.tick()
+            fams = obs_prom.validate(runner.engine.render_prom(0))
+            n_active = sum(
+                1 for v in healthy["objectives"] if v["active"]
+            )
+            healthy_ok = (
+                healthy["ok"] and n_active >= 1 and "ocm_slo_ok" in fams
+                and "ocm_slo_burn_rate" in fams
+            )
+            print(f"slo selftest healthy: ok={healthy['ok']} "
+                  f"active={n_active}/{len(healthy['objectives'])} "
+                  f"ocm_slo families={len(fams)}")
+            _slo_table(healthy, runner.history.meta())
+            for d in c.daemons:
+                d.handler_delay_types = frozenset(
+                    {MsgType.DATA_PUT, MsgType.DATA_GET}
+                )
+                d.handler_delay_s = 0.15
+            try:
+                burst(4)
+            finally:
+                for d in c.daemons:
+                    d.handler_delay_s = 0.0
+                    d.handler_delay_types = frozenset()
+            time.sleep(0.2)
+            burning = runner.tick()
+            tripped = [
+                v["objective"] for v in burning["objectives"]
+                if not v["ok"]
+            ]
+            burn_events = [
+                e for e in journal.events() if e.get("ev") == "slo_burn"
+            ]
+            print()
+            print(f"slo selftest seeded burn: tripped={tripped or '-'} "
+                  f"slo_burn events={len(burn_events)}")
+            _slo_table(burning, runner.history.meta())
+            burn_ok = (
+                not burning["ok"]
+                and "latency_high" in tripped
+                and burn_events
+            )
+    finally:
+        journal.set_enabled(was_journaling)
+    ok = bool(healthy_ok and burn_ok)
+    print(f"slo selftest: {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+def _slo_cmd(argv: list[str]) -> int:
+    """``python -m oncilla_tpu.obs slo`` — evaluate the OCM_SLO
+    objectives against live ranks (two STATUS_PROM sweeps feed the
+    windowed history) and print the verdict table."""
+    ap = argparse.ArgumentParser(
+        prog="python -m oncilla_tpu.obs slo",
+        description="SLO burn-rate verdicts over in-band STATUS_PROM "
+                    "scrapes",
+    )
+    ap.add_argument("--nodefile", default=None,
+                    help="membership nodefile (default: $OCM_NODEFILE)")
+    ap.add_argument("--interval", type=float, default=1.0, metavar="S",
+                    help="spacing between the two one-shot scrapes "
+                         "(and the --watch redraw period)")
+    ap.add_argument("--watch", action="store_true",
+                    help="keep scraping and redraw the table until "
+                         "Ctrl-C")
+    ap.add_argument("--watch-count", type=int, default=0, metavar="K",
+                    help="with --watch: stop after K redraws")
+    ap.add_argument("--prom", action="store_true", dest="as_prom",
+                    help="print the ocm_slo_* exposition instead of "
+                         "the table")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable verdict on stdout")
+    ap.add_argument("--selftest", action="store_true",
+                    help="self-contained healthy + seeded-burn fixture "
+                         "on an in-process cluster (ignores --nodefile)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _slo_selftest()
+
+    from oncilla_tpu.obs import slo as obs_slo
+    from oncilla_tpu.runtime.membership import parse_nodefile
+    from oncilla_tpu.runtime.protocol import Message, MsgType
+
+    nodefile = args.nodefile or os.environ.get("OCM_NODEFILE")
+    if not nodefile:
+        ap.error("--nodefile (or $OCM_NODEFILE) is required")
+    entries = parse_nodefile(nodefile)
+
+    def fetch(rank: int) -> str:
+        r = _rank_request(entries[rank], Message(MsgType.STATUS_PROM, {}))
+        return bytes(r.data).decode("utf-8")
+
+    runner = obs_slo.SloRunner.from_env(fetch, range(len(entries)))
+    if runner is None:
+        print(f"slo: disabled ({obs_slo.ENV_SLO}="
+              f"{os.environ.get(obs_slo.ENV_SLO)!r})", file=sys.stderr)
+        return 2
+    interval = max(args.interval, 0.1)
+    runner.tick()
+    drawn = 0
+    rc = 0
+    try:
+        while True:
+            time.sleep(interval)
+            result = runner.tick()
+            if args.watch and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            if args.as_prom:
+                sys.stdout.write(runner.engine.render_prom(0))
+            elif args.as_json:
+                json.dump(runner.meta(), sys.stdout, indent=2,
+                          default=str)
+                print()
+            else:
+                if args.watch:
+                    print(f"every {interval:g}s  "
+                          f"{time.strftime('%H:%M:%S')}  (Ctrl-C to exit)")
+                _slo_table(result, runner.history.meta())
+            rc = 0 if result["ok"] else 1
+            drawn += 1
+            if not args.watch:
+                return rc
+            if args.watch_count and drawn >= args.watch_count:
+                return rc
+    except KeyboardInterrupt:
+        print()
+        return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "audit":
         return _audit_cmd(argv[1:])
+    if argv and argv[0] == "critpath":
+        return _critpath_cmd(argv[1:])
+    if argv and argv[0] == "slo":
+        return _slo_cmd(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m oncilla_tpu.obs",
         description="oncilla-tpu cluster observability",

@@ -84,9 +84,22 @@ def chrome_trace(events: list[dict]) -> dict:
 
     # Spans grouped per trace for the cross-track flow pass.
     by_trace: dict[int, list[tuple[float, int, int, str]]] = {}
+    # Lifecycle instants for the hedge/cancel stitching pass:
+    # key -> [(ts_us, pid, tid, ev_name)] in merge order.
+    hedges: dict[object, list[tuple[float, int, int, str]]] = {}
+    cancels: dict[object, list[tuple[float, int, int, str]]] = {}
     for e in events:
         p = pid_of(e)
         t = tid_of(e, p)
+        ev = e.get("ev")
+        if ev in ("hedge_fired", "hedge_won", "hedge_lost"):
+            hedges.setdefault(e.get("alloc_id"), []).append(
+                (float(e.get("ts", 0.0)) * 1e6, p, t, str(ev))
+            )
+        elif ev in ("cancel_sent", "cancel_ack"):
+            cancels.setdefault(e.get("tag"), []).append(
+                (float(e.get("ts", 0.0)) * 1e6, p, t, str(ev))
+            )
         if e.get("ev") == "span":
             ts_us = float(e.get("t_wall") or e.get("ts", 0.0)) * 1e6
             dur_us = float(e.get("dur_us", 0.0))
@@ -134,17 +147,61 @@ def chrome_trace(events: list[dict]) -> dict:
             if ph == "f":
                 ev["bp"] = "e"  # bind to the enclosing slice
             out.append(ev)
+
+    # Lifecycle stitching: hedged reads and cancels used to render as
+    # unconnected instants, leaving the reader to eyeball which
+    # hedge_won answered which hedge_fired. Pair each opener with the
+    # NEAREST SUBSEQUENT closer sharing its key (alloc_id for hedges,
+    # tag for cancels) and draw a dedicated flow arrow per pair.
+    def stitch(groups: dict, openers: tuple, prefix: str) -> None:
+        n = 0
+        for key, evts in sorted(groups.items(), key=lambda kv: str(kv[0])):
+            evts.sort(key=lambda r: r[0])
+            pending: list[tuple[float, int, int, str]] = []
+            for rec in evts:
+                if rec[3] in openers:
+                    pending.append(rec)
+                elif pending:
+                    src = pending.pop(0)
+                    fid = f"{prefix}-{key}-{n}"
+                    n += 1
+                    out.append({
+                        "name": prefix, "cat": "ocm.lifecycle", "ph": "s",
+                        "id": fid, "ts": src[0] + 0.001,
+                        "pid": src[1], "tid": src[2],
+                    })
+                    out.append({
+                        "name": prefix, "cat": "ocm.lifecycle", "ph": "f",
+                        "bp": "e", "id": fid, "ts": rec[0] + 0.001,
+                        "pid": rec[1], "tid": rec[2],
+                    })
+
+    stitch(hedges, ("hedge_fired",), "hedge")
+    stitch(cancels, ("cancel_sent",), "cancel")
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def cross_track_flows(trace: dict) -> int:
     """How many distinct flow ids the trace stitches across >1 pid —
-    the smoke test's "did client and daemon actually connect" figure."""
+    the smoke test's "did client and daemon actually connect" figure.
+    Lifecycle pairs (hedge/cancel, usually same-process) are counted by
+    :func:`lifecycle_flows` instead."""
     by_id: dict[str, set[int]] = {}
     for e in trace.get("traceEvents", []):
-        if e.get("ph") in ("s", "t", "f"):
+        if e.get("ph") in ("s", "t", "f") and e.get("cat") != "ocm.lifecycle":
             by_id.setdefault(str(e.get("id")), set()).add(int(e["pid"]))
     return sum(1 for pids in by_id.values() if len(pids) > 1)
+
+
+def lifecycle_flows(trace: dict) -> int:
+    """How many hedge/cancel lifecycle pairs the trace stitched (one
+    arrow = one opener matched to its closer)."""
+    ids = {
+        str(e.get("id"))
+        for e in trace.get("traceEvents", [])
+        if e.get("cat") == "ocm.lifecycle"
+    }
+    return len(ids)
 
 
 def write_chrome_trace(events: list[dict], path: str) -> dict:
@@ -162,4 +219,5 @@ def write_chrome_trace(events: list[dict], path: str) -> dict:
             if e.get("ph") == "M" and e.get("name") == "process_name"
         ),
         "flows": cross_track_flows(trace),
+        "lifecycle_flows": lifecycle_flows(trace),
     }

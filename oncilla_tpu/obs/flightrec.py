@@ -58,13 +58,21 @@ _MAX_FRAME = 16 << 20
 
 _lock = threading.Lock()
 _dir: str | None = os.environ.get(ENV_DIR) or None
-_seg_bytes = int(os.environ.get(ENV_SEG_BYTES, "") or (4 << 20))
+# Tolerant parse (watchdog.reload_threshold stance): a typo'd size knob
+# falls back to the default instead of crashing every obs importer.
+try:
+    _seg_bytes = int(os.environ.get(ENV_SEG_BYTES, "") or (4 << 20))
+except ValueError:
+    _seg_bytes = 4 << 20
 # 0 = unbounded. With a cap, this WRITER's oldest segment is deleted
 # once the cap is exceeded (a long soak used to grow the directory
 # without bound); other processes' segments are never touched — their
 # names embed a different jid, and deleting someone else's evidence
 # would be tampering, not rotation.
-_max_segs = int(os.environ.get(ENV_MAX_SEGS, "") or 0)
+try:
+    _max_segs = int(os.environ.get(ENV_MAX_SEGS, "") or 0)
+except ValueError:
+    _max_segs = 0
 _own_segs: list[str] = []  # this writer's segments, creation order
 _fh = None
 _fh_path: str | None = None

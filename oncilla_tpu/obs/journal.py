@@ -40,7 +40,12 @@ _ENABLED = (
     os.environ.get("OCM_EVENTS", "") not in ("", "0")
     or bool(os.environ.get(_flightrec.ENV_DIR))
 )
-_CAP = int(os.environ.get("OCM_EVENTS_CAP", "") or 8192)
+# Tolerant parse (same stance as watchdog.reload_threshold): a typo'd
+# knob must degrade to the default, not crash every importer of obs.
+try:
+    _CAP = int(os.environ.get("OCM_EVENTS_CAP", "") or 8192)
+except ValueError:
+    _CAP = 8192
 
 # Journal identity: exporters merging event streams from several sources
 # must drop duplicates when two sources turn out to be the SAME journal
@@ -88,6 +93,25 @@ def record(ev: str, *, force: bool = False, **fields) -> None:
     # Spill OUTSIDE the ring lock: the recorder has its own lock, and a
     # slow disk must never serialize hot-path record() callers.
     _flightrec.append(rec)
+
+
+def phase(name: str, dur_s: float, *, ctx=None, **fields) -> None:
+    """Record a named phase of an enclosing span's wall time (``ev=
+    "phase"``). Phases are the critical-path attributor's raw material:
+    each one says "``dur_s`` of the surrounding span went to ``name``".
+    ``ctx`` is an explicit :class:`obs.trace.TraceCtx` to bind to; when
+    omitted the ambient context is used, so a phase recorded inside a
+    tracer span lands on that span without plumbing."""
+    if not _ENABLED:
+        return
+    if ctx is None:
+        from oncilla_tpu.obs import trace as _trace
+
+        ctx = _trace.current()
+    if ctx is not None:
+        fields.setdefault("trace_id", ctx.trace_id)
+        fields.setdefault("span_id", ctx.span_id)
+    record("phase", phase=name, dur_us=round(dur_s * 1e6, 1), **fields)
 
 
 def set_cap(n: int) -> None:

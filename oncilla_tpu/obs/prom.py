@@ -227,6 +227,24 @@ def _serving_samples(doc: "_Doc", srv: dict, rank) -> None:
                        "between batched decode steps.",
                        batch.get("prefill_chunks", 0), rank=rank,
                        engine=name)
+        ttft = eng.get("ttft")
+        if ttft and ttft.get("count"):
+            # Cumulative-by-construction like the batch histograms.
+            n = ttft.get("count", 0)
+            fam = "ocm_serving_ttft_seconds"
+            help_ = ("Time from request submit to first emitted token "
+                     "(cumulative histogram).")
+            for le, cnt in sorted(ttft.get("hist", {}).items()):
+                doc.sample(fam, "histogram", help_, cnt,
+                           name=fam + "_bucket", rank=rank, engine=name,
+                           le=_num(le))
+            doc.sample(fam, "histogram", help_, n,
+                       name=fam + "_bucket", rank=rank, engine=name,
+                       le="+Inf")
+            doc.sample(fam, "histogram", help_, ttft.get("sum_s", 0.0),
+                       name=fam + "_sum", rank=rank, engine=name)
+            doc.sample(fam, "histogram", help_, n,
+                       name=fam + "_count", rank=rank, engine=name)
         for reason, n in sorted(eng.get("preempts", {}).items()):
             doc.sample("ocm_serving_preempts_total", "counter",
                        "Batch-slot preemptions by reason (slot = lost "

@@ -295,6 +295,9 @@ class ControlPlaneClient:
         # path so a sick-but-not-DEAD peer fails FAST instead of eating
         # every op's budget on full connect/transfer timeouts.
         self._breaker = timebudget.breaker_from(self.config)
+        # In-process SLO watcher (obs/slo.py): armed by start_slo(),
+        # surfaced through status()["slo"].
+        self._slo = None
         # CONNECT / CONNECT_CONFIRM handshake (lib.c:128-132), offering
         # the trace capability — and, when OCM_REPLICAS > 1, the replica
         # capability (never offered at k=1, so the default wire is
@@ -580,6 +583,7 @@ class ControlPlaneClient:
         (without detach) reclaims the process's allocations at that rank.
         """
         self._hb_stop.set()
+        self.stop_slo()
         if self._mux is not None and self._mux_hb is not None:
             self._mux.cancel_periodic(self._mux_hb)
             self._mux_hb = None
@@ -1207,6 +1211,7 @@ class ControlPlaneClient:
                              addr, None, stats, 0, budget)
             stats["stripes"] = 1
             return stats
+        lease0 = time.monotonic() if obs_journal.enabled() else 0.0
         try:
             entries = self._pool.lease_set(addr[0], addr[1], nstripes)
         except OcmConnectError:
@@ -1228,6 +1233,11 @@ class ControlPlaneClient:
                 break
             if entries is None:
                 raise
+        if lease0:
+            obs_journal.phase(
+                "client_queue", time.monotonic() - lease0,
+                priority=self.config.priority,
+            )
         # Contention shrank the set: re-split so every leased socket
         # still carries a contiguous range of its fair share.
         nstripes = len(entries)
@@ -1585,7 +1595,18 @@ class ControlPlaneClient:
             return
         host, port = addr
         if entry is None:
-            entry = self._pool.lease(host, port)  # exclusive for the stripe
+            if obs_journal.enabled():
+                # Pool contention (all connections leased, at the peer
+                # cap) shows up here as lease wait — mark it so critpath
+                # separates "queued in the client" from wire time.
+                w0 = time.monotonic()
+                entry = self._pool.lease(host, port)
+                obs_journal.phase(
+                    "client_queue", time.monotonic() - w0,
+                    priority=self.config.priority,
+                )
+            else:
+                entry = self._pool.lease(host, port)  # exclusive stripe
         s = entry.sock
         try:
             caps = self._dcn_caps_for(addr, s)
@@ -1740,6 +1761,50 @@ class ControlPlaneClient:
             self._rank_request(rank, Message(MsgType.STATUS, {}))
         )
 
+    # -- SLO watcher (obs/slo.py) ----------------------------------------
+
+    def _slo_samples(self) -> list[tuple[str, str, dict, float]]:
+        """Client-local counters the daemons cannot expose, injected as
+        synthetic families into the SLO history every tick. Today: the
+        per-peer circuit breaker's opens (an availability error the
+        daemon literally cannot see — it is the peer being avoided)."""
+        if not self._breaker.enabled:
+            return []
+        opens = float(self._breaker.snapshot().get("opens", 0))
+        labels = {"rank": str(self.rank)}
+        return [(
+            "ocm_client_breaker_opens_total",
+            "ocm_client_breaker_opens_total", labels, opens,
+        )]
+
+    def start_slo(self, interval_s: float | None = None):
+        """Arm the in-process SLO watcher: a background scraper polls
+        every rank's STATUS_PROM through this client's existing in-band
+        path into history rings, and the burn-rate engine evaluates the
+        ``OCM_SLO`` objectives each tick. Idempotent; returns the
+        :class:`~oncilla_tpu.obs.slo.SloRunner` (or None when ``OCM_SLO``
+        disables it). Verdicts surface in ``status()["slo"]``."""
+        from oncilla_tpu.obs import slo as obs_slo
+
+        if self._slo is not None:
+            return self._slo
+        cfg = self.config
+        runner = obs_slo.SloRunner.from_env(
+            self.fetch_prom, range(self.nnodes),
+            interval_s=interval_s,
+            budget_s=(cfg.deadline_ms / 1000.0) if cfg.deadline_ms > 0
+            else None,
+            extra_samples=self._slo_samples,
+        )
+        if runner is not None:
+            self._slo = runner.start()
+        return self._slo
+
+    def stop_slo(self) -> None:
+        runner, self._slo = self._slo, None
+        if runner is not None:
+            runner.stop()
+
     def fetch_prom(self, rank: int | None = None) -> str:
         """A rank's Prometheus text exposition (STATUS_PROM), served
         in-band — no scrape port to open on the daemon."""
@@ -1774,6 +1839,8 @@ class ControlPlaneClient:
                 pass  # tail from a future daemon we don't understand
         f["dcn_client"] = {"transfers": self.tracer.transfers(last=32)}
         f["client"] = self.client_footprint()
+        if self._slo is not None:
+            f["slo"] = self._slo.meta()
         return f
 
     def client_footprint(self) -> dict:

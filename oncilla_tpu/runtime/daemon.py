@@ -458,6 +458,13 @@ class Daemon:
         # deterministic window to land in.
         self.serve_delay_s = 0.0
         self.serve_delay_types: frozenset = frozenset()
+        # Sibling hook, different placement: serve_delay sleeps BEFORE
+        # the serve-side tracer span (a slow wire/replica — invisible in
+        # ocm_op_latency_seconds), handler_delay sleeps INSIDE _dispatch
+        # (a slow handler — the latency histograms see it). The SLO
+        # selftest's seeded-burn fixture is built on the latter.
+        self.handler_delay_s = 0.0
+        self.handler_delay_types: frozenset = frozenset()
         self.detector = (
             FailureDetector(
                 len(entries), rank,
@@ -1645,7 +1652,7 @@ class Daemon:
         try:
             pool.submit(
                 self._serve_tagged, conn, wlock, msg, tctx, tag, cstate,
-                seq, budget, conn_id,
+                seq, budget, conn_id, time.monotonic(),
             )
         except RuntimeError:  # pool shut down between check and submit
             cstate.note_done(seq)
@@ -1657,7 +1664,7 @@ class Daemon:
 
     def _serve_tagged(self, conn, wlock, msg: Message, tctx, tag: int,
                       cstate, seq: int, budget=None,
-                      conn_id: int = -1) -> None:
+                      conn_id: int = -1, t_enq: float = 0.0) -> None:
         # A cancel that landed while this op sat QUEUED revokes it
         # before any side effect: nothing dispatched, nothing reserved,
         # no reply (the client already tombstoned the tag).
@@ -1673,6 +1680,15 @@ class Daemon:
                 tag=tag, stage="queued",
             )
             return
+        if t_enq and obs_journal.enabled():
+            # Time spent queued behind the bounded mux worker pool. The
+            # phase binds to the CLIENT op's wire ctx (tctx): the wait
+            # precedes the serve span, so it falls in the client span's
+            # self time — exactly where the attributor must charge it.
+            obs_journal.phase(
+                "daemon_queue", time.monotonic() - t_enq, ctx=tctx,
+                track=self.tracer.track,
+            )
         try:
             # OCM_WAITWATCH: this thread occupies a bounded mux-pool
             # slot for the dispatch — the resource the static
@@ -2125,6 +2141,8 @@ class Daemon:
     # -- dispatch --------------------------------------------------------
 
     def _dispatch(self, msg: Message) -> Message:
+        if self.handler_delay_s > 0 and msg.type in self.handler_delay_types:
+            time.sleep(self.handler_delay_s)
         if self._fenced and msg.type in _FENCED_REJECT:
             # A fenced daemon outlived its own DEAD verdict: its replicas
             # were promoted under a newer epoch, so serving data or
@@ -3130,6 +3148,22 @@ class Daemon:
         primary dead (the pre-promotion window)."""
         if not e.chain:
             return
+        fan0 = time.monotonic() if obs_journal.enabled() else 0.0
+        try:
+            self._fan_out_legs(e, offset, nbytes, data)
+        finally:
+            if fan0:
+                # Bound to the ambient serve span (dcn_put_srv): the
+                # synchronous mirror legs are the dominant slice of a
+                # replicated put's server time, and critpath should name
+                # them instead of lumping them into "handler".
+                obs_journal.phase(
+                    "replica_fanout", time.monotonic() - fan0,
+                    track=self.tracer.track, chain=len(e.chain),
+                )
+
+    def _fan_out_legs(self, e: RegEntry, offset: int, nbytes: int,
+                      data) -> None:
         for rr in e.chain:
             if rr == self.rank or not 0 <= rr < len(self.entries):
                 continue

@@ -474,7 +474,18 @@ class MuxChannel:
         msg = self._trace_wrap(self._budget_wrap(msg, budget), tctx)
         if not self.muxed:
             return await self._request_lockstep(msg)
-        await self._take_credit()
+        if self._credits <= 0 and obs_journal.enabled():
+            # Saturated in-flight window: the op is about to queue behind
+            # the credit counter. Mark the wait as a phase of the op span
+            # so the critical-path attributor can tell "window full" from
+            # "daemon slow".
+            w0 = time.monotonic()
+            await self._take_credit()
+            obs_journal.phase(
+                "mux_window_wait", time.monotonic() - w0, ctx=tctx
+            )
+        else:
+            await self._take_credit()
         tag = self._next_tag()
         fut = self._loop.create_future()
         self._pending[tag] = fut

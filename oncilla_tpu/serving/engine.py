@@ -52,7 +52,7 @@ from oncilla_tpu.serving import metrics as serving_metrics
 from oncilla_tpu.serving.metrics import ServingStats
 from oncilla_tpu.serving.prefix import PrefixCache, SharedExtent
 from oncilla_tpu.serving.tiers import Page, Tier, TieredPageStore
-from oncilla_tpu.utils.debug import printd
+from oncilla_tpu.utils.debug import GLOBAL_TRACER, printd
 
 
 def _pow2(n: int) -> int:
@@ -232,6 +232,8 @@ class _Session:
         self.stall_s = 0.0
         self.done = False
         self.priority = int(getattr(req, "priority", PRIO_NORMAL))
+        self.submit_t = float(getattr(req, "_submit_t", 0.0) or 0.0)
+        self.ttft_noted = False
         self._tail_shape = (cfg.n_layers, 1, cfg.n_kv_heads, page_tokens,
                             cfg.head_dim)
         self._tail_dt = jnp.dtype(dtype)
@@ -341,6 +343,9 @@ class ServingEngine:
     # -- submission / driving --------------------------------------------
 
     def submit(self, req: Request) -> None:
+        # TTFT starts at SUBMIT, not admission: queue wait under
+        # contention is exactly the latency a tenant experiences.
+        req._submit_t = time.perf_counter()
         self.queue.append(req)
 
     def run(self, turn_tokens: int | None = None) -> list[SessionResult]:
@@ -614,6 +619,7 @@ class ServingEngine:
                     or sess.prompt_consumed == len(sess.prompt))
             if emit:
                 sess.out.append(int(jnp.argmax(logits[0])))
+                self._note_first_token(sess)
                 if not prefill:
                     self.stats.note_tokens(1)
             if sess.tail_len == self.page_tokens:
@@ -680,15 +686,26 @@ class ServingEngine:
             # partial adoption) instead of prefilling in lockstep.
             self._match_more(sess)
             if self._bulk_prefill(sess):
-                self._prefill_chunk(sess)
+                # Span per chunk: phases inside (and any cold-tier dcn
+                # fetch spans the chunk faults on) tree under it.
+                with GLOBAL_TRACER.span("serve_prefill_chunk"):
+                    self._prefill_chunk(sess)
                 chunked = True
         batch = self._select_batch(allow_force=not chunked)
         if batch:
-            self._batch_step(batch)
+            with GLOBAL_TRACER.span("serve_batch_step"):
+                self._batch_step(batch)
         for sess in self.active:
             if sess.done:
                 self._finish(sess)
         self.active = [s for s in self.active if not s.done]
+
+    def _note_first_token(self, sess: _Session) -> None:
+        """TTFT: observed once per session, on its first emitted token
+        (submit -> first visible output)."""
+        if len(sess.out) == 1 and sess.submit_t and not sess.ttft_noted:
+            sess.ttft_noted = True
+            self.stats.note_ttft(time.perf_counter() - sess.submit_t)
 
     def _bulk_prefill(self, sess: _Session) -> bool:
         """True while >= one whole page of prompt remains and the tail is
@@ -701,15 +718,27 @@ class ServingEngine:
         """Teacher-force one full page of prompt in one fused dispatch,
         ship it, and emit the seed token when the prompt completes."""
         P = self.page_tokens
+        r0 = time.perf_counter()
         self._ensure_resident(sess)
         k_ctx, v_ctx = self._context(sess)
+        if obs_journal.enabled():
+            obs_journal.phase(
+                "residency", time.perf_counter() - r0,
+                priority=sess.priority,
+            )
         pc = sess.prompt_consumed
         chunk = sess.prompt[pc:pc + P]
         meta = jnp.asarray([sess.pos, 0], jnp.int32)
+        j0 = time.perf_counter()
         logits, sess.tail_k, sess.tail_v = paged_decode_page_jit(
             self.params, jnp.asarray([chunk], jnp.int32), meta,
             k_ctx, v_ctx, sess.tail_k, sess.tail_v, self.cfg,
         )
+        if obs_journal.enabled():
+            obs_journal.phase(
+                "jit_step", time.perf_counter() - j0,
+                priority=sess.priority,
+            )
         sess.pos += P
         sess.tail_len = P
         sess.page_toks = list(chunk)
@@ -720,6 +749,7 @@ class ServingEngine:
                            tokens=P, pos=sess.pos)
         if sess.prompt_consumed == len(sess.prompt):
             sess.out.append(int(jnp.argmax(logits[0, -1])))
+            self._note_first_token(sess)
             if len(sess.out) == sess.req.max_new_tokens:
                 sess.done = True
         self._ship(sess)
@@ -848,6 +878,14 @@ class ServingEngine:
         bitwise the interleaved per-session step."""
         t0 = time.perf_counter()
         self._ensure_resident_batch(batch)
+        if obs_journal.enabled():
+            # Residency vs compute: the two halves of a tick the "where
+            # did the step budget go" question needs split. Bound to the
+            # serve_batch_step span (ambient, installed by _tick).
+            obs_journal.phase(
+                "residency", time.perf_counter() - t0,
+                priority=max(s.priority for s in batch),
+            )
         P = self.page_tokens
         cfg = self.cfg
         pool_k, pool_v, table, tables = self._batch_pool(batch)
@@ -889,6 +927,7 @@ class ServingEngine:
         tab_key = (tab.shape, tab.tobytes())
         if self._tab_cache[0] != tab_key:
             self._tab_cache = (tab_key, jnp.asarray(tab))
+        j0 = time.perf_counter()
         logits, ntk, ntv = paged_decode_batch_step_jit(
             self.params, jnp.asarray(toks, jnp.int32),
             jnp.asarray(metas, jnp.int32), pool_k, pool_v,
@@ -898,6 +937,11 @@ class ServingEngine:
         # (row b is bitwise jnp.argmax(logits[b]) — same bits, same
         # first-max tie-break); doubles as the step's device sync.
         best = np.asarray(jnp.argmax(logits, axis=-1))
+        if obs_journal.enabled():
+            obs_journal.phase(
+                "jit_step", time.perf_counter() - j0,
+                priority=max(s.priority for s in batch),
+            )
         dt = time.perf_counter() - t0
         self.stats.note_batch_step(len(batch), dt)
         obs_journal.record(
@@ -918,6 +962,7 @@ class ServingEngine:
                     or sess.prompt_consumed == len(sess.prompt))
             if emit:
                 sess.out.append(int(best[b]))
+                self._note_first_token(sess)
                 if not prefill:
                     self.stats.note_tokens(1)
             if sess.tail_len == P:

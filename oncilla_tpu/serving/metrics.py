@@ -73,9 +73,16 @@ class ServingStats:
         self.step_s_hist = {b: 0 for b in self.STEP_BUCKETS}
         self.prefill_chunks = 0
         self.preempts: dict[str, int] = {}
+        # Time-to-first-token per session (submit -> first emitted
+        # token), same cumulative prom-style bucket shape as the step
+        # histogram so the SLO engine can window a quantile over it.
+        self.ttft_count = 0
+        self.ttft_s_sum = 0.0
+        self.ttft_s_hist = {b: 0 for b in self.TTFT_BUCKETS}
 
     BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
     STEP_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5)
+    TTFT_BUCKETS = (0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
 
     # -- mutation ---------------------------------------------------------
 
@@ -151,6 +158,15 @@ class ServingStats:
                 if seconds <= b:
                     self.step_s_hist[b] += 1
 
+    def note_ttft(self, seconds: float) -> None:
+        """One session's time-to-first-token."""
+        with self._mu:
+            self.ttft_count += 1
+            self.ttft_s_sum += seconds
+            for b in self.TTFT_BUCKETS:
+                if seconds <= b:
+                    self.ttft_s_hist[b] += 1
+
     def note_preempt(self, reason: str) -> None:
         """A session lost (or yielded) its batch slot this tick:
         ``slot`` = lost priority-ordered slot contention, ``cold_page``
@@ -220,6 +236,11 @@ class ServingStats:
                     "prefill_chunks": self.prefill_chunks,
                 },
                 "preempts": dict(self.preempts),
+                "ttft": {
+                    "count": self.ttft_count,
+                    "sum_s": round(self.ttft_s_sum, 6),
+                    "hist": dict(self.ttft_s_hist),
+                },
             }
 
 

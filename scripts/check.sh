@@ -173,6 +173,27 @@ else
     fail=1
 fi
 
+echo "== obs slo + critpath =="
+# The evaluation layer, end to end: (1) chaos smoke under OCM_EVENTS=1
+# with the flight recorder armed, then critical-path attribution over
+# the capture — the gate demands >=1 cross-rank op tree with >=95% of
+# its wall time attributed to NAMED phases (client queue, daemon queue,
+# replica fan-out, handler self time); (2) the SLO selftest — a healthy
+# in-process run must evaluate green with active objectives and a
+# validating ocm_slo_* exposition, and a planted slow handler
+# (handler_delay_s) must trip the multi-window burn-rate alert.
+cpdir=$(mktemp -d)
+if JAX_PLATFORMS=cpu OCM_EVENTS=1 OCM_FLIGHTREC="$cpdir" \
+        python -m oncilla_tpu.resilience --smoke >/dev/null \
+    && JAX_PLATFORMS=cpu python -m oncilla_tpu.obs critpath "$cpdir"/* \
+        --min-attrib 0.95 --require-cross-rank \
+    && JAX_PLATFORMS=cpu python -m oncilla_tpu.obs slo --selftest; then
+    rm -rf "$cpdir"
+else
+    echo "check.sh: obs slo/critpath stage failed (capture kept at $cpdir)"
+    fail=1
+fi
+
 echo "== native obs smoke =="
 # The native daemon's black box, end to end: the native dcn smoke runs
 # with OCM_FLIGHTREC armed (the C++ daemons stream CRC-framed segments

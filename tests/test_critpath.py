@@ -1,0 +1,257 @@
+"""Critical-path attribution (obs/critpath.py) and the ``obs critpath``
+CLI gates.
+
+Synthetic span/phase streams pin the decomposition math (self time vs
+children, phase scaling, clock-skew clamping, the backward critical-path
+sweep); the integration test runs real put/get traffic and holds the
+assembled trees to the acceptance bar: >=1 cross-rank tree with >=95%
+of wall time attributed to named phases.
+"""
+
+import numpy as np
+import pytest
+
+from oncilla_tpu.obs import critpath, flightrec, journal
+from oncilla_tpu.obs.__main__ import main as obs_main
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+from oncilla_tpu import OcmKind
+
+
+@pytest.fixture
+def journaling():
+    was = journal.enabled()
+    journal.set_enabled(True)
+    journal.clear()
+    yield journal
+    journal.set_enabled(was)
+    journal.clear()
+
+
+def _span(op, t0, dur_s, *, trace=1, span=1, parent=0, track="client",
+          **extra):
+    return {
+        "ev": "span", "op": op, "ts": t0, "t_wall": t0,
+        "dur_us": dur_s * 1e6, "trace_id": trace, "span_id": span,
+        "parent_span_id": parent, "track": track, **extra,
+    }
+
+
+def _phase(name, dur_s, *, trace=1, span=1, **extra):
+    return {
+        "ev": "phase", "phase": name, "ts": 0.0, "dur_us": dur_s * 1e6,
+        "trace_id": trace, "span_id": span, **extra,
+    }
+
+
+# -- tree assembly and attribution --------------------------------------
+
+
+def test_single_span_attributes_to_own_op():
+    trees = critpath.assemble([_span("dcn_put", 10.0, 0.010)])
+    assert len(trees) == 1
+    t = trees[0]
+    assert t["root_op"] == "dcn_put" and t["n_spans"] == 1
+    assert t["attribution"] == {"dcn_put": pytest.approx(0.010)}
+    assert t["attributed_frac"] == pytest.approx(1.0)
+    assert t["critical_path"] == [("dcn_put", pytest.approx(0.010))]
+
+
+def test_child_carves_self_time_and_both_ops_attributed():
+    evs = [
+        _span("dcn_put", 10.0, 0.010, span=1),
+        _span("dcn_put_srv", 10.002, 0.006, span=2, parent=1,
+              track="daemon-r1"),
+    ]
+    (t,) = critpath.assemble(evs)
+    assert t["n_spans"] == 2 and set(t["tracks"]) == {"client", "daemon-r1"}
+    assert t["attribution"]["dcn_put"] == pytest.approx(0.004)
+    assert t["attribution"]["dcn_put_srv"] == pytest.approx(0.006)
+    assert t["attributed_frac"] == pytest.approx(1.0)
+    # Critical path walks through the child: 4 ms client + 6 ms server.
+    assert dict(t["critical_path"]) == {
+        "dcn_put": pytest.approx(0.004),
+        "dcn_put_srv": pytest.approx(0.006),
+    }
+
+
+def test_phases_carve_named_slices_out_of_self_time():
+    evs = [
+        _span("dcn_put", 10.0, 0.010, span=1),
+        _phase("client_queue", 0.003, span=1),
+    ]
+    (t,) = critpath.assemble(evs)
+    assert t["attribution"]["client_queue"] == pytest.approx(0.003)
+    assert t["attribution"]["dcn_put"] == pytest.approx(0.007)
+    assert t["attributed_frac"] == pytest.approx(1.0)
+
+
+def test_overclaiming_phases_scaled_never_inflate():
+    # Phases claim 12 ms of a 10 ms span: scaled down to the self time,
+    # keeping their relative weights; nothing left for the op itself.
+    evs = [
+        _span("dcn_put", 10.0, 0.010, span=1),
+        _phase("client_queue", 0.009, span=1),
+        _phase("daemon_queue", 0.003, span=1),
+    ]
+    (t,) = critpath.assemble(evs)
+    assert sum(t["attribution"].values()) == pytest.approx(0.010)
+    assert t["attribution"]["client_queue"] == pytest.approx(0.0075)
+    assert t["attribution"]["daemon_queue"] == pytest.approx(0.0025)
+    assert "dcn_put" not in t["attribution"]
+
+
+def test_clock_skew_child_clamped_into_parent():
+    # The server span's wall clock runs ahead: it "ends" after its
+    # parent. Clamping keeps the tree's total at the root's wall time.
+    evs = [
+        _span("dcn_put", 10.0, 0.010, span=1),
+        _span("dcn_put_srv", 10.008, 0.008, span=2, parent=1,
+              track="daemon-r1"),
+    ]
+    (t,) = critpath.assemble(evs)
+    assert t["wall_s"] == pytest.approx(0.010)
+    assert sum(t["attribution"].values()) == pytest.approx(0.010)
+    assert t["attributed_frac"] == pytest.approx(1.0)
+
+
+def test_orphan_parent_becomes_root_and_priorities_collected():
+    evs = [
+        _span("dcn_get", 10.0, 0.004, trace=7, span=3, parent=99,
+              priority=2),
+        _phase("client_queue", 0.001, trace=7, span=3, priority=2),
+    ]
+    (t,) = critpath.assemble(evs)
+    assert t["root_op"] == "dcn_get" and t["priority"] == "2"
+
+
+def test_trees_sorted_by_wall_time_and_zero_duration_skipped():
+    evs = [
+        _span("fast", 10.0, 0.001, trace=1, span=1),
+        _span("slow", 10.0, 0.050, trace=2, span=1),
+        _span("empty", 10.0, 0.0, trace=3, span=1),
+    ]
+    trees = critpath.assemble(evs)
+    assert [t["root_op"] for t in trees] == ["slow", "fast"]
+
+
+def test_phase_table_groups_by_op_and_priority():
+    evs = [
+        _span("dcn_put", 10.0, 0.010, trace=1, span=1, priority=1),
+        _phase("client_queue", 0.004, trace=1, span=1),
+        _span("dcn_put", 20.0, 0.020, trace=2, span=1, priority=1),
+        _phase("client_queue", 0.008, trace=2, span=1),
+    ]
+    rows = critpath.phase_table(critpath.assemble(evs))
+    by_phase = {r["phase"]: r for r in rows}
+    assert by_phase["client_queue"]["n"] == 2
+    assert by_phase["client_queue"]["p50_s"] == pytest.approx(0.004)
+    assert by_phase["client_queue"]["p99_s"] == pytest.approx(0.008)
+    assert by_phase["client_queue"]["share"] + by_phase["dcn_put"]["share"] \
+        == pytest.approx(1.0)
+
+
+def test_render_report_handles_empty_stream():
+    assert "no op trees" in critpath.render_report([])
+
+
+# -- loading ------------------------------------------------------------
+
+
+def test_load_events_merges_segments_and_jsonl(tmp_path, journaling):
+    evs = [
+        {"ev": "span", "op": "a", "ts": 1.0, "t_wall": 1.0,
+         "dur_us": 5.0, "trace_id": 1, "span_id": 1, "parent_span_id": 0,
+         "jid": "w1", "seq": 1},
+        {"ev": "span", "op": "b", "ts": 2.0, "t_wall": 2.0,
+         "dur_us": 5.0, "trace_id": 2, "span_id": 1, "parent_span_id": 0,
+         "jid": "w1", "seq": 2},
+    ]
+    frdir = tmp_path / "fr"
+    prev = flightrec.segment_dir()
+    flightrec.set_dir(str(frdir))
+    try:
+        seg = flightrec.dump_events(evs, label="dump")
+    finally:
+        flightrec.set_dir(prev)
+    jl = tmp_path / "j.jsonl"
+    jl.write_text(journal.dump_jsonl(evs))  # duplicates: must dedup away
+    merged = critpath.load_events([str(frdir), str(jl)])
+    assert len(merged) == 2
+    assert len(critpath.load_events([seg])) == 2
+
+
+# -- CLI gates -----------------------------------------------------------
+
+
+def test_cli_gates_pass_and_fail(tmp_path, capsys, journaling):
+    evs = [
+        _span("dcn_put", 10.0, 0.010, span=1, jid="w", seq=1),
+        _span("dcn_put_srv", 10.002, 0.006, span=2, parent=1,
+              track="daemon-r1", jid="w", seq=2),
+    ]
+    path = tmp_path / "j.jsonl"
+    path.write_text(journal.dump_jsonl(evs))
+    rc = obs_main(["critpath", str(path), "--min-attrib", "0.95",
+                   "--require-cross-rank"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 cross-rank" in out and "dcn_put_srv" in out
+    # Single-track stream fails the cross-rank gate.
+    solo = tmp_path / "solo.jsonl"
+    solo.write_text(journal.dump_jsonl(
+        [_span("dcn_put", 10.0, 0.010, span=1, jid="w", seq=1)]
+    ))
+    assert obs_main(["critpath", str(solo), "--require-cross-rank"]) == 1
+    # No spans at all fails outright.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert obs_main(["critpath", str(empty)]) == 1
+
+
+def test_cli_json_output(tmp_path, capsys, journaling):
+    import json as _json
+
+    path = tmp_path / "j.jsonl"
+    path.write_text(journal.dump_jsonl(
+        [_span("dcn_put", 10.0, 0.010, span=1, jid="w", seq=1)]
+    ))
+    assert obs_main(["critpath", str(path), "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["trees"][0]["root_op"] == "dcn_put"
+    assert doc["phases"]
+
+
+# -- integration: real traffic meets the acceptance bar ------------------
+
+
+def test_real_traffic_builds_cross_rank_trees_95pct_attributed(journaling):
+    cfg = OcmConfig(
+        host_arena_bytes=8 << 20, device_arena_bytes=1 << 20,
+        chunk_bytes=128 << 10, dcn_stripes=2,
+        dcn_stripe_min_bytes=128 << 10, heartbeat_s=5.0,
+    )
+    with local_cluster(2, config=cfg) as c:
+        ctx = c.context(0, heartbeat=False)
+        data = np.arange(512 << 10, dtype=np.uint8)
+        for _ in range(3):
+            h = ctx.alloc(len(data), OcmKind.REMOTE_HOST)
+            try:
+                ctx.put(h, data)
+                np.asarray(ctx.get(h))
+            finally:
+                ctx.free(h)
+    trees = critpath.assemble(journal.events())
+    assert trees
+    cross = [t for t in trees if len(t["tracks"]) > 1]
+    assert cross, "expected >=1 cross-rank op tree"
+    best = max(t["attributed_frac"] for t in cross)
+    assert best >= 0.95
+    # The instrumented wait phases actually appear in the decomposition.
+    phases = set()
+    for t in trees:
+        phases.update(t["attribution"])
+    assert "client_queue" in phases
+    names = {r["phase"] for r in critpath.phase_table(trees)}
+    assert "client_queue" in names

@@ -118,6 +118,82 @@ def test_max_segs_never_touches_other_writers_segments(spill):
     assert 0 < len(own) <= 2, own
 
 
+def test_two_writer_dir_rotation_deletes_only_owners_oldest(spill):
+    """Two writers stream into one directory (distinct jids, as two
+    processes would): writer A's MAX_SEGS rotation deletes A's OLDEST
+    segment and nothing of writer B's."""
+    # Writer B: forge a real streamed segment under a foreign jid, the
+    # exact bytes another process's append() would have produced.
+    foreign = os.path.join(spill, "fr-beefcafe-00001.seg")
+    with open(foreign, "wb") as fh:
+        fh.write(flightrec._HDR)
+        for i in range(5):
+            fh.write(flightrec._frame(
+                {"ev": "span", "op": f"b{i}", "ts": float(i),
+                 "jid": "beefcafe", "seq": i + 1}
+            ))
+    flightrec.set_seg_bytes(600)
+    flightrec.set_max_segs(2)
+    try:
+        for i in range(40):
+            journal.record("span", op=f"a{i}")
+        own_after_rotation = [
+            n for n in _segs(spill) if "beefcafe" not in n
+        ]
+    finally:
+        flightrec.set_seg_bytes(4 << 20)
+        flightrec.set_max_segs(0)
+    assert len(own_after_rotation) == 2
+    # B's segment survives, fully readable.
+    evs, problems = flightrec.read_segment(foreign)
+    assert problems == [] and [e["op"] for e in evs] == [
+        f"b{i}" for i in range(5)
+    ]
+    # A's surviving segments are its newest: the oldest was the
+    # rotation victim.
+    evs_a, _ = flightrec.read_dir(spill)
+    a_ops = [e["op"] for e in evs_a if str(e.get("op", "")).startswith("a")]
+    assert "a39" in a_ops and "a0" not in a_ops
+
+
+def test_seg_bytes_env_knob_tolerates_garbage(monkeypatch):
+    """OCM_FLIGHTREC_SEG_BYTES=<non-integer> degrades to the 4 MiB
+    default at import instead of raising."""
+    import importlib
+
+    monkeypatch.setenv(flightrec.ENV_SEG_BYTES, "four-megs")
+    monkeypatch.delenv(flightrec.ENV_DIR, raising=False)
+    monkeypatch.delenv(flightrec.ENV_MAX_SEGS, raising=False)
+    try:
+        importlib.reload(flightrec)
+        assert flightrec._seg_bytes == 4 << 20
+        monkeypatch.setenv(flightrec.ENV_SEG_BYTES, "1024")
+        importlib.reload(flightrec)
+        assert flightrec._seg_bytes == 1024
+    finally:
+        monkeypatch.delenv(flightrec.ENV_SEG_BYTES, raising=False)
+        importlib.reload(flightrec)
+
+
+def test_max_segs_env_knob_tolerates_garbage(monkeypatch):
+    """OCM_FLIGHTREC_MAX_SEGS=<non-integer> degrades to unbounded (0)
+    at import instead of raising."""
+    import importlib
+
+    monkeypatch.setenv(flightrec.ENV_MAX_SEGS, "lots")
+    monkeypatch.delenv(flightrec.ENV_DIR, raising=False)
+    monkeypatch.delenv(flightrec.ENV_SEG_BYTES, raising=False)
+    try:
+        importlib.reload(flightrec)
+        assert flightrec._max_segs == 0
+        monkeypatch.setenv(flightrec.ENV_MAX_SEGS, "3")
+        importlib.reload(flightrec)
+        assert flightrec._max_segs == 3
+    finally:
+        monkeypatch.delenv(flightrec.ENV_MAX_SEGS, raising=False)
+        importlib.reload(flightrec)
+
+
 def test_ring_overflow_spill_keeps_full_stream(spill):
     """Satellite: the in-memory ring stays bounded at the cap while the
     spill keeps the complete stream (no journal-gap finding)."""

@@ -151,6 +151,60 @@ def test_journal_disabled_records_nothing_without_force():
         journal.clear()
 
 
+def test_journal_cap_env_knob_tolerates_garbage(monkeypatch):
+    """OCM_EVENTS_CAP=<non-integer> must degrade to the default at
+    import, never raise (the knob used to crash every obs importer)."""
+    import importlib
+
+    monkeypatch.setenv("OCM_EVENTS_CAP", "not-an-int")
+    monkeypatch.delenv("OCM_EVENTS", raising=False)
+    try:
+        importlib.reload(journal)
+        assert journal._CAP == 8192
+        journal.set_enabled(True)
+        journal.record("span", op="after-bad-cap")  # ring still works
+        assert journal.events()[-1]["op"] == "after-bad-cap"
+        monkeypatch.setenv("OCM_EVENTS_CAP", "64")
+        importlib.reload(journal)
+        assert journal._CAP == 64
+    finally:
+        monkeypatch.delenv("OCM_EVENTS_CAP", raising=False)
+        importlib.reload(journal)
+
+
+def test_journal_ring_overflow_newest_n_under_concurrent_writers(
+    journaling,
+):
+    """The ring bound holds under racing writers and keeps exactly the
+    newest N by sequence — no gap, no stale survivor."""
+    journal.set_cap(256)
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda w=w: [
+                    journal.record("span", op=f"w{w}", i=i)
+                    for i in range(500)
+                ]
+            )
+            for w in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        evs = journal.events()
+        assert len(evs) == 256
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs)
+        # Newest-N: the survivors are one contiguous run of the global
+        # sequence (no gap mid-ring) ending at the final record — i.e.
+        # exactly the last 256 events appended.
+        assert seqs[-1] - seqs[0] == 255
+        assert len(set(seqs)) == 256
+    finally:
+        journal.set_cap(8192)
+
+
 def test_journal_jsonl_dump_load_roundtrip(journaling, tmp_path):
     journal.record("span", op="x", nbytes=3)
     p = tmp_path / "j.jsonl"
@@ -209,6 +263,64 @@ def test_single_track_trace_has_no_flows():
          "trace_id": 5, "span_id": 2},
     ]
     assert export.cross_track_flows(export.chrome_trace(evs)) == 0
+
+
+def test_hedge_and_cancel_lifecycles_stitched_as_flows():
+    """Satellite: hedge_fired→hedge_won/lost and cancel_sent→cancel_ack
+    render as dedicated flow arrows (cat ocm.lifecycle), not as the
+    unconnected instants they used to be."""
+    evs = [
+        {"ev": "hedge_fired", "ts": 1.0, "track": "client", "tid": 1,
+         "alloc_id": 7},
+        {"ev": "hedge_won", "ts": 1.02, "track": "client", "tid": 1,
+         "alloc_id": 7},
+        # Second hedge on the same alloc, resolved as a loss: nearest
+        # -subsequent pairing, not first-opener-takes-all.
+        {"ev": "hedge_fired", "ts": 2.0, "track": "client", "tid": 1,
+         "alloc_id": 7},
+        {"ev": "hedge_lost", "ts": 2.05, "track": "client", "tid": 1,
+         "alloc_id": 7},
+        {"ev": "cancel_sent", "ts": 3.0, "track": "client", "tid": 1,
+         "tag": 42},
+        {"ev": "cancel_ack", "ts": 3.01, "track": "daemon-r1", "tid": 9,
+         "tag": 42},
+        # Unmatched opener: no arrow, no crash.
+        {"ev": "cancel_sent", "ts": 4.0, "track": "client", "tid": 1,
+         "tag": 99},
+    ]
+    trace = export.chrome_trace(evs)
+    flows = [e for e in trace["traceEvents"]
+             if e.get("cat") == "ocm.lifecycle"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 3
+    assert export.lifecycle_flows(trace) == 3
+    assert {e["name"] for e in flows} == {"hedge", "cancel"}
+    # Each pair shares an id; the cancel arrow crosses tracks.
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    assert all(len(pair) == 2 for pair in by_id.values())
+    cancel_pair = [p for fid, p in by_id.items() if "cancel" in fid][0]
+    assert len({e["pid"] for e in cancel_pair}) == 2
+    # Lifecycle ids stay out of the cross-track trace-flow count.
+    assert export.cross_track_flows(trace) == 0
+    # The instants themselves still render (arrows are additive).
+    assert sum(1 for e in trace["traceEvents"]
+               if e.get("ph") == "i" and e["name"] == "hedge_fired") == 2
+
+
+def test_lifecycle_summary_counted_in_write_chrome_trace(tmp_path):
+    evs = [
+        {"ev": "hedge_fired", "ts": 1.0, "track": "c", "tid": 1,
+         "alloc_id": 1},
+        {"ev": "hedge_won", "ts": 1.1, "track": "c", "tid": 1,
+         "alloc_id": 1},
+    ]
+    out = tmp_path / "t.json"
+    summary = export.write_chrome_trace(evs, str(out))
+    assert summary["lifecycle_flows"] == 1
+    json.loads(out.read_text())  # parses as Chrome-trace JSON
 
 
 # -- end-to-end: one trace_id stitches client and daemon spans -----------
