@@ -145,8 +145,8 @@ _RELAY_CLASS: dict[str, str] = {
     "REQ_LEAVE": "forward",
     "REQ_LOCATE": "leaf",
     "RE_REPLICATE": "forward",     # repair -> DO_REPLICA/DATA_PUT
-    "SHM_GET": "leaf",
-    "SHM_MAP": "leaf",
+    "SHM_GET": "forward",          # thaw-on-fault -> evictor free legs
+    "SHM_MAP": "forward",
     "SHM_PUT": "forward",          # -> FLAG_FANOUT replica legs
     "STATUS": "leaf",
     "STATUS_EVENTS": "leaf",

@@ -28,8 +28,10 @@ rule                  violated when
 ``lease-chain``       an app renewed leases but the timeline never
                       terminates them (no disconnect / free / reclaim /
                       eviction for that app)
-``eviction-priority`` a pressure eviction fired on an ACTIVE lease above
-                      the low priority class
+``eviction-priority`` a pressure eviction OR frozen-tier demotion fired
+                      on an ACTIVE lease above the low priority class,
+                      or a demoted-to-FROZEN alloc was reported
+                      destroyed while still frozen
 ``fenced-silence``    a fenced daemon emitted a post-fence client ack or
                       replica fan-out (split-brain writes)
 ``leader-unique``     more than one rank claimed leadership under the
@@ -319,16 +321,49 @@ def _check_lease_chains(tl: Timeline) -> list[AuditFinding]:
 
 @invariant("eviction-priority")
 def _check_evictions(tl: Timeline) -> list[AuditFinding]:
+    """Pressure victims obey the class invariant — and the FROZEN tier
+    (persist/) never lies about destruction. ``qos_evict`` means the
+    bytes are gone; ``tier_demote`` means they spilled to disk. Both
+    legs run inside the same victim loop, so BOTH must respect the
+    active-above-low prohibition; and an alloc the timeline shows as
+    demoted-to-frozen must never be reported destroyed while it is
+    still frozen (that qos_evict would be silent durable-data loss —
+    a frozen entry holds no arena bytes and is not a legal victim).
+    The frozen set is tracked per PROCESS stream (seq order is program
+    order for one daemon); a ``tier_promote`` or ``free_local`` for
+    the alloc lifts the prohibition."""
     out = []
     for e in tl.events:
-        if (e.get("ev") == "qos_evict" and e.get("active")
+        if (e.get("ev") in ("qos_evict", "tier_demote") and e.get("active")
                 and int(e.get("priority", _PRIO_LOW)) > _PRIO_LOW):
+            verb = ("eviction" if e.get("ev") == "qos_evict"
+                    else "demotion to frozen")
             out.append(AuditFinding(
                 rule="eviction-priority", rank=_rank_of(e),
-                message=f"pressure eviction fired on ACTIVE priority-"
+                message=f"pressure {verb} fired on ACTIVE priority-"
                         f"{e.get('priority')} alloc {e.get('alloc_id')}",
                 events=(_ref(e),),
             ))
+    for jid, evs in tl.streams.items():
+        frozen_at: dict[tuple, dict] = {}  # (track, alloc_id) -> demote ev
+        for e in evs:
+            ev = e.get("ev")
+            if ev not in ("tier_demote", "tier_promote", "qos_evict",
+                          "free_local"):
+                continue
+            key = (e.get("track"), e.get("alloc_id"))
+            if ev == "tier_demote":
+                frozen_at.setdefault(key, e)
+            elif ev in ("tier_promote", "free_local"):
+                frozen_at.pop(key, None)
+            elif e.get("destroyed") and key in frozen_at:
+                out.append(AuditFinding(
+                    rule="eviction-priority", rank=_rank_of(e),
+                    message=f"alloc {e.get('alloc_id')} reported "
+                            "DESTROYED by qos_evict while demoted to the "
+                            "frozen tier (durable payload silently lost)",
+                    events=(_ref(frozen_at[key]), _ref(e)),
+                ))
     return out
 
 

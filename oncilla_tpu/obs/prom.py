@@ -442,6 +442,21 @@ def render(meta: dict) -> str:
                        "state.",
                        rec.get("active", 0),
                        rank=rank, priority=prio, lease="active")
+        for prio, rec in sorted(
+            (qos.get("demotions_by_priority") or {}).items()
+        ):
+            doc.sample("ocm_demotions_by_priority", "counter",
+                       "Pressure victims demoted to the frozen tier "
+                       "(bytes survive on disk) by priority class and "
+                       "lease state.",
+                       rec.get("expired", 0),
+                       rank=rank, priority=prio, lease="expired")
+            doc.sample("ocm_demotions_by_priority", "counter",
+                       "Pressure victims demoted to the frozen tier "
+                       "(bytes survive on disk) by priority class and "
+                       "lease state.",
+                       rec.get("active", 0),
+                       rank=rank, priority=prio, lease="active")
         for app, rec in sorted((qos.get("apps") or {}).items()):
             doc.sample("ocm_quota_bytes_used", "gauge",
                        "Live admitted bytes per app (origin-daemon "
@@ -542,6 +557,34 @@ def render(meta: dict) -> str:
                    "REQ_ALLOCs additionally unwound via the free "
                    "path).",
                    tb.get("cancel_drops", 0), rank=rank)
+
+    frz = meta.get("frozen")
+    if frz:
+        doc.sample("ocm_frozen_demotes_total", "counter",
+                   "Arena extents demoted (spilled) to the disk-backed "
+                   "frozen tier under pressure.",
+                   frz.get("demotes", 0), rank=rank)
+        doc.sample("ocm_frozen_promotes_total", "counter",
+                   "Frozen extents thawed back into the host arena on "
+                   "client access.",
+                   frz.get("promotes", 0), rank=rank)
+        doc.sample("ocm_frozen_lost_total", "counter",
+                   "Frozen entries refused at open or read (CRC/format "
+                   "failure) and quarantined — reported lost, never "
+                   "served as garbage.",
+                   frz.get("lost", 0), rank=rank)
+        doc.sample("ocm_warm_boot_extents_total", "counter",
+                   "Frozen extents re-adopted by a restarted daemon "
+                   "incarnation at start.",
+                   frz.get("warm_boot_extents", 0), rank=rank)
+        doc.sample("ocm_frozen_bytes", "gauge",
+                   "Payload bytes currently stored in this daemon's "
+                   "frozen tier.",
+                   frz.get("bytes", 0), rank=rank)
+        doc.sample("ocm_frozen_extents", "gauge",
+                   "Entries currently stored in this daemon's frozen "
+                   "tier.",
+                   frz.get("extents", 0), rank=rank)
 
     srv = meta.get("serving")
     if srv:

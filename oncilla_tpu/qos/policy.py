@@ -112,6 +112,13 @@ class QosManager:
         # [priority][active] split is what pins the invariant — the
         # active column above PRIO_LOW must stay 0 forever.
         self.evictions = [[0, 0], [0, 0], [0, 0]]
+        # Demotions to FROZEN (persist/), same shape: victims that
+        # spilled to disk instead of being destroyed. Split from
+        # evictions so the journal's tier_demote/qos_evict distinction
+        # survives into the accounting — a demotion is NOT data
+        # destruction, and the tenant's quota stays held (the bytes are
+        # still stored on its behalf).
+        self.demotions = [[0, 0], [0, 0], [0, 0]]
 
     # -- profile registration (CONNECT) ----------------------------------
 
@@ -248,6 +255,13 @@ class QosManager:
             p = min(max(priority, PRIO_LOW), PRIO_HIGH)
             self.evictions[p][1 if active else 0] += 1
 
+    def note_demotion(self, priority: int, active: bool) -> None:
+        """A pressure victim spilled to FROZEN (not destroyed): counted
+        apart from evictions, quota untouched."""
+        with self._lock:
+            p = min(max(priority, PRIO_LOW), PRIO_HIGH)
+            self.demotions[p][1 if active else 0] += 1
+
     def metrics(self, now: float | None = None) -> dict:
         """What STATUS / STATUS_PROM / the obs cluster table render."""
         now = time.monotonic() if now is None else now
@@ -258,6 +272,13 @@ class QosManager:
                     PRIO_NAMES[p]: {
                         "expired": self.evictions[p][0],
                         "active": self.evictions[p][1],
+                    }
+                    for p in (PRIO_LOW, PRIO_NORMAL, PRIO_HIGH)
+                },
+                "demotions_by_priority": {
+                    PRIO_NAMES[p]: {
+                        "expired": self.demotions[p][0],
+                        "active": self.demotions[p][1],
                     }
                     for p in (PRIO_LOW, PRIO_NORMAL, PRIO_HIGH)
                 },

@@ -28,6 +28,12 @@ Fault vocabulary:
                 leads until the heal lets the fence reach it. Unlike
                 ``partition`` (one-way, at the pool seam only), this
                 models the whole host vanishing from the network.
+- ``restart`` — hard-kill a daemon AND relaunch it at the same address
+                as a fresh incarnation (persist/ warm-boot scenarios):
+                no snapshot is written, so only the frozen tier's
+                manifest survives. Fired through the harness-bound
+                ``restart_fn(rank)``; the victim's journal ring is
+                spilled first, exactly like ``kill``.
 - ``join``/``leave``/``migrate`` — elastic-membership fault points
                 (elastic/): fire the harness-bound ``join_fn`` /
                 ``leave_fn(rank)`` / ``migrate_fn`` at a deterministic
@@ -55,7 +61,7 @@ from oncilla_tpu.obs import journal as obs_journal
 from oncilla_tpu.runtime import pool as _pool
 
 ACTIONS = ("kill", "drop", "delay", "partition", "heal", "corrupt_snapshot",
-           "join", "leave", "migrate", "isolate", "heal_isolate")
+           "join", "leave", "migrate", "isolate", "heal_isolate", "restart")
 
 
 @dataclass(frozen=True)
@@ -126,11 +132,12 @@ class ChaosController:
     def __init__(self, schedule: ChaosSchedule, entries,
                  kill_fn=None, snapshot_paths: dict[int, str] | None = None,
                  join_fn=None, leave_fn=None, migrate_fn=None,
-                 isolate_fn=None):
+                 isolate_fn=None, restart_fn=None):
         self.schedule = schedule
         self.entries = entries  # live membership list (ports resolve late)
         self.kill_fn = kill_fn
         self.isolate_fn = isolate_fn
+        self.restart_fn = restart_fn
         self.snapshot_paths = snapshot_paths or {}
         # Elastic-membership fault points (elastic/): bound by the
         # harness; a schedule naming them without a binding is a no-op
@@ -185,6 +192,15 @@ class ChaosController:
                 obs_journal.spill_ring(label=f"chaos-kill-r{f.rank}")
                 if self.kill_fn is not None:
                     self.kill_fn(f.rank)
+            elif f.action == "restart":
+                # Kill-then-relaunch at the same address: the outgoing
+                # incarnation's evidence spills like a kill's, then the
+                # harness brings a fresh incarnation up (frozen-tier
+                # warm boot; no snapshot was written).
+                self.victim_rings[f.rank] = obs_journal.events()
+                obs_journal.spill_ring(label=f"chaos-restart-r{f.rank}")
+                if self.restart_fn is not None:
+                    self.restart_fn(f.rank)
             elif f.action == "delay":
                 time.sleep(f.delay_s)
             elif f.action == "drop":
@@ -238,6 +254,11 @@ class ChaosController:
             obs_journal.spill_ring(label=f"chaos-kill-r{rank}")
             if self.kill_fn is not None:
                 self.kill_fn(rank)
+        elif action == "restart":
+            self.victim_rings[rank] = obs_journal.events()
+            obs_journal.spill_ring(label=f"chaos-restart-r{rank}")
+            if self.restart_fn is not None:
+                self.restart_fn(rank)
         elif action == "delay":
             time.sleep(delay_s)
         elif action == "isolate":

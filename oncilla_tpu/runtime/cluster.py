@@ -30,6 +30,8 @@ class LocalCluster:
         ndevices: int = 1,
     ):
         self.config = config or OcmConfig()
+        self._policy = policy
+        self._ndevices = ndevices
         self.entries = [NodeEntry(r, "127.0.0.1", 0) for r in range(nnodes)]
         self.daemons: list[Daemon] = []
         # Start rank 0 first so ADD_NODE from the others lands (the
@@ -66,6 +68,33 @@ class LocalCluster:
         stays in ``daemons`` so teardown's stop() (idempotent) still
         runs; chaos schedules use this as their kill_fn."""
         self.daemons[rank].kill()
+
+    def restart(self, rank: int) -> Daemon:
+        """Hard-kill one daemon and relaunch a FRESH incarnation on the
+        same address (the entries list already holds its concrete port;
+        SO_REUSEADDR makes the rebind immediate). No snapshot is written
+        — kill() forbids it — so the only state that survives is what
+        the frozen tier (persist/) put on disk; the new incarnation's
+        start() re-adopts it. Chaos ``restart`` schedules bind this as
+        their restart_fn."""
+        from oncilla_tpu.analysis import alloctrace
+
+        old = self.daemons[rank]
+        old.kill()
+        # The killed incarnation's memory is gone (a real SIGKILL'd
+        # process takes its ledger with it): drop its trace scopes so
+        # drained-ledger assertions see only live state. The smokes'
+        # dead-scope exclusion pattern can't apply here — the old
+        # object leaves ``daemons`` below.
+        alloctrace.drop_scope(old._trace_scope)
+        alloctrace.drop_scope(old.host_arena.allocator._trace_scope)
+        d = Daemon(
+            rank, self.entries, config=self.config, policy=self._policy,
+            ndevices=self._ndevices,
+        )
+        d.start()
+        self.daemons[rank] = d
+        return d
 
     def stop(self) -> None:
         with self._lock:

@@ -27,7 +27,7 @@ import threading
 import time
 
 from oncilla_tpu.analysis import alloctrace, waitwatch
-from oncilla_tpu.analysis.lockwatch import make_lock
+from oncilla_tpu.analysis.lockwatch import make_lock, make_rlock
 from oncilla_tpu.core.arena import ArenaAllocator, Extent, check_bounds
 from oncilla_tpu.core.errors import (
     OcmAdmissionDenied,
@@ -285,6 +285,36 @@ class Daemon:
         # placement policy from peer STATUS polls in the reaper loop.
         self.qos = QosManager(self.config)
         self._last_load_poll = time.monotonic()
+        # FROZEN tier (persist/): disk-backed extent store, one
+        # directory per daemon rank. Constructed ONLY when configured
+        # (OCM_FROZEN_DIR set and OCM_FROZEN!=0) — None keeps every
+        # demotion/eviction/data path byte-identical to the pre-persist
+        # daemon. The open itself adopts nothing; surviving extents are
+        # re-registered by _adopt_frozen() in start(). A failed open
+        # (unwritable dir) degrades to no-FROZEN rather than killing
+        # the daemon.
+        self._frozen = None
+        # Reentrant: a thaw's arena-full retry runs the pressure
+        # evictor, whose demote leg re-enters the same lock.
+        self._frz_lock = make_rlock("daemon._frz_lock")
+        self.frz_counters = {
+            "demotes": 0,        # victims spilled to disk (tier_demote)
+            "promotes": 0,       # frozen entries thawed back into the arena
+            "lost": 0,           # corrupt/torn entries refused at open/read
+            "warm_boot_extents": 0,  # extents re-adopted after a restart
+        }
+        if self.config.frozen_enabled:
+            from oncilla_tpu.persist.store import FrozenStore
+
+            try:
+                self._frozen = FrozenStore(
+                    os.path.join(self.config.frozen_dir, f"r{self.rank}"),
+                    max_bytes=self.config.frozen_max_bytes,
+                )
+                self.frz_counters["lost"] = len(self._frozen.lost)
+            except OSError as e:
+                printd("daemon r%d: frozen store open failed: %s",
+                       self.rank, e)
         # Device-plane endpoint (host, port) registered by the SPMD
         # controller's client via PLANE_SERVE; device-kind data ops are
         # relayed there (tuple rebind is atomic under the GIL). The daemon
@@ -516,6 +546,10 @@ class Daemon:
         else:
             self._notify_leader()
         self._maybe_restore()
+        # Warm boot: re-adopt frozen extents that survived a hard kill
+        # (no snapshot was written) AFTER the snapshot restore, so
+        # snapshot-known entries win and only orphans are adopted.
+        self._adopt_frozen()
         t = threading.Thread(target=self._accept_loop, daemon=True, name=f"d{self.rank}-accept")
         t.start()
         self._threads.append(t)
@@ -1113,10 +1147,16 @@ class Daemon:
     # -- checkpoint / resume (SURVEY.md §5.4 upgrade) --------------------
 
     def save_snapshot(self, path: str | None = None) -> None:
-        """Persist the registry and the REMOTE_HOST arm's live bytes."""
+        """Persist the registry and the REMOTE_HOST arm's live bytes.
+
+        FROZEN entries are excluded: their payload is already durable in
+        the frozen manifest (CRC-trailed extent files), which restore
+        re-adopts via ``_adopt_frozen`` — writing them again here would
+        double-store every demoted byte and re-couple their durability
+        to the snapshot the hard-kill path never writes."""
         from oncilla_tpu.runtime import snapshot as snap
 
-        reg_entries = self.registry.snapshot()
+        reg_entries = [e for e in self.registry.snapshot() if not e.frozen]
 
         def lazy_entries():
             # Arena bytes are read per entry inside the write loop, so peak
@@ -1208,6 +1248,64 @@ class Daemon:
             "daemon %d restored %d allocations from snapshot",
             self.rank, len(sp.entries),
         )
+
+    def _adopt_frozen(self) -> None:
+        """Warm boot: re-register every surviving frozen extent (fresh
+        incarnation, same addr — PR-5/PR-12 fencing covers the epoch
+        side). Runs after ``_maybe_restore`` so a snapshot-known id is
+        never double-adopted; a hard kill writes no snapshot at all, so
+        this path alone is what upholds the durability contract — every
+        acked write demoted to FROZEN before the kill comes back.
+        Corrupt entries were already quarantined at store open (counted
+        ``lost``, never adopted, never served)."""
+        if self._frozen is None:
+            return
+        adopted = 0
+        for key in self._frozen.keys():
+            if not key.startswith("alloc-"):
+                continue  # serving/prefix extents are app-plane state
+            meta = self._frozen.meta(key)
+            if meta.get("kind") != "alloc":
+                continue
+            aid = int(meta["alloc_id"])
+            try:
+                self.registry.lookup(aid)
+                continue
+            except OcmInvalidHandle:
+                pass
+            kind = OcmKind(WIRE_KIND_INV[meta["wire_kind"]])
+            self.registry.insert(
+                RegEntry(
+                    alloc_id=aid,
+                    kind=kind,
+                    rank=self.rank,
+                    device_index=0,
+                    extent=Extent(0, 0),
+                    nbytes=int(meta["nbytes"]),
+                    origin_rank=int(meta["origin_rank"]),
+                    origin_pid=int(meta["origin_pid"]),
+                    lease_expiry=self.registry.new_lease_deadline(),
+                    priority=int(meta.get("priority", 1)),
+                    frozen=True,
+                )
+            )
+            # Same max-wins counter resync as the snapshot path: ids
+            # minted after the restart must never collide with an
+            # adopted one. id = (rank << 32) | (counter << 1).
+            self.registry.restore_counter((aid & 0xFFFFFFFF) >> 1)
+            alloctrace.note_alloc(
+                self._trace_scope, aid, int(meta["nbytes"]), kind.name
+            )
+            adopted += 1
+        self.frz_counters["warm_boot_extents"] = adopted
+        if adopted:
+            obs_journal.record(
+                "warm_boot", track=f"daemon-r{self.rank}", rank=self.rank,
+                extents=adopted, lost=len(self._frozen.lost),
+                incarnation=self.incarnation,
+            )
+            printd("daemon %d warm-booted %d frozen extents (%d lost)",
+                   self.rank, adopted, len(self._frozen.lost))
 
     def _on_note_alloc(self, msg: Message) -> Message:
         if self.is_leader:
@@ -1855,6 +1953,18 @@ class Daemon:
                 # Victim queue is sorted, but the guard stays explicit:
                 # the invariant must hold even if the ordering changes.
                 continue
+            # Demote-to-FROZEN leg (persist/): with a frozen store
+            # attached, a victim spills to disk instead of being
+            # destroyed — same victim order, same invariant, but the
+            # payload survives and the first client data op thaws it
+            # back. Replicated entries keep the destroy path (a frozen
+            # primary under a live chain would fork ownership), as does
+            # anything mid-migration. A full/unwritable store falls
+            # through to the pre-FROZEN destroy.
+            if (self._frozen is not None and not e.chain
+                    and not e.migrating
+                    and self._demote_to_frozen(e, active)):
+                continue
             try:
                 self._do_free_local(e.alloc_id)
             except OcmInvalidHandle:
@@ -1869,6 +1979,7 @@ class Daemon:
                 "qos_evict", track=self.tracer.track,
                 alloc_id=e.alloc_id, priority=e.priority, active=active,
                 nbytes=e.nbytes, origin_pid=e.origin_pid,
+                destroyed=True,
             )
             printd(
                 "daemon %d evicted alloc %d under pressure "
@@ -1876,6 +1987,92 @@ class Daemon:
                 self.rank, e.alloc_id, e.priority,
                 "active" if active else "expired", e.nbytes,
             )
+
+    def _demote_to_frozen(self, e, active: bool) -> bool:
+        """Spill one eviction victim's bytes to the frozen store and
+        release its arena extent, keeping the registry entry (marked
+        ``frozen``) so the id stays valid and leases keep renewing.
+        Returns False — caller destroys as before — when the store
+        refuses (budget) or the write fails; the entry is untouched in
+        that case (the write is atomic, tmp+replace)."""
+        with self._frz_lock:
+            if e.frozen:
+                return True  # raced with another demote
+            try:
+                data = self.host_arena.read(e.extent, e.nbytes, 0).tobytes()
+                self._frozen.write(
+                    f"alloc-{e.alloc_id}", data,
+                    meta={
+                        "kind": "alloc",
+                        "alloc_id": e.alloc_id,
+                        "wire_kind": WIRE_KIND[e.kind.value],
+                        "nbytes": e.nbytes,
+                        "origin_rank": e.origin_rank,
+                        "origin_pid": e.origin_pid,
+                        "priority": e.priority,
+                    },
+                )
+            except (OSError, OcmError) as exc:
+                printd("daemon %d: demote of %d to frozen declined: %s",
+                       self.rank, e.alloc_id, exc)
+                return False
+            self.host_arena.free(e.extent)
+            e.extent = Extent(0, 0)
+            e.frozen = True
+        self.frz_counters["demotes"] += 1
+        self.qos.note_demotion(e.priority, active)
+        obs_journal.record(
+            "tier_demote", track=self.tracer.track,
+            alloc_id=e.alloc_id, priority=e.priority, active=active,
+            nbytes=e.nbytes, origin_pid=e.origin_pid,
+            dst="frozen", destroyed=False,
+        )
+        printd(
+            "daemon %d demoted alloc %d to FROZEN under pressure "
+            "(priority %d, %s, %d B)",
+            self.rank, e.alloc_id, e.priority,
+            "active" if active else "expired", e.nbytes,
+        )
+        return True
+
+    def _thaw(self, e, _retried: bool = False) -> None:
+        """Promote a frozen entry back into the host arena (the first
+        client data op's page-fault). Rides the existing data-plane
+        handlers — a FROZEN extent is just a slow read at its owner, so
+        clients need zero new wire surface. On an arena-full fault the
+        pressure evictor runs once OUTSIDE ``_frz_lock`` (its free
+        fan-out may dial peers; it may demote OTHER victims to make
+        room) and the thaw retries once; a corrupt frozen file surfaces
+        as the typed OcmFrozenCorrupt, never as garbage bytes."""
+        import numpy as np
+
+        with self._frz_lock:
+            if not e.frozen:
+                return  # raced with another thaw
+            data = self._frozen.read_bytes(f"alloc-{e.alloc_id}")
+            try:
+                extent = self.host_arena.alloc(e.nbytes)
+            except OcmOutOfMemory:
+                if _retried:
+                    raise
+                extent = None
+            if extent is not None:
+                self.host_arena.write(
+                    extent, np.frombuffer(data, dtype=np.uint8), 0
+                )
+                e.extent = extent
+                e.frozen = False
+                self._frozen.delete(f"alloc-{e.alloc_id}")
+        if extent is None:
+            self._pressure_evict()
+            self._thaw(e, _retried=True)
+            return
+        self.frz_counters["promotes"] += 1
+        obs_journal.record(
+            "tier_promote", track=self.tracer.track,
+            alloc_id=e.alloc_id, priority=e.priority,
+            nbytes=e.nbytes, origin_pid=e.origin_pid, src="frozen",
+        )
 
     def _feed_load_stats(self) -> None:
         """Rank-0, policy="loadaware" only: refresh the placement
@@ -2918,7 +3115,13 @@ class Daemon:
                            "backstop)", self.rank, alloc_id, target)
             self.qos.release(alloc_id)
             return
-        if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
+        if e.frozen:
+            # The payload lives on disk, not in the arena: freeing the
+            # entry deletes its frozen file (idempotent) — the one
+            # legitimate way a frozen extent's bytes are destroyed.
+            if self._frozen is not None:
+                self._frozen.delete(f"alloc-{e.alloc_id}")
+        elif e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             self.host_arena.free(e.extent)
         else:
             # Scrub-at-free for device extents, BEFORE the offset returns
@@ -3037,6 +3240,8 @@ class Daemon:
             e = self.registry.lookup(f["alloc_id"])
             if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
                 return None  # device relay needs the payload as a message
+            if e.frozen:
+                return None  # no arena extent yet; the handler thaws
             if (
                 not e.is_primary(self.rank) or e.migrating
             ) and not msg.flags & FLAG_FANOUT:
@@ -3102,6 +3307,8 @@ class Daemon:
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
         self._check_data_role(e, msg)
+        if e.frozen:
+            self._thaw(e)
         if len(msg.data) != f["nbytes"]:
             raise OcmProtocolError("DATA_PUT length mismatch")
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
@@ -3220,6 +3427,10 @@ class Daemon:
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             return self._relay_device_op(msg, e)
         self._check_data_role(e, msg)
+        if e.frozen:
+            # Promotion rides the existing get path: the FROZEN extent
+            # is just a slow read at its owner (thaw, then serve).
+            self._thaw(e)
         check_bounds(Extent(e.extent.offset, e.nbytes), f["offset"], f["nbytes"])
         # One-copy reply payload: SNAPSHOT the extent bytes at handler
         # time (a live view would keep streaming the arena for the whole
@@ -3277,6 +3488,11 @@ class Daemon:
                 "shm fabric serves host-kind allocations only"
             )
         self._check_data_role(e, msg)
+        if e.frozen:
+            # The client's memcpy needs a live arena extent; SHM_MAP
+            # replies with the thawed offset, so stale-mapping checks
+            # below always see the post-thaw extent.
+            self._thaw(e)
         if "ext_offset" in f:
             if f["ext_offset"] != e.extent.offset:
                 raise OcmInvalidHandle(
@@ -3958,6 +4174,10 @@ class Daemon:
         e = self._lookup_serving(f["alloc_id"])
         if e.kind not in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST):
             raise OcmInvalidHandle("only host-kind allocations migrate")
+        if e.frozen:
+            # Migration streams from the arena: thaw first (the target
+            # receives a plain live copy — FROZEN is owner-local state).
+            self._thaw(e)
         if not e.is_primary(self.rank):
             raise OcmInvalidHandle(
                 f"rank {self.rank} is not primary for alloc {f['alloc_id']}"
@@ -4380,6 +4600,7 @@ class Daemon:
             "elastic": self._elastic_meta(),
             "mux": self._mux_meta(),
             "timebudget": dict(self.tb_counters),
+            "frozen": self._frozen_meta(),
             # Arena capacities (control/): what a promoted leader's
             # whole-resync reads to rebuild placement accounting from
             # the survivors' own numbers.
@@ -4438,6 +4659,21 @@ class Daemon:
             "counters": dict(self.fabric_counters),
         }
 
+    def _frozen_meta(self) -> dict | None:
+        """FROZEN-tier counters + live occupancy for STATUS and the
+        ocm_frozen_* prom families. None (omitted by render) when the
+        tier is off — the STATUS tail is then byte-identical to the
+        pre-persist daemon's."""
+        if self._frozen is None:
+            return None
+        return {
+            **self.frz_counters,
+            "lost": len(self._frozen.lost),
+            "bytes": self._frozen.bytes_stored,
+            "extents": len(self._frozen.keys()),
+            "max_bytes": self._frozen.max_bytes,
+        }
+
     def _serving_meta(self) -> dict | None:
         """Co-located serving-engine stats (serving/metrics.py): an
         engine in THIS process publishes its counters and the daemon
@@ -4477,6 +4713,7 @@ class Daemon:
             "elastic": self._elastic_meta(),
             "mux": self._mux_meta(),
             "timebudget": dict(self.tb_counters),
+            "frozen": self._frozen_meta(),
             "serving": self._serving_meta(),
         }
 

@@ -53,6 +53,14 @@ class RegEntry:
     # a half-streamed copy must never serve or fork a chain.
     migrating: bool = False
     migrate_src: int = -1
+    # FROZEN tier (persist/): True while the payload lives in the
+    # daemon's FrozenStore instead of the host arena. ``extent`` is a
+    # zero placeholder meanwhile (the arena bytes were freed at
+    # demotion); the first client data op thaws the entry back into the
+    # arena. A frozen entry is never an eviction candidate — it holds
+    # no arena bytes, and destroying it would silently lose durable
+    # payload (the audit's eviction-priority invariant pins this).
+    frozen: bool = False
 
     def is_primary(self, self_rank: int) -> bool:
         """Primary = unreplicated owner, or first member of the chain."""
@@ -285,6 +293,7 @@ class AllocRegistry:
                 e for e in self._entries.values()
                 if e.kind in (OcmKind.REMOTE_HOST, OcmKind.LOCAL_HOST)
                 and e.is_primary(self_rank)
+                and not e.frozen
             ]
         cands.sort(
             key=lambda e: (e.lease_expiry >= now, e.priority, e.lease_expiry)

@@ -77,7 +77,8 @@ def _build_engine(cfg, params, *, page_tokens: int, hot: int, warm: int,
                   cold_client, share: bool, name: str,
                   prefetch_workers: int, max_active: int = 4,
                   batched: bool | None = None,
-                  max_batch: int | None = None):
+                  max_batch: int | None = None,
+                  frozen_backend=None):
     import oncilla_tpu as ocm
 
     from oncilla_tpu.serving.engine import ServingEngine
@@ -94,6 +95,7 @@ def _build_engine(cfg, params, *, page_tokens: int, hot: int, warm: int,
     store = TieredPageStore(
         ctx, page_bytes, hot_capacity=hot, warm_capacity=warm,
         cold_backend=cold_client, stats=ServingStats(name),
+        frozen_backend=frozen_backend,
     )
     prefix = PrefixCache(store, page_tokens) if share else None
     engine = ServingEngine(
@@ -108,7 +110,7 @@ def _run_cell(cl, cfg, params, *, share: bool, prompts, new_tokens: int,
               page_tokens: int, hot: int, warm: int,
               prefetch_workers: int, name: str, mux: bool = False,
               max_active: int = 4, batched: bool | None = None,
-              max_batch: int | None = None) -> dict:
+              max_batch: int | None = None, frozen_backend=None) -> dict:
     """One measured cell: a tenant fleet decoded to completion through
     one engine. Returns outputs + the engine's metric snapshot."""
     from oncilla_tpu.serving.engine import Request
@@ -119,6 +121,7 @@ def _run_cell(cl, cfg, params, *, share: bool, prompts, new_tokens: int,
         cold_client=cold, share=share, name=name,
         prefetch_workers=prefetch_workers, max_active=max_active,
         batched=batched, max_batch=max_batch,
+        frozen_backend=frozen_backend,
     )
     try:
         for t, toks in enumerate(prompts):
@@ -150,6 +153,7 @@ def _run_cell(cl, cfg, params, *, share: bool, prompts, new_tokens: int,
             "cold_sim": meta["cold_sim"],
             "batch": meta["batch"],
             "preempts": meta["preempts"],
+            "ttft": meta["ttft"],
         }
     finally:
         engine.close()
@@ -489,6 +493,147 @@ def run_chaos(seed: int, *, new_tokens: int = 24, page_tokens: int = 8,
     }
 
 
+def run_warmboot(seed: int, *, tenants: int = 3, shared_tokens: int = 20,
+                 suffix_tokens: int = 4, new_tokens: int = 8,
+                 page_tokens: int = 8, hot: int = 12, warm: int = 8,
+                 prefetch_workers: int = 2) -> dict:
+    """The FROZEN-tier warm-boot cell (ROADMAP item 5): the same tenant
+    fleet decodes through four arms on one cluster —
+
+    - **ref**: no frozen backend, never restarted — the byte-exact
+      reference (``OCM_FROZEN`` off must equal it too);
+    - **seeded**: a frozen dir attached; engine close persists the
+      prefix trie to disk;
+    - chaos ``restart`` then hard-kills EVERY daemon and relaunches a
+      fresh incarnation at the same address (no snapshot — only the
+      disk manifest survives);
+    - **cold**: post-restart, NO frozen backend — the baseline a
+      restart without the persist/ subsystem would pay;
+    - **warm**: post-restart, the seeded dir — the engine re-publishes
+      the persisted extents at boot, so prefill rides pages computed by
+      the previous incarnation. A discarded jit-warmup pass runs first
+      (the batched-sweep discipline): resuming prefill mid-prefix is a
+      shape the cold arms never compile, and TTFT must measure skipped
+      prefill work, not one XLA compile. For the same reason the hot
+      tier is sized above the restored working set — a restored page
+      that lands in the COLD tier pays a loopback-DCN fetch per hit,
+      which on a tiny CPU model dwarfs the prefill it skipped; the
+      tier-churn axis belongs to the paired cells, not this one.
+
+    Asserts every arm's decode is byte-exact vs ref, the warm arm's
+    prefix hit ratio is STRICTLY higher and its mean TTFT STRICTLY
+    lower than the cold arm's, and the whole scenario replays
+    identically (chaos log + outputs) a second time."""
+    import tempfile
+
+    from oncilla_tpu.persist import FrozenStore
+    from oncilla_tpu.resilience.chaos import ChaosController, ChaosSchedule
+    from oncilla_tpu.runtime.cluster import local_cluster
+
+    cfg, params = _tiny_model()
+    prompts = _prompts(seed, tenants, shared_tokens, suffix_tokens,
+                       cfg.vocab)
+    prompt_tokens = sum(len(p) for p in prompts)
+
+    def cell(cl, name, frozen_dir):
+        return _run_cell(
+            cl, cfg, params, share=True, prompts=prompts,
+            new_tokens=new_tokens, page_tokens=page_tokens, hot=hot,
+            warm=warm, prefetch_workers=prefetch_workers, name=name,
+            frozen_backend=FrozenStore(frozen_dir) if frozen_dir else None,
+        )
+
+    def scenario():
+        from oncilla_tpu.analysis import alloctrace
+
+        alloctrace.reset()
+        with tempfile.TemporaryDirectory() as tmp:
+            seed_dir = os.path.join(tmp, "seeded")
+            with local_cluster(3, config=_cluster_cfg()) as cl:
+                ref = cell(cl, "serve-warmboot-ref", None)
+                seeded = cell(cl, "serve-warmboot-seed", seed_dir)
+                persisted = sum(
+                    1 for k in FrozenStore(seed_dir).keys()
+                    if k.startswith("prefix-")
+                )
+                if persisted == 0:
+                    raise AssertionError(
+                        "seeding arm persisted no prefix extents"
+                    )
+                controller = ChaosController(
+                    ChaosSchedule(seed=seed), cl.entries,
+                    restart_fn=cl.restart,
+                )
+                for r in range(len(cl.daemons)):
+                    controller.force("restart", r)
+                coldarm = cell(cl, "serve-warmboot-cold", None)
+                cell(cl, "serve-warmboot-jitwarm", seed_dir)  # discarded
+                warmarm = cell(cl, "serve-warmboot-warm", seed_dir)
+                drained = _assert_drained(cl)
+        return {
+            "ref": ref, "seeded": seeded, "cold": coldarm,
+            "warm": warmarm, "persisted": persisted,
+            "log": list(controller.log), "drained": drained,
+        }
+
+    def phr(c) -> float:
+        return round(c["prefix_tokens_reused"] / prompt_tokens, 4)
+
+    def ttft_mean(c) -> float:
+        t = c["ttft"]
+        return round(t["sum_s"] / t["count"], 6) if t["count"] else 0.0
+
+    r1 = scenario()
+    r2 = scenario()
+    for run in (r1, r2):
+        for arm in ("seeded", "cold", "warm"):
+            if run[arm]["outputs"] != run["ref"]["outputs"]:
+                raise AssertionError(
+                    f"{arm} arm decode is not byte-exact vs the "
+                    f"never-restarted reference"
+                )
+        if phr(run["warm"]) <= phr(run["cold"]):
+            raise AssertionError(
+                f"warm boot did not raise the prefix hit ratio "
+                f"({phr(run['warm'])} vs cold {phr(run['cold'])})"
+            )
+        if ttft_mean(run["warm"]) >= ttft_mean(run["cold"]):
+            raise AssertionError(
+                f"warm boot did not cut mean TTFT "
+                f"({ttft_mean(run['warm'])}s vs cold "
+                f"{ttft_mean(run['cold'])}s)"
+            )
+    if (r1["log"], {a: r1[a]["outputs"] for a in ("ref", "cold", "warm")}
+            ) != (r2["log"],
+                  {a: r2[a]["outputs"] for a in ("ref", "cold", "warm")}):
+        raise AssertionError(
+            f"warm-boot scenario replay diverged: {r1['log']} vs "
+            f"{r2['log']}"
+        )
+    for arm in ("ref", "seeded", "cold", "warm"):
+        r1[arm].pop("outputs")
+    return {
+        "seed": seed,
+        "tenants": tenants,
+        "prompt_tokens": prompt_tokens,
+        "restarted_ranks": sorted({r for _, a, r in r1["log"]
+                                   if a == "restart"}),
+        "persisted_extents": r1["persisted"],
+        "cells": {a: r1[a] for a in ("ref", "seeded", "cold", "warm")},
+        "prefix_hit_ratio": {"cold": phr(r1["cold"]),
+                             "warm": phr(r1["warm"])},
+        "ttft_mean_s": {"cold": ttft_mean(r1["cold"]),
+                        "warm": ttft_mean(r1["warm"])},
+        "byte_exact": True,
+        "deterministic_replay": True,
+        "chaos_log": [list(t) for t in r1["log"]],
+        "note": (
+            "1-core CPU container: TTFT deltas show prefill work "
+            "skipped via restored extents, not chip latency"
+        ),
+    }
+
+
 def smoke(seed: int, mux: bool | None = None) -> int:
     from oncilla_tpu.analysis import alloctrace
     from oncilla_tpu.obs import audit as obs_audit
@@ -563,9 +708,23 @@ def smoke(seed: int, mux: bool | None = None) -> int:
     print(f"  owner rank {chaos['owner_killed']} killed; "
           f"{chaos['tokens']} tokens byte-exact through failover; "
           f"chaos log {chaos['chaos_log']}")
+
+    print(f"serving smoke: warm-boot leg (persist prefix trie, chaos "
+          f"restart of every daemon, cold-vs-warm arms), seed={seed}, "
+          f"two audited runs ...")
+    with obs_audit.recorded("serving-warmboot") as rec:
+        wb = run_warmboot(seed)
+    print(f"  flight recorder: {rec.summary()}")
+    print(f"  {wb['persisted_extents']} extents persisted; ranks "
+          f"{wb['restarted_ranks']} restarted; prefix hit ratio "
+          f"cold {wb['prefix_hit_ratio']['cold']} -> warm "
+          f"{wb['prefix_hit_ratio']['warm']}; mean TTFT "
+          f"cold {wb['ttft_mean_s']['cold']}s -> warm "
+          f"{wb['ttft_mean_s']['warm']}s; byte-exact, replay identical")
     print("serving smoke: OK — paired cells byte-identical, sharing "
           "measurably cheaper, CoW exercised, chaos decode byte-exact "
-          "with deterministic replay, audit clean, ledger drained")
+          "with deterministic replay, warm boot beats cold restart, "
+          "audit clean, ledger drained")
     return 0
 
 
@@ -587,6 +746,9 @@ def run_bench(seed: int = 1234, *, chaos: bool = True,
         with obs_audit.recorded("serving-bench-chaos") as rec:
             out["chaos"] = run_chaos(seed, new_tokens=16, hot=2, warm=2)
         out["chaos"]["audit"] = rec.summary()
+    with obs_audit.recorded("serving-bench-warmboot") as rec:
+        out["warmboot"] = run_warmboot(seed)
+    out["warmboot"]["audit"] = rec.summary()
     out["note"] = (
         "1-core CPU container: tok/s is relative evidence, not a chip "
         "number; remote tier is a loopback daemon pair"

@@ -329,6 +329,14 @@ class ServingEngine:
                 f"{expect} (cfg/page_tokens/store_dtype mismatch)"
             )
         serving_metrics.publish(self.stats)
+        # Warm boot (persist/, ROADMAP item 5): a store built over a
+        # FrozenStore re-publishes the prefix extents a previous engine
+        # incarnation persisted at close — cross-restart prefix hits
+        # without recomputing a single prompt page. No backend (the
+        # default everywhere) → byte-identical cold behavior.
+        if (self.prefix is not None
+                and getattr(store, "frozen_backend", None) is not None):
+            self.prefix.restore(store.frozen_backend)
 
     @staticmethod
     def page_nbytes(cfg, page_tokens: int,
@@ -386,6 +394,15 @@ class ServingEngine:
         for sess in self.active:
             self._finish(sess, abandon=True)
         self.active = []
+        # Persist the prefix trie into the frozen tier (if one backs
+        # the store) BEFORE the prefetcher drains: the pages are still
+        # readable, and the next incarnation's __init__ restores them.
+        if (self.prefix is not None
+                and getattr(self.store, "frozen_backend", None) is not None):
+            try:
+                self.prefix.persist(self.store.frozen_backend)
+            except OSError:
+                pass  # a full/broken disk must never wedge shutdown
         self.prefetcher.close()
         serving_metrics.unpublish(self.stats)
 

@@ -14,6 +14,13 @@ pages live in exactly one of three tiers —
   plane (REMOTE_HOST through a ``ControlPlaneClient`` — or, when the
   store runs without a control plane, a LOCAL_HOST stand-in flagged
   ``cold_sim`` so a benchmark can never mistake loopback for DCN).
+- ``FROZEN`` — disk, via an attached :class:`~oncilla_tpu.persist.
+  FrozenStore` (``frozen_backend``). The fourth rung (ROADMAP item 5):
+  watermark demotion spills COLD victims to CRC-trailed extent files
+  instead of destroying them, and a persisted prefix cache restores
+  from the same store on warm boot. No backend attached (the default)
+  = the tier has zero capacity and every code path is byte-identical
+  to the three-tier store.
 
 Movement is **watermark-driven**: each bounded tier demotes LRU pages to
 the next tier down when occupancy crosses its high watermark, down to
@@ -43,7 +50,11 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from oncilla_tpu.core.errors import OcmError, OcmInvalidHandle
+from oncilla_tpu.core.errors import (
+    OcmError,
+    OcmInvalidHandle,
+    OcmOutOfMemory,
+)
 from oncilla_tpu.core.handle import OcmAlloc
 from oncilla_tpu.core.kinds import OcmKind
 from oncilla_tpu.obs import journal as obs_journal
@@ -56,18 +67,30 @@ class Tier(enum.Enum):
     HOT = "hbm"
     WARM = "host"
     COLD = "remote"
+    FROZEN = "frozen"
 
 
 #: The PR-6 QoS mapping: what priority class each tier's allocations
 #: should declare at CONNECT, so daemon-side pressure eviction and the
-#: serving-side evictor enforce one policy.
+#: serving-side evictor enforce one policy. FROZEN shares PRIO_LOW with
+#: COLD: both are the preferred victims; FROZEN is just the rung where
+#: "victim" stops meaning "destroyed".
 TIER_PRIORITY = {
     Tier.HOT: PRIO_HIGH,
     Tier.WARM: PRIO_NORMAL,
     Tier.COLD: PRIO_LOW,
+    Tier.FROZEN: PRIO_LOW,
 }
 
-_ORDER = (Tier.HOT, Tier.WARM, Tier.COLD)
+_ORDER = (Tier.HOT, Tier.WARM, Tier.COLD, Tier.FROZEN)
+
+
+@dataclass(frozen=True)
+class FrozenPageHandle:
+    """Handle for a FROZEN-resident page: the store key of its extent
+    file (no arena offset exists — disk is addressed by name)."""
+
+    key: str
 
 
 @dataclass
@@ -109,18 +132,44 @@ class TieredPageStore:
         high_pct: int = 90,
         low_pct: int = 70,
         stats: ServingStats | None = None,
+        frozen_backend=None,
+        cold_capacity: int | None = None,
     ):
         self.ctx = ctx
         self.page_bytes = int(page_bytes)
+        # COLD is unbounded in the three-tier store (it is the floor);
+        # with a frozen backend attached it must be finite or nothing
+        # would ever spill to disk. FROZEN with no backend has zero
+        # capacity: every pre-persist code path is untouched.
+        if cold_capacity is None:
+            cold_capacity = (
+                (1 << 30) if frozen_backend is None
+                else max(2 * int(warm_capacity), 1)
+            )
         self.capacity = {Tier.HOT: int(hot_capacity),
                          Tier.WARM: int(warm_capacity),
-                         Tier.COLD: 1 << 30}
+                         Tier.COLD: int(cold_capacity),
+                         Tier.FROZEN: (1 << 30) if frozen_backend is not None
+                         else 0}
         self.high_pct = high_pct
         self.low_pct = low_pct
         self.cold_backend = cold_backend
+        self.frozen_backend = frozen_backend
         #: True when COLD is simulated in the local host arena (no
         #: control plane attached): benchmarks must label the cell.
         self.cold_sim = cold_backend is None
+        # Ephemeral frozen page keys continue past any leftover
+        # ``page-N`` files from a prior run so a stale extent is never
+        # silently overwritten by an unrelated page.
+        frz_start = 0
+        if frozen_backend is not None:
+            for k in frozen_backend.keys():
+                if k.startswith("page-"):
+                    try:
+                        frz_start = max(frz_start, int(k[5:]))
+                    except ValueError:
+                        pass
+        self._frz_ids = itertools.count(frz_start + 1)
         self.stats = stats or ServingStats()
         self.pages: dict[int, Page] = {}
         self._ids = itertools.count(1)
@@ -138,19 +187,31 @@ class TieredPageStore:
             return self.ctx.alloc(self.page_bytes, OcmKind.LOCAL_DEVICE)
         if tier == Tier.WARM:
             return self.ctx.alloc(self.page_bytes, OcmKind.LOCAL_HOST)
+        if tier == Tier.FROZEN:
+            if self.frozen_backend is None:
+                raise OcmError("no frozen backend attached")
+            if not self.frozen_backend.has_room(self.page_bytes):
+                raise OcmOutOfMemory("frozen store budget exhausted")
+            return FrozenPageHandle(f"page-{next(self._frz_ids)}")
         if self.cold_backend is not None:
             return self.cold_backend.alloc(self.page_bytes,
                                            OcmKind.REMOTE_HOST)
         return self.ctx.alloc(self.page_bytes, OcmKind.LOCAL_HOST)
 
     def _free_handle(self, tier: Tier, handle: OcmAlloc) -> None:
-        if tier == Tier.COLD and self.cold_backend is not None:
+        if tier == Tier.FROZEN:
+            self.frozen_backend.delete(handle.key)
+        elif tier == Tier.COLD and self.cold_backend is not None:
             self.cold_backend.free(handle)
         else:
             self.ctx.free(handle)
 
     def _put(self, tier: Tier, handle: OcmAlloc, data: np.ndarray) -> None:
-        if tier == Tier.COLD and self.cold_backend is not None:
+        if tier == Tier.FROZEN:
+            self.frozen_backend.write(
+                handle.key, np.asarray(data).tobytes(), meta={"kind": "page"}
+            )
+        elif tier == Tier.COLD and self.cold_backend is not None:
             self.cold_backend.put(handle, data, 0)
             self.stats.note_remote(data.nbytes, inbound=False)
         else:
@@ -161,6 +222,16 @@ class TieredPageStore:
         """Read a page's bytes, landing in ``out`` when given (the
         registered-receive path: ``get_into`` on the DCN leg, ``get(out=)``
         through the context)."""
+        if tier == Tier.FROZEN:
+            # A slow CRC-verified read; OcmFrozenCorrupt propagates
+            # typed — a corrupt extent is refused, never served.
+            raw = np.frombuffer(
+                self.frozen_backend.read_bytes(handle.key), dtype=np.uint8
+            )
+            if out is not None:
+                out[:nbytes] = raw[:nbytes]
+                return out[:nbytes]
+            return raw[:nbytes].copy()
         if tier == Tier.COLD and self.cold_backend is not None:
             if out is not None:
                 get_into = getattr(self.cold_backend, "get_into", None)
@@ -413,6 +484,8 @@ class TieredPageStore:
     def _make_room(self, tier: Tier) -> None:
         """Demote until ``tier`` has a free slot (promotion headroom)."""
         nxt = {Tier.HOT: Tier.WARM, Tier.WARM: Tier.COLD}.get(tier)
+        if tier == Tier.COLD and self.frozen_backend is not None:
+            nxt = Tier.FROZEN
         if nxt is None:
             return
         while len(self._live(tier)) >= self.capacity[tier]:
@@ -425,8 +498,12 @@ class TieredPageStore:
     def enforce_watermarks(self) -> None:
         """High/low watermark demotion per bounded tier, exactly the
         daemon reaper's ``_pressure_evict`` shape: past high, demote
-        LRU victims down to low."""
-        for tier, nxt in ((Tier.HOT, Tier.WARM), (Tier.WARM, Tier.COLD)):
+        LRU victims down to low. With a frozen backend attached, COLD is
+        bounded too and spills to disk — the demote-to-FROZEN leg."""
+        pairs = [(Tier.HOT, Tier.WARM), (Tier.WARM, Tier.COLD)]
+        if self.frozen_backend is not None:
+            pairs.append((Tier.COLD, Tier.FROZEN))
+        for tier, nxt in pairs:
             cap = self.capacity[tier]
             # Floor at one page: integer watermark math on a tiny tier
             # must never read "demote everything, always".

@@ -309,6 +309,25 @@ class OcmConfig:
         default_factory=lambda: _env_int("OCM_MIGRATE_CHUNK", 1 << 20)
     )
 
+    # FROZEN tier (persist/): disk-backed fourth arena tier below COLD.
+    # OCM_FROZEN_DIR names the root directory (each daemon uses the
+    # subdirectory r<rank>); unset leaves the tier off entirely — no
+    # FrozenStore is constructed and behavior (and the wire) is
+    # byte-identical to a build without persist/. OCM_FROZEN=0 is the
+    # hard off-switch even with a dir configured (the usual pinned
+    # escape hatch). OCM_FROZEN_MAX_BYTES bounds the payload bytes per
+    # store (0 = unbounded); writes past the budget fall back to the
+    # pre-FROZEN destroy path.
+    frozen: bool = field(
+        default_factory=lambda: bool(_env_int("OCM_FROZEN", 1))
+    )
+    frozen_dir: str | None = field(
+        default_factory=lambda: os.environ.get("OCM_FROZEN_DIR") or None
+    )
+    frozen_max_bytes: int = field(
+        default_factory=lambda: _env_int("OCM_FROZEN_MAX_BYTES", 0)
+    )
+
     # Client CONNECT retry: a daemon restarting mid-failover refuses
     # connections for a beat; the app-side client retries with capped
     # exponential backoff + jitter instead of surfacing a hard connect
@@ -453,6 +472,11 @@ class OcmConfig:
                 f"leader_lease_s must be > 0 (got {self.leader_lease_s}) — "
                 "a zero lease makes every replicated state copy stale"
             )
+        if self.frozen_max_bytes < 0:
+            raise ValueError(
+                f"frozen_max_bytes must be >= 0 (got "
+                f"{self.frozen_max_bytes}); 0 = unbounded"
+            )
         if self.placement not in ("leader", "hash"):
             raise ValueError(
                 f"placement must be 'leader' or 'hash' (got "
@@ -460,6 +484,14 @@ class OcmConfig:
                 "PR-11 plan shape, 'hash' computes host-kind placements "
                 "at the origin daemon by rendezvous hashing"
             )
+
+    @property
+    def frozen_enabled(self) -> bool:
+        """Whether this daemon runs a FROZEN tier: a directory is
+        configured AND the OCM_FROZEN off-switch is not thrown. False
+        keeps demotion/eviction byte-identical to the pre-persist
+        behavior (victims destroyed, ``qos_evict`` only)."""
+        return self.frozen and self.frozen_dir is not None
 
     @property
     def fabric_offer(self) -> bool:

@@ -143,6 +143,18 @@ JAX_PLATFORMS=cpu python -m oncilla_tpu.resilience --deadline-smoke || fail=1
 # chain member: strictly lower hedged p99 at equal byte-exactness.
 JAX_PLATFORMS=cpu python -m oncilla_tpu.benchmarks.dcn --hedge --smoke || fail=1
 
+echo "== persist smoke =="
+# FROZEN tier (persist/): FrozenStore CRC round-trip + corrupt-entry
+# typed refusal (quarantined WHOLE, reported lost), then the full
+# demote -> chaos restart -> warm-boot -> promote loop on a live
+# daemon: acked PRIO_LOW writes spill to disk under arena pressure,
+# a hard kill + same-address relaunch re-adopts every surviving
+# extent, the same handles read byte-exact from the fresh
+# incarnation, and frees drain the frozen dir, the registry, and the
+# alloctrace ledger. Two runs with identical seeded interleavings,
+# each wrapped in the flight-recorder invariant audit. CPU-only.
+JAX_PLATFORMS=cpu python -m oncilla_tpu.persist --smoke || fail=1
+
 echo "== serving smoke =="
 # Flagship serving workload (serving/): paired shared-vs-noshare decode
 # cells over a 3-daemon cluster (outputs must be byte-identical, sharing

@@ -1,0 +1,383 @@
+"""persist/ — the FROZEN tier: disk-backed arenas + restart-surviving
+state (ROADMAP item 5).
+
+Covers the store's refuse-whole CRC discipline, the serving-side spill
+rung, the daemon's demote/thaw legs with the ``tier_demote`` vs
+``qos_evict`` journal split, warm-boot re-adoption through the chaos
+``restart`` action, and the ``OCM_FROZEN`` off-switch. Cluster legs run
+a 1-node ``local_cluster`` with ``priority=0`` — demotion NEVER touches
+an active above-low entry, so only a PRIO_LOW client's allocations are
+legal pressure victims while its leases renew.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import oncilla_tpu as ocm
+from oncilla_tpu import OcmKind
+from oncilla_tpu.core.errors import (
+    OcmError,
+    OcmInvalidHandle,
+    OcmOutOfMemory,
+)
+from oncilla_tpu.persist import FrozenStore, OcmFrozenCorrupt
+from oncilla_tpu.persist.store import _fname
+from oncilla_tpu.resilience.chaos import corrupt_file
+from oncilla_tpu.runtime.cluster import local_cluster
+from oncilla_tpu.utils.config import OcmConfig
+
+PB = 4096
+
+
+@pytest.fixture
+def journal():
+    from oncilla_tpu.obs import journal as obs_journal
+
+    prev = obs_journal.enabled()
+    obs_journal.set_enabled(True)
+    yield obs_journal
+    obs_journal.set_enabled(prev)
+
+
+# -- FrozenStore -------------------------------------------------------------
+
+
+def test_store_roundtrip_and_reopen(tmp_path):
+    st = FrozenStore(str(tmp_path))
+    payload = bytes(range(256)) * 16
+    st.write("alloc-7", payload, meta={"alloc_id": 7, "nbytes": len(payload)})
+    assert st.read_bytes("alloc-7") == payload
+    assert st.meta("alloc-7")["alloc_id"] == 7
+    assert st.payload_nbytes("alloc-7") == len(payload)
+    # A fresh open re-adopts from disk alone.
+    re = FrozenStore(str(tmp_path))
+    data, meta = re.read("alloc-7")
+    assert data == payload and meta["nbytes"] == len(payload)
+    assert re.keys() == ["alloc-7"] and not re.lost
+    # Overwrite replaces, delete is idempotent.
+    st.write("alloc-7", b"v2")
+    assert st.read_bytes("alloc-7") == b"v2"
+    st.delete("alloc-7")
+    st.delete("alloc-7")
+    assert not st.has("alloc-7")
+    with pytest.raises(OcmInvalidHandle):
+        st.read("alloc-7")
+
+
+def test_store_budget_is_typed_oom(tmp_path):
+    st = FrozenStore(str(tmp_path), max_bytes=1024)
+    st.write("alloc-1", b"x" * 1000)
+    assert not st.has_room(100)
+    with pytest.raises(OcmOutOfMemory):
+        st.write("alloc-2", b"y" * 100)
+    # The refused write left no file behind; the budget frees with data.
+    assert st.keys() == ["alloc-1"]
+    st.delete("alloc-1")
+    st.write("alloc-2", b"y" * 100)
+    assert st.bytes_stored == 100
+
+
+def test_corrupt_entry_refused_whole_and_reported_lost(tmp_path):
+    st = FrozenStore(str(tmp_path))
+    st.write("alloc-1", b"a" * 500)
+    st.write("alloc-2", b"b" * 500)
+    corrupt_file(str(tmp_path / _fname("alloc-1")), offset=100)
+    # Open-time scan: quarantined + on ``lost``, the healthy entry kept.
+    re = FrozenStore(str(tmp_path))
+    assert [ls.key for ls in re.lost] == ["alloc-1"]
+    assert re.lost[0].path.endswith(".corrupt")
+    assert not re.has("alloc-1") and re.read_bytes("alloc-2") == b"b" * 500
+    # Read-time rot on a live store: the typed refusal, never garbage,
+    # and OcmFrozenCorrupt is an OcmError so wire code can map it.
+    assert issubclass(OcmFrozenCorrupt, OcmError)
+    with pytest.raises(OcmFrozenCorrupt):
+        st.read("alloc-1")
+    assert [ls.key for ls in st.lost] == ["alloc-1"]
+    assert not st.has("alloc-1")
+
+
+def test_torn_tmp_and_truncated_files_refused(tmp_path):
+    st = FrozenStore(str(tmp_path))
+    st.write("alloc-1", b"a" * 100)
+    (tmp_path / (_fname("alloc-2") + ".tmp")).write_bytes(b"half a write")
+    (tmp_path / _fname("alloc-3")).write_bytes(b"OC")  # torn header
+    re = FrozenStore(str(tmp_path))
+    assert re.keys() == ["alloc-1"]
+    assert [ls.key for ls in re.lost] == ["alloc-3"]
+    # The tmp orphan is gone (the replace never happened).
+    assert not (tmp_path / (_fname("alloc-2") + ".tmp")).exists()
+
+
+def test_unsafe_keys_refused_early(tmp_path):
+    st = FrozenStore(str(tmp_path))
+    for bad in ("", "../escape", "a/b", "a b"):
+        with pytest.raises(ValueError):
+            st.write(bad, b"x")
+
+
+def test_frozen_enabled_config(tmp_path, monkeypatch):
+    assert not OcmConfig().frozen_enabled  # no dir -> off
+    assert OcmConfig(frozen_dir=str(tmp_path)).frozen_enabled
+    assert not OcmConfig(frozen_dir=str(tmp_path), frozen=False).frozen_enabled
+    monkeypatch.setenv("OCM_FROZEN", "0")
+    monkeypatch.setenv("OCM_FROZEN_DIR", str(tmp_path))
+    assert not OcmConfig().frozen_enabled  # the emergency off-switch
+
+
+# -- serving tiers: the fourth rung ------------------------------------------
+
+
+def make_store(tmp_path, hot=1, warm=1, **kw):
+    from oncilla_tpu.serving.metrics import ServingStats
+    from oncilla_tpu.serving.tiers import TieredPageStore
+
+    ctx = ocm.Ocm(config=ocm.OcmConfig(
+        host_arena_bytes=1 << 20, device_arena_bytes=1 << 20,
+    ))
+    frozen = FrozenStore(str(tmp_path))
+    store = TieredPageStore(ctx, PB, hot_capacity=hot, warm_capacity=warm,
+                            stats=ServingStats("test-frozen"),
+                            frozen_backend=frozen, **kw)
+    return ctx, store, frozen
+
+
+def page_data(seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 256, PB, dtype=np.uint8)
+
+
+def test_pages_spill_to_frozen_and_read_byte_exact(tmp_path):
+    from oncilla_tpu.serving.tiers import Tier
+
+    ctx, store, frozen = make_store(tmp_path, hot=1, warm=1)
+    try:
+        datas = [page_data(i) for i in range(6)]
+        pages = [store.alloc_page(d) for d in datas]
+        # hot 1 + warm 1 + cold 2 (finite once a frozen backend is
+        # attached): the overflow reached disk.
+        assert any(p.tier == Tier.FROZEN for p in pages)
+        assert frozen.keys()  # real files, not just a tier label
+        for p, d in zip(pages, datas):
+            assert bytes(store.read_page(p)) == d.tobytes(), p.tier
+        occ = store.occupancy()
+        assert occ["frozen"]["pages"] >= 1
+        for p in pages:
+            store.free_page(p)
+        assert not frozen.keys()  # frees drain the disk manifest too
+    finally:
+        store.close()
+        ctx.tini()
+
+
+def test_referenced_shared_extent_never_frozen(tmp_path):
+    from oncilla_tpu.serving.tiers import Tier
+
+    ctx, store, _frozen = make_store(tmp_path, hot=1, warm=1)
+    try:
+        d0 = page_data(0)
+        shared = store.alloc_page(d0, shared=True)
+        shared.refs += 1  # a live prefix-cache reference
+        for i in range(1, 7):  # pressure that spills everyone else
+            store.alloc_page(page_data(i))
+        # The referenced shared page never left its rung — freezing it
+        # mid-use would stall every tenant attending to it.
+        assert shared.tier == Tier.HOT
+        assert bytes(store.read_page(shared)) == d0.tobytes()
+        with pytest.raises(OcmError):
+            store.write_page(shared, page_data(9))
+    finally:
+        store.close()
+        ctx.tini()
+
+
+def test_frozen_leftovers_do_not_collide_with_new_pages(tmp_path):
+    from oncilla_tpu.serving.tiers import Tier
+
+    # A previous incarnation left page files behind: new ephemeral keys
+    # must mint PAST them, never overwrite.
+    FrozenStore(str(tmp_path)).write("page-3", b"z" * PB,
+                                     meta={"kind": "page"})
+    ctx, store, frozen = make_store(tmp_path, hot=1, warm=1)
+    try:
+        pages = [store.alloc_page(page_data(i)) for i in range(6)]
+        assert frozen.read_bytes("page-3") == b"z" * PB
+        assert any(p.tier == Tier.FROZEN for p in pages)
+    finally:
+        store.close()
+        ctx.tini()
+
+
+# -- daemon: demote / thaw / warm boot ---------------------------------------
+
+
+def cluster_cfg(tmp_path=None, **kw):
+    d = dict(
+        host_arena_bytes=1 << 20,
+        device_arena_bytes=1 << 20,
+        chunk_bytes=64 << 10,
+        heartbeat_s=0.2,
+        priority=0,          # PRIO_LOW client: demotable while live
+        arena_high_pct=70,
+        arena_low_pct=40,
+    )
+    if tmp_path is not None:
+        d["frozen_dir"] = str(tmp_path)
+    d.update(kw)
+    return OcmConfig(**d)
+
+
+def _fill(c, rng, n=4, nb=200 << 10):
+    hs, datas = [], []
+    for _ in range(n):
+        h = c.alloc(nb, OcmKind.REMOTE_HOST)
+        data = rng.integers(0, 256, nb, dtype=np.uint8)
+        c.put(h, data)
+        hs.append(h)
+        datas.append(data)
+    return hs, datas, nb
+
+
+def test_demote_promote_roundtrip_and_journal_split(tmp_path, rng, journal):
+    obs_journal = journal
+    with local_cluster(1, config=cluster_cfg(tmp_path)) as cl:
+        c = cl.client(0)
+        d = cl.daemons[0]
+        start = len(obs_journal.events())
+        hs, datas, nb = _fill(c, rng)
+        d._pressure_evict()
+        assert d.frz_counters["demotes"] >= 1
+        frozen_ids = {e.alloc_id for e in d.registry.snapshot() if e.frozen}
+        assert frozen_ids and d._frozen.keys()
+        # Demoted entries hold no arena bytes but keep their ids.
+        assert d.registry.live_count() == len(hs)
+        # Every read is byte-exact — frozen victims thaw on demand.
+        for h, data in zip(hs, datas):
+            np.testing.assert_array_equal(np.asarray(c.get(h, nb)), data)
+        assert d.frz_counters["promotes"] >= 1
+        evs = obs_journal.events()[start:]
+        demotes = [e for e in evs if e.get("ev") == "tier_demote"]
+        promotes = [e for e in evs if e.get("ev") == "tier_promote"]
+        # The journal split: spill-to-disk is NEVER reported destroyed.
+        assert {e["alloc_id"] for e in demotes} == frozen_ids
+        assert all(e["destroyed"] is False and e["dst"] == "frozen"
+                   for e in demotes)
+        assert all(int(e["priority"]) == 0 for e in demotes)
+        assert {e["alloc_id"] for e in promotes} == frozen_ids
+        assert not [e for e in evs if e.get("ev") == "qos_evict"]
+        for h in hs:
+            c.free(h)
+        assert not d._frozen.keys()
+        c.close()
+
+
+def test_eviction_destroys_when_frozen_unconfigured(tmp_path, rng, journal):
+    obs_journal = journal
+    # No frozen_dir: the daemon must behave byte-identically to the
+    # pre-persist build — pressure victims are destroyed, not spilled.
+    with local_cluster(1, config=cluster_cfg(None)) as cl:
+        c = cl.client(0)
+        d = cl.daemons[0]
+        assert d._frozen is None
+        start = len(obs_journal.events())
+        hs, datas, nb = _fill(c, rng)
+        d._pressure_evict()
+        evs = obs_journal.events()[start:]
+        evicts = [e for e in evs if e.get("ev") == "qos_evict"]
+        assert evicts and all(e["destroyed"] is True for e in evicts)
+        assert not [e for e in evs if e.get("ev") == "tier_demote"]
+        assert d.frz_counters["demotes"] == 0
+        assert d.registry.live_count() == len(hs) - len(evicts)
+        c.close()
+
+
+def test_warm_boot_readopts_and_serves_byte_exact(tmp_path, rng):
+    with local_cluster(1, config=cluster_cfg(tmp_path)) as cl:
+        c = cl.client(0)
+        d = cl.daemons[0]
+        hs, datas, nb = _fill(c, rng)
+        d._pressure_evict()
+        nfrozen = sum(1 for e in d.registry.snapshot() if e.frozen)
+        assert nfrozen >= 1
+        # Hard kill + fresh incarnation at the same address, while the
+        # app's client is STILL LIVE (a crash is not a disconnect).
+        d2 = cl.restart(0)
+        assert d2 is not d
+        assert d2.frz_counters["warm_boot_extents"] == nfrozen
+        c2 = cl.client(0)
+        survivors = {e.alloc_id for e in d2.registry.snapshot()}
+        served = 0
+        for h, data in zip(hs, datas):
+            if h.alloc_id in survivors:
+                np.testing.assert_array_equal(
+                    np.asarray(c2.get(h, nb)), data
+                )
+                served += 1
+                c2.free(h)
+        assert served == nfrozen
+        assert d2.registry.live_count() == 0 and not d2._frozen.keys()
+        c.close()
+        c2.close()
+
+
+def test_warm_boot_refuses_corrupt_extent_and_counts_loss(tmp_path, rng):
+    with local_cluster(1, config=cluster_cfg(tmp_path)) as cl:
+        c = cl.client(0)
+        d = cl.daemons[0]
+        hs, datas, nb = _fill(c, rng)
+        d._pressure_evict()
+        frozen_keys = d._frozen.keys()
+        assert frozen_keys
+        corrupt_file(
+            os.path.join(str(tmp_path), "r0", _fname(frozen_keys[0])),
+            offset=64,
+        )
+        d2 = cl.restart(0)
+        # The torn extent is a REPORTED loss, not a silent skip and not
+        # garbage: it is quarantined, counted, and absent from the new
+        # incarnation's registry; healthy peers still adopt.
+        assert d2.frz_counters["lost"] >= 1
+        assert d2.frz_counters["warm_boot_extents"] == len(frozen_keys) - 1
+        adopted = {e.alloc_id for e in d2.registry.snapshot()}
+        lost_id = int(frozen_keys[0].split("-", 1)[1])
+        assert lost_id not in adopted
+        c.close()
+
+
+# -- chaos restart action ----------------------------------------------------
+
+
+def test_chaos_restart_action(tmp_path, rng):
+    from oncilla_tpu.resilience.chaos import (
+        ChaosController,
+        ChaosSchedule,
+        Fault,
+    )
+
+    # Schedule vocabulary: restart is a first-class action.
+    Fault(op=3, action="restart", rank=1)
+    with pytest.raises(ValueError):
+        Fault(op=3, action="reboot")
+    calls = []
+    ctl = ChaosController(ChaosSchedule(seed=1), [],
+                          restart_fn=calls.append)
+    ctl.force("restart", 2)
+    assert calls == [2] and ctl.log == [(-1, "restart", 2)]
+    assert 2 in ctl.victim_rings  # the outgoing incarnation's evidence
+    # End to end on a live cluster: the relaunched daemon serves frozen
+    # extents minted by its previous incarnation.
+    with local_cluster(1, config=cluster_cfg(tmp_path)) as cl:
+        c = cl.client(0)
+        d = cl.daemons[0]
+        hs, datas, nb = _fill(c, rng)
+        d._pressure_evict()
+        nfrozen = sum(1 for e in d.registry.snapshot() if e.frozen)
+        ctl = ChaosController(ChaosSchedule(seed=1), cl.entries,
+                              restart_fn=cl.restart)
+        ctl.force("restart", 0)
+        d2 = cl.daemons[0]
+        assert d2 is not d and d2._running.is_set()
+        assert d2.frz_counters["warm_boot_extents"] == nfrozen
+        c.close()
